@@ -1,0 +1,72 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on jax/XLA/Pallas/pjit. See SURVEY.md for the
+blueprint and per-component reference citations."""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (bfloat16, bool_, complex64, complex128,  # noqa: F401
+                         float16, float32, float64, int8, int16, int32,
+                         int64, uint8)
+from .core.device import (device_count, get_device,  # noqa: F401
+                          is_compiled_with_tpu, set_device, synchronize)
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.random import (get_state as get_rng_state,  # noqa: F401
+                          seed, set_state as set_rng_state)
+from .core.tensor import (Parameter, Tensor, enable_grad,  # noqa: F401
+                          is_grad_enabled, no_grad, set_grad_enabled,
+                          to_tensor)
+
+# ops namespaces -----------------------------------------------------------
+from . import ops  # noqa: F401  (installs Tensor methods)
+from .ops.creation import (arange, assign, bernoulli, diag,  # noqa: F401
+                           diagflat, empty, empty_like, eye, full, full_like,
+                           linspace, logspace, meshgrid, multinomial, normal,
+                           ones, ones_like, rand, randint, randn, randperm,
+                           tril, tril_indices, triu, triu_indices, uniform,
+                           zeros, zeros_like)
+from .ops.linalg import (bmm, dot, einsum, matmul, mm, mv, t)  # noqa: F401
+from .ops.manipulation import (broadcast_to, chunk, concat, expand,  # noqa: F401
+                               expand_as, flatten, flip, gather, gather_nd,
+                               index_select, masked_select, moveaxis,
+                               nonzero, numel, one_hot, reshape, roll,
+                               scatter, scatter_nd, scatter_nd_add, split,
+                               squeeze, stack, tile, topk, transpose, unbind,
+                               unique, unsqueeze, where)
+from .ops.math import (abs, add, all, allclose, any, argmax,  # noqa: F401
+                       argmin, cast, ceil, clip, cos, cumprod, cumsum,
+                       divide, equal, equal_all, exp, floor, floor_divide,
+                       isfinite, isinf, isnan, log, logical_and, logical_not,
+                       logical_or, logsumexp, max, maximum, mean, median,
+                       min, minimum, multiply, pow, prod, remainder, round,
+                       rsqrt, scale, sign, sin, sqrt, square, std, subtract,
+                       sum, tanh, trunc, var)
+
+get_default_dtype = _dtype_mod.get_default_dtype
+set_default_dtype = _dtype_mod.set_default_dtype
+
+# subsystems ---------------------------------------------------------------
+from . import amp  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from .framework_io import load, save  # noqa: F401,E402
+from .jit.api import grad, value_and_grad  # noqa: F401,E402
+
+# `paddle.distributed`-style access is heavy: import lazily ---------------
+_LAZY = {"distributed", "models", "vision", "kernels", "hapi", "profiler",
+         "incubate", "static"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

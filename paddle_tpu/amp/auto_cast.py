@@ -1,0 +1,128 @@
+"""AMP autocast.
+
+Reference analog: paddle.amp.auto_cast (python/paddle/amp/auto_cast.py:21)
+over C++ white/black lists (python/paddle/fluid/dygraph/amp/auto_cast.py:270)
+with per-op cast insertion in eager codegen (eager_gen.py:1567). TPU-first:
+bf16 is the native low precision (no loss scaling needed), the white list
+is "MXU ops" (matmul/conv), black list is numerically-sensitive reductions.
+Cast insertion happens in core.tensor.dispatch via this module's hook.
+
+O1: white-listed ops compute in low precision, black-listed stay fp32.
+O2: the Layer is converted to low-precision weights up front
+    (`amp.decorate` ≈ pure_fp16 mode) with fp32 master weights kept by the
+    optimizer (multi_precision=True).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+
+_STATE = threading.local()
+
+# ops that benefit from low precision on the MXU (≈ the reference's
+# white list: conv2d, matmul, mul — fluid/dygraph/amp/auto_cast.py)
+WHITE_LIST = {
+    "matmul", "bmm", "mm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "scaled_dot_product_attention", "addmm",
+}
+# numerically sensitive: keep fp32 (≈ reference black list: softmax,
+# cross_entropy, layer_norm, ...)
+BLACK_LIST = {
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "layer_norm",
+    "rms_norm", "batch_norm_train", "batch_norm_infer", "group_norm",
+    "logsumexp", "sum", "mean", "exp", "log", "pow", "norm",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits", "kl_div",
+    "mse_loss", "l1_loss",
+}
+
+
+def is_autocast_enabled() -> bool:
+    return getattr(_STATE, "enabled", False)
+
+
+def get_autocast_dtype():
+    return getattr(_STATE, "dtype", jnp.bfloat16)
+
+
+def get_autocast_level() -> str:
+    return getattr(_STATE, "level", "O1")
+
+
+class auto_cast:
+    """Context manager: `with paddle_tpu.amp.auto_cast(): ...`"""
+
+    def __init__(self, enable: bool = True, custom_white_list=None,
+                 custom_black_list=None, level: str = "O1",
+                 dtype: str = None):
+        self.enable = enable
+        self.level = level
+        self.dtype = dtype_mod.convert_dtype(
+            dtype or __import__("paddle_tpu.core.flags", fromlist=["f"])
+            .get_flag("amp_dtype"))
+        self.white = set(WHITE_LIST)
+        self.black = set(BLACK_LIST)
+        if custom_white_list:
+            self.white |= set(custom_white_list)
+            self.black -= set(custom_white_list)
+        if custom_black_list:
+            self.black |= set(custom_black_list)
+            self.white -= set(custom_black_list)
+
+    def __enter__(self):
+        self._prev = (getattr(_STATE, "enabled", False),
+                      getattr(_STATE, "dtype", None),
+                      getattr(_STATE, "level", "O1"),
+                      getattr(_STATE, "white", None),
+                      getattr(_STATE, "black", None))
+        _STATE.enabled = self.enable
+        _STATE.dtype = self.dtype
+        _STATE.level = self.level
+        _STATE.white = self.white
+        _STATE.black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_STATE.enabled, _STATE.dtype, _STATE.level, _STATE.white,
+         _STATE.black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_args(op_name: str, raw_leaves):
+    """Called from dispatch: cast floating inputs per autocast policy."""
+    if not is_autocast_enabled():
+        return raw_leaves
+    white = getattr(_STATE, "white", WHITE_LIST)
+    black = getattr(_STATE, "black", BLACK_LIST)
+    low = get_autocast_dtype()
+    if op_name in white:
+        return [l.astype(low)
+                if hasattr(l, "dtype") and l.dtype in
+                (jnp.float32, jnp.float16, jnp.bfloat16) and l.dtype != low
+                else l for l in raw_leaves]
+    if op_name in black:
+        return [l.astype(jnp.float32)
+                if hasattr(l, "dtype") and l.dtype in
+                (jnp.float16, jnp.bfloat16) else l for l in raw_leaves]
+    return raw_leaves
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """≈ paddle.amp.decorate: convert model params to low precision (O2).
+    Optimizers should be built with multi_precision=True to keep fp32
+    masters."""
+    d = dtype_mod.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        if m is not None:
+            m.to(dtype=d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

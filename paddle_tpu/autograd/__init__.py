@@ -1,0 +1,13 @@
+from ..core.tensor import enable_grad, is_grad_enabled, no_grad  # noqa: F401
+from .backward_engine import run_backward  # noqa: F401
+from .py_layer import PyLayer  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward analog."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        run_backward(t, g, retain_graph)

@@ -1,0 +1,111 @@
+"""Topological backward over the eager tape.
+
+Reference analog: egr::Backward / RunBackward
+(paddle/fluid/eager/backward.cc:105,393) — a topological queue over GradNodes
+with GradTensorHolder accumulation and per-tensor hooks. Same algorithm here,
+over `GradNode`s whose grad function is a jax vjp closure.
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import GradNode, Tensor
+
+
+def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
+                 retain_graph: bool = False):
+    if root.stop_gradient or root._node is None:
+        raise RuntimeError(
+            "Tensor has no grad graph (stop_gradient=True or no recorded "
+            "ops); cannot call backward(). Note: backward() is an eager-mode "
+            "API — inside paddle_tpu.jit-traced functions use "
+            "paddle_tpu.grad / value_and_grad instead.")
+    if grad_tensor is None:
+        if root.size != 1:
+            raise RuntimeError(
+                f"grad_tensor must be given for non-scalar root "
+                f"(shape {root.shape})")
+        seed_ct = jnp.ones(root.data.shape, root.dtype)
+    else:
+        seed_ct = grad_tensor.data if isinstance(grad_tensor, Tensor) \
+            else jnp.asarray(grad_tensor)
+
+    # --- collect reachable graph; count in-degrees (uses of each node) -----
+    indegree: dict[GradNode, int] = defaultdict(int)
+    seen = set()
+    stack = [root._node]
+    seen.add(root._node)
+    while stack:
+        node = stack.pop()
+        for t in node.inputs:
+            n = t._node
+            if n is not None:
+                indegree[n] += 1
+                if n not in seen:
+                    seen.add(n)
+                    stack.append(n)
+
+    if not retain_graph:
+        for node in seen:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "Trying to backward through the graph a second time "
+                    "(use retain_graph=True on the first backward).")
+
+    root._node.add_cotangent(root._out_index, seed_ct)
+
+    ready = deque([n for n in seen if indegree[n] == 0])
+    processed = 0
+    while ready:
+        node = ready.popleft()
+        processed += 1
+        if retain_graph:
+            vjp_fn, avals = node.vjp_fn, node.out_avals
+            grads = _run_with_retain(node)
+        else:
+            grads = node.run_vjp()
+        for t, g in zip(node.inputs, grads):
+            g = _apply_hooks(t, g)
+            n = t._node
+            if n is None:
+                # leaf: accumulate into .grad
+                if t.grad is None:
+                    t.grad = Tensor(g, stop_gradient=True)
+                else:
+                    t.grad = Tensor(t.grad.data + g, stop_gradient=True)
+            else:
+                n.add_cotangent(t._out_index, g)
+                indegree[n] -= 1
+                if indegree[n] == 0:
+                    ready.append(n)
+    if processed != len(seen):
+        raise RuntimeError("Cycle detected in autograd graph")
+
+
+def _run_with_retain(node: GradNode):
+    import jax
+    cts = []
+    for i in range(node.n_outs):
+        ct = node.pending.get(i)
+        if ct is None:
+            shape, dt = node.out_avals[i]
+            ct = jnp.zeros(shape, dt)
+        cts.append(ct)
+    ct_tree = jax.tree_util.tree_unflatten(node.out_treedef, cts)
+    grads = node.vjp_fn(ct_tree)
+    node.pending.clear()
+    return grads
+
+
+def _apply_hooks(t: Tensor, g):
+    if not t._hooks:
+        return g
+    gt = Tensor(g, stop_gradient=True)
+    for hook in t._hooks:
+        res = hook(gt)
+        if res is not None:
+            gt = res if isinstance(res, Tensor) else Tensor(res)
+    return gt.data

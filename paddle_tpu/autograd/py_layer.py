@@ -1,0 +1,80 @@
+"""PyLayer: user-defined forward/backward (≈ paddle.autograd.PyLayer,
+paddle/fluid/eager/pylayer/py_layer_node.h). The custom backward plugs into
+the same GradNode tape; under jit-tracing the pair lowers to a
+jax.custom_vjp-style closure."""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import GradNode, Tensor, is_grad_enabled
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.extra: dict = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError(f"Use {cls.__name__}.apply(...) instead of "
+                           f"constructing it")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (list, tuple))
+        out_list = [outs] if single else list(outs)
+
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient]
+        if diff_inputs and is_grad_enabled():
+            out_tensors = [o if isinstance(o, Tensor) else Tensor(o)
+                           for o in out_list]
+
+            def vjp_fn(ct_tree):
+                cts = jax.tree_util.tree_leaves(ct_tree)
+                grads = cls.backward(
+                    ctx, *[Tensor(ct, stop_gradient=True) for ct in cts])
+                if not isinstance(grads, (list, tuple)):
+                    grads = (grads,)
+                raw = [g.data if isinstance(g, Tensor) else g for g in grads]
+                # align to diff inputs (paddle: backward returns one grad
+                # per differentiable forward input, in order)
+                return tuple(raw[:len(diff_inputs)])
+
+            leaves = [t.data for t in out_tensors]
+            _, treedef = jax.tree_util.tree_flatten(leaves)
+            avals = [(o.shape, o.dtype) for o in leaves]
+            node = GradNode(cls.__name__, vjp_fn, diff_inputs, treedef,
+                            len(leaves), avals)
+            for i, t in enumerate(out_tensors):
+                t.stop_gradient = False
+                t._node = node
+                t._out_index = i
+            return out_tensors[0] if single else tuple(out_tensors)
+        outs2 = [o if isinstance(o, Tensor) else Tensor(o) for o in out_list]
+        return outs2[0] if single else tuple(outs2)

@@ -1,0 +1,3 @@
+from . import device, dtype, flags, random  # noqa: F401
+from .tensor import (Parameter, Tensor, enable_grad,  # noqa: F401
+                     is_grad_enabled, no_grad, set_grad_enabled, to_tensor)

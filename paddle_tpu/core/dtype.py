@@ -1,0 +1,83 @@
+"""Dtype registry.
+
+Paddle exposes dtypes as `paddle.float32` etc. backed by a VarType enum
+(reference: paddle/phi/common/data_type.h, python/paddle/framework/dtype.py).
+Here dtypes ARE numpy/jax dtypes — no parallel enum; we keep paddle's names
+and string aliases so `astype('float32')`, `dtype='bfloat16'` work.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtypes (jnp dtypes are numpy dtypes + ml_dtypes extensions).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "fp16": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOAT_DTYPES = (float16, bfloat16, float32, float64)
+INT_DTYPES = (uint8, int8, int16, int32, int64)
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (string / np dtype / jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return np.dtype(_ALIASES[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype {dtype!r}") from None
+    return np.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def get_default_dtype():
+    from . import flags
+
+    return convert_dtype(flags.get_flag("default_dtype"))
+
+
+def set_default_dtype(dtype):
+    from . import flags
+
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    flags.set_flags({"default_dtype": d.name})

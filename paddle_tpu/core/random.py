@@ -1,0 +1,58 @@
+"""Global stateful RNG over jax's functional PRNG.
+
+The reference keeps per-device stateful generators
+(paddle/phi/core/generator.h; python/paddle/fluid/framework.py default
+generators; TP dropout determinism via the RNG-state tracker
+python/paddle/distributed/fleet/layers/mpu/random.py). jax PRNG is
+functional, so the compatibility layer is: one global key, split on every
+eager draw. `seed()` resets it reproducibly. Inside jit-traced code this
+module must NOT be used (stateful splitting would bake a constant); traced
+dropout draws from explicit rng args — see nn/functional/dropout and
+distributed/parallel/random.py (the TP tracker folds mesh-axis indices into
+the key, which is the functional analog of per-rank generator states).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+_LOCK = threading.Lock()
+_KEY: Optional[jax.Array] = None
+_SEED: Optional[int] = None
+
+
+def seed(s: int):
+    """paddle.seed analog: reset the global generator."""
+    global _KEY, _SEED
+    with _LOCK:
+        _SEED = int(s)
+        _KEY = jax.random.PRNGKey(int(s))
+    return _SEED
+
+
+def get_seed() -> Optional[int]:
+    return _SEED
+
+
+def next_key() -> jax.Array:
+    """Split one subkey off the global key (eager-mode draws only)."""
+    global _KEY
+    with _LOCK:
+        if _KEY is None:
+            import os
+            _KEY = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "little"))
+        _KEY, sub = jax.random.split(_KEY)
+        return sub
+
+
+def get_state():
+    """Snapshot RNG state (≈ paddle.get_rng_state)."""
+    return _KEY
+
+
+def set_state(state):
+    global _KEY
+    with _LOCK:
+        _KEY = state

@@ -1,0 +1,526 @@
+"""Tensor: the user-facing array type, with an eager autograd tape.
+
+Design (TPU-first, not a port):
+  The reference's dygraph hot path is a per-op C++ dispatch
+  (python/paddle/tensor/linalg.py:236 -> generated matmul_ad_func ->
+  phi::MatmulKernel; grad graph via GradNodeBase,
+  paddle/fluid/eager/grad_node_info.h:168; backward engine
+  paddle/fluid/eager/backward.cc:393). Here, eager ops ARE jax ops — XLA
+  executes them — and the grad graph is built from `jax.vjp` closures
+  recorded per op call. The performance path is never this tape: real
+  training steps are traced whole into XLA via `paddle_tpu.jit` and use
+  `jax.grad`. The tape exists for the dygraph UX (`loss.backward()`,
+  hooks, `.grad`) and for golden tests.
+
+  Inside a jax trace (inputs are Tracers) recording is skipped entirely,
+  so Layer code is transparently jit-compatible.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from . import flags
+
+__all__ = [
+    "Tensor", "Parameter", "to_tensor", "is_grad_enabled", "no_grad",
+    "enable_grad", "set_grad_enabled",
+]
+
+# ------------------------------------------------------------------ grad mode
+
+_STATE = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_STATE, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _STATE.grad_enabled = bool(mode)
+
+
+class _GradModeCtx:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    # allow use as decorator, like paddle.no_grad
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with self.__class__(self._mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad():
+    return _GradModeCtx(False)
+
+
+def enable_grad():
+    return _GradModeCtx(True)
+
+
+# ------------------------------------------------------------------ grad node
+
+
+class GradNode:
+    """One recorded differentiable op (≈ egr::GradNodeBase,
+    paddle/fluid/eager/grad_node_info.h:168). Holds the jax vjp closure and
+    edges to the differentiable inputs."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_treedef", "n_outs",
+                 "pending", "out_avals")
+
+    def __init__(self, name: str, vjp_fn, inputs: Sequence["Tensor"],
+                 out_treedef, n_outs: int, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)          # differentiable input Tensors
+        self.out_treedef = out_treedef
+        self.n_outs = n_outs
+        self.out_avals = out_avals          # (shape, dtype) per output leaf
+        self.pending: Dict[int, Any] = {}   # out index -> accumulated cotangent
+
+    def add_cotangent(self, index: int, ct):
+        cur = self.pending.get(index)
+        self.pending[index] = ct if cur is None else cur + ct
+
+    def run_vjp(self):
+        cts = []
+        for i in range(self.n_outs):
+            ct = self.pending.get(i)
+            if ct is None:
+                shape, dt = self.out_avals[i]
+                ct = jnp.zeros(shape, dt)
+            cts.append(ct)
+        ct_tree = jax.tree_util.tree_unflatten(self.out_treedef, cts)
+        grads = self.vjp_fn(ct_tree)
+        self.vjp_fn = None  # free residuals
+        self.pending.clear()
+        return grads
+
+
+# -------------------------------------------------------------------- Tensor
+
+
+def _as_array(value, dtype=None):
+    if isinstance(value, Tensor):
+        arr = value._data
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        return arr
+    if isinstance(value, (bool, int, float, complex)) and dtype is None:
+        # python scalars adopt the default float dtype for floats, int32 ints
+        if isinstance(value, float):
+            return jnp.asarray(value, dtype_mod.get_default_dtype())
+        if isinstance(value, bool):
+            return jnp.asarray(value, jnp.bool_)
+        if isinstance(value, int):
+            return jnp.asarray(value, jnp.int32)
+    if isinstance(value, np.ndarray) and value.dtype == np.float64 and dtype is None:
+        # numpy float64 inputs adopt default dtype (paddle: to_tensor keeps
+        # dtype, but float64 on TPU is emulated and slow; flag-controlled)
+        value = value.astype(dtype_mod.get_default_dtype())
+    return jnp.asarray(value, dtype)
+
+
+class Tensor:
+    """Array wrapper with optional autograd taping.
+
+    `stop_gradient` defaults to True (matching paddle: only Parameters and
+    tensors explicitly marked participate in autograd).
+    """
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
+                 "name", "persistable", "_hooks", "trainable")
+    __array_priority__ = 100  # numpy defers binary ops to us
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        if dtype is not None:
+            dtype = dtype_mod.convert_dtype(dtype)
+        self._data = _as_array(data, dtype)
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node: Optional[GradNode] = None
+        self._out_index: int = 0
+        self.name = name
+        self.persistable = False
+        self._hooks: List[Callable] = []
+        self.trainable = True
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def place(self):
+        from .device import Place
+        devs = getattr(self._data, "devices", None)
+        if callable(devs):
+            try:
+                return Place(next(iter(self._data.devices())))
+            except Exception:
+                pass
+        from .device import current_place
+        return current_place()
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.linalg.transpose_last2(self) if self.ndim >= 2 else self
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = ", stop_gradient=True" if self.stop_gradient else ""
+        return (f"Tensor(shape={self.shape}, dtype={self._data.dtype.name}"
+                f"{grad_str},\n       {self._data})")
+
+    # jax interop: jnp.* functions accept Tensor directly
+    def __jax_array__(self):
+        return self._data
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    def detach(self) -> "Tensor":
+        t = Tensor.__new__(Tensor)
+        t._data = self._data
+        t.stop_gradient = True
+        t.grad = None
+        t._node = None
+        t._out_index = 0
+        t.name = self.name
+        t.persistable = self.persistable
+        t._hooks = []
+        t.trainable = self.trainable
+        return t
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.math.clone(self)
+
+    def register_hook(self, hook: Callable) -> Callable:
+        """Gradient hook: called with the grad Tensor during backward; may
+        return a replacement (≈ Tensor._register_grad_hook)."""
+        self._hooks.append(hook)
+
+        def remove():
+            self._hooks.remove(hook)
+
+        return remove
+
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        from ..autograd.backward_engine import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def _replace_data(self, new_array, keep_dtype: bool = True):
+        """In-place value update (optimizer step / set_state_dict). Detaches
+        from any recorded graph. keep_dtype=False adopts the new array's
+        dtype (used by Layer.to(dtype) casts)."""
+        if isinstance(new_array, Tensor):
+            new_array = new_array._data
+        self._data = jnp.asarray(new_array,
+                                 self._data.dtype if keep_dtype else None)
+        self._node = None
+        self._out_index = 0
+
+    def set_value(self, value):
+        self._replace_data(value)
+
+    def copy_(self, other):
+        self._replace_data(other)
+        return self
+
+    # -- operator sugar (implementations in ops/) ---------------------------
+    def _binop(self, other, opname, reverse=False):
+        from .. import ops
+        fn = getattr(ops.math, opname)
+        return fn(other, self) if reverse else fn(self, other)
+
+    def __add__(self, o):
+        return self._binop(o, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "subtract")
+
+    def __rsub__(self, o):
+        return self._binop(o, "subtract", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "divide")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "divide", reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binop(o, "floor_divide")
+
+    def __mod__(self, o):
+        return self._binop(o, "remainder")
+
+    def __pow__(self, o):
+        return self._binop(o, "pow")
+
+    def __rpow__(self, o):
+        return self._binop(o, "pow", reverse=True)
+
+    def __matmul__(self, o):
+        from .. import ops
+        return ops.linalg.matmul(self, o)
+
+    def __rmatmul__(self, o):
+        from .. import ops
+        return ops.linalg.matmul(o, self)
+
+    def __neg__(self):
+        return self._binop(-1.0 if dtype_mod.is_floating(self.dtype) else -1,
+                           "multiply")
+
+    def __abs__(self):
+        from .. import ops
+        return ops.math.abs(self)
+
+    def __eq__(self, o):
+        return self._binop(o, "equal")
+
+    def __ne__(self, o):
+        return self._binop(o, "not_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "less_than")
+
+    def __le__(self, o):
+        return self._binop(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binop(o, "greater_equal")
+
+    def __getitem__(self, idx):
+        from .. import ops
+        return ops.manipulation.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from .. import ops
+        out = ops.manipulation.setitem(self, idx, value)
+        # in-place semantics: adopt the result's value AND its grad record,
+        # so `x[i] = v; loss(x).backward()` differentiates through scatter.
+        self._data = out._data
+        self._node = out._node
+        self._out_index = out._out_index
+        self.stop_gradient = out.stop_gradient
+
+    # -- method-style op aliases (populated by ops package at import) -------
+    # e.g. t.sum(), t.reshape(), t.astype() — see ops/__init__.py
+
+
+class Parameter(Tensor):
+    """Trainable tensor (≈ paddle.fluid.framework.Parameter / EagerParamBase).
+    stop_gradient defaults to False."""
+
+    def __init__(self, data, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor analog. `place` accepted for API parity; data lives
+    wherever jax's default device is (see core.device.set_device)."""
+    if isinstance(data, Tensor) and dtype is None:
+        t = data.detach()
+        t.stop_gradient = stop_gradient
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+# ----------------------------------------------------------------- dispatch
+
+
+def _is_tensorlike(x) -> bool:
+    return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
+
+def _contains_tracer(leaves) -> bool:
+    for leaf in leaves:
+        arr = leaf._data if isinstance(leaf, Tensor) else leaf
+        if isinstance(arr, jax.core.Tracer):
+            return True
+    return False
+
+
+def dispatch(name: str, impl: Callable, args: tuple, kwargs: dict,
+             differentiable: bool = True):
+    """Run op `impl` (pure jax, takes raw arrays) on Tensor-bearing args.
+
+    Eager + grad-enabled + differentiable inputs  -> record via jax.vjp.
+    Otherwise (no_grad, tracing, int ops)         -> plain call.
+    """
+    tree = (args, kwargs)
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+    tracing = _contains_tracer(leaves)
+    record = (differentiable and not tracing and is_grad_enabled()
+              and any(isinstance(l, Tensor) and not l.stop_gradient
+                      for l in leaves))
+
+    raw_leaves = [l._data if isinstance(l, Tensor) else l for l in leaves]
+
+    # amp hook (module fetched via importlib: the package re-exports a
+    # class under the same name `auto_cast`)
+    import importlib
+    _amp = importlib.import_module("paddle_tpu.amp.auto_cast")
+    if _amp.is_autocast_enabled():
+        raw_leaves = _amp.maybe_cast_args(name, raw_leaves)
+
+    if not record:
+        rargs, rkwargs = jax.tree_util.tree_unflatten(treedef, raw_leaves)
+        out = impl(*rargs, **rkwargs)
+        if flags.get_flag("check_nan_inf") and not tracing:
+            _check_nan_inf(name, out)
+        return _wrap_outputs(out, node=None)
+
+    diff_idx = [i for i, l in enumerate(leaves)
+                if isinstance(l, Tensor) and not l.stop_gradient]
+    diff_tensors = [leaves[i] for i in diff_idx]
+
+    def closed(*diff_vals):
+        vals = list(raw_leaves)
+        for i, v in zip(diff_idx, diff_vals):
+            vals[i] = v
+        cargs, ckwargs = jax.tree_util.tree_unflatten(treedef, vals)
+        return impl(*cargs, **ckwargs)
+
+    # diff inputs take their (possibly amp-cast) values from raw_leaves so
+    # autocast applies on the grad-recording path too
+    out, vjp_fn = jax.vjp(closed, *[raw_leaves[i] for i in diff_idx])
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    avals = [(o.shape, o.dtype) for o in out_leaves]
+    node = GradNode(name, vjp_fn, diff_tensors, out_treedef,
+                    len(out_leaves), avals)
+    if flags.get_flag("check_nan_inf"):
+        _check_nan_inf(name, out)
+    return _wrap_outputs(out, node=node)
+
+
+def _wrap_outputs(out, node):
+    idx = [0]
+
+    def wrap(leaf):
+        if not isinstance(leaf, (jax.Array, np.ndarray)) and not jnp.isscalar(leaf):
+            return leaf
+        t = Tensor.__new__(Tensor)
+        t._data = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+        t.grad = None
+        t.name = None
+        t.persistable = False
+        t._hooks = []
+        t.trainable = True
+        if node is not None:
+            t.stop_gradient = False
+            t._node = node
+            t._out_index = idx[0]
+        else:
+            t.stop_gradient = True
+            t._node = None
+            t._out_index = 0
+        idx[0] += 1
+        return t
+
+    return jax.tree_util.tree_map(wrap, out)
+
+
+def _check_nan_inf(name, out):
+    for leaf in jax.tree_util.tree_leaves(out):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            raise FloatingPointError(
+                f"NaN/Inf detected in output of op '{name}' "
+                f"(FLAGS_check_nan_inf is enabled)")

@@ -1,0 +1,16 @@
+"""paddle.device analog namespace."""
+from ..core.device import (Place, current_place, device_count,  # noqa: F401
+                           get_device, is_compiled_with_tpu, set_device,
+                           synchronize)
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False

@@ -1,0 +1,79 @@
+"""paddle.save / paddle.load analogs.
+
+Reference: python/paddle/framework/io.py:640 (save), :870 (load) — pickled
+nested state dicts with C++ tensor serialization. Here tensors serialize as
+numpy arrays inside a pickle; bfloat16 round-trips via ml_dtypes. Sharded/
+async checkpointing for the distributed path lives in
+paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.data))
+    if isinstance(obj, jnp.ndarray):
+        return _TensorPayload(np.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array",)
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+    def __reduce__(self):
+        # bfloat16 has no native numpy wire format: ship as uint16 + tag
+        arr = self.array
+        if arr.dtype == jnp.bfloat16:
+            return (_restore_bf16, (arr.view(np.uint16), arr.shape))
+        return (_restore, (arr,))
+
+
+def _restore(arr):
+    return arr
+
+
+def _restore_bf16(u16, shape):
+    return u16.view(jnp.bfloat16).reshape(shape)
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+
+    def back(o):
+        if isinstance(o, np.ndarray):
+            return Tensor(o)
+        if isinstance(o, dict):
+            return {k: back(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(back(v) for v in o)
+        return o
+
+    return back(obj)

@@ -1,0 +1,136 @@
+"""DataLoader (≈ python/paddle/fluid/reader.py:322 DataLoader;
+multi-process iterator fluid/dataloader/dataloader_iter.py:381).
+
+TPU-first shape: the loader produces HOST numpy batches and prefetches
+device transfers asynchronously (double buffering) so input pipeline
+overlaps with device compute — the role the reference's shared-memory
+worker queues + pin_memory play for GPUs. Worker parallelism uses a
+thread pool (numpy collation releases the GIL for the heavy copies);
+a multiprocessing mode can be added where transforms are Python-bound.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b.data) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    return batch
+
+
+class _PrefetchIterator:
+    def __init__(self, loader: "DataLoader"):
+        self._loader = loader
+        self._index_iter = iter(loader.batch_sampler) \
+            if loader.batch_sampler is not None else None
+        self._queue: "queue.Queue" = queue.Queue(
+            maxsize=max(2, loader.prefetch_factor))
+        self._done = object()
+        self._err = None
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _fetch_batch(self, indices):
+        ds = self._loader.dataset
+        samples = [ds[i] for i in indices]
+        return self._loader.collate_fn(samples)
+
+    def _produce(self):
+        try:
+            if isinstance(self._loader.dataset, IterableDataset):
+                batch = []
+                for item in self._loader.dataset:
+                    batch.append(item)
+                    if len(batch) == self._loader.batch_size:
+                        self._queue.put(self._to_device(
+                            self._loader.collate_fn(batch)))
+                        batch = []
+                if batch and not self._loader.drop_last:
+                    self._queue.put(self._to_device(
+                        self._loader.collate_fn(batch)))
+            else:
+                for indices in self._index_iter:
+                    self._queue.put(self._to_device(
+                        self._fetch_batch(indices)))
+        except Exception as e:  # surface in consumer thread
+            self._err = e
+        finally:
+            self._queue.put(self._done)
+
+    def _to_device(self, batch):
+        # async host->device: device_put returns immediately, transfer
+        # overlaps with compute on the prior batch
+        def put(x):
+            if isinstance(x, np.ndarray):
+                if x.dtype == np.float64:
+                    x = x.astype(np.float32)
+                if x.dtype == np.int64 and self._loader.keep_int64 is False:
+                    x = x.astype(np.int32)
+                return Tensor(jax.device_put(x))
+            return x
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._done:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def __iter__(self):
+        return self
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler=None, batch_size=1,
+                 shuffle: bool = False, drop_last: bool = False,
+                 collate_fn: Optional[Callable] = None, num_workers: int = 0,
+                 use_buffer_reader: bool = True, prefetch_factor: int = 2,
+                 use_shared_memory: bool = False, timeout=0,
+                 worker_init_fn=None, keep_int64: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch_factor = prefetch_factor
+        self.keep_int64 = keep_int64
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __iter__(self):
+        return _PrefetchIterator(self)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)

@@ -1,0 +1,3 @@
+from .api import (TrainStep, functional_call, grad, jit, to_static,  # noqa: F401
+                  value_and_grad)
+from .save_load import load, save  # noqa: F401

@@ -1,0 +1,204 @@
+"""Graph capture: the @to_static analog.
+
+Reference analog: paddle.jit @to_static rewrites Python AST into a static
+ProgramDesc (python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py) executed by run_program. On TPU there is no AST
+surgery: Layer code is already pure jax underneath (the tape skips
+recording for Tracers), so capture == `jax.jit` over a functionalized
+view of (parameters, buffers, inputs). Compile caching is jax's; the
+whole train step compiles to ONE XLA program — the design goal the
+reference's InterpreterCore + fused kernels approximate.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor, no_grad
+from ..nn.layer import Layer
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap(x):
+    return Tensor(x) if isinstance(x, jax.Array) else x
+
+
+def functional_call(layer: Layer, params_and_buffers: Dict[str, Any],
+                    *args, **kwargs):
+    """Run `layer` with parameter/buffer values taken from the dict
+    (name -> array/Tensor), without mutating the layer. The bridge between
+    the stateful Layer API and jax transforms (≈ torch.func.functional_call;
+    no reference analog — Paddle's static bridge is dy2static)."""
+    state = layer.state_dict()
+    saved = {name: t._data for name, t in state.items()}
+    try:
+        for name, value in params_and_buffers.items():
+            if name in state:
+                state[name]._data = _unwrap(value)
+        with no_grad():
+            out = layer(*args, **kwargs)
+        return out
+    finally:
+        for name, t in state.items():
+            t._data = saved[name]
+
+
+def to_static(function=None, input_spec=None, full_graph=True, backend=None,
+              donate_params: bool = False, static_argnums=()):
+    """Decorator: compile a function or Layer.forward with jax.jit.
+    Tensor args are passed as traced arrays; outputs come back as Tensors.
+    For a Layer, parameters/buffers are captured as traced constants
+    re-read on every call (so `opt.step()` updates are seen) but donate
+    nothing; use TrainStep for the fused, donated training path."""
+
+    def deco(fn):
+        is_layer = isinstance(fn, Layer)
+        target = fn.forward if is_layer else fn
+
+        @functools.partial(jax.jit, static_argnums=static_argnums)
+        def jitted(state_vals, arg_vals, kw_vals):
+            if is_layer:
+                names = jitted._state_names
+                out = functional_call(fn, dict(zip(names, state_vals)),
+                                      *arg_vals, **kw_vals)
+            else:
+                with no_grad():
+                    out = target(*arg_vals, **kw_vals)
+            return jax.tree_util.tree_map(_unwrap, out,
+                                          is_leaf=lambda x: isinstance(x, Tensor))
+
+        jitted._state_names = None
+
+        @functools.wraps(target)
+        def wrapper(*args, **kwargs):
+            if is_layer:
+                state = fn.state_dict()
+                jitted._state_names = list(state.keys())
+                state_vals = tuple(t._data for t in state.values())
+            else:
+                state_vals = ()
+            arg_vals = jax.tree_util.tree_map(
+                _unwrap, args, is_leaf=lambda x: isinstance(x, Tensor))
+            kw_vals = jax.tree_util.tree_map(
+                _unwrap, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+            out = jitted(state_vals, arg_vals, kw_vals)
+            return jax.tree_util.tree_map(_wrap, out)
+
+        wrapper.__wrapped_layer__ = fn if is_layer else None
+        wrapper._jitted = jitted
+        return wrapper
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+jit = to_static  # alias
+
+
+def grad(fn: Callable, argnums=0, has_aux: bool = False):
+    """Functional gradient of a Tensor-level function (jax.grad with Tensor
+    marshalling). This is the jit-compatible autodiff; the eager tape's
+    .backward() is the dygraph one."""
+
+    def wrapped(*args, **kwargs):
+        def pure(*raw_args):
+            targs = jax.tree_util.tree_map(_wrap, raw_args)
+            out = fn(*targs, **kwargs)
+            return jax.tree_util.tree_map(
+                _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+        raw = jax.tree_util.tree_map(
+            _unwrap, args, is_leaf=lambda x: isinstance(x, Tensor))
+        g = jax.grad(pure, argnums=argnums, has_aux=has_aux)(*raw)
+        return jax.tree_util.tree_map(_wrap, g)
+
+    return wrapped
+
+
+def value_and_grad(fn: Callable, argnums=0, has_aux: bool = False):
+    def wrapped(*args, **kwargs):
+        def pure(*raw_args):
+            targs = jax.tree_util.tree_map(_wrap, raw_args)
+            out = fn(*targs, **kwargs)
+            return jax.tree_util.tree_map(
+                _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+        raw = jax.tree_util.tree_map(
+            _unwrap, args, is_leaf=lambda x: isinstance(x, Tensor))
+        v, g = jax.value_and_grad(pure, argnums=argnums,
+                                  has_aux=has_aux)(*raw)
+        return (jax.tree_util.tree_map(_wrap, v),
+                jax.tree_util.tree_map(_wrap, g))
+
+    return wrapped
+
+
+class TrainStep:
+    """Fused, donated training step: (params, opt_state, batch) -> (loss,
+    params', opt_state') as ONE compiled XLA program.
+
+    This is the TPU answer to the reference's per-op dygraph loop + fused
+    optimizer kernels + Reducer overlap: forward, backward, (clip), update
+    all fuse under XLA, with parameter buffers donated so updates are
+    in-place in HBM.
+
+    Usage:
+        step = TrainStep(model, opt, loss_fn)
+        for batch in loader:
+            loss = step(batch_inputs, labels)   # updates model in place
+    Sharding: pass in_shardings/mesh via `sharding` (see distributed.fleet).
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn: Callable,
+                 donate: bool = True, sharding=None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._sharding = sharding
+
+        self._param_names = [n for n, _ in model.named_parameters()]
+        self._opt_state_tree = None
+
+        def step_fn(param_vals, opt_state, lr, step_no, *batch):
+            params = dict(zip(self._param_names, param_vals))
+
+            def loss_of(pvals):
+                pdict = dict(zip(self._param_names, pvals))
+                out = functional_call(self.model, pdict, *batch[:-1])
+                loss = self.loss_fn(out, _wrap(batch[-1]))
+                return _unwrap(loss)
+
+            loss, grads = jax.value_and_grad(loss_of)(list(param_vals))
+            new_params, new_state = self.optimizer.apply_gradients(
+                list(param_vals), grads, opt_state, lr=lr, step=step_no)
+            return loss, new_params, new_state
+
+        donate_argnums = (0, 1) if donate else ()
+        self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+
+    def __call__(self, *batch):
+        params = [p for _, p in self.model.named_parameters()]
+        if self._opt_state_tree is None:
+            self._opt_state_tree = [
+                self.optimizer._init_state(p.data.shape, p.data.dtype)
+                for p in params]
+        lr = self.optimizer.get_lr()
+        self.optimizer._step_count += 1
+        raw_batch = tuple(_unwrap(b) for b in batch)
+        loss, new_vals, self._opt_state_tree = self._jitted(
+            [p._data for p in params], self._opt_state_tree,
+            np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)
+        for p, v in zip(params, new_vals):
+            p._data = v
+        from ..optimizer.lr import LRScheduler
+        if isinstance(self.optimizer._lr, LRScheduler) and \
+                self.optimizer._lr._step_each_iter:
+            self.optimizer._lr.step()
+        return _wrap(loss)

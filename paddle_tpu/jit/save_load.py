@@ -1,0 +1,88 @@
+"""jit.save/load: AOT export of traced functions.
+
+Reference analog: paddle.jit.save -> inference ProgramDesc + params
+(python/paddle/fluid/dygraph/jit.py; consumed by AnalysisPredictor).
+TPU-native: `jax.export` serializes the StableHLO of the traced function;
+params ship as an .npz next to it. Loading returns a callable that runs
+the compiled artifact — the serving path without Python model code.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad
+from ..nn.layer import Layer
+from .api import functional_call
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None):
+    """Export `layer` (or a to_static-wrapped function) as
+    {path}.stablehlo + {path}.pdiparams.npz + {path}.meta.json."""
+    from jax import export as jexport
+
+    if isinstance(layer, Layer):
+        state = layer.state_dict()
+        names = list(state.keys())
+        vals = [t._data for t in state.values()]
+        if input_spec is None:
+            raise ValueError("jit.save(layer, ...) needs input_spec "
+                             "(list of example Tensors or ShapeDtypeStructs)")
+        specs = [_to_sds(s) for s in input_spec]
+
+        def fn(state_vals, *inputs):
+            out = functional_call(layer, dict(zip(names, state_vals)),
+                                  *[Tensor(i) for i in inputs])
+            return jax.tree_util.tree_map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+
+        state_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals]
+        exported = jexport.export(jax.jit(fn))(state_specs, *specs)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(exported.serialize())
+        np.savez(path + ".pdiparams.npz",
+                 **{n: np.asarray(v) for n, v in zip(names, vals)})
+        with open(path + ".meta.json", "w") as f:
+            json.dump({"param_names": names,
+                       "n_inputs": len(specs)}, f)
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+class LoadedFunction:
+    def __init__(self, path: str):
+        from jax import export as jexport
+        with open(path + ".stablehlo", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(path + ".meta.json") as f:
+            self._meta = json.load(f)
+        npz = np.load(path + ".pdiparams.npz")
+        self._state_vals = [jnp.asarray(npz[n])
+                            for n in self._meta["param_names"]]
+
+    def __call__(self, *inputs):
+        raw = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+               for i in inputs]
+        out = self._exported.call(self._state_vals, *raw)
+        return jax.tree_util.tree_map(
+            lambda x: Tensor(x) if isinstance(x, jax.Array) else x, out)
+
+
+def load(path: str) -> LoadedFunction:
+    return LoadedFunction(path)
+
+
+def _to_sds(s):
+    if isinstance(s, jax.ShapeDtypeStruct):
+        return s
+    if isinstance(s, Tensor):
+        return jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
+    arr = jnp.asarray(s)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)

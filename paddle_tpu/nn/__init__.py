@@ -1,0 +1,20 @@
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+from .layer import Layer  # noqa: F401
+from .layers_common import *  # noqa: F401,F403
+from .layers_common import (  # noqa: F401
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool2D, BatchNorm, BatchNorm1D,
+    BatchNorm2D, BatchNorm3D, BCELoss, BCEWithLogitsLoss, Conv1D, Conv2D,
+    Conv2DTranspose, Conv3D, CrossEntropyLoss, Dropout, Dropout2D, ELU,
+    Embedding, Flatten, GELU, GroupNorm, Hardsigmoid, Hardswish,
+    InstanceNorm2D, KLDivLoss, L1Loss, LayerNorm, LeakyReLU, Linear,
+    LogSoftmax, MaxPool2D, Mish, MSELoss, NLLLoss, PReLU, ReLU, ReLU6,
+    RMSNorm, Sigmoid, Silu, SmoothL1Loss, Softmax, Swish, SyncBatchNorm,
+    Tanh,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)

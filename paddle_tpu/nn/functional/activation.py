@@ -1,0 +1,97 @@
+"""Activations (≈ python/paddle/nn/functional/activation.py over
+phi/kernels/*/activation_kernel.*). Pure jnp — XLA fuses these into
+neighboring matmuls, which is exactly what the reference's fused ops
+(operators/fused/) do by hand."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.op_registry import op
+
+relu = op("relu")(jax.nn.relu)
+relu6 = op("relu6")(jax.nn.relu6)
+sigmoid = op("sigmoid")(jax.nn.sigmoid)
+log_sigmoid = op("log_sigmoid")(jax.nn.log_sigmoid)
+tanh_act = op("tanh_act")(jnp.tanh)
+silu = op("silu")(jax.nn.silu)
+swish = silu
+mish = op("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+gelu = op("gelu")(
+    lambda x, approximate=False: jax.nn.gelu(x, approximate=approximate))
+elu = op("elu")(lambda x, alpha=1.0: jax.nn.elu(x, alpha=alpha))
+selu = op("selu")(
+    lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+    scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+celu = op("celu")(lambda x, alpha=1.0: jax.nn.celu(x, alpha=alpha))
+leaky_relu = op("leaky_relu")(
+    lambda x, negative_slope=0.01: jax.nn.leaky_relu(x, negative_slope))
+prelu = op("prelu")(
+    lambda x, weight, data_format="NCHW":
+    jnp.where(x > 0, x, _prelu_broadcast(weight, x, data_format) * x))
+
+
+def _prelu_broadcast(w, x, data_format):
+    if w.size == 1 or x.ndim <= 1:
+        return w.reshape(())if w.size == 1 else w
+    shape = [1] * x.ndim
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape[ch_axis] = w.size
+    return w.reshape(shape)
+
+
+hardtanh = op("hardtanh")(
+    lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max))
+hardshrink = op("hardshrink")(
+    lambda x, threshold=0.5: jnp.where(jnp.abs(x) > threshold, x, 0.0))
+softshrink = op("softshrink")(
+    lambda x, threshold=0.5:
+    jnp.where(x > threshold, x - threshold,
+              jnp.where(x < -threshold, x + threshold, 0.0)))
+hardsigmoid = op("hardsigmoid")(
+    lambda x, slope=1.0 / 6.0, offset=0.5:
+    jnp.clip(slope * x + offset, 0.0, 1.0))
+hardswish = op("hardswish")(
+    lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+softplus = op("softplus")(
+    lambda x, beta=1.0, threshold=20.0:
+    jnp.where(x * beta > threshold, x, jnp.log1p(jnp.exp(beta * x)) / beta))
+softsign = op("softsign")(jax.nn.soft_sign)
+tanhshrink = op("tanhshrink")(lambda x: x - jnp.tanh(x))
+thresholded_relu = op("thresholded_relu")(
+    lambda x, threshold=1.0: jnp.where(x > threshold, x, 0.0))
+
+softmax = op("softmax")(
+    lambda x, axis=-1: jax.nn.softmax(x, axis=axis))
+log_softmax = op("log_softmax")(
+    lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis))
+gumbel_softmax = op("gumbel_softmax")(
+    lambda x, temperature=1.0, hard=False, axis=-1:
+    _gumbel_softmax(x, temperature, hard, axis))
+
+
+def _gumbel_softmax(x, temperature, hard, axis):
+    # eager-mode gumbel noise from the global key
+    from ...core import random as random_mod
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(random_mod.next_key(), x.shape) + 1e-20) + 1e-20)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), x.shape[axis],
+                                axis=axis, dtype=y.dtype)
+        y = y_hard + y - jax.lax.stop_gradient(y)
+    return y
+
+
+@op("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@op("maxout")
+def maxout(x, groups, axis=1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)

@@ -1,0 +1,71 @@
+"""Attention functional. The XLA path is a plain softmax(QK^T)V — XLA fuses
+it decently; the Pallas flash kernel (paddle_tpu.kernels.flash_attention)
+is used automatically for long sequences on TPU. Reference analog:
+paddle/fluid/operators/fused/fused_attention_op.cu (hand-fused CUDA);
+here fusion is the compiler's job with a Pallas override for the hot case.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.op_registry import op
+
+_FLASH_MIN_SEQ = 1024  # below this XLA's fusion is typically fine
+
+
+def _sdpa_xla(q, k, v, mask=None, dropout_p=0.0, is_causal=False, scale=None,
+              dropout_rng=None):
+    # q,k,v: [B, S, H, D] (paddle convention)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    qh = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        logits = jnp.where(causal, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+@op("scaled_dot_product_attention")
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, dropout_rng=None):
+    """query/key/value: [batch, seq, num_heads, head_dim]. Attention dropout
+    draws from `dropout_rng` if given, else the global eager key (tracing
+    without an explicit rng disables dropout rather than baking a key)."""
+    if dropout_p > 0.0 and training and dropout_rng is None:
+        import jax.core as _jcore
+        if not isinstance(query, _jcore.Tracer):
+            from ...core import random as random_mod
+            dropout_rng = random_mod.next_key()
+    if not training:
+        dropout_p = 0.0
+    use_flash = (attn_mask is None and dropout_p == 0.0
+                 and query.shape[1] >= _FLASH_MIN_SEQ
+                 and query.shape[1] == key.shape[1]
+                 and query.shape[-1] in (64, 128, 256)
+                 and jax.default_backend() == "tpu")
+    if use_flash:
+        try:
+            from ...kernels.flash_attention import flash_attention
+            return flash_attention(query, key, value, causal=is_causal,
+                                   scale=scale)
+        except Exception:
+            pass  # fall through to XLA path
+    return _sdpa_xla(query, key, value, attn_mask, dropout_p, is_causal,
+                     scale, dropout_rng)

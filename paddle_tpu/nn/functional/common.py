@@ -1,0 +1,157 @@
+"""Common NN functional ops: linear, dropout, embedding, interpolate, …
+(≈ python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as random_mod
+from ...core.tensor import Tensor, dispatch, is_grad_enabled
+from ...ops.op_registry import op
+
+
+@op("linear")
+def linear(x, weight, bias=None):
+    # paddle stores Linear weight as [in, out] (transposed vs torch)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op("embedding")
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            rng=None, name=None):
+    """Dropout. In eager mode draws from the global RNG; under jit pass
+    `rng` explicitly (see Layer rng plumbing / distributed RNG tracker)."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if rng is None:
+        rng = random_mod.next_key()
+
+    def impl(arr):
+        shape = list(arr.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in [a % arr.ndim for a in axes] else 1
+                     for i, s in enumerate(arr.shape)]
+        keep = jax.random.bernoulli(rng, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, arr / (1.0 - p), 0.0).astype(arr.dtype)
+        return jnp.where(keep, arr, 0.0).astype(arr.dtype)
+
+    return dispatch("dropout", impl, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", rng=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training, rng=rng)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", rng=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training, rng=rng)
+
+
+def alpha_dropout(x, p=0.5, training=True, rng=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if rng is None:
+        rng = random_mod.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def impl(arr):
+        keep = jax.random.bernoulli(rng, 1.0 - p, arr.shape)
+        a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, arr, alpha_p) + b).astype(arr.dtype)
+
+    return dispatch("alpha_dropout", impl, (x,), {})
+
+
+@op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+@op("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@op("normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                            keepdims=True), 1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+@op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@op("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    # NCHW 4-D only for now (covers resnet/vision use)
+    assert x.ndim == 4, "interpolate: only 4-D inputs supported"
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            (scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "bicubic": "cubic", "area": "linear"}[mode]
+    out = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+upsample = interpolate
+
+
+@op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else \
+        [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+    oh = (h + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+    ow = (w + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=tuple(ks), window_strides=tuple(st),
+        padding="VALID", rhs_dilation=tuple(dl),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * ks[0] * ks[1], oh * ow)

@@ -1,0 +1,148 @@
+"""Convolutions via lax.conv_general_dilated (≈ phi/kernels/*/conv_kernel.*).
+One primitive covers conv1d/2d/3d/transpose/grouped/dilated; XLA lowers it
+onto the MXU. NCHW accepted for API parity but NHWC is TPU-preferred —
+layers default to the input's layout and XLA's layout assignment handles
+the rest."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.op_registry import op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _padding(padding, nsp):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nsp
+    padding = list(padding)
+    if len(padding) == nsp:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nsp)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nsp,
+          data_format):
+    chars = "DHW"[-nsp:]
+    if data_format.endswith("C"):
+        lhs_spec = "N" + chars + "C"
+    else:
+        lhs_spec = "NC" + chars
+    dn = (lhs_spec, "OI" + chars, lhs_spec)
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=_tuple(stride, nsp),
+        padding=_padding(padding, nsp),
+        rhs_dilation=_tuple(dilation, nsp),
+        feature_group_count=groups,
+        dimension_numbers=dn,
+        preferred_element_type=x.dtype if x.dtype != jnp.bfloat16 else None)
+    if bias is not None:
+        shape = [1] * out.ndim
+        ch_axis = lhs_spec.index("C")
+        shape[ch_axis] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+conv1d = op("conv1d")(
+    lambda x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCL":
+    _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+          "NCW" if data_format == "NCL" else "NWC"))
+
+conv2d = op("conv2d")(
+    lambda x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCHW":
+    _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format))
+
+conv3d = op("conv3d")(
+    lambda x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+    data_format="NCDHW":
+    _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format))
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, nsp, data_format):
+    chars = "DHW"[-nsp:]
+    lhs_spec = ("N" + chars + "C") if data_format.endswith("C") else \
+        ("NC" + chars)
+    dn = (lhs_spec, "IO" + chars, lhs_spec)
+    pad = _padding(padding, nsp)
+    if isinstance(pad, str):
+        padding_cfg = pad
+    else:
+        # transposed conv: effective padding = k-1-p (gradient of fwd conv)
+        ks = weight.shape[2:]
+        dl = _tuple(dilation, nsp)
+        padding_cfg = [((k - 1) * d - p[0], (k - 1) * d - p[1] +
+                        (op_ if isinstance(op_, int) else 0))
+                       for k, d, p, op_ in zip(
+                           ks, dl, pad, _tuple(output_padding, nsp))]
+    out = jax.lax.conv_general_dilated(
+        x, weight,
+        window_strides=(1,) * nsp,
+        padding=padding_cfg,
+        lhs_dilation=_tuple(stride, nsp),
+        rhs_dilation=_tuple(dilation, nsp),
+        feature_group_count=groups,
+        dimension_numbers=dn,
+    ) if groups == 1 else _grouped_transpose(
+        x, weight, stride, padding_cfg, dilation, groups, nsp, dn)
+    # flip spatial dims of kernel for true transpose semantics
+    if bias is not None:
+        shape = [1] * out.ndim
+        shape[lhs_spec.index("C")] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def _grouped_transpose(x, weight, stride, padding_cfg, dilation, groups, nsp, dn):
+    lhs_spec = dn[0]
+    ch_axis = lhs_spec.index("C")
+    xs = jnp.split(x, groups, axis=ch_axis)
+    ws = jnp.split(weight, groups, axis=0)
+    outs = [jax.lax.conv_general_dilated(
+        xi, wi, window_strides=(1,) * nsp, padding=padding_cfg,
+        lhs_dilation=_tuple(stride, nsp), rhs_dilation=_tuple(dilation, nsp),
+        dimension_numbers=dn) for xi, wi in zip(xs, ws)]
+    return jnp.concatenate(outs, axis=ch_axis)
+
+
+@op("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW"):
+    # paddle weight layout: [in, out//groups, kh, kw]; flip spatial for
+    # transpose-as-dilated-conv
+    w = jnp.flip(weight, axis=(-1, -2))
+    return _conv_transpose(x, w, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format)
+
+
+@op("conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL"):
+    w = jnp.flip(weight, axis=(-1,))
+    return _conv_transpose(x, w, bias, stride, padding, output_padding,
+                           dilation, groups, 1,
+                           "NCW" if data_format == "NCL" else "NWC")
+
+
+@op("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW"):
+    w = jnp.flip(weight, axis=(-1, -2, -3))
+    return _conv_transpose(x, w, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format)

@@ -1,0 +1,194 @@
+"""Loss functionals (≈ python/paddle/nn/functional/loss.py over phi
+softmax_with_cross_entropy etc.). cross_entropy fuses log_softmax+NLL like
+the reference's fused kernel (phi/kernels/*/cross_entropy_kernel.*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.op_registry import op
+
+
+def _reduce(loss, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(loss) / weight_sum
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@op("cross_entropy")
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  label_smoothing=0.0):
+    logp = jax.nn.log_softmax(input, axis=axis)
+    if soft_label:
+        tgt = label
+        if label_smoothing > 0.0:
+            k = input.shape[axis]
+            tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+        loss = -jnp.sum(tgt * logp, axis=axis)
+        return _reduce(loss, reduction)
+    lbl = label
+    if lbl.ndim == logp.ndim:  # [..., 1] index labels
+        lbl = jnp.squeeze(lbl, axis=axis)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe, axis).astype(jnp.int32), axis=axis)
+    nll = -jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0.0:
+        k = input.shape[axis]
+        smooth = -jnp.mean(logp, axis=axis)
+        nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        nll = nll * w
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+        return _reduce(nll, reduction)
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(
+            jnp.sum(valid.astype(nll.dtype)), 1.0)
+    return _reduce(nll, reduction)
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+@op("nll_loss")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    picked = -jnp.take_along_axis(input, safe[..., None].astype(jnp.int32),
+                                  axis=-1)[..., 0]
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        picked = jnp.where(valid, picked * w, 0.0)
+        if reduction == "mean":
+            return jnp.sum(picked) / jnp.sum(jnp.where(valid, w, 0.0))
+    picked = jnp.where(valid, picked, 0.0)
+    if reduction == "mean":
+        return jnp.sum(picked) / jnp.maximum(jnp.sum(valid), 1)
+    return _reduce(picked, reduction)
+
+
+mse_loss = op("mse_loss")(
+    lambda input, label, reduction="mean":
+    _reduce(jnp.square(input - label), reduction))
+l1_loss = op("l1_loss")(
+    lambda input, label, reduction="mean":
+    _reduce(jnp.abs(input - label), reduction))
+
+
+@op("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@op("binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    neg_abs = -jnp.abs(logit)
+    loss = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = loss * log_w
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@op("kl_div")
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return _reduce(jnp.maximum(-label * (input - other) + margin, 0.0),
+                   reduction)
+
+
+@op("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(loss, reduction)
+
+
+@op("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1.0 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+@op("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def pdist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1),
+                         1.0 / p)
+
+    d_pos = pdist(input, positive)
+    d_neg = pdist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, pdist(positive, negative))
+    return _reduce(jnp.maximum(d_pos - d_neg + margin, 0.0), reduction)
+
+
+@op("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0.0) - logit * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@op("square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@op("ctc_loss")
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean"):
+    # log_probs: [T, B, C] paddle convention
+    import optax
+    lp = jnp.transpose(log_probs, (1, 0, 2))  # -> [B, T, C]
+    t = lp.shape[1]
+    logitpad = jnp.arange(t)[None, :] >= input_lengths[:, None]
+    lmax = labels.shape[1]
+    labelpad = jnp.arange(lmax)[None, :] >= label_lengths[:, None]
+    loss = optax.ctc_loss(lp, logitpad.astype(lp.dtype), labels,
+                          labelpad.astype(lp.dtype), blank_id=blank)
+    return _reduce(loss, reduction)

@@ -1,0 +1,131 @@
+"""Normalization functionals (≈ phi batch_norm/layer_norm/group_norm
+kernels). Plain jnp: XLA fuses the mean/var/normalize chain; the Pallas
+fused layer_norm in paddle_tpu.kernels is swapped in by LayerNorm when
+shapes qualify."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops.op_registry import op
+
+
+@op("layer_norm")
+def layer_norm(x, normalized_shape=None, weight=None, bias=None,
+               epsilon=1e-5):
+    if normalized_shape is None:
+        ndims = 1
+    else:
+        ndims = 1 if isinstance(normalized_shape, int) else \
+            len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - ndims, x.ndim))
+    # reduce in fp32 for bf16 inputs (matches reference's fp32 accumulators)
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@op("rms_norm")
+def rms_norm(x, weight=None, epsilon=1e-6):
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf / jnp.sqrt(ms + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@op("batch_norm_infer")
+def batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
+                     epsilon=1e-5, data_format="NCHW"):
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    rm = running_mean.reshape(shape)
+    rv = running_var.reshape(shape)
+    out = (x - rm) / jnp.sqrt(rv + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("batch_norm_train")
+def batch_norm_train(x, weight=None, bias=None, epsilon=1e-5,
+                     data_format="NCHW"):
+    """Returns (out, batch_mean, batch_var); running-stat update happens in
+    the Layer (stateful, outside the traced fn)."""
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    axes = tuple(a for a in range(x.ndim) if a != ch_axis)
+    xf = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (xf - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+@op("group_norm")
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    if ch_axis != 1:
+        x_t = jnp.moveaxis(x, ch_axis, 1)
+    else:
+        x_t = x
+    n, c = x_t.shape[:2]
+    spatial = x_t.shape[2:]
+    g = x_t.reshape(n, num_groups, c // num_groups, *spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(x_t.shape)
+    shape = [1, c] + [1] * len(spatial)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if ch_axis != 1:
+        out = jnp.moveaxis(out, 1, ch_axis)
+    return out
+
+
+@op("instance_norm")
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+@op("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    c = x.shape[1]
+    half = size // 2
+    padded = jnp.pad(sq, [(0, 0), (half, size - half - 1)] +
+                     [(0, 0)] * (x.ndim - 2))
+    acc = jnp.zeros_like(sq)
+    for i in range(size):
+        acc = acc + jnp.take(padded, jnp.arange(i, i + c), axis=1)
+    return x / jnp.power(k + alpha * acc, beta)

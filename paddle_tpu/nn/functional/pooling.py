@@ -1,0 +1,143 @@
+"""Pooling via lax.reduce_window (≈ phi pool kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.op_registry import op
+
+
+def _tuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+def _window(x_ndim, ksize, stride, nsp, channel_last):
+    if channel_last:
+        dims = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + ksize
+        strides = (1, 1) + stride
+    return dims, strides
+
+
+def _pool(x, ksize, stride, padding, nsp, data_format, kind,
+          ceil_mode=False, exclusive=True):
+    channel_last = data_format.endswith("C")
+    ksize = _tuple(ksize, nsp)
+    stride = _tuple(stride if stride is not None else ksize, nsp)
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        p = _tuple(padding, nsp)
+        sp_shape = x.shape[1:1 + nsp] if channel_last else x.shape[2:2 + nsp]
+        hi = list(p)
+        if ceil_mode:
+            # extra high-side padding so output size rounds up (paddle
+            # ceil_mode); padded cells are excluded from avg counts below
+            for i, (sz, k, s, pi) in enumerate(zip(sp_shape, ksize, stride,
+                                                   p)):
+                out_sz = -(-(sz + 2 * pi - k) // s) + 1  # ceil div
+                need = (out_sz - 1) * s + k - (sz + 2 * pi)
+                hi[i] = pi + max(need, 0)
+        pairs = tuple((pi, h) for pi, h in zip(p, hi))
+        if channel_last:
+            pad_cfg = ((0, 0),) + pairs + ((0, 0),)
+        else:
+            pad_cfg = ((0, 0), (0, 0)) + pairs
+    dims, strides = _window(x.ndim, ksize, stride, nsp, channel_last)
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, dims, strides,
+                                     pad_cfg)
+    # avg
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                                   dims, strides, pad_cfg)
+    if exclusive and not isinstance(pad_cfg, str):
+        ones = jnp.ones(x.shape, x.dtype)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides,
+                                       pad_cfg)
+        return summed / counts
+    return summed / float(np.prod(ksize))
+
+
+max_pool1d = op("max_pool1d")(
+    lambda x, kernel_size, stride=None, padding=0, ceil_mode=False,
+    data_format="NCL":
+    _pool(x, kernel_size, stride, padding, 1,
+          "NCW" if data_format == "NCL" else "NWC", "max", ceil_mode))
+max_pool2d = op("max_pool2d")(
+    lambda x, kernel_size, stride=None, padding=0, ceil_mode=False,
+    data_format="NCHW":
+    _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode))
+max_pool3d = op("max_pool3d")(
+    lambda x, kernel_size, stride=None, padding=0, ceil_mode=False,
+    data_format="NCDHW":
+    _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode))
+avg_pool1d = op("avg_pool1d")(
+    lambda x, kernel_size, stride=None, padding=0, exclusive=True,
+    ceil_mode=False, data_format="NCL":
+    _pool(x, kernel_size, stride, padding, 1,
+          "NCW" if data_format == "NCL" else "NWC", "avg", ceil_mode,
+          exclusive))
+avg_pool2d = op("avg_pool2d")(
+    lambda x, kernel_size, stride=None, padding=0, exclusive=True,
+    ceil_mode=False, data_format="NCHW":
+    _pool(x, kernel_size, stride, padding, 2, data_format, "avg", ceil_mode,
+          exclusive))
+avg_pool3d = op("avg_pool3d")(
+    lambda x, kernel_size, stride=None, padding=0, exclusive=True,
+    ceil_mode=False, data_format="NCDHW":
+    _pool(x, kernel_size, stride, padding, 3, data_format, "avg", ceil_mode,
+          exclusive))
+
+
+def _adaptive_pool(x, output_size, nsp, data_format, kind):
+    channel_last = data_format.endswith("C")
+    out_sz = _tuple(output_size, nsp)
+    sp_axes = list(range(1, 1 + nsp)) if channel_last else \
+        list(range(x.ndim - nsp, x.ndim))
+    out = x
+    for ax, osz in zip(sp_axes, out_sz):
+        isz = out.shape[ax]
+        if osz == 1:
+            out = (jnp.max if kind == "max" else jnp.mean)(
+                out, axis=ax, keepdims=True)
+        elif isz % osz == 0:
+            k = isz // osz
+            newshape = out.shape[:ax] + (osz, k) + out.shape[ax + 1:]
+            out = (jnp.max if kind == "max" else jnp.mean)(
+                out.reshape(newshape), axis=ax + 1)
+        else:
+            # general case: windowed gather per output index
+            idx = [np.arange((i * isz) // osz, max((i * isz) // osz + 1,
+                   -(-((i + 1) * isz) // osz))) for i in range(osz)]
+            slices = [(jnp.max if kind == "max" else jnp.mean)(
+                jnp.take(out, jnp.asarray(ii), axis=ax), axis=ax)
+                for ii in idx]
+            out = jnp.stack(slices, axis=ax)
+    return out
+
+
+adaptive_avg_pool1d = op("adaptive_avg_pool1d")(
+    lambda x, output_size, data_format="NCL":
+    _adaptive_pool(x, output_size, 1,
+                   "NCW" if data_format == "NCL" else "NWC", "avg"))
+adaptive_avg_pool2d = op("adaptive_avg_pool2d")(
+    lambda x, output_size, data_format="NCHW":
+    _adaptive_pool(x, output_size, 2, data_format, "avg"))
+adaptive_avg_pool3d = op("adaptive_avg_pool3d")(
+    lambda x, output_size, data_format="NCDHW":
+    _adaptive_pool(x, output_size, 3, data_format, "avg"))
+adaptive_max_pool1d = op("adaptive_max_pool1d")(
+    lambda x, output_size, data_format="NCL":
+    _adaptive_pool(x, output_size, 1,
+                   "NCW" if data_format == "NCL" else "NWC", "max"))
+adaptive_max_pool2d = op("adaptive_max_pool2d")(
+    lambda x, output_size, data_format="NCHW":
+    _adaptive_pool(x, output_size, 2, data_format, "max"))
+adaptive_max_pool3d = op("adaptive_max_pool3d")(
+    lambda x, output_size, data_format="NCDHW":
+    _adaptive_pool(x, output_size, 3, data_format, "max"))

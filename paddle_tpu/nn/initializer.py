@@ -1,0 +1,168 @@
+"""Weight initializers (≈ python/paddle/nn/initializer/ over phi full/
+gaussian/uniform kernels). Initializers are callables (shape, dtype) ->
+jax array, drawing from the global eager RNG."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weight is [in, out]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight [out_c, in_c/groups, *k]
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(tuple(shape), self.value,
+                        dtype_mod.convert_dtype(dtype or "float32"))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        return jax.random.uniform(
+            random_mod.next_key(), tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"),
+            minval=self.low, maxval=self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        return self.mean + self.std * jax.random.normal(
+            random_mod.next_key(), tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        return self.mean + self.std * jax.random.truncated_normal(
+            random_mod.next_key(), -2.0, 2.0, tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(
+            random_mod.next_key(), tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"),
+            minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(
+            random_mod.next_key(), tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "relu":
+            return math.sqrt(2.0)
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return 1.0
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        return jax.random.uniform(
+            random_mod.next_key(), tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"),
+            minval=-limit, maxval=limit)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self._gain() / math.sqrt(fi)
+        return std * jax.random.normal(
+            random_mod.next_key(), tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        return self.gain * jax.nn.initializers.orthogonal()(
+            random_mod.next_key(), tuple(shape),
+            dtype_mod.convert_dtype(dtype or "float32"))
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        arr = jnp.asarray(getattr(self.value, "data", self.value),
+                          dtype_mod.convert_dtype(dtype or "float32"))
+        return arr.reshape(tuple(shape))
+
+
+class ParamAttr:
+    """≈ paddle.ParamAttr: bundles initializer/trainable/name for
+    create_parameter."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 trainable=True, regularizer=None, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+        self.regularizer = regularizer
+        self.need_clip = need_clip

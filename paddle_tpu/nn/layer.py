@@ -1,0 +1,290 @@
+"""Layer: module base class.
+
+Reference analog: python/paddle/fluid/dygraph/layers.py:97 (`class Layer`) —
+parameters/buffers/sublayers registries, forward pre/post hooks,
+state_dict/set_state_dict, train/eval. Same surface here; parameters are
+`Parameter` tensors living in plain dicts, so a Layer doubles as a pytree
+source for the functional/jit path (see paddle_tpu.jit.functional_call).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from . import initializer as init_mod
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+        self._dtype = dtype
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------ registry
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._parameters[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if name in getattr(self, "_parameters", {}):
+                del self._parameters[name]
+            if name in getattr(self, "_sub_layers", {}):
+                del self._sub_layers[name]
+            if name in getattr(self, "_buffers", {}):
+                if isinstance(value, Tensor):
+                    self._buffers[name] = value
+                    return
+                del self._buffers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, dtype=None, default_initializer=None,
+                         attr=None, is_bias: bool = False):
+        """≈ Layer.create_parameter (layers.py): build + initialize a
+        Parameter. `attr` may be a ParamAttr carrying an initializer."""
+        dtype = dtype or self._dtype
+        initializer = None
+        trainable = True
+        if attr is not None and attr is not False:
+            initializer = getattr(attr, "initializer", None)
+            trainable = getattr(attr, "trainable", True)
+        if initializer is None:
+            initializer = default_initializer
+        if initializer is None:
+            initializer = (init_mod.Constant(0.0) if is_bias
+                           else init_mod.XavierNormal())
+        data = initializer(shape, dtype)
+        return Parameter(data, dtype=dtype, trainable=trainable)
+
+    # ------------------------------------------------------------ traversal
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "",
+                         include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in (self.named_sublayers(prefix=prefix,
+                                                 include_self=True)
+                            if include_sublayers else [(prefix, self)]):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (name + "." + pname if name else pname), p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in (self.named_sublayers(prefix=prefix,
+                                                 include_self=True)
+                            if include_sublayers else [(prefix, self)]):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (name + "." + bname if name else bname), b
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = prefix + "." + name if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix,
+                                           include_self=True)
+
+    def children(self) -> Iterator["Layer"]:
+        yield from (l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        yield from ((n, l) for n, l in self._sub_layers.items()
+                    if l is not None)
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # ------------------------------------------------------------ modes
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # ------------------------------------------------------------ hooks
+    def register_forward_pre_hook(self, hook):
+        handle = _HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle._id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle._id] = hook
+        return handle
+
+    # ------------------------------------------------------------ call
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # ------------------------------------------------------------ state
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "",
+                   use_hook: bool = True) -> Dict[str, Tensor]:
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        non_persist = set()
+        for lname, layer in self.named_sublayers(include_self=True):
+            for b in layer._non_persistable_buffer_names:
+                non_persist.add((lname + "." + b) if lname else b)
+        for name, b in self.named_buffers(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            if name not in non_persist:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, tensor in own.items():
+            if name in state_dict:
+                val = state_dict[name]
+                arr = val.data if isinstance(val, Tensor) else np.asarray(val)
+                if tuple(np.shape(arr)) != tuple(tensor.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: checkpoint "
+                        f"{np.shape(arr)} vs layer {tuple(tensor.shape)}")
+                tensor._replace_data(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            from ..core import dtype as dtype_mod
+            d = dtype_mod.convert_dtype(dtype)
+            for _, p in self.named_parameters():
+                if dtype_mod.is_floating(p.dtype):
+                    p._replace_data(p.data.astype(d), keep_dtype=False)
+            for _, b in self.named_buffers():
+                if dtype_mod.is_floating(b.dtype):
+                    b._replace_data(b.data.astype(d), keep_dtype=False)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"({name}): " + ("\n  ".join(sub_repr)))
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+
+class _HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks = hooks_dict
+        self._id = _HookRemoveHelper._next_id
+        _HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)

@@ -1,0 +1,53 @@
+"""Functional op namespace; also installs method-style aliases on Tensor
+(the reference generates Tensor methods in pybind
+`eager_method.cc`/`eager_op_function_generator`; here it's a loop)."""
+from __future__ import annotations
+
+from . import creation, linalg, manipulation, math
+from .op_registry import OPS, get_op, op
+from ..core.tensor import Tensor
+
+# ---- method aliases on Tensor ------------------------------------------
+
+_METHOD_SOURCES = {
+    math: [
+        "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+        "pow", "maximum", "minimum", "abs", "sqrt", "rsqrt", "square", "exp",
+        "log", "log2", "log10", "log1p", "sin", "cos", "tan", "tanh", "floor",
+        "ceil", "round", "sign", "reciprocal", "erf", "clip", "scale", "cast",
+        "cumsum", "cumprod", "sum", "mean", "max", "min", "prod", "std",
+        "var", "logsumexp", "all", "any", "argmax", "argmin", "isnan",
+        "isinf", "isfinite", "allclose", "equal_all", "trace", "lerp",
+        "nan_to_num", "count_nonzero", "median", "clone", "equal",
+        "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal", "logical_and", "logical_or", "logical_not",
+    ],
+    manipulation: [
+        "reshape", "flatten", "squeeze", "unsqueeze", "split", "chunk",
+        "transpose", "tile", "expand", "expand_as", "broadcast_to", "flip",
+        "roll", "gather", "gather_nd", "scatter", "scatter_nd_add",
+        "index_select", "masked_select", "masked_fill", "topk", "sort",
+        "argsort", "unbind", "numel", "unique", "repeat_interleave",
+        "take_along_axis", "put_along_axis", "moveaxis", "nonzero", "pad",
+    ],
+    linalg: [
+        "matmul", "mm", "bmm", "dot", "mv", "norm", "dist", "cholesky",
+        "inverse", "det", "matrix_power", "pinv", "solve", "qr", "svd", "t",
+        "trace" if False else "cross",
+    ],
+    creation: ["tril", "triu", "zeros_like", "ones_like", "full_like"],
+}
+
+for module, names in _METHOD_SOURCES.items():
+    for name in names:
+        fn = getattr(module, name, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+
+def _astype(self, dtype):
+    return math.cast(self, dtype)
+
+
+Tensor.astype = _astype
+Tensor.cast = _astype

@@ -1,0 +1,199 @@
+"""Tensor creation ops (≈ python/paddle/tensor/creation.py; phi full/empty
+kernels). Creation is pure XLA; RNG creation ops draw from the global
+stateful key (core/random.py) in eager mode — inside jit-traced code use
+the functional seeds (paddle_tpu.jit / Layer rngs) instead."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+from ..core.tensor import Tensor, to_tensor  # re-export
+from .op_registry import op
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return dtype_mod.get_default_dtype() if default_float else np.dtype("int64")
+    return dtype_mod.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None):
+    # XLA has no uninitialized memory concept; zeros is the honest analog.
+    return zeros(shape, dtype)
+
+
+zeros_like = op("zeros_like", differentiable=False)(
+    lambda x, dtype=None: jnp.zeros_like(x, dtype_mod.convert_dtype(dtype)))
+ones_like = op("ones_like", differentiable=False)(
+    lambda x, dtype=None: jnp.ones_like(x, dtype_mod.convert_dtype(dtype)))
+full_like = op("full_like", differentiable=False)(
+    lambda x, fill_value, dtype=None:
+    jnp.full_like(x, fill_value, dtype=dtype_mod.convert_dtype(dtype)))
+empty_like = zeros_like
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or dtype_mod.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step,
+                             dtype_mod.convert_dtype(dtype) if dtype else None))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jnp.diag(arr, k=offset)
+    if padding_value != 0 and arr.ndim == 1:
+        mask = jnp.eye(out.shape[0], dtype=bool)
+        n = arr.shape[0]
+        mask = jnp.eye(n + abs(offset), dtype=bool) if offset else mask
+        out = jnp.where(jnp.diag(jnp.ones(n, bool), k=offset), out, padding_value)
+    return Tensor(out)
+
+
+def diagflat(x, offset=0):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(arr, k=offset))
+
+
+def meshgrid(*args):
+    arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a) for a in
+            (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple))
+             else args)]
+    return [Tensor(g) for g in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output: Optional[Tensor] = None):
+    val = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._replace_data(val)
+        return output
+    return Tensor(val)
+
+
+tril = op("tril")(lambda x, diagonal=0: jnp.tril(x, k=diagonal))
+triu = op("triu")(lambda x, diagonal=0: jnp.triu(x, k=diagonal))
+
+
+def tril_indices(row, col, offset=0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(jnp.int64))
+
+
+def triu_indices(row, col, offset=0):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(jnp.int64))
+
+
+def clone(x):
+    from . import math as math_ops
+    return math_ops.clone(x)
+
+
+# ------------------------------------------------------------------ random
+
+
+def rand(shape, dtype=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    d = dtype_mod.convert_dtype(dtype) if dtype else np.dtype("int64")
+    return Tensor(jax.random.randint(key, _shape(shape), low, high).astype(d))
+
+
+def randperm(n, dtype=None):
+    key = random_mod.next_key()
+    d = dtype_mod.convert_dtype(dtype) if dtype else np.dtype("int64")
+    return Tensor(jax.random.permutation(key, n).astype(d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    key = random_mod.next_key() if seed == 0 else jax.random.PRNGKey(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.data if isinstance(mean, Tensor) else mean
+        s = std.data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = random_mod.next_key()
+        return Tensor(jax.random.normal(key, shp,
+                                        dtype_mod.get_default_dtype()) * s + m)
+    key = random_mod.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape or (1,)),
+                                    dtype_mod.get_default_dtype()) * std + mean)
+
+
+def bernoulli(x):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    key = random_mod.next_key()
+    return Tensor(jax.random.bernoulli(key, arr).astype(arr.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    key = random_mod.next_key()
+    logits = jnp.log(jnp.maximum(arr, 1e-30))
+    if arr.ndim == 1:
+        out = jax.random.choice(key, arr.shape[0], (num_samples,),
+                                replace=replacement, p=arr / arr.sum())
+    else:
+        keys = jax.random.split(key, arr.shape[0])
+        out = jnp.stack([
+            jax.random.choice(k, arr.shape[1], (num_samples,),
+                              replace=replacement, p=row / row.sum())
+            for k, row in zip(keys, arr)])
+    return Tensor(out.astype(jnp.int64))

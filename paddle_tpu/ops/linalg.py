@@ -1,0 +1,147 @@
+"""Linear algebra ops (≈ python/paddle/tensor/linalg.py;
+phi/kernels/*/matmul_kernel.*, cholesky, svd, ...). matmul is THE MXU op:
+keep it one jnp.matmul call so XLA tiles it onto the systolic array
+(bf16 inputs accumulate in fp32 on the MXU by default)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .op_registry import op
+
+
+@op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    # bf16 inputs: XLA:TPU accumulates in fp32 on the MXU by default and
+    # emits bf16 outputs — no preferred_element_type override needed.
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+bmm = op("bmm")(lambda x, y: jnp.matmul(x, y))
+dot = op("dot")(
+    lambda x, y: jnp.sum(x * y, axis=-1))
+mv = op("mv")(lambda x, vec: jnp.matmul(x, vec))
+outer = op("outer_linalg")(lambda x, y: jnp.outer(x, y))
+
+transpose_last2 = op("transpose_last2")(lambda x: jnp.swapaxes(x, -1, -2))
+t = op("t")(lambda x: x.T if x.ndim <= 2 else jnp.swapaxes(x, -1, -2))
+
+einsum_impl = op("einsum")(lambda *ops, equation=None: jnp.einsum(equation, *ops))
+
+
+def einsum(equation, *operands):
+    return einsum_impl(*operands, equation=equation)
+
+
+@op("norm")
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == np.inf or p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == -np.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+dist = op("dist")(
+    lambda x, y, p=2: _p_norm_scalar(x - y, p))
+
+
+def _p_norm_scalar(d, p):
+    if p == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(d)))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+cholesky = op("cholesky")(
+    lambda x, upper=False: jnp.linalg.cholesky(x).swapaxes(-1, -2).conj()
+    if upper else jnp.linalg.cholesky(x))
+inv = op("inverse")(jnp.linalg.inv)
+inverse = inv
+det = op("det")(jnp.linalg.det)
+slogdet = op("slogdet")(
+    lambda x: jnp.stack(jnp.linalg.slogdet(x)))
+matrix_power = op("matrix_power")(
+    lambda x, n: jnp.linalg.matrix_power(x, n))
+matrix_rank = op("matrix_rank", differentiable=False)(
+    lambda x, tol=None, hermitian=False:
+    jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int64))
+pinv = op("pinv")(
+    lambda x, rcond=1e-15, hermitian=False: jnp.linalg.pinv(x, rtol=rcond,
+                                                            hermitian=hermitian))
+solve = op("solve")(jnp.linalg.solve)
+triangular_solve = op("triangular_solve")(
+    lambda x, y, upper=True, transpose=False, unitriangular=False:
+    jax.scipy.linalg.solve_triangular(x, y, lower=not upper,
+                                      trans=1 if transpose else 0,
+                                      unit_diagonal=unitriangular))
+cholesky_solve = op("cholesky_solve")(
+    lambda x, y, upper=False: jax.scipy.linalg.cho_solve((y, not upper), x))
+lstsq = op("lstsq", differentiable=False)(
+    lambda x, y, rcond=None: jnp.linalg.lstsq(x, y, rcond=rcond)[0])
+
+
+def qr(x, mode="reduced"):
+    from ..core.tensor import dispatch
+    return dispatch("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)),
+                    (x,), {})
+
+
+def svd(x, full_matrices=False):
+    from ..core.tensor import dispatch
+    return dispatch(
+        "svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+        (x,), {})
+
+
+def eig(x):
+    from ..core.tensor import dispatch
+    return dispatch("eig", lambda a: tuple(np_eig(a)), (x,), {},
+                    differentiable=False)
+
+
+def np_eig(a):
+    w, v = np.linalg.eig(np.asarray(a))  # XLA:TPU has no nonsymmetric eig
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+def eigh(x, UPLO="L"):
+    from ..core.tensor import dispatch
+    return dispatch("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)),
+                    (x,), {})
+
+
+eigvalsh = op("eigvalsh")(lambda x, UPLO="L": jnp.linalg.eigvalsh(x, UPLO=UPLO))
+
+cross = op("cross")(
+    lambda x, y, axis=9: jnp.cross(x, y, axis=-1 if axis == 9 else axis))
+
+cov = op("cov")(
+    lambda x, rowvar=True, ddof=True, fweights=None, aweights=None:
+    jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+            fweights=fweights, aweights=aweights))
+corrcoef = op("corrcoef")(
+    lambda x, rowvar=True: jnp.corrcoef(x, rowvar=rowvar))
+histogram = op("histogram", differentiable=False)(
+    lambda x, bins=100, min=0, max=0:
+    jnp.histogram(x, bins=bins,
+                  range=None if min == 0 and max == 0 else (min, max))[0])
+bincount = op("bincount", differentiable=False)(
+    lambda x, weights=None, minlength=0:
+    jnp.bincount(x, weights=weights, minlength=minlength))

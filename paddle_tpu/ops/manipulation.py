@@ -1,0 +1,396 @@
+"""Shape / indexing / reorganization ops (≈ python/paddle/tensor/
+manipulation.py over phi reshape/concat/gather/... kernels). Gather/scatter
+lower to XLA gather/scatter — dynamic shapes (masked_select, nonzero,
+unique) are host-synced in eager mode and documented jit-unfriendly, same
+boundary the reference draws for -1 shaped ops."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .op_registry import op
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(s) for s in shape)
+
+
+reshape = op("reshape")(lambda x, shape: jnp.reshape(x, _norm_shape(shape)))
+view = reshape
+
+
+@op("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    sa = start_axis % nd
+    so = stop_axis % nd
+    newshape = x.shape[:sa] + (-1,) + x.shape[so + 1:]
+    return jnp.reshape(x, newshape)
+
+
+@op("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a % max(x.ndim, 1) for a in axis if x.shape[a % max(x.ndim, 1)] == 1)
+    return jnp.squeeze(x, axis) if axis else x
+
+
+@op("unsqueeze")
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    # paddle semantics: every axis refers to the FINAL output rank
+    out_rank = x.ndim + len(axis)
+    norm = sorted(a % out_rank for a in axis)
+    out = x
+    for a in norm:
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+concat = op("concat")(
+    lambda x, axis=0: jnp.concatenate(list(x), axis=int(axis)))
+stack = op("stack")(lambda x, axis=0: jnp.stack(list(x), axis=axis))
+vstack = op("vstack")(lambda x: jnp.vstack(list(x)))
+hstack = op("hstack")(lambda x: jnp.hstack(list(x)))
+dstack = op("dstack")(lambda x: jnp.dstack(list(x)))
+
+
+def split(x, num_or_sections, axis=0):
+    total = (x.shape if isinstance(x, Tensor) else jnp.shape(x))[axis]
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if total % n != 0:
+            raise ValueError(f"split: dim {axis} size {total} not divisible "
+                             f"by {n}")
+        secs = [total // n] * n
+    else:
+        secs = list(num_or_sections)
+        if any(s == -1 for s in secs):
+            known = sum(s for s in secs if s != -1)
+            secs = [total - known if s == -1 else s for s in secs]
+    from ..core.tensor import dispatch
+    # each slice routed through dispatch so the split participates in the tape
+    return tuple(
+        dispatch("split", lambda a, lo=lo, hi=hi: jax.lax.slice_in_dim(
+            a, lo, hi, axis=axis), (x,), {})
+        for lo, hi in _bounds(secs))
+
+
+def _bounds(sizes):
+    out, acc = [], 0
+    for s in sizes:
+        out.append((acc, acc + s))
+        acc += s
+    return out
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    n = (x.shape if isinstance(x, Tensor) else jnp.shape(x))[axis]
+    from ..core.tensor import dispatch
+    return tuple(
+        dispatch("unbind", lambda a, i=i: jnp.take(a, i, axis=axis), (x,), {})
+        for i in range(n))
+
+
+transpose = op("transpose")(
+    lambda x, perm: jnp.transpose(x, tuple(perm)))
+moveaxis = op("moveaxis")(
+    lambda x, source, destination: jnp.moveaxis(x, source, destination))
+swapaxes = op("swapaxes")(
+    lambda x, axis1, axis2: jnp.swapaxes(x, axis1, axis2))
+
+tile = op("tile")(lambda x, repeat_times: jnp.tile(x, _norm_shape(repeat_times)))
+
+
+@op("expand")
+def expand(x, shape):
+    shape = list(_norm_shape(shape))
+    xshape = list(x.shape)
+    # paddle semantics: -1 keeps the original dim; leading dims may be added
+    diff = len(shape) - len(xshape)
+    for i, s in enumerate(shape):
+        if s == -1 and i >= diff:
+            shape[i] = xshape[i - diff]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+broadcast_to = expand
+expand_as = op("expand_as")(lambda x, y: jnp.broadcast_to(x, jnp.shape(y)))
+
+
+def broadcast_tensors(inputs):
+    arrs = [t.data if isinstance(t, Tensor) else jnp.asarray(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    from ..core.tensor import dispatch
+    return [dispatch("broadcast_tensors",
+                     lambda a, s=shape: jnp.broadcast_to(a, s), (t,), {})
+            for t in inputs]
+
+
+flip = op("flip")(lambda x, axis: jnp.flip(x, axis=tuple(axis) if
+                                           isinstance(axis, (list, tuple)) else axis))
+roll = op("roll")(
+    lambda x, shifts, axis=None: jnp.roll(x, shifts, axis=axis))
+rot90 = op("rot90")(lambda x, k=1, axes=(0, 1): jnp.rot90(x, k=k, axes=tuple(axes)))
+
+gather = op("gather")(
+    lambda x, index, axis=0: jnp.take(x, index.reshape(-1) if index.ndim > 1
+                                      else index, axis=int(axis)))
+index_select = op("index_select")(
+    lambda x, index, axis=0: jnp.take(x, index, axis=int(axis)))
+take_along_axis = op("take_along_axis")(
+    lambda arr, indices, axis, broadcast=True:
+    jnp.take_along_axis(arr, indices, axis=axis))
+
+
+@op("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(values, indices.shape) if jnp.ndim(values) else \
+        jnp.full(indices.shape, values, arr.dtype)
+    mode = {"assign": None, "add": "add", "mul": "multiply",
+            "multiply": "multiply"}[reduce]
+    if mode is None:
+        return jnp.put_along_axis(arr, indices, values, axis=axis,
+                                  inplace=False)
+    dnums = jnp.put_along_axis(arr, indices,
+                               jnp.take_along_axis(arr, indices, axis),
+                               axis=axis, inplace=False)
+    if mode == "add":
+        upd = jnp.zeros_like(arr)
+        upd = _scatter_add_along(upd, indices, values, axis)
+        return arr + upd
+    upd = _scatter_add_along(jnp.zeros_like(arr), indices,
+                             jnp.log(jnp.maximum(values, 1e-30)), axis)
+    return arr * jnp.exp(upd)
+
+
+def _scatter_add_along(base, indices, values, axis):
+    axis = axis % base.ndim
+    idx_grids = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape],
+                             indexing="ij")
+    full_idx = list(idx_grids)
+    full_idx[axis] = indices
+    return base.at[tuple(full_idx)].add(values)
+
+
+@op("gather_nd")
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    from ..core.tensor import dispatch
+    return dispatch(
+        "scatter_nd",
+        lambda idx, upd: jnp.zeros(_norm_shape(shape),
+                                   upd.dtype).at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd),
+        (index, updates), {})
+
+
+where = op("where")(
+    lambda condition, x=None, y=None: jnp.where(condition, x, y)
+    if x is not None else jnp.stack(jnp.nonzero(condition), -1))
+
+
+def nonzero(x, as_tuple=False):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    idx = np.nonzero(np.asarray(arr))  # host sync: dynamic output shape
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.stack([jnp.asarray(i) for i in idx], -1)
+                  if idx else jnp.zeros((0, arr.ndim), jnp.int64))
+
+
+def masked_select(x, mask):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    m = np.asarray(mask.data if isinstance(mask, Tensor) else mask)
+    return Tensor(arr[jnp.asarray(np.nonzero(m.reshape(-1))[0])]
+                  if arr.ndim == 1 else
+                  arr.reshape(-1)[jnp.asarray(np.nonzero(m.reshape(-1))[0])])
+
+
+masked_fill = op("masked_fill")(
+    lambda x, mask, value: jnp.where(mask, value, x))
+
+repeat_interleave = op("repeat_interleave")(
+    lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis))
+
+pad = op("pad")(
+    lambda x, pad, mode="constant", value=0.0, data_format="NCHW":
+    _pad_impl(x, pad, mode, value, data_format))
+
+
+def _pad_impl(x, pad, mode, value, data_format):
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-rank spec, paddle order: innermost-last pairs like torch?
+        # paddle.nn.functional.pad with len==2*ndim applies to all dims in
+        # order (dim0_lo, dim0_hi, dim1_lo, ...)
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # spatial spec (NCHW/NHWC): pad last spatial dims, torch-style
+        # (left,right[,top,bottom[,front,back]]) applied innermost-first
+        nspatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC / NLC / NDHWC: spatial before C
+            spatial_axes = list(range(1, 1 + nspatial))
+        else:
+            spatial_axes = list(range(nd - nspatial, nd))
+        for i in range(nspatial):
+            ax = spatial_axes[::-1][i] if not data_format.endswith("C") \
+                else spatial_axes[::-1][i]
+            widths[ax] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, widths, mode="constant", constant_values=value)
+    return jnp.pad(x, widths, mode=jmode)
+
+
+def one_hot(x, num_classes):
+    from ..core.tensor import dispatch
+    return dispatch("one_hot",
+                    lambda idx: jax.nn.one_hot(idx, num_classes), (x,), {},
+                    differentiable=False)
+
+
+# ------------------------------------------------------------- sort / topk
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    from ..core.tensor import dispatch
+
+    def impl(arr):
+        a = arr if largest else -arr
+        a = jnp.moveaxis(a, axis, -1)
+        vals, idx = jax.lax.top_k(a, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, axis),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, axis))
+
+    vals, idx = dispatch("topk", impl, (x,), {})
+    idx.stop_gradient = True
+    return vals, idx
+
+
+sort = op("sort")(
+    lambda x, axis=-1, descending=False:
+    -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis))
+argsort = op("argsort", differentiable=False)(
+    lambda x, axis=-1, descending=False:
+    (jnp.argsort(-x, axis=axis) if descending
+     else jnp.argsort(x, axis=axis)).astype(jnp.int64))
+searchsorted = op("searchsorted", differentiable=False)(
+    lambda sorted_sequence, values, right=False:
+    jnp.searchsorted(sorted_sequence, values,
+                     side="right" if right else "left").astype(jnp.int64))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def numel(x):
+    return Tensor(jnp.asarray(int(np.prod((x.shape if isinstance(x, Tensor)
+                                           else jnp.shape(x)) or (1,))),
+                              jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape if isinstance(x, Tensor)
+                              else jnp.shape(x), jnp.int32))
+
+
+@op("as_strided")
+def as_strided(x, shape, stride, offset=0):
+    flat = jnp.ravel(x)
+    idx = offset + _strided_indices(_norm_shape(shape), tuple(stride))
+    return flat[idx]
+
+
+def _strided_indices(shape, stride):
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    idx = jnp.zeros(shape, jnp.int32)
+    for g, st in zip(grids, stride):
+        idx = idx + g * st
+    return idx
+
+
+# ------------------------------------------------------------- get/setitem
+
+
+def _norm_index(idx):
+    """Convert Tensor-bearing index specs to raw arrays (static where
+    possible so eager indexing matches python semantics)."""
+    if isinstance(idx, Tensor):
+        return idx.data
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def getitem(x, idx):
+    from ..core.tensor import dispatch
+    nidx = _norm_index(idx)
+    if _index_is_bool_mask(nidx):
+        # boolean masking produces dynamic shape: resolve on host (eager only)
+        arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        mask = np.asarray(nidx)
+        gidx = jnp.asarray(np.nonzero(mask.reshape(-1))[0])
+        lead = mask.ndim
+        flat = arr.reshape((-1,) + arr.shape[lead:])
+        return dispatch("getitem_bool",
+                        lambda a: a.reshape((-1,) + a.shape[lead:])[gidx],
+                        (x,), {})
+    return dispatch("getitem", lambda a: a[nidx], (x,), {})
+
+
+def _index_is_bool_mask(idx):
+    return (isinstance(idx, (jax.Array, np.ndarray))
+            and idx.dtype == np.bool_)
+
+
+def setitem(x, idx, value):
+    from ..core.tensor import dispatch
+    nidx = _norm_index(idx)
+    return dispatch("setitem", lambda a, v: a.at[nidx].set(v), (x, value), {})

@@ -1,0 +1,221 @@
+"""Elementwise & reduction math ops (≈ python/paddle/tensor/math.py over
+phi kernels, e.g. paddle/phi/kernels/cpu/elementwise_*). All impls are jnp
+one-liners — XLA fuses them; no hand kernels needed at this level."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .op_registry import op
+from ..core import dtype as dtype_mod
+
+# ------------------------------------------------------------- binary
+
+add = op("add")(lambda x, y: jnp.add(x, y))
+subtract = op("subtract")(lambda x, y: jnp.subtract(x, y))
+multiply = op("multiply")(lambda x, y: jnp.multiply(x, y))
+divide = op("divide")(lambda x, y: jnp.true_divide(x, y))
+floor_divide = op("floor_divide", differentiable=False)(jnp.floor_divide)
+remainder = op("remainder")(lambda x, y: jnp.remainder(x, y))
+mod = remainder
+pow = op("pow")(lambda x, y: jnp.power(x, y))
+maximum = op("maximum")(jnp.maximum)
+minimum = op("minimum")(jnp.minimum)
+fmax = op("fmax")(jnp.fmax)
+fmin = op("fmin")(jnp.fmin)
+atan2 = op("atan2")(jnp.arctan2)
+hypot = op("hypot")(lambda x, y: jnp.sqrt(x * x + y * y))
+logaddexp = op("logaddexp")(jnp.logaddexp)
+heaviside = op("heaviside", differentiable=False)(jnp.heaviside)
+lerp = op("lerp")(lambda x, y, w: x + w * (y - x))
+inner = op("inner")(jnp.inner)
+outer = op("outer")(jnp.outer)
+kron = op("kron")(jnp.kron)
+gcd = op("gcd", differentiable=False)(jnp.gcd)
+lcm = op("lcm", differentiable=False)(jnp.lcm)
+
+# ------------------------------------------------------------- comparison
+
+equal = op("equal", differentiable=False)(lambda x, y: jnp.equal(x, y))
+not_equal = op("not_equal", differentiable=False)(jnp.not_equal)
+less_than = op("less_than", differentiable=False)(jnp.less)
+less_equal = op("less_equal", differentiable=False)(jnp.less_equal)
+greater_than = op("greater_than", differentiable=False)(jnp.greater)
+greater_equal = op("greater_equal", differentiable=False)(jnp.greater_equal)
+equal_all = op("equal_all", differentiable=False)(
+    lambda x, y: jnp.array_equal(x, y))
+allclose = op("allclose", differentiable=False)(
+    lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+    jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+isclose = op("isclose", differentiable=False)(
+    lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+    jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+logical_and = op("logical_and", differentiable=False)(jnp.logical_and)
+logical_or = op("logical_or", differentiable=False)(jnp.logical_or)
+logical_not = op("logical_not", differentiable=False)(jnp.logical_not)
+logical_xor = op("logical_xor", differentiable=False)(jnp.logical_xor)
+bitwise_and = op("bitwise_and", differentiable=False)(jnp.bitwise_and)
+bitwise_or = op("bitwise_or", differentiable=False)(jnp.bitwise_or)
+bitwise_xor = op("bitwise_xor", differentiable=False)(jnp.bitwise_xor)
+bitwise_not = op("bitwise_not", differentiable=False)(jnp.bitwise_not)
+
+isnan = op("isnan", differentiable=False)(jnp.isnan)
+isinf = op("isinf", differentiable=False)(jnp.isinf)
+isfinite = op("isfinite", differentiable=False)(jnp.isfinite)
+
+# ------------------------------------------------------------- unary
+
+abs = op("abs")(jnp.abs)
+neg = op("neg")(jnp.negative)
+sqrt = op("sqrt")(jnp.sqrt)
+rsqrt = op("rsqrt")(lambda x: jax.lax.rsqrt(x))
+square = op("square")(jnp.square)
+exp = op("exp")(jnp.exp)
+expm1 = op("expm1")(jnp.expm1)
+log = op("log")(jnp.log)
+log2 = op("log2")(jnp.log2)
+log10 = op("log10")(jnp.log10)
+log1p = op("log1p")(jnp.log1p)
+sin = op("sin")(jnp.sin)
+cos = op("cos")(jnp.cos)
+tan = op("tan")(jnp.tan)
+asin = op("asin")(jnp.arcsin)
+acos = op("acos")(jnp.arccos)
+atan = op("atan")(jnp.arctan)
+sinh = op("sinh")(jnp.sinh)
+cosh = op("cosh")(jnp.cosh)
+tanh = op("tanh")(jnp.tanh)
+asinh = op("asinh")(jnp.arcsinh)
+acosh = op("acosh")(jnp.arccosh)
+atanh = op("atanh")(jnp.arctanh)
+floor = op("floor", differentiable=False)(jnp.floor)
+ceil = op("ceil", differentiable=False)(jnp.ceil)
+round = op("round", differentiable=False)(jnp.round)
+trunc = op("trunc", differentiable=False)(jnp.trunc)
+frac = op("frac")(lambda x: x - jnp.trunc(x))
+sign = op("sign", differentiable=False)(jnp.sign)
+reciprocal = op("reciprocal")(lambda x: 1.0 / x)
+erf = op("erf")(jax.scipy.special.erf)
+erfinv = op("erfinv")(jax.scipy.special.erfinv)
+lgamma = op("lgamma")(jax.scipy.special.gammaln)
+digamma = op("digamma")(jax.scipy.special.digamma)
+deg2rad = op("deg2rad")(jnp.deg2rad)
+rad2deg = op("rad2deg")(jnp.rad2deg)
+angle = op("angle")(jnp.angle)
+conj = op("conj")(jnp.conj)
+real = op("real")(jnp.real)
+imag = op("imag")(jnp.imag)
+nan_to_num = op("nan_to_num")(
+    lambda x, nan=0.0, posinf=None, neginf=None:
+    jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+
+clip = op("clip")(lambda x, min=None, max=None: jnp.clip(x, min, max))
+scale = op("scale")(
+    lambda x, scale=1.0, bias=0.0, bias_after_scale=True:
+    x * scale + bias if bias_after_scale else (x + bias) * scale)
+clone = op("clone")(lambda x: x + jnp.zeros((), x.dtype))
+increment = op("increment")(lambda x, value=1.0: x + value)
+stanh = op("stanh")(
+    lambda x, scale_a=0.67, scale_b=1.7159: scale_b * jnp.tanh(scale_a * x))
+multiplex = op("multiplex", differentiable=False)(
+    lambda inputs, index: jnp.stack(inputs, 0)[index[:, 0],
+                                               jnp.arange(index.shape[0])])
+
+cast = op("cast", differentiable=False)(
+    lambda x, dtype: x.astype(dtype_mod.convert_dtype(dtype)))
+
+# ------------------------------------------------------------- cumulative
+
+cumsum = op("cumsum")(lambda x, axis=None: jnp.cumsum(x, axis=axis))
+cumprod = op("cumprod")(lambda x, dim=None: jnp.cumprod(x, axis=dim))
+cummax = op("cummax", differentiable=False)(
+    lambda x, axis=None: jax.lax.cummax(x, axis=axis if axis is not None else 0))
+cummin = op("cummin", differentiable=False)(
+    lambda x, axis=None: jax.lax.cummin(x, axis=axis if axis is not None else 0))
+@op("logcumsumexp")
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    # numerically stable log-space prefix sum
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+# ------------------------------------------------------------- reductions
+
+
+def _axis(axis):
+    if isinstance(axis, (list,)):
+        return tuple(axis)
+    return axis
+
+
+sum = op("sum")(
+    lambda x, axis=None, dtype=None, keepdim=False:
+    jnp.sum(x, axis=_axis(axis), dtype=dtype_mod.convert_dtype(dtype),
+            keepdims=keepdim))
+mean = op("mean")(
+    lambda x, axis=None, keepdim=False:
+    jnp.mean(x, axis=_axis(axis), keepdims=keepdim))
+max = op("max")(
+    lambda x, axis=None, keepdim=False:
+    jnp.max(x, axis=_axis(axis), keepdims=keepdim))
+min = op("min")(
+    lambda x, axis=None, keepdim=False:
+    jnp.min(x, axis=_axis(axis), keepdims=keepdim))
+amax = max
+amin = min
+prod = op("prod")(
+    lambda x, axis=None, keepdim=False, dtype=None:
+    jnp.prod(x, axis=_axis(axis), keepdims=keepdim,
+             dtype=dtype_mod.convert_dtype(dtype)))
+std = op("std")(
+    lambda x, axis=None, unbiased=True, keepdim=False:
+    jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim))
+var = op("var")(
+    lambda x, axis=None, unbiased=True, keepdim=False:
+    jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim))
+nansum = op("nansum")(
+    lambda x, axis=None, dtype=None, keepdim=False:
+    jnp.nansum(x, axis=_axis(axis), dtype=dtype_mod.convert_dtype(dtype),
+               keepdims=keepdim))
+nanmean = op("nanmean")(
+    lambda x, axis=None, keepdim=False:
+    jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim))
+logsumexp = op("logsumexp")(
+    lambda x, axis=None, keepdim=False:
+    jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim))
+all = op("all", differentiable=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.all(x, axis=_axis(axis), keepdims=keepdim))
+any = op("any", differentiable=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.any(x, axis=_axis(axis), keepdims=keepdim))
+argmax = op("argmax", differentiable=False)(
+    lambda x, axis=None, keepdim=False, dtype="int64":
+    jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    .astype(dtype_mod.convert_dtype(dtype)))
+argmin = op("argmin", differentiable=False)(
+    lambda x, axis=None, keepdim=False, dtype="int64":
+    jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    .astype(dtype_mod.convert_dtype(dtype)))
+count_nonzero = op("count_nonzero", differentiable=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim))
+median = op("median", differentiable=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.median(x, axis=_axis(axis), keepdims=keepdim))
+quantile = op("quantile", differentiable=False)(
+    lambda x, q, axis=None, keepdim=False:
+    jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim))
+
+trace = op("trace")(
+    lambda x, offset=0, axis1=0, axis2=1:
+    jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
+diagonal = op("diagonal")(
+    lambda x, offset=0, axis1=0, axis2=1:
+    jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2))
+
+addmm = op("addmm")(
+    lambda input, x, y, beta=1.0, alpha=1.0:
+    beta * input + alpha * jnp.matmul(x, y))

@@ -1,0 +1,50 @@
+"""Op registry + tape-aware wrapper decorator.
+
+Reference analog: phi's KernelFactory + yaml codegen
+(paddle/phi/core/kernel_registry.h:376 PD_REGISTER_KERNEL;
+paddle/phi/api/yaml/generator/api_gen.py). On TPU there is exactly one
+backend (XLA), so "registration" reduces to: name -> pure-jax impl, plus a
+differentiability bit. The wrapper routes through core.tensor.dispatch which
+records the eager grad tape; shape/dtype inference (InferMeta,
+paddle/phi/infermeta/) is jax abstract evaluation for free.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Callable, Dict
+
+from ..core.tensor import dispatch
+
+
+@dataclass
+class OpDef:
+    name: str
+    impl: Callable          # pure jax: raw arrays in, raw arrays out
+    public: Callable        # Tensor-aware wrapper
+    differentiable: bool
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def op(name: str = None, differentiable: bool = True):
+    """Register a pure-jax op impl and return its Tensor-aware wrapper."""
+
+    def deco(impl: Callable) -> Callable:
+        opname = name or impl.__name__
+
+        @functools.wraps(impl)
+        def public(*args, **kwargs):
+            return dispatch(opname, impl, args, kwargs, differentiable)
+
+        OPS[opname] = OpDef(opname, impl, public, differentiable)
+        public.op_name = opname
+        public.raw = impl
+        return public
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return OPS[name]

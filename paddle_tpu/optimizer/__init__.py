@@ -1,0 +1,6 @@
+from . import lr  # noqa: F401
+from .grad_clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                        ClipGradByValue)
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (SGD, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
+                         Momentum, RMSProp)

@@ -1,0 +1,58 @@
+"""Gradient clipping (≈ python/paddle/fluid/clip.py: ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Clips operate on lists of raw arrays
+so they work both eagerly and inside jitted train steps. The TP-aware
+variant (global norm psum over model-parallel axis) lives in
+distributed/fleet — see HybridParallelClipGrad analog."""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads: List[jax.Array]) -> List[jax.Array]:
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2 clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global L2 clip across all grads (the default for LLM training)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def global_norm(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        return jnp.sqrt(sq)
+
+    def __call__(self, grads):
+        gnorm = self.global_norm(grads)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads]

@@ -1,0 +1,197 @@
+"""Concrete optimizers (≈ python/paddle/optimizer/{sgd,momentum,adam,adamw,
+lamb,...}.py; fused GPU kernels phi/kernels/gpu/{adam,adamw,lamb}_kernel.cu).
+Each is one pure `_update` rule; XLA fuses the whole step."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update(self, p, g, state, lr, step):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, shape, dtype):
+        return {"velocity": jnp.zeros(shape, dtype)}
+
+    def _update(self, p, g, state, lr, step):
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            p_new = p - lr * (g + self._momentum * v)
+        else:
+            p_new = p - lr * v
+        return p_new, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, shape, dtype):
+        return {"moment": jnp.full(shape, self._init_acc, dtype)}
+
+    def _update(self, p, g, state, lr, step):
+        m = state["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, shape, dtype):
+        st = {"mean_square": jnp.zeros(shape, dtype),
+              "momentum": jnp.zeros(shape, dtype)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(shape, dtype)
+        return st
+
+    def _update(self, p, g, state, lr, step):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        new = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new["momentum"] = mom
+        return p - mom, new
+
+
+class _AdamBase(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, shape, dtype):
+        # master weights: keep moments (and fp32 master param when the param
+        # itself is low precision) in fp32 — the reference's multi_precision
+        # path (phi/kernels/gpu/adamw_kernel.cu master-weight arguments)
+        mdtype = jnp.float32
+        st = {"moment1": jnp.zeros(shape, mdtype),
+              "moment2": jnp.zeros(shape, mdtype)}
+        if self.multi_precision and jnp.dtype(dtype) in (
+                jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+            st["master"] = None  # filled lazily from the param on first step
+        return st
+
+    def _adam_m_v(self, g, state, step):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        mhat = m / (1 - self._beta1 ** step)
+        vhat = v / (1 - self._beta2 ** step)
+        return m, v, mhat, vhat
+
+
+class Adam(_AdamBase):
+    def _update(self, p, g, state, lr, step):
+        m, v, mhat, vhat = self._adam_m_v(g, state, step)
+        new_state = {"moment1": m, "moment2": v}
+        if "master" in state:
+            master = state["master"] if state["master"] is not None \
+                else p.astype(jnp.float32)
+            master = master - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+            new_state["master"] = master
+            return master.astype(p.dtype), new_state
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), new_state
+
+
+class AdamW(_AdamBase):
+    """Decoupled weight decay (Loshchilov & Hutter), ≈ paddle.optimizer.AdamW
+    (python/paddle/optimizer/adamw.py; decay applied multiplicatively to the
+    param before the adam update, coeff default 0.01)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 apply_decay_param_fun=None, lr_ratio=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         float(weight_decay), grad_clip,
+                         multi_precision=multi_precision)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_decay(self):
+        return True
+
+    def _update(self, p, g, state, lr, step):
+        m, v, mhat, vhat = self._adam_m_v(g, state, step)
+        new_state = {"moment1": m, "moment2": v}
+        wd = self._weight_decay or 0.0
+        if "master" in state:
+            master = state["master"] if state["master"] is not None \
+                else p.astype(jnp.float32)
+            master = master * (1.0 - lr * wd)
+            master = master - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+            new_state["master"] = master
+            return master.astype(p.dtype), new_state
+        p32 = p.astype(jnp.float32)
+        p32 = p32 * (1.0 - lr * wd)
+        p32 = p32 - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p32.astype(p.dtype), new_state
+
+
+class Adamax(_AdamBase):
+    def _init_state(self, shape, dtype):
+        return {"moment": jnp.zeros(shape, jnp.float32),
+                "inf_norm": jnp.zeros(shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        upd = lr / (1 - self._beta1 ** step) * m / (u + self._epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment": m, "inf_norm": u}
+
+
+class Lamb(_AdamBase):
+    """Layer-wise adaptive moments (≈ paddle.optimizer.Lamb,
+    phi/kernels/gpu/lamb_kernel.cu)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update(self, p, g, state, lr, step):
+        m, v, mhat, vhat = self._adam_m_v(g, state, step)
+        p32 = p.astype(jnp.float32)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), \
+            {"moment1": m, "moment2": v}

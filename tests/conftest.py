@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE any jax
+backend initializes (SURVEY.md §4: reference proves distributed logic with
+single-host multi-process + CPU collectives; here it's jax CPU devices).
+The axon sitecustomize pins JAX_PLATFORMS=axon, so we override via
+jax.config before first device use."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    import paddle_tpu
+    paddle_tpu.seed(1234)
+    yield

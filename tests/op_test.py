@@ -1,0 +1,87 @@
+"""OpTest-style golden-comparison harness.
+
+Reference analog: python/paddle/fluid/tests/unittests/op_test.py:333 —
+check_output runs ops through both static and dygraph paths vs numpy;
+check_grad compares analytic grads against finite differences
+(get_numeric_gradient, op_test.py:140). Here the two execution paths are
+(a) the eager tape and (b) jax.jit-traced, and grads check the tape's vjp
+against central finite differences.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(op_fn: Callable, np_ref: Callable, inputs: Sequence,
+                 kwargs=None, rtol=1e-5, atol=1e-6, check_jit=True):
+    """Run op eagerly and jitted; compare both to the numpy reference."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(np.asarray(i)) for i in inputs]
+    expected = np_ref(*[np.asarray(i) for i in inputs], **kwargs)
+
+    out_eager = op_fn(*tensors, **kwargs)
+    _assert_close(out_eager, expected, rtol, atol, "eager")
+
+    if check_jit:
+        jitted = jax.jit(lambda *raw: _unwrap_tree(
+            op_fn(*[Tensor(r) for r in raw], **kwargs)))
+        out_jit = jitted(*[t.data for t in tensors])
+        _assert_close(out_jit, expected, rtol, atol, "jit")
+
+
+def check_grad(op_fn: Callable, inputs: Sequence, grad_idx=0, kwargs=None,
+               eps=1e-3, rtol=1e-2, atol=1e-3, reduce_to_scalar=True):
+    """Compare tape gradients to central finite differences (float64 on CPU
+    would be ideal; we use float32 + loose tolerances like the reference's
+    fp32 white-list)."""
+    kwargs = kwargs or {}
+    arrays = [np.asarray(i, np.float32) for i in inputs]
+    tensors = [paddle.to_tensor(a, stop_gradient=(k != grad_idx))
+               for k, a in enumerate(arrays)]
+
+    out = op_fn(*tensors, **kwargs)
+    loss = out.sum() if reduce_to_scalar else out
+    loss.backward()
+    analytic = np.asarray(tensors[grad_idx].grad.numpy(), np.float64)
+
+    def scalar_f(x_flat):
+        args = [a.copy() for a in arrays]
+        args[grad_idx] = x_flat.reshape(arrays[grad_idx].shape).astype(
+            np.float32)
+        o = op_fn(*[paddle.to_tensor(a) for a in args], **kwargs)
+        return float(o.sum().numpy())
+
+    x0 = arrays[grad_idx].reshape(-1).astype(np.float64)
+    numeric = np.zeros_like(x0)
+    for i in range(x0.size):
+        xp = x0.copy()
+        xp[i] += eps
+        xm = x0.copy()
+        xm[i] -= eps
+        numeric[i] = (scalar_f(xp) - scalar_f(xm)) / (2 * eps)
+    numeric = numeric.reshape(arrays[grad_idx].shape)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                               err_msg=f"grad mismatch for {op_fn}")
+
+
+def _unwrap_tree(out):
+    return jax.tree_util.tree_map(
+        lambda t: t.data if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _assert_close(out, expected, rtol, atol, tag):
+    out_leaves = jax.tree_util.tree_leaves(
+        _unwrap_tree(out))
+    exp_leaves = expected if isinstance(expected, (list, tuple)) else \
+        [expected]
+    for o, e in zip(out_leaves, exp_leaves):
+        np.testing.assert_allclose(
+            np.asarray(o, np.float64), np.asarray(e, np.float64),
+            rtol=rtol, atol=atol, err_msg=f"[{tag}] output mismatch")

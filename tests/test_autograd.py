@@ -1,0 +1,114 @@
+"""Eager tape semantics tests (≈ unittests/test_imperative_*.py,
+test_custom_grad / PyLayer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_backward_accumulates_over_reuse():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * x + x * 3.0  # dy/dx = 2x + 3 = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-6)
+
+
+def test_second_backward_raises_without_retain():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    g1 = x.grad.numpy().copy()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * g1)
+
+
+def test_no_grad():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_stop_gradient_cuts_graph():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = (x * 2).detach()
+    z = (y * 3).sum()
+    assert z.stop_gradient  # no diff inputs upstream
+
+
+def test_grad_hook():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g) or g * 2)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), 6 * np.ones(3))
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    (a.sum() * 2 + b.sum()).backward()
+    expected = np.array([[2, 2, 2], [1, 1, 1]], np.float32)
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_non_scalar_backward_needs_grad_tensor():
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y2 = x * 2
+    y2.backward(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 2)))
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones(3))
+
+
+def test_functional_grad_matches_tape():
+    w = paddle.to_tensor(np.random.randn(4, 3).astype(np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+
+    def loss_fn(wt):
+        return (paddle.matmul(x, wt) ** 2).mean()
+
+    g_func = paddle.grad(loss_fn)(w)
+    loss_fn(w).backward()
+    np.testing.assert_allclose(g_func.numpy(), w.grad.numpy(), rtol=1e-5)
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            paddle.divide(x, paddle.to_tensor(np.zeros(2, np.float32)))
+    finally:
+        paddle.set_flags({"check_nan_inf": False})

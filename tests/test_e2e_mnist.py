@@ -1,0 +1,109 @@
+"""End-to-end slice: LeNet on synthetic MNIST, dygraph + jitted TrainStep
+(SURVEY.md §7 step 3 = BASELINE.json config #1). Mirrors the reference's
+book/e2e tests (python/paddle/fluid/tests/book/) which train to
+convergence; here we train a few steps and assert the loss drops."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.nn import functional as F
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120),
+            nn.Linear(120, 84),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = paddle.flatten(x, 1)
+        return self.fc(x)
+
+
+def _synthetic_mnist(n=256):
+    rng = np.random.RandomState(42)
+    labels = rng.randint(0, 10, n)
+    # separable synthetic digits: class-dependent blob position
+    imgs = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i, l in enumerate(labels):
+        imgs[i, 0, 2 + 2 * (l // 5): 10 + 2 * (l // 5),
+             2 + 2 * (l % 5): 10 + 2 * (l % 5)] += 1.0
+    return imgs, labels.astype(np.int64)
+
+
+def test_mnist_dygraph_loss_drops():
+    paddle.seed(0)
+    imgs, labels = _synthetic_mnist(128)
+    model = LeNet()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    loader = DataLoader(TensorDataset([imgs, labels]), batch_size=32,
+                        shuffle=True)
+    losses = []
+    for epoch in range(2):
+        for x, y in loader:
+            logits = model(x)
+            loss = F.cross_entropy(logits, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_mnist_jitted_trainstep():
+    paddle.seed(0)
+    imgs, labels = _synthetic_mnist(128)
+    model = LeNet()
+    opt = optimizer.Adam(learning_rate=2e-3, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt, F.cross_entropy)
+    losses = []
+    for epoch in range(3):
+        for i in range(0, 128, 32):
+            loss = step(paddle.to_tensor(imgs[i:i + 32]),
+                        paddle.to_tensor(labels[i:i + 32]))
+            losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_jitted_and_eager_same_model():
+    """The jitted forward on a model equals the eager forward."""
+    model = LeNet()
+    model.eval()
+    x = paddle.randn((4, 1, 28, 28))
+    eager_out = model(x)
+    jitted = paddle.jit.to_static(model)
+    jit_out = jitted(x)
+    np.testing.assert_allclose(eager_out.numpy(), jit_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_save_load_checkpoint_resume(tmp_path):
+    model = LeNet()
+    opt = optimizer.Adam(parameters=model.parameters())
+    x = paddle.randn((8, 1, 28, 28))
+    y = paddle.to_tensor(np.zeros(8, np.int64))
+    loss = F.cross_entropy(model(x), y)
+    loss.backward()
+    opt.step()
+    paddle.save({"model": model.state_dict(), "opt": opt.state_dict()},
+                str(tmp_path / "ckpt.pdparams"))
+    ckpt = paddle.load(str(tmp_path / "ckpt.pdparams"))
+    model2 = LeNet()
+    model2.set_state_dict(ckpt["model"])
+    opt2 = optimizer.Adam(parameters=model2.parameters())
+    opt2.set_state_dict(ckpt["opt"])
+    model.eval()
+    model2.eval()
+    np.testing.assert_allclose(model(x).numpy(), model2(x).numpy(),
+                               rtol=1e-5, atol=1e-6)

@@ -1,0 +1,122 @@
+"""Layer system tests (≈ unittests/test_layers.py, test_imperative_*)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_forward_shape():
+    m = nn.Linear(8, 4)
+    x = paddle.randn((2, 8))
+    out = m(x)
+    assert list(out.shape) == [2, 4]
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy() @ m.weight.numpy() + m.bias.numpy(),
+        rtol=1e-5)
+
+
+def test_parameters_and_state_dict():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params = m.parameters()
+    assert len(params) == 4
+    sd = m.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    x = paddle.randn((3, 4))
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_save_load_roundtrip(tmp_path):
+    m = nn.Linear(4, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    loaded = paddle.load(path)
+    m2 = nn.Linear(4, 3)
+    m2.set_state_dict(loaded)
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_train_eval_mode_dropout():
+    m = nn.Dropout(0.5)
+    x = paddle.ones((100,))
+    m.eval()
+    np.testing.assert_allclose(m(x).numpy(), np.ones(100))
+    m.train()
+    out = m(x).numpy()
+    assert (out == 0).any()
+    # upscale_in_train: kept elements are scaled by 1/(1-p)
+    assert np.allclose(out[out != 0], 2.0)
+
+
+def test_forward_hooks():
+    m = nn.Linear(3, 3)
+    calls = []
+    h = m.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    m(paddle.randn((1, 3)))
+    assert calls == [1]
+    h.remove()
+    m(paddle.randn((1, 3)))
+    assert calls == [1]
+
+
+def test_batchnorm_running_stats():
+    m = nn.BatchNorm2D(3)
+    x = paddle.randn((8, 3, 4, 4)) * 2 + 1
+    m.train()
+    m(x)
+    assert not np.allclose(m._mean.numpy(), np.zeros(3))
+    m.eval()
+    out = m(x)
+    assert list(out.shape) == [8, 3, 4, 4]
+
+
+def test_embedding_padding_idx():
+    m = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([0, 3], np.int32))
+    out = m(idx)
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+
+
+def test_layerlist_and_dict():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll.parameters()) == 6
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+    assert len(ld.parameters()) == 2
+
+
+def test_multi_head_attention():
+    m = nn.MultiHeadAttention(16, 4)
+    m.eval()
+    x = paddle.randn((2, 5, 16))
+    out = m(x)
+    assert list(out.shape) == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    enc.eval()
+    x = paddle.randn((2, 6, 16))
+    out = enc(x)
+    assert list(out.shape) == [2, 6, 16]
+
+
+def test_named_parameters_unique():
+    shared = nn.Linear(3, 3)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = shared
+            self.b = shared
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    m = M()
+    names = [n for n, _ in m.named_parameters()]
+    assert len(names) == 2  # shared params counted once

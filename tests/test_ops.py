@@ -1,0 +1,329 @@
+"""Op golden tests via the OpTest harness (≈ unittests/test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(0)
+
+
+class TestMath:
+    def test_add(self):
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(3, 4).astype("float32")
+        check_output(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, [a, b], grad_idx=0)
+
+    def test_broadcast_add(self):
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(4).astype("float32")
+        check_output(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, [a, b], grad_idx=1)
+
+    def test_mul_grad(self):
+        a = rng.randn(2, 3).astype("float32")
+        b = rng.randn(2, 3).astype("float32")
+        check_grad(paddle.multiply, [a, b], grad_idx=0)
+
+    def test_exp_log(self):
+        a = rng.rand(3, 4).astype("float32") + 0.5
+        check_output(paddle.exp, np.exp, [a])
+        check_output(paddle.log, np.log, [a], rtol=1e-5)
+        check_grad(paddle.log, [a])
+
+    def test_tanh_grad(self):
+        a = rng.randn(5).astype("float32")
+        check_grad(paddle.tanh, [a])
+
+    def test_reductions(self):
+        a = rng.randn(3, 4, 5).astype("float32")
+        check_output(paddle.sum, np.sum, [a])
+        check_output(lambda x: paddle.sum(x, axis=1),
+                     lambda x: np.sum(x, axis=1), [a])
+        check_output(lambda x: paddle.mean(x, axis=[0, 2], keepdim=True),
+                     lambda x: np.mean(x, axis=(0, 2), keepdims=True), [a])
+        check_output(paddle.max, np.max, [a])
+        check_grad(lambda x: paddle.mean(x, axis=1), [a])
+
+    def test_clip(self):
+        a = rng.randn(4, 4).astype("float32")
+        check_output(lambda x: paddle.clip(x, min=-0.5, max=0.5),
+                     lambda x: np.clip(x, -0.5, 0.5), [a])
+
+    def test_cumsum(self):
+        a = rng.randn(3, 4).astype("float32")
+        check_output(lambda x: paddle.cumsum(x, axis=1),
+                     lambda x: np.cumsum(x, axis=1), [a])
+
+    def test_comparison(self):
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(3, 4).astype("float32")
+        assert np.array_equal((paddle.to_tensor(a) < paddle.to_tensor(b)).numpy(),
+                              a < b)
+
+    def test_logsumexp(self):
+        a = rng.randn(3, 4).astype("float32")
+        from scipy.special import logsumexp as sls
+        check_output(lambda x: paddle.logsumexp(x, axis=1),
+                     lambda x: sls(x, axis=1), [a], rtol=1e-5)
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(4, 5).astype("float32")
+        check_output(paddle.matmul, np.matmul, [a, b], rtol=1e-4)
+        check_grad(paddle.matmul, [a, b], grad_idx=0)
+        check_grad(paddle.matmul, [a, b], grad_idx=1)
+
+    def test_matmul_transpose(self):
+        a = rng.randn(4, 3).astype("float32")
+        b = rng.randn(4, 5).astype("float32")
+        check_output(lambda x, y: paddle.matmul(x, y, transpose_x=True),
+                     lambda x, y: x.T @ y, [a, b], rtol=1e-4)
+
+    def test_bmm(self):
+        a = rng.randn(2, 3, 4).astype("float32")
+        b = rng.randn(2, 4, 5).astype("float32")
+        check_output(paddle.bmm, np.matmul, [a, b], rtol=1e-4)
+
+    def test_einsum(self):
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(4, 5).astype("float32")
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4)
+
+    def test_norm(self):
+        a = rng.randn(3, 4).astype("float32")
+        check_output(lambda x: paddle.ops.linalg.norm(x),
+                     lambda x: np.linalg.norm(x), [a], rtol=1e-5)
+
+    def test_solve_inverse(self):
+        a = (rng.randn(4, 4) + 4 * np.eye(4)).astype("float32")
+        b = rng.randn(4, 2).astype("float32")
+        check_output(paddle.ops.linalg.solve, np.linalg.solve, [a, b],
+                     rtol=1e-3, atol=1e-4)
+        check_output(paddle.ops.linalg.inv, np.linalg.inv, [a],
+                     rtol=1e-3, atol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_flatten(self):
+        a = rng.randn(2, 3, 4).astype("float32")
+        check_output(lambda x: paddle.reshape(x, [6, 4]),
+                     lambda x: x.reshape(6, 4), [a])
+        check_output(lambda x: paddle.flatten(x, 1),
+                     lambda x: x.reshape(2, 12), [a])
+        check_grad(lambda x: paddle.reshape(x, [24]), [a])
+
+    def test_concat_split(self):
+        a = rng.randn(2, 3).astype("float32")
+        b = rng.randn(2, 5).astype("float32")
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)],
+                            axis=1)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.concatenate([a, b], axis=1))
+        parts = paddle.split(out, [3, 5], axis=1)
+        np.testing.assert_allclose(parts[0].numpy(), a)
+        np.testing.assert_allclose(parts[1].numpy(), b)
+
+    def test_split_grad(self):
+        a = paddle.to_tensor(rng.randn(4, 6).astype("float32"),
+                             stop_gradient=False)
+        p1, p2 = paddle.split(a, 2, axis=1)
+        loss = p1.sum() + (2 * p2).sum()
+        loss.backward()
+        expected = np.concatenate([np.ones((4, 3)), 2 * np.ones((4, 3))], 1)
+        np.testing.assert_allclose(a.grad.numpy(), expected)
+
+    def test_transpose(self):
+        a = rng.randn(2, 3, 4).astype("float32")
+        check_output(lambda x: paddle.transpose(x, [2, 0, 1]),
+                     lambda x: x.transpose(2, 0, 1), [a])
+
+    def test_gather_scatter(self):
+        a = rng.randn(5, 3).astype("float32")
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(a), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), a[idx])
+
+    def test_where(self):
+        c = rng.rand(3, 4) > 0.5
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(3, 4).astype("float32")
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a),
+                           paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.where(c, a, b))
+
+    def test_topk(self):
+        a = rng.randn(3, 10).astype("float32")
+        vals, idx = paddle.topk(paddle.to_tensor(a), k=3)
+        ref = np.sort(a, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_getitem_setitem(self):
+        a = paddle.to_tensor(rng.randn(4, 5).astype("float32"))
+        np.testing.assert_allclose(a[1:3].numpy(), a.numpy()[1:3])
+        np.testing.assert_allclose(a[:, ::2].numpy(), a.numpy()[:, ::2])
+        a2 = a.numpy().copy()
+        a[0] = 7.0
+        a2[0] = 7.0
+        np.testing.assert_allclose(a.numpy(), a2)
+
+    def test_getitem_grad(self):
+        x = paddle.to_tensor(rng.randn(4, 5).astype("float32"),
+                             stop_gradient=False)
+        y = x[1:3, :2].sum()
+        y.backward()
+        g = np.zeros((4, 5), np.float32)
+        g[1:3, :2] = 1
+        np.testing.assert_allclose(x.grad.numpy(), g)
+
+    def test_pad(self):
+        a = rng.randn(2, 3).astype("float32")
+        out = paddle.ops.manipulation.pad(paddle.to_tensor(a),
+                                          [1, 1, 2, 2])
+        assert list(out.shape) == [4, 7]
+
+
+class TestActivation:
+    @pytest.mark.parametrize("fn,ref", [
+        (F.relu, lambda x: np.maximum(x, 0)),
+        (F.sigmoid, lambda x: 1 / (1 + np.exp(-x))),
+        (F.softplus, lambda x: np.log1p(np.exp(x))),
+        (F.silu, lambda x: x / (1 + np.exp(-x))),
+    ])
+    def test_forward(self, fn, ref):
+        a = rng.randn(3, 4).astype("float32")
+        check_output(fn, ref, [a], rtol=1e-5)
+
+    def test_softmax(self):
+        a = rng.randn(3, 4).astype("float32")
+
+        def ref(x):
+            e = np.exp(x - x.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+
+        check_output(F.softmax, ref, [a], rtol=1e-5)
+        check_grad(F.softmax, [a])
+
+    def test_gelu_grad(self):
+        a = rng.randn(6).astype("float32")
+        check_grad(F.gelu, [a])
+
+
+class TestLoss:
+    def test_cross_entropy(self):
+        logits = rng.randn(4, 10).astype("float32")
+        labels = rng.randint(0, 10, (4,))
+
+        def ref(lg, lb):
+            e = np.exp(lg - lg.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            return -np.log(p[np.arange(4), lb]).mean()
+
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels))
+        np.testing.assert_allclose(float(out), ref(logits, labels),
+                                   rtol=1e-5)
+
+    def test_cross_entropy_grad(self):
+        logits = rng.randn(4, 6).astype("float32")
+        labels = rng.randint(0, 6, (4,))
+        check_grad(lambda x: F.cross_entropy(x, paddle.to_tensor(labels)),
+                   [logits])
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rng.randn(4, 6).astype("float32")
+        labels = np.array([1, -100, 3, -100])
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        expected = -np.log(p[[0, 2], [1, 3]]).mean()
+        np.testing.assert_allclose(float(out), expected, rtol=1e-5)
+
+    def test_mse(self):
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(3, 4).astype("float32")
+        check_output(F.mse_loss, lambda x, y: ((x - y) ** 2).mean(), [a, b],
+                     rtol=1e-5)
+
+    def test_bce_with_logits(self):
+        lg = rng.randn(8).astype("float32")
+        lb = (rng.rand(8) > 0.5).astype("float32")
+        out = F.binary_cross_entropy_with_logits(paddle.to_tensor(lg),
+                                                 paddle.to_tensor(lb))
+        p = 1 / (1 + np.exp(-lg))
+        ref = -(lb * np.log(p) + (1 - lb) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+
+class TestConvPool:
+    def test_conv2d_identity(self):
+        x = rng.randn(1, 1, 5, 5).astype("float32")
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        w[0, 0, 1, 1] = 1.0  # identity kernel
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+        np.testing.assert_allclose(out.numpy(), x, atol=1e-6)
+
+    def test_conv2d_vs_manual(self):
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32")
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), stride=2,
+                       padding=1)
+        assert list(out.shape) == [2, 4, 4, 4]
+        # spot-check one output element
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        ref = (xp[0, :, 0:3, 0:3] * w[1]).sum()
+        np.testing.assert_allclose(float(out.numpy()[0, 1, 0, 0]), ref,
+                                   rtol=1e-4)
+
+    def test_conv_grad(self):
+        x = rng.randn(1, 2, 5, 5).astype("float32")
+        w = rng.randn(3, 2, 3, 3).astype("float32")
+        check_grad(lambda a, b: F.conv2d(a, b, padding=1), [x, w],
+                   grad_idx=1, rtol=2e-2, atol=2e-3)
+
+    def test_max_pool(self):
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_avg_pool(self):
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+        out = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_adaptive_avg_pool(self):
+        x = rng.randn(1, 3, 8, 8).astype("float32")
+        out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+        np.testing.assert_allclose(out.numpy()[..., 0, 0],
+                                   x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+class TestNorm:
+    def test_layer_norm(self):
+        x = rng.randn(2, 3, 8).astype("float32")
+
+        def ref(a):
+            m = a.mean(-1, keepdims=True)
+            v = a.var(-1, keepdims=True)
+            return (a - m) / np.sqrt(v + 1e-5)
+
+        check_output(lambda a: F.layer_norm(a, 8), ref, [x], rtol=1e-4,
+                     atol=1e-5)
+        check_grad(lambda a: F.layer_norm(a, 8), [x], rtol=3e-2, atol=3e-3)
+
+    def test_batch_norm_train_stats(self):
+        x = rng.randn(4, 3, 5, 5).astype("float32")
+        out, mean, var = F.batch_norm_train(paddle.to_tensor(x))
+        np.testing.assert_allclose(mean.numpy(), x.mean(axis=(0, 2, 3)),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(out.numpy().mean(axis=(0, 2, 3)),
+                                   np.zeros(3), atol=1e-5)
