@@ -1,0 +1,93 @@
+"""Flagship benchmark: GPT pretraining step throughput + MFU on the local
+chip. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = achieved MFU / 0.40 (the north-star ERNIE-3.0 target from
+BASELINE.md; >1.0 beats the target)."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+PEAKS_BF16 = {  # dense bf16 TFLOP/s per chip
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
+    "TPU v6 lite": 918e12, "TPU v6e": 918e12, "TPU v5p": 459e12,
+    "cpu": 1e12,  # nominal, so CPU smoke runs produce a number
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "") or ""
+    for name, val in PEAKS_BF16.items():
+        if kind.lower().startswith(name.lower()) or name.lower() in kind.lower():
+            return val
+    return 197e12 if device.platform == "tpu" else 1e12
+
+
+def main():
+    import os
+    import jax
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models.gpt import gpt
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    # sized to fit one v5e chip (16GB HBM) in bf16 with fp32 masters
+    if on_tpu:
+        name, batch, seq = "gpt2-small", 16, 1024
+    else:  # CPU smoke config
+        name, batch, seq = "test-tiny", 2, 64
+
+    paddle.seed(0)
+    model = gpt(name, max_position_embeddings=seq)
+    model.bfloat16() if on_tpu else None
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=on_tpu)
+    step = paddle.jit.TrainStep(
+        model, opt, lambda logits, labels: model.loss(logits, labels))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, model.cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(ids.astype(np.int64))
+
+    # warmup (compile). Sync via host transfer of the loss: on the axon
+    # remote tunnel block_until_ready can acknowledge before execution
+    # completes, and donated param buffers alias inputs — float() is the
+    # only reliable fence.
+    loss = step(x, y)
+    float(loss)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    flops_per_token = model.flops_per_token(seq)
+    achieved = tokens_per_sec * flops_per_token
+    mfu = achieved / peak_flops(dev)
+
+    print(json.dumps({
+        "metric": f"{name} train tokens/sec/chip (b{batch} s{seq}, "
+                  f"MFU={mfu:.3f}, loss={float(loss):.3f}, "
+                  f"device={dev.device_kind})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+from . import collective  # noqa: F401
+from . import fleet  # noqa: F401
+from . import topology  # noqa: F401
+from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
+                         all_to_all, alltoall_single, broadcast,
+                         reduce_scatter, scatter)
+from .env import (ParallelEnv, barrier, get_rank, get_world_size,  # noqa: F401
+                  init_parallel_env, is_initialized)
+from .parallel import mp_layers, random, recompute, sharding  # noqa: F401
+from .parallel.mp_layers import (ColumnParallelLinear,  # noqa: F401
+                                 ParallelCrossEntropy, RowParallelLinear,
+                                 VocabParallelEmbedding)
+from .parallel.random import get_rng_state_tracker  # noqa: F401
+from .parallel.recompute import RecomputeWrapper, recompute  # noqa: F401
+from .parallel.sharding import (ShardingStrategy,  # noqa: F401
+                                group_sharded_parallel)
+from .topology import (HybridCommunicateGroup, create_mesh,  # noqa: F401
+                       get_hybrid_communicate_group, get_mesh,
+                       set_hybrid_communicate_group)
+
+alltoall = all_to_all

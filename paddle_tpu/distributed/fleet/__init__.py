@@ -1,0 +1,4 @@
+from .base import (DistributedStrategy, distributed_model,  # noqa: F401
+                   distributed_optimizer, get_hybrid_communicate_group,
+                   init, worker_index, worker_num)
+from .train_step import DistributedTrainStep, shard_model  # noqa: F401

@@ -1,0 +1,184 @@
+"""DistributedTrainStep: the hybrid-parallel fused training step.
+
+This is where the reference's whole distributed runtime collapses into one
+XLA program: Reducer grad bucketing+allreduce (imperative/reducer.cc:451),
+sharding stage1/2/3 reduce-scatter/all-gather (group_sharded_stage2/3.py),
+TP collectives (mp_ops.py), and comm/compute overlap (ProcessGroupNCCL
+comm streams) are ALL emitted by XLA's SPMD partitioner + latency-hiding
+scheduler from the shardings declared here:
+
+  params:    per-layer spec (mp) composed with ZeRO stage>=3 (sharding)
+  grads:     constrained to ZeRO stage>=2 specs (reduce-scatter fusion)
+  opt state: ZeRO stage>=1 specs
+  batch:     sharded over (dp, sharding) on dim 0
+  loss mean: global psum inserted automatically by the partitioner
+
+Gradient accumulation (the reference's gradient_merge /
+GradientMergeOptimizer) is a lax.scan over microbatches inside the same
+program.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...jit.api import functional_call, _unwrap, _wrap
+from ...nn.layer import Layer
+from .. import topology
+from ..parallel.sharding import ShardingStrategy
+
+DATA_AXES = ("dp", "sharding")  # batch dim shards over both (ZeRO axes
+# are data-parallel axes too — fleet's sharding group is a dp subgroup)
+
+
+def _param_base_spec(p) -> P:
+    return getattr(p, "spec", P())
+
+
+def shard_model(model: Layer, mesh: Optional[Mesh] = None,
+                strategy: Optional[ShardingStrategy] = None):
+    """Place every parameter according to its spec (+ ZeRO stage 3).
+    ≈ the initial broadcast/partition pass of DataParallel/stage3."""
+    mesh = mesh or topology.get_mesh()
+    if mesh is None:
+        return model
+    strategy = strategy or ShardingStrategy(stage=0)
+    for _, p in model.named_parameters():
+        spec = strategy.param_spec(tuple(p.data.shape), mesh,
+                                   _param_base_spec(p))
+        p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+    for _, b in model.named_buffers():
+        b._data = jax.device_put(b._data, NamedSharding(mesh, P()))
+    return model
+
+
+class DistributedTrainStep:
+    """Sharded, donated, fused train step over the active hybrid mesh.
+
+    loss_fn(outputs, labels) -> scalar mean loss over the GLOBAL batch.
+    accumulate_steps>1 runs gradient accumulation as an in-program scan
+    over leading-dim microbatches (inputs get an extra leading dim).
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn: Callable,
+                 mesh: Optional[Mesh] = None, donate: bool = True,
+                 accumulate_steps: int = 1):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or topology.get_mesh()
+        if self.mesh is None:
+            raise RuntimeError("No mesh: call fleet.init(strategy) first")
+        self.strategy: ShardingStrategy = getattr(
+            optimizer, "_sharding_strategy", ShardingStrategy(stage=0))
+        self.accumulate_steps = accumulate_steps
+
+        shard_model(model, self.mesh, self.strategy)
+        self._params = [p for _, p in model.named_parameters()]
+        self._param_names = [n for n, _ in model.named_parameters()]
+
+        m, s = self.mesh, self.strategy
+        self._param_shardings = [
+            NamedSharding(m, s.param_spec(tuple(p.data.shape), m,
+                                          _param_base_spec(p)))
+            for p in self._params]
+        self._grad_specs = [
+            s.grad_spec(tuple(p.data.shape), m, _param_base_spec(p))
+            for p in self._params]
+        self._opt_state_tree = None
+        self._jitted = None
+
+    # ----------------------------------------------------------------- build
+    def _build(self, batch_ndims):
+        m = self.mesh
+        names = self._param_names
+        grad_specs = self._grad_specs
+        acc = self.accumulate_steps
+        loss_fn = self.loss_fn
+        model = self.model
+        opt = self.optimizer
+
+        def loss_of(pvals, *batch):
+            pdict = dict(zip(names, pvals))
+            out = functional_call(model, pdict, *[Tensor(b) if
+                                                  isinstance(b, jax.Array)
+                                                  else b for b in batch[:-1]])
+            loss = loss_fn(out, _wrap(batch[-1]))
+            return _unwrap(loss)
+
+        def grads_of(pvals, *batch):
+            loss, grads = jax.value_and_grad(loss_of)(list(pvals), *batch)
+            grads = [
+                jax.lax.with_sharding_constraint(
+                    g, NamedSharding(m, spec))
+                for g, spec in zip(grads, grad_specs)]
+            return loss, grads
+
+        def step_fn(param_vals, opt_state, lr, step_no, *batch):
+            if acc == 1:
+                loss, grads = grads_of(param_vals, *batch)
+            else:
+                # microbatch scan: batch elems have leading dim acc
+                def body(carry, micro):
+                    l_acc, g_acc = carry
+                    l, g = grads_of(param_vals, *micro)
+                    return (l_acc + l,
+                            [a + b for a, b in zip(g_acc, g)]), None
+
+                zero_g = [jnp.zeros_like(p) for p in param_vals]
+                (loss, grads), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), zero_g), batch)
+                loss = loss / acc
+                grads = [g / acc for g in grads]
+            new_params, new_state = opt.apply_gradients(
+                list(param_vals), grads, opt_state, lr=lr, step=step_no)
+            return loss, new_params, new_state
+
+        donate = (0, 1)
+        self._jitted = jax.jit(
+            step_fn, donate_argnums=donate,
+            out_shardings=(NamedSharding(m, P()),
+                           self._param_shardings, None))
+
+    # ------------------------------------------------------------------ call
+    def _shard_batch(self, arr):
+        nd = arr.ndim
+        lead = 1 if self.accumulate_steps > 1 else 0
+        parts = [None] * nd
+        if nd > lead:
+            parts[lead] = DATA_AXES
+        return jax.device_put(arr, NamedSharding(self.mesh, P(*parts)))
+
+    def __call__(self, *batch):
+        params = self._params
+        if self._opt_state_tree is None:
+            m, s = self.mesh, self.strategy
+            self._opt_state_tree = []
+            for p in params:
+                st = self.optimizer.init_state_for(p)
+                st = {k: (jax.device_put(
+                    v, NamedSharding(m, s.opt_state_spec(
+                        tuple(v.shape), m, _param_base_spec(p))))
+                    if v is not None else None)
+                    for k, v in st.items()}
+                self._opt_state_tree.append(st)
+        if self._jitted is None:
+            self._build(tuple(getattr(b, "ndim", 0) for b in batch))
+        raw_batch = tuple(self._shard_batch(_unwrap(b)) for b in batch)
+        lr = self.optimizer.get_lr()
+        self.optimizer._step_count += 1
+        loss, new_vals, self._opt_state_tree = self._jitted(
+            [p._data for p in params], self._opt_state_tree,
+            np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)
+        for p, v in zip(params, new_vals):
+            p._data = v
+        from ...optimizer.lr import LRScheduler
+        if isinstance(self.optimizer._lr, LRScheduler) and \
+                self.optimizer._lr._step_each_iter:
+            self.optimizer._lr.step()
+        return _wrap(loss)

@@ -1,0 +1,77 @@
+"""TP RNG state tracker.
+
+Reference analog: python/paddle/distributed/fleet/layers/mpu/random.py —
+RNGStatesTracker keeps per-name generator states so dropout inside
+model-parallel regions differs per mp rank while replicated regions match.
+
+Functional jax version: a tracker maps name -> base key; `get_states_
+tracker().rng_state('local_seed')` yields a key folded with the mesh
+position along the given axes (different per mp shard), while
+'global_seed' yields the unfolded key (same everywhere). Inside shard_map
+the fold uses jax.lax.axis_index so it traces correctly.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Sequence
+
+import jax
+
+_MODEL_PARALLEL_RNG = "model_parallel_rng"
+_GLOBAL_RNG = "global_seed"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states.clear()
+
+    def add(self, name: str, seed: int):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = jax.random.PRNGKey(seed)
+
+    def get_states(self):
+        return dict(self.states)
+
+    def set_states(self, states):
+        self.states = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = _MODEL_PARALLEL_RNG,
+                  fold_axes: Sequence[str] = ("mp",)):
+        """Context yielding a key; folded per mesh position for
+        model-parallel names so parallel dropout masks differ per shard."""
+        if name not in self.states:
+            import zlib
+            # stable across processes (hash() is PYTHONHASHSEED-randomized,
+            # which would silently desync dp replicas across hosts)
+            self.add(name, zlib.crc32(name.encode()) % (2 ** 31))
+        key = self.states[name]
+        if name != _GLOBAL_RNG:
+            for ax in fold_axes:
+                try:
+                    key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+                except NameError:
+                    pass  # axis not bound (not inside shard_map) -> global
+        # split so repeated entries differ
+        self.states[name], sub = jax.random.split(self.states[name])
+        yield jax.random.fold_in(sub, 0) if name == _GLOBAL_RNG else \
+            jax.random.fold_in(key, 1)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 1234):
+    """≈ mpu.random.model_parallel_random_seed: seed global + local
+    streams."""
+    _TRACKER.reset()
+    _TRACKER.add(_GLOBAL_RNG, seed)
+    _TRACKER.add(_MODEL_PARALLEL_RNG, seed + 1024)

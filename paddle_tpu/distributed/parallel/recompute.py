@@ -1,0 +1,85 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference analog: python/paddle/distributed/fleet/recompute/recompute.py
+:224 (RecomputeFunction PyLayer re-running forward with RNG restore),
+:386 (recompute entry). TPU-native: jax.checkpoint (remat) — XLA re-emits
+the forward in the backward pass; RNG is functional so no state juggling.
+Policies map to jax.checkpoint_policies (e.g. save matmul outputs ≈ the
+reference's selective offload)."""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ...core.tensor import Tensor, dispatch
+from ...nn.layer import Layer
+
+_POLICIES = {
+    None: None,
+    "full": None,  # save nothing, recompute everything
+    "save_dots": jax.checkpoint_policies.dots_saveable,
+    "save_dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def recompute(function: Callable, *args, policy=None, **kwargs):
+    """≈ fleet.recompute: run `function` without saving intermediates;
+    recompute them in backward. When `function` is a Layer its parameters
+    become explicit tape inputs (via functional_call) so eager backward
+    differentiates through the remat region."""
+    if isinstance(function, Layer):
+        names = [n for n, _ in function.named_parameters()]
+        params = [p for _, p in function.named_parameters()]
+        n_args = len(args)
+
+        def raw_fn(*raw):
+            raw_args, raw_params = raw[:n_args], raw[n_args:]
+            from ...jit.api import functional_call
+            out = functional_call(function, dict(zip(names, raw_params)),
+                                  *[_maybe_tensor(a) for a in raw_args],
+                                  **kwargs)
+            return _unwrap_tree(out)
+
+        ckpt_fn = jax.checkpoint(raw_fn,
+                                 policy=_POLICIES.get(policy, policy))
+        return dispatch("recompute", ckpt_fn, tuple(args) + tuple(params),
+                        {})
+
+    ckpt_fn = jax.checkpoint(
+        lambda *raw: _raw_call(function, raw, kwargs),
+        policy=_POLICIES.get(policy, policy))
+    return dispatch("recompute", ckpt_fn, args, {})
+
+
+def _maybe_tensor(a):
+    import jax as _jax
+    import numpy as _np
+    if isinstance(a, Tensor) or not isinstance(a, (_jax.Array, _np.ndarray)):
+        return a
+    return Tensor(a)
+
+
+def _raw_call(function, raw_args, kwargs):
+    targs = [_maybe_tensor(a) for a in raw_args]
+    out = function(*targs, **kwargs)
+    return _unwrap_tree(out)
+
+
+def _unwrap_tree(out):
+    return jax.tree_util.tree_map(
+        lambda t: t._data if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+class RecomputeWrapper(Layer):
+    """Wrap a sublayer so its forward is rematerialized (the PipelineLayer
+    per-chunk recompute analog, pp_layers.py:206)."""
+
+    def __init__(self, layer: Layer, policy=None):
+        super().__init__()
+        self.inner = layer
+        self.policy = policy
+
+    def forward(self, *args, **kwargs):
+        return recompute(self.inner, *args, policy=self.policy, **kwargs)

@@ -1,0 +1,122 @@
+"""Hybrid-parallel topology -> jax device Mesh.
+
+Reference analog: CommunicateTopology / HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py:26,50,136) builds the
+4-D process grid [dp, pp, sharding, mp] and carves NCCL sub-groups per
+axis. TPU-native: the grid IS a jax.sharding.Mesh with named axes; XLA
+emits the right ICI/DCN collectives from shardings, so "sub-groups" are
+just axis names. Axis order follows the scaling-book recipe: put the
+highest-traffic axis (mp/tp) innermost so it rides ICI neighbors; dp/pp
+outermost so their collectives tolerate DCN (the ProcessGroupHeter
+hierarchy, ProcessGroupHeter.h:128-134, falls out of this ordering for
+free on multi-slice).
+
+Axes: dp (data), sharding (ZeRO), pp (pipeline), sp (sequence/context —
+NEW capability, absent in the reference per SURVEY §5), ep (expert), mp
+(tensor). Degenerate axes (degree 1) are kept in the mesh so specs are
+uniform.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical axis order, outermost (DCN-tolerant) -> innermost (ICI-hungry)
+AXIS_ORDER = ("dp", "sharding", "pp", "sp", "ep", "mp")
+
+
+class HybridCommunicateGroup:
+    """Builds and owns the device mesh for hybrid parallelism."""
+
+    def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
+                 pp_degree: int = 1, sharding_degree: int = 1,
+                 sp_degree: int = 1, ep_degree: int = 1,
+                 devices: Optional[Sequence] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        degrees = {"dp": dp_degree, "sharding": sharding_degree,
+                   "pp": pp_degree, "sp": sp_degree, "ep": ep_degree,
+                   "mp": mp_degree}
+        total = int(np.prod(list(degrees.values())))
+        if total == 0:
+            raise ValueError("degrees must be positive")
+        if total != len(devices):
+            rest = int(np.prod([degrees[a] for a in AXIS_ORDER
+                                if a != "dp"]))
+            if degrees["dp"] in (0, 1) and len(devices) % rest == 0:
+                # dp left at default: infer it to fill the device count
+                degrees["dp"] = len(devices) // rest
+                total = len(devices)
+            else:
+                # an explicitly requested layout that doesn't fit is an
+                # error, never silently overridden (paddle raises too)
+                raise ValueError(
+                    f"degree product {total} != {len(devices)} devices "
+                    f"(degrees={degrees}); adjust hybrid_configs")
+        self.degrees: Dict[str, int] = degrees
+        shape = [degrees[a] for a in AXIS_ORDER]
+        self.mesh = Mesh(np.array(devices).reshape(shape), AXIS_ORDER)
+
+    # --- paddle-parity accessors (fleet/base/topology.py API) -------------
+    def get_data_parallel_world_size(self) -> int:
+        return self.degrees["dp"]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.degrees["mp"]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.degrees["pp"]
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self.degrees["sharding"]
+
+    def get_sequence_parallel_world_size(self) -> int:
+        return self.degrees["sp"]
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self.degrees["ep"]
+
+    def topology(self):
+        return self.degrees
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod(list(self.degrees.values())))
+
+    def axis_names(self) -> List[str]:
+        return list(AXIS_ORDER)
+
+    def active_axes(self) -> List[str]:
+        return [a for a in AXIS_ORDER if self.degrees[a] > 1]
+
+    def __repr__(self):
+        return f"HybridCommunicateGroup({self.degrees})"
+
+
+_GLOBAL_HCG: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _GLOBAL_HCG
+    _GLOBAL_HCG = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _GLOBAL_HCG
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _GLOBAL_HCG.mesh if _GLOBAL_HCG is not None else None
+
+
+def create_mesh(axes: Dict[str, int],
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Free-form mesh builder for advanced users (jax-style)."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = tuple(axes.keys())
+    shape = [axes[n] for n in names]
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+    return Mesh(np.array(devices).reshape(shape), names)

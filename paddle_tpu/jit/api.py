@@ -186,9 +186,8 @@ class TrainStep:
     def __call__(self, *batch):
         params = [p for _, p in self.model.named_parameters()]
         if self._opt_state_tree is None:
-            self._opt_state_tree = [
-                self.optimizer._init_state(p.data.shape, p.data.dtype)
-                for p in params]
+            self._opt_state_tree = [self.optimizer.init_state_for(p)
+                                    for p in params]
         lr = self.optimizer.get_lr()
         self.optimizer._step_count += 1
         raw_batch = tuple(_unwrap(b) for b in batch)

@@ -1,0 +1,5 @@
+from . import gpt, resnet  # noqa: F401
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
+from .lenet import LeNet  # noqa: F401
+from .resnet import (resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152)

@@ -1,0 +1,207 @@
+"""GPT decoder-only transformer — the flagship pretraining model
+(BASELINE.json config #4; capability analog of the reference's
+auto_parallel_gpt_model.py test fixture and PaddleNLP GPT).
+
+TPU-first: every weight carries a PartitionSpec (mp on qkv/ffn out-dims,
+vocab on embedding) so the SAME model runs single-chip or hybrid
+dp×mp×sharding under DistributedTrainStep; attention goes through
+F.scaled_dot_product_attention (Pallas flash kernel for long seq);
+bf16-friendly throughout (fp32 layernorm accumulation)."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..distributed.parallel.mp_layers import sharded_constraint
+from ..distributed.parallel.recompute import recompute
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, LayerNorm, Linear
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None   # default 4*hidden
+    max_position_embeddings: int = 1024
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    tie_word_embeddings: bool = True
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def _linear(in_f, out_f, std, spec_w, spec_b=None, has_bias=True):
+    layer = Linear(in_f, out_f,
+                   weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)),
+                   bias_attr=None if has_bias else False)
+    layer.weight.spec = spec_w
+    if has_bias and layer.bias is not None:
+        layer.bias.spec = spec_b if spec_b is not None else P()
+    return layer
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h, nh = cfg.hidden_size, cfg.num_heads
+        self.num_heads = nh
+        self.head_dim = h // nh
+        std = cfg.initializer_range
+        # fused qkv, out-dim mp-sharded (column parallel)
+        self.qkv_proj = _linear(h, 3 * h, std, P(None, "mp"), P("mp"))
+        # out proj, in-dim mp-sharded (row parallel)
+        self.out_proj = _linear(h, h, std / math.sqrt(2 * cfg.num_layers),
+                                P("mp", None), P())
+        self.dropout_p = cfg.dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = sharded_constraint(qkv, P(("dp", "sharding"), None, "mp"))
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=True,
+            dropout_p=self.dropout_p, training=self.training)
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.fc1 = _linear(cfg.hidden_size, cfg.ffn_size, std,
+                           P(None, "mp"), P("mp"))
+        self.fc2 = _linear(cfg.ffn_size, cfg.hidden_size,
+                           std / math.sqrt(2 * cfg.num_layers),
+                           P("mp", None), P())
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.attn(self.ln1(x), attn_mask)
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        std = cfg.initializer_range
+        self.wte = Embedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)))
+        self.wte.weight.spec = P("mp", None)  # vocab-parallel
+        self.wpe = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)))
+        self.wpe.weight.spec = P()
+        self.drop = Dropout(cfg.dropout)
+        self.blocks = LayerList([GPTBlock(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size,
+                              epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None):
+        b, s = input_ids.shape
+        from .. import ops
+        pos = ops.creation.arange(s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = sharded_constraint(x, P(("dp", "sharding"), None, None))
+        x = self.drop(x)
+        for block in self.blocks:
+            if self.cfg.use_recompute and self.training:
+                x = recompute(block, x, attn_mask, policy="save_dots")
+            else:
+                x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = _linear(cfg.hidden_size, cfg.vocab_size,
+                                   cfg.initializer_range, P(None, "mp"),
+                                   has_bias=False)
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.gpt(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = F.linear(h, _transpose(self.gpt.wte.weight))
+        return sharded_constraint(
+            logits, P(("dp", "sharding"), None, "mp"))
+
+    def loss(self, logits, labels):
+        """Shifted LM loss (mean over non-shifted tokens)."""
+        shifted = logits[:, :-1, :]
+        targets = labels[:, 1:]
+        return F.cross_entropy(
+            shifted.reshape([-1, shifted.shape[-1]]),
+            targets.reshape([-1]))
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs/token (6N + attention term)."""
+        n = self.num_params()
+        att = 12 * self.cfg.num_layers * self.cfg.hidden_size * seq_len
+        return 6 * n + att
+
+
+def _transpose(w):
+    from .. import ops
+    return ops.linalg.t(w)
+
+
+# convenience configs (≈ PaddleNLP gpt2 sizes; 6.7B = BASELINE config #4)
+CONFIGS = {
+    "gpt2-small": GPTConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt2-medium": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-large": GPTConfig(hidden_size=1280, num_layers=36, num_heads=20),
+    "gpt3-6.7b": GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                           max_position_embeddings=2048),
+    "test-tiny": GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                           num_heads=4, max_position_embeddings=128),
+}
+
+
+def gpt(name: str = "gpt2-small", **overrides) -> GPTForCausalLM:
+    import dataclasses
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return GPTForCausalLM(cfg)

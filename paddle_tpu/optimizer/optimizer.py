@@ -55,6 +55,18 @@ class Optimizer:
     def _init_state(self, param_shape, param_dtype) -> Dict[str, Any]:
         return {}
 
+    def init_state_for(self, param_value) -> Dict[str, Any]:
+        """State for one param, with value-dependent slots (fp32 master
+        weights) materialized eagerly so the state pytree structure is
+        stable across steps (a lazily-filled None would retrigger jit
+        compilation on step 2)."""
+        arr = param_value.data if isinstance(param_value, Tensor) \
+            else param_value
+        st = self._init_state(arr.shape, arr.dtype)
+        if "master" in st and st["master"] is None:
+            st["master"] = arr.astype(jnp.float32)
+        return st
+
     def _update(self, p, g, state: Dict[str, Any], lr, step):
         """Pure update rule on raw arrays. Returns (new_p, new_state)."""
         raise NotImplementedError
