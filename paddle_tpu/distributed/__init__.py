@@ -6,7 +6,10 @@ from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
                          reduce_scatter, scatter)
 from .env import (ParallelEnv, barrier, get_rank, get_world_size,  # noqa: F401
                   init_parallel_env, is_initialized)
-from .parallel import mp_layers, random, recompute, sharding  # noqa: F401
+from .parallel import (mp_layers, pipeline, random, recompute,  # noqa: F401
+                       sharding)
+from .parallel.pipeline import (LayerDesc, PipelineLayer,  # noqa: F401
+                                PipelineParallel, SharedLayerDesc)
 from .parallel.mp_layers import (ColumnParallelLinear,  # noqa: F401
                                  ParallelCrossEntropy, RowParallelLinear,
                                  VocabParallelEmbedding)

@@ -53,7 +53,8 @@ def shard_model(model: Layer, mesh: Optional[Mesh] = None,
                                    _param_base_spec(p))
         p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
     for _, b in model.named_buffers():
-        b._data = jax.device_put(b._data, NamedSharding(mesh, P()))
+        b._data = jax.device_put(
+            b._data, NamedSharding(mesh, getattr(b, "spec", P())))
     return model
 
 

@@ -1,1 +1,1 @@
-from . import mp_layers, random, recompute, sharding  # noqa: F401
+from . import mp_layers, pipeline, random, recompute, sharding  # noqa: F401

@@ -111,10 +111,12 @@ class GPTBlock(Layer):
         return x
 
 
-class GPTModel(Layer):
+class GPTEmbeddings(Layer):
+    """Token + position embedding (+ dropout). Shared by the serial model
+    and the pipeline 'pre' segment (≈ PaddleNLP GPTEmbeddings)."""
+
     def __init__(self, cfg: GPTConfig):
         super().__init__()
-        self.cfg = cfg
         std = cfg.initializer_range
         self.wte = Embedding(
             cfg.vocab_size, cfg.hidden_size,
@@ -125,18 +127,38 @@ class GPTModel(Layer):
             weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)))
         self.wpe.weight.spec = P()
         self.drop = Dropout(cfg.dropout)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        from .. import ops
+        pos = ops.creation.arange(s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = sharded_constraint(x, P(("dp", "sharding"), None, None))
+        return self.drop(x)
+
+
+def _lm_logits(x, head, wte_weight):
+    """Final head dispatch (tied vs separate), with the output constraint.
+    Shared by GPTForCausalLM and GPTHeadPipe."""
+    if head is not None:
+        logits = head(x)
+    else:
+        logits = F.linear(x, _transpose(wte_weight))
+    return sharded_constraint(logits, P(("dp", "sharding"), None, "mp"))
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed = GPTEmbeddings(cfg)
         self.blocks = LayerList([GPTBlock(cfg)
                                  for _ in range(cfg.num_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size,
                               epsilon=cfg.layer_norm_epsilon)
 
     def forward(self, input_ids, attn_mask=None):
-        b, s = input_ids.shape
-        from .. import ops
-        pos = ops.creation.arange(s, dtype="int32")
-        x = self.wte(input_ids) + self.wpe(pos)
-        x = sharded_constraint(x, P(("dp", "sharding"), None, None))
-        x = self.drop(x)
+        x = self.embed(input_ids)
         for block in self.blocks:
             if self.cfg.use_recompute and self.training:
                 x = recompute(block, x, attn_mask, policy="save_dots")
@@ -159,12 +181,8 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, attn_mask=None):
         h = self.gpt(input_ids, attn_mask)
-        if self.lm_head is not None:
-            logits = self.lm_head(h)
-        else:
-            logits = F.linear(h, _transpose(self.gpt.wte.weight))
-        return sharded_constraint(
-            logits, P(("dp", "sharding"), None, "mp"))
+        return _lm_logits(h, self.lm_head,
+                          self.gpt.embed.wte.weight)
 
     def loss(self, logits, labels):
         """Shifted LM loss (mean over non-shifted tokens)."""
@@ -205,3 +223,52 @@ def gpt(name: str = "gpt2-small", **overrides) -> GPTForCausalLM:
     import dataclasses
     cfg = dataclasses.replace(CONFIGS[name], **overrides)
     return GPTForCausalLM(cfg)
+
+
+# ---------------------------------------------------------------- pipeline
+GPTEmbeddingPipe = GPTEmbeddings  # the 'pre' segment IS the embedding
+
+
+class GPTHeadPipe(Layer):
+    """'post' segment: final norm + (tied) LM head. Holds an unregistered
+    reference to the embedding for weight tying (the SharedLayerDesc
+    analog — values flow through the embedding's own name under
+    functional_call)."""
+
+    def __init__(self, cfg: GPTConfig, embed: Optional[GPTEmbeddings]):
+        super().__init__()
+        self.ln_f = LayerNorm(cfg.hidden_size,
+                              epsilon=cfg.layer_norm_epsilon)
+        self._embed_ref = [embed]
+        if embed is None:
+            self.head = _linear(cfg.hidden_size, cfg.vocab_size,
+                                cfg.initializer_range, P(None, "mp"),
+                                has_bias=False)
+        else:
+            self.head = None
+
+    def forward(self, x):
+        x = self.ln_f(x)
+        wte = self._embed_ref[0].wte.weight if self.head is None else None
+        return _lm_logits(x, self.head, wte)
+
+
+def gpt_pipe(name: str = "gpt2-small", num_stages: Optional[int] = None,
+             num_microbatches: Optional[int] = None, **overrides):
+    """Pipeline-parallel GPT: [embed | blocks... | norm+head] as a
+    PipelineLayer over the 'pp' mesh axis (≈ GPTForCausalLMPipe)."""
+    import dataclasses
+    from ..distributed.parallel.pipeline import PipelineLayer
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    embed = GPTEmbeddingPipe(cfg)
+    layers = ([embed] + [GPTBlock(cfg) for _ in range(cfg.num_layers)]
+              + [GPTHeadPipe(cfg, embed if cfg.tie_word_embeddings
+                             else None)])
+    model = PipelineLayer(
+        layers, num_stages=num_stages,
+        num_microbatches=num_microbatches,
+        use_recompute=cfg.use_recompute,
+        loss_fn=lambda logits, labels: GPTForCausalLM.loss(
+            None, logits, labels))
+    model.cfg = cfg
+    return model

@@ -1,0 +1,116 @@
+"""Pipeline-parallel tests on the 8-device CPU mesh.
+
+Golden comparison ≈ the reference's hybrid_parallel_pp_* tests
+(unittests/collective/fleet/hybrid_parallel_pp_embedding.py etc.): the
+pipelined model must produce the SAME forward/loss/updates as the serial
+model with identical weights — pipelining is a schedule, not a different
+computation."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.parallel.pipeline import (LayerDesc,
+                                                      PipelineLayer)
+from paddle_tpu.models.gpt import gpt, gpt_pipe
+
+
+@pytest.fixture
+def mesh_pp4():
+    hcg = fleet.init(strategy=fleet.DistributedStrategy(
+        hybrid_configs={"dp_degree": 2, "pp_degree": 4}))
+    yield hcg
+    dist.set_hybrid_communicate_group(None)
+
+
+def _copy_gpt_weights_to_pipe(serial, pipe):
+    """Map serial GPT state -> PipelineLayer state (stacked trunk)."""
+    import jax.numpy as jnp
+    sd = serial.state_dict()
+    tgt = pipe.state_dict()
+    # pre: embeddings
+    tgt["pre.0.wte.weight"].set_value(sd["gpt.embed.wte.weight"])
+    tgt["pre.0.wpe.weight"].set_value(sd["gpt.embed.wpe.weight"])
+    # post: final norm
+    tgt["post.0.ln_f.weight"].set_value(sd["gpt.ln_f.weight"])
+    tgt["post.0.ln_f.bias"].set_value(sd["gpt.ln_f.bias"])
+    # trunk: stack blocks along stage dim
+    n_layers = serial.cfg.num_layers
+    stages = pipe.num_stages
+    per = n_layers // stages
+    for name in pipe._unit_state_names:
+        # name like "0.ln1.weight" (index within stage) -> block index
+        idx, rest = name.split(".", 1)
+        stacked = []
+        for s in range(stages):
+            blk = s * per + int(idx)
+            stacked.append(sd[f"gpt.blocks.{blk}.{rest}"]._data)
+        reg = pipe._stacked_names[name]
+        tgt[reg].set_value(paddle.to_tensor(jnp.stack(stacked, axis=0)))
+
+
+def test_pipeline_forward_matches_serial(mesh_pp4):
+    paddle.seed(7)
+    serial = gpt("test-tiny", num_layers=4, tie_word_embeddings=True)
+    serial.eval()
+    pipe = gpt_pipe("test-tiny", num_layers=4, num_stages=4,
+                    num_microbatches=4, tie_word_embeddings=True)
+    pipe.eval()
+    _copy_gpt_weights_to_pipe(serial, pipe)
+
+    ids = np.random.RandomState(0).randint(0, 512, (8, 16)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    ref = serial(x).numpy()
+    out = pipe(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_train_step_matches_serial(mesh_pp4):
+    paddle.seed(7)
+    serial = gpt("test-tiny", num_layers=4)
+    pipe = gpt_pipe("test-tiny", num_layers=4, num_stages=4,
+                    num_microbatches=4)
+    _copy_gpt_weights_to_pipe(serial, pipe)
+
+    ids = np.random.RandomState(1).randint(0, 512, (8, 16)).astype(np.int32)
+    labels = ids.astype(np.int64)
+
+    # serial loss/grads on a 1-device view: compute loss value directly
+    x = paddle.to_tensor(ids)
+    serial.eval()
+    logits = serial(x)
+    ref_loss = float(serial.loss(logits, paddle.to_tensor(labels)))
+
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=pipe.parameters())
+    opt = fleet.distributed_optimizer(opt)
+    step = fleet.DistributedTrainStep(
+        pipe, opt, pipe.loss_fn)
+    pipe.eval()  # disable dropout for determinism (dropout=0 anyway)
+    loss = step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+    assert abs(float(loss) - ref_loss) < 2e-3, (float(loss), ref_loss)
+    # params actually changed
+    loss2 = step(paddle.to_tensor(ids), paddle.to_tensor(labels))
+    assert float(loss2) < float(loss)
+
+
+def test_pipeline_degenerate_single_stage():
+    # no mesh needed: num_stages=1 runs serially
+    pipe = gpt_pipe("test-tiny", num_layers=2, num_stages=1)
+    pipe.eval()
+    ids = np.random.RandomState(0).randint(0, 512, (2, 8)).astype(np.int32)
+    out = pipe(paddle.to_tensor(ids))
+    assert tuple(out.shape) == (2, 8, 512)
+
+
+def test_layerdesc_deferred_build():
+    d = LayerDesc(nn.Linear, 4, 4)
+    layer = d.build()
+    assert isinstance(layer, nn.Linear)
+
+
+def test_pipeline_rejects_bad_division(mesh_pp4):
+    with pytest.raises(ValueError):
+        gpt_pipe("test-tiny", num_layers=3, num_stages=4)
