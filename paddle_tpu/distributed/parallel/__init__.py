@@ -1,1 +1,4 @@
-from . import mp_layers, pipeline, random, recompute, sharding  # noqa: F401
+from . import (context_parallel, mp_layers, pipeline, random,  # noqa: F401
+               recompute, sharding)
+from .context_parallel import (ring_attention, split_sequence,  # noqa: F401
+                               ulysses_attention)

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the hot ops (≈ the reference's hand-fused CUDA in
+paddle/fluid/operators/fused/ + the KPS primitive layer
+paddle/phi/kernels/primitive/). Everything *not* in this package trusts XLA
+fusion; these kernels exist where fusion alone leaves performance on the
+table: flash attention (O(S) memory online softmax), fused layer/rms norm,
+and the fused AdamW parameter update.
+
+All kernels run compiled on TPU and fall back to Pallas interpreter mode on
+CPU so the unit tests validate identical code paths without hardware.
+"""
+from .flash_attention import flash_attention  # noqa: F401
+from .fused_norm import fused_layer_norm, fused_rms_norm  # noqa: F401
+from .fused_adamw import fused_adamw_update  # noqa: F401

@@ -1,0 +1,143 @@
+"""Fused LayerNorm / RMSNorm Pallas kernels (forward + input-grad backward).
+
+One VMEM pass computes mean/var/normalize/affine per row block (the
+reference hand-fuses this in phi's layer_norm_kernel.cu; XLA usually fuses
+it too — the Pallas version guarantees the single-pass fp32-accumulated
+form and is the swap-in for the hot transformer shapes).
+
+Backward: dx runs as a Pallas kernel (recomputing row statistics, flash
+style, instead of saving them); dweight/dbias are plain XLA reductions.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_DEF_BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    block = min(preferred, n)
+    while n % block:
+        block //= 2
+    return max(block, 1)
+
+
+def _stats(x, eps, rms):
+    if rms:
+        mean = jnp.zeros((x.shape[0], 1), x.dtype)
+        ms = jnp.mean(jnp.square(x), axis=1, keepdims=True)
+    else:
+        mean = jnp.mean(x, axis=1, keepdims=True)
+        ms = jnp.mean(jnp.square(x - mean), axis=1, keepdims=True)
+    return mean, jax.lax.rsqrt(ms + eps)
+
+
+def _fwd_kernel(x_ref, w_ref, b_ref, y_ref, *, eps, rms):
+    x = x_ref[:].astype(jnp.float32)
+    mean, rstd = _stats(x, eps, rms)
+    xhat = (x - mean) * rstd
+    y_ref[:] = (xhat * w_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, w_ref, dy_ref, dx_ref, *, eps, rms):
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    mean, rstd = _stats(x, eps, rms)
+    xhat = (x - mean) * rstd
+    g = dy * w_ref[:].astype(jnp.float32)
+    c2 = jnp.mean(g * xhat, axis=1, keepdims=True)
+    if rms:
+        dx = rstd * (g - xhat * c2)
+    else:
+        c1 = jnp.mean(g, axis=1, keepdims=True)
+        dx = rstd * (g - c1 - xhat * c2)
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _norm_2d_fwd_pallas(x2, w, b, eps, rms):
+    rows, cols = x2.shape
+    br = _pick_block(rows, _DEF_BLOCK_ROWS)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, rms=rms),
+        grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((1, cols), lambda i: (0, 0)),
+            pl.BlockSpec((1, cols), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x2.dtype),
+        interpret=_interpret(),
+    )(x2, w.reshape(1, cols), b.reshape(1, cols))
+
+
+def _norm_2d_dx_pallas(x2, w, dy2, eps, rms):
+    rows, cols = x2.shape
+    br = _pick_block(rows, _DEF_BLOCK_ROWS)
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, eps=eps, rms=rms),
+        grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+            pl.BlockSpec((1, cols), lambda i: (0, 0)),
+            pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x2.dtype),
+        interpret=_interpret(),
+    )(x2, w.reshape(1, cols), dy2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _norm(x, w, b, eps, rms):
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    return _norm_2d_fwd_pallas(x2, w, b, eps, rms).reshape(shape)
+
+
+def _norm_fwd(x, w, b, eps, rms):
+    return _norm(x, w, b, eps, rms), (x, w)
+
+
+def _norm_bwd(eps, rms, res, dy):
+    x, w = res
+    shape = x.shape
+    cols = shape[-1]
+    x2 = x.reshape(-1, cols)
+    dy2 = dy.reshape(-1, cols)
+    dx = _norm_2d_dx_pallas(x2, w, dy2, eps, rms).reshape(shape)
+    xf = x2.astype(jnp.float32)
+    mean, rstd = _stats(xf, eps, rms)
+    xhat = (xf - mean) * rstd
+    dyf = dy2.astype(jnp.float32)
+    dw = jnp.sum(dyf * xhat, axis=0).astype(w.dtype)
+    db = jnp.sum(dyf, axis=0).astype(w.dtype)
+    return dx, dw, db
+
+
+_norm.defvjp(_norm_fwd, _norm_bwd)
+
+
+def fused_layer_norm(x, weight=None, bias=None, epsilon=1e-5):
+    """LayerNorm over the last axis via a fused Pallas kernel."""
+    cols = x.shape[-1]
+    w = weight if weight is not None else jnp.ones((cols,), x.dtype)
+    b = bias if bias is not None else jnp.zeros((cols,), x.dtype)
+    return _norm(x, w, b, float(epsilon), False)
+
+
+def fused_rms_norm(x, weight=None, epsilon=1e-6):
+    """RMSNorm over the last axis via a fused Pallas kernel."""
+    cols = x.shape[-1]
+    w = weight if weight is not None else jnp.ones((cols,), x.dtype)
+    b = jnp.zeros((cols,), x.dtype)
+    return _norm(x, w, b, float(epsilon), True)

@@ -39,6 +39,8 @@ class GPTConfig:
     initializer_range: float = 0.02
     use_recompute: bool = False
     tie_word_embeddings: bool = True
+    sequence_parallel: bool = False   # shard seq dim over 'sp' +
+    # ring attention (NEW vs the reference — SURVEY §5 long-context story)
 
     @property
     def ffn_size(self) -> int:
@@ -68,16 +70,35 @@ class GPTAttention(Layer):
         self.out_proj = _linear(h, h, std / math.sqrt(2 * cfg.num_layers),
                                 P("mp", None), P())
         self.dropout_p = cfg.dropout
+        self.sequence_parallel = cfg.sequence_parallel
 
     def forward(self, x, attn_mask=None):
         b, s, h = x.shape
+        seq = "sp" if self.sequence_parallel else None
         qkv = self.qkv_proj(x)
-        qkv = sharded_constraint(qkv, P(("dp", "sharding"), None, "mp"))
+        qkv = sharded_constraint(qkv, P(("dp", "sharding"), seq, "mp"))
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=True,
-            dropout_p=self.dropout_p, training=self.training)
+        if self.sequence_parallel:
+            if attn_mask is not None:
+                raise ValueError(
+                    "sequence_parallel ring attention does not support an "
+                    "explicit attn_mask (causal only)")
+            if self.dropout_p > 0.0 and self.training:
+                raise ValueError(
+                    "sequence_parallel ring attention does not support "
+                    "attention dropout; set cfg.dropout = 0")
+            from ..core.tensor import dispatch
+            from ..distributed.parallel.context_parallel import \
+                ring_attention
+            out = dispatch(
+                "ring_attention",
+                lambda q_, k_, v_: ring_attention(q_, k_, v_, causal=True),
+                (q, k, v), {})
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=True,
+                dropout_p=self.dropout_p, training=self.training)
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 
@@ -127,13 +148,15 @@ class GPTEmbeddings(Layer):
             weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)))
         self.wpe.weight.spec = P()
         self.drop = Dropout(cfg.dropout)
+        self.sequence_parallel = cfg.sequence_parallel
 
     def forward(self, input_ids):
         b, s = input_ids.shape
         from .. import ops
         pos = ops.creation.arange(s, dtype="int32")
         x = self.wte(input_ids) + self.wpe(pos)
-        x = sharded_constraint(x, P(("dp", "sharding"), None, None))
+        seq = "sp" if getattr(self, "sequence_parallel", False) else None
+        x = sharded_constraint(x, P(("dp", "sharding"), seq, None))
         return self.drop(x)
 
 

@@ -1,0 +1,111 @@
+"""Context-parallel (ring / Ulysses) attention on the 8-device CPU mesh,
+compared against single-device dense attention (the reference's
+collective-test pattern: per-rank program vs numpy golden,
+unittests/collective/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.distributed.parallel import ring_attention, ulysses_attention
+from paddle_tpu.nn.functional.attention import _sdpa_xla
+
+
+def _mk_mesh(sp):
+    hcg = topology.HybridCommunicateGroup(
+        dp_degree=len(jax.devices()) // sp, sp_degree=sp)
+    topology.set_hybrid_communicate_group(hcg)
+    return hcg.mesh
+
+
+def _rand(*shape, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).standard_normal(shape)
+        .astype(np.float32) * 0.3)
+
+
+@pytest.fixture(autouse=True)
+def _restore_mesh():
+    prev = topology.get_hybrid_communicate_group()
+    yield
+    topology.set_hybrid_communicate_group(prev)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        _mk_mesh(sp=4)
+        b, s, h, d = 2, 64, 4, 16
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, causal=causal))(q, k, v)
+        ref = _sdpa_xla(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_grads_match_dense(self):
+        _mk_mesh(sp=4)
+        b, s, h, d = 1, 32, 2, 8
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(_sdpa_xla(q, k, v, is_causal=True) ** 2)
+
+        gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        gd = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_trivial_axis_fallback(self):
+        _mk_mesh(sp=1)
+        q, k, v = (_rand(1, 16, 2, 8, seed=i) for i in range(3))
+        out = ring_attention(q, k, v, causal=True)
+        ref = _sdpa_xla(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        _mk_mesh(sp=4)
+        b, s, h, d = 2, 64, 4, 16  # heads divisible by sp
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+        out = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, causal=causal))(q, k, v)
+        ref = _sdpa_xla(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_heads_not_divisible_raises(self):
+        _mk_mesh(sp=4)
+        q, k, v = (_rand(1, 32, 3, 8, seed=i) for i in range(3))
+        with pytest.raises(ValueError):
+            ulysses_attention(q, k, v)
+
+
+class TestGPTSequenceParallel:
+    def test_gpt_with_ring_attention_trains(self):
+        """GPT forward+backward with sp axis active end to end."""
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu import optimizer
+        strategy = fleet.DistributedStrategy(
+            hybrid_configs={"dp_degree": 2, "sp_degree": 4})
+        fleet.init(strategy=strategy)
+        paddle.seed(0)
+        model = gpt("test-tiny", sequence_parallel=True)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        step = fleet.DistributedTrainStep(
+            model, opt, lambda logits, labels: model.loss(logits, labels))
+        ids = np.random.RandomState(0).randint(0, 512, (4, 32)).astype(
+            np.int32)
+        loss = step(paddle.to_tensor(ids),
+                    paddle.to_tensor(ids.astype(np.int64)))
+        assert np.isfinite(float(loss))

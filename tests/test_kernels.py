@@ -1,0 +1,180 @@
+"""Pallas kernel tests: run in interpreter mode on CPU and compare against
+plain-XLA references (the reference's OpTest golden-comparison pattern,
+op_test.py:1533 style: same op through two execution paths + numeric grads).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import (
+    flash_attention, fused_adamw_update, fused_layer_norm, fused_rms_norm)
+from paddle_tpu.nn.functional.attention import _sdpa_xla
+
+
+def _rand(*shape, dtype=np.float32, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).standard_normal(shape).astype(dtype))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_xla(self, causal):
+        b, s, h, d = 2, 256, 2, 64
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+        out = flash_attention(q, k, v, causal=causal,
+                              block_q=128, block_k=128)
+        ref = _sdpa_xla(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_lengths(self):
+        q = _rand(1, 128, 2, 64, seed=0)
+        k = _rand(1, 256, 2, 64, seed=1)
+        v = _rand(1, 256, 2, 64, seed=2)
+        out = flash_attention(q, k, v, block_q=128, block_k=128)
+        ref = _sdpa_xla(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gqa(self):
+        q = _rand(1, 128, 4, 64, seed=0)
+        k = _rand(1, 128, 2, 64, seed=1)
+        v = _rand(1, 128, 2, 64, seed=2)
+        out = flash_attention(q, k, v, block_q=128, block_k=128)
+        kr = jnp.repeat(k, 2, axis=2)
+        vr = jnp.repeat(v, 2, axis=2)
+        ref = _sdpa_xla(q, kr, vr)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_xla(self, causal):
+        b, s, h, d = 1, 256, 2, 64
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=128, block_k=128)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = _sdpa_xla(q, k, v, is_causal=causal)
+            return jnp.sum(o * o)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_jit_and_multiblock(self):
+        # seq > block so the online-softmax accumulation loop runs >1 step
+        q, k, v = (_rand(1, 512, 1, 64, seed=i) for i in range(3))
+        f = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128))
+        out = f(q, k, v)
+        ref = _sdpa_xla(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestFusedNorm:
+    def test_layer_norm_matches(self):
+        x = _rand(4, 32, 256)
+        w = _rand(256, seed=1) * 0.1 + 1.0
+        b = _rand(256, seed=2) * 0.1
+        out = fused_layer_norm(x, w, b)
+        xf = x
+        mean = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), -1, keepdims=True)
+        ref = (xf - mean) / jnp.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_rms_norm_matches(self):
+        x = _rand(8, 256)
+        w = _rand(256, seed=1) * 0.1 + 1.0
+        out = fused_rms_norm(x, w)
+        ref = x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_layer_norm_grads(self):
+        x = _rand(16, 128)
+        w = _rand(128, seed=1) * 0.1 + 1.0
+        b = _rand(128, seed=2) * 0.1
+
+        def loss_fused(x, w, b):
+            return jnp.sum(jnp.square(fused_layer_norm(x, w, b)))
+
+        def loss_ref(x, w, b):
+            mean = jnp.mean(x, -1, keepdims=True)
+            var = jnp.mean(jnp.square(x - mean), -1, keepdims=True)
+            return jnp.sum(jnp.square(
+                (x - mean) / jnp.sqrt(var + 1e-5) * w + b))
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_rms_norm_grads(self):
+        x = _rand(16, 128)
+        w = _rand(128, seed=1) * 0.1 + 1.0
+
+        def loss_fused(x, w):
+            return jnp.sum(jnp.square(fused_rms_norm(x, w)))
+
+        def loss_ref(x, w):
+            return jnp.sum(jnp.square(
+                x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w))
+
+        gf = jax.grad(loss_fused, argnums=(0, 1))(x, w)
+        gr = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       atol=1e-4, rtol=1e-4)
+
+
+class TestFusedAdamW:
+    def test_matches_reference_update(self):
+        shape = (130, 7)  # deliberately unaligned → exercises padding
+        p = _rand(*shape, seed=0)
+        g = _rand(*shape, seed=1)
+        m = jnp.zeros(shape, jnp.float32)
+        v = jnp.zeros(shape, jnp.float32)
+        lr, b1, b2, eps, wd = 1e-3, 0.9, 0.999, 1e-8, 0.01
+        new_p, new_m, new_v = p, m, v
+        for step in (1, 2, 3):
+            new_p, new_m, new_v = fused_adamw_update(
+                new_p, g, new_m, new_v, lr, b1, b2, eps, wd, step)
+        # reference loop
+        rp, rm, rv = np.asarray(p), np.zeros(shape, np.float32), \
+            np.zeros(shape, np.float32)
+        gn = np.asarray(g)
+        for step in (1, 2, 3):
+            rm = b1 * rm + (1 - b1) * gn
+            rv = b2 * rv + (1 - b2) * gn * gn
+            mh = rm / (1 - b1 ** step)
+            vh = rv / (1 - b2 ** step)
+            rp = rp - lr * (mh / (np.sqrt(vh) + eps) + wd * rp)
+        np.testing.assert_allclose(np.asarray(new_p), rp, atol=1e-5,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(new_m), rm, atol=1e-6,
+                                   rtol=1e-6)
+
+    def test_traced_lr_no_recompile(self):
+        p = _rand(64, seed=0)
+        g = _rand(64, seed=1)
+        m = jnp.zeros((64,), jnp.float32)
+        v = jnp.zeros((64,), jnp.float32)
+
+        @jax.jit
+        def step(p, g, m, v, lr, t):
+            return fused_adamw_update(p, g, m, v, lr, 0.9, 0.999, 1e-8,
+                                      0.0, t)
+        p1, m1, v1 = step(p, g, m, v, jnp.float32(1e-3), jnp.float32(1))
+        p2, _, _ = step(p1, g, m1, v1, jnp.float32(5e-4), jnp.float32(2))
+        assert np.all(np.isfinite(np.asarray(p2)))
