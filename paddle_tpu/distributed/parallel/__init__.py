@@ -1,4 +1,5 @@
-from . import (context_parallel, mp_layers, pipeline, random,  # noqa: F401
-               recompute, sharding)
+from . import (context_parallel, moe, mp_layers, pipeline,  # noqa: F401
+               random, recompute, sharding)
 from .context_parallel import (ring_attention, split_sequence,  # noqa: F401
                                ulysses_attention)
+from .moe import MoEMLP, aux_loss as moe_aux_loss  # noqa: F401

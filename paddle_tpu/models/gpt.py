@@ -41,6 +41,19 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     sequence_parallel: bool = False   # shard seq dim over 'sp' +
     # ring attention (NEW vs the reference — SURVEY §5 long-context story)
+    moe_num_experts: int = 0          # >0: MoE FFN over the 'ep' axis
+    moe_gate: str = "gshard"
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+
+    def __post_init__(self):
+        if self.moe_num_experts > 0 and self.use_recompute:
+            # l_aux is carried out of the block as a layer attribute;
+            # jax.checkpoint would leak that tracer out of its scope
+            raise ValueError(
+                "moe_num_experts > 0 is not yet compatible with "
+                "use_recompute: the MoE aux loss cannot escape the "
+                "rematerialized block; disable one of the two")
 
     @property
     def ffn_size(self) -> int:
@@ -124,7 +137,14 @@ class GPTBlock(Layer):
         self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
         self.attn = GPTAttention(cfg)
         self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
-        self.mlp = GPTMLP(cfg)
+        if cfg.moe_num_experts > 0:
+            from ..distributed.parallel.moe import MoEMLP
+            self.mlp = MoEMLP(cfg.hidden_size, cfg.ffn_size,
+                              num_experts=cfg.moe_num_experts,
+                              gate=cfg.moe_gate,
+                              capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(cfg)
 
     def forward(self, x, attn_mask=None):
         x = x + self.attn(self.ln1(x), attn_mask)
@@ -208,12 +228,18 @@ class GPTForCausalLM(Layer):
                           self.gpt.embed.wte.weight)
 
     def loss(self, logits, labels):
-        """Shifted LM loss (mean over non-shifted tokens)."""
+        """Shifted LM loss (mean over non-shifted tokens) + MoE aux loss
+        when experts are active (read in the same trace as forward)."""
         shifted = logits[:, :-1, :]
         targets = labels[:, 1:]
-        return F.cross_entropy(
+        ce = F.cross_entropy(
             shifted.reshape([-1, shifted.shape[-1]]),
             targets.reshape([-1]))
+        if self is not None and getattr(self, "cfg", None) is not None \
+                and self.cfg.moe_num_experts > 0:
+            from ..distributed.parallel.moe import aux_loss
+            ce = ce + self.cfg.moe_aux_weight * aux_loss(self)
+        return ce
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
@@ -283,6 +309,13 @@ def gpt_pipe(name: str = "gpt2-small", num_stages: Optional[int] = None,
     import dataclasses
     from ..distributed.parallel.pipeline import PipelineLayer
     cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    if cfg.moe_num_experts > 0:
+        # per-stage aux-loss collection across the pp shard_map stages is
+        # not wired yet; fail loudly rather than silently dropping the
+        # load-balancing loss
+        raise NotImplementedError(
+            "MoE inside the pipeline-parallel GPT is not supported yet; "
+            "use the serial gpt() model with ep/dp/mp axes instead")
     embed = GPTEmbeddingPipe(cfg)
     layers = ([embed] + [GPTBlock(cfg) for _ in range(cfg.num_layers)]
               + [GPTHeadPipe(cfg, embed if cfg.tie_word_embeddings
