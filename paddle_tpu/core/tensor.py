@@ -26,6 +26,7 @@ import numpy as np
 
 from . import dtype as dtype_mod
 from . import flags
+from . import prof_hook
 
 __all__ = [
     "Tensor", "Parameter", "to_tensor", "is_grad_enabled", "no_grad",
@@ -441,7 +442,21 @@ def dispatch(name: str, impl: Callable, args: tuple, kwargs: dict,
 
     Eager + grad-enabled + differentiable inputs  -> record via jax.vjp.
     Otherwise (no_grad, tracing, int ops)         -> plain call.
+
+    When a Profiler records, every dispatch is wrapped in an op span (the
+    executors' RecordEvent instrumentation in the reference).
     """
+    if prof_hook.enabled:
+        prof_hook.begin(("op::" + name).encode())
+        try:
+            return _dispatch_body(name, impl, args, kwargs, differentiable)
+        finally:
+            prof_hook.end()
+    return _dispatch_body(name, impl, args, kwargs, differentiable)
+
+
+def _dispatch_body(name: str, impl: Callable, args: tuple, kwargs: dict,
+                   differentiable: bool = True):
     tree = (args, kwargs)
     leaves, treedef = jax.tree_util.tree_flatten(
         tree, is_leaf=lambda x: isinstance(x, Tensor))

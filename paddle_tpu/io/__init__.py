@@ -1,4 +1,5 @@
 from .dataloader import DataLoader  # noqa: F401
+from .token_loader import TokenLoader  # noqa: F401
 from .dataset import (ChainDataset, ComposeDataset, Dataset,  # noqa: F401
                       IterableDataset, Subset, TensorDataset, random_split)
 from .sampler import (BatchSampler, DistributedBatchSampler,  # noqa: F401

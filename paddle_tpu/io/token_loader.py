@@ -1,0 +1,108 @@
+"""TokenLoader: high-throughput LM pretraining input pipeline backed by
+the native C++ feeder (paddle_tpu/native/token_feeder.cc — the
+data_feed.cc / DataLoader-worker analog), with a pure-Python fallback.
+
+Feeds fixed [batch, seq_len+1] int32 windows from a flat binary token
+corpus; shuffled per epoch, sharded across dp ranks. Iteration yields
+(input_ids, labels) where labels are input_ids shifted by one token —
+pair them with a per-position LM loss (for GPTForCausalLM.loss, which
+shifts internally, pass the same window as both arguments instead).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class TokenLoader:
+    def __init__(self, path: str, seq_len: int, batch_size: int,
+                 num_workers: int = 2, seed: int = 0,
+                 prefetch: int = 4, rank: int = 0, world_size: int = 1,
+                 drop_last: bool = True, use_native: Optional[bool] = None):
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self.path = path
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        self.num_workers = max(num_workers, 1)
+        self.seed = seed
+        self.prefetch = max(prefetch, 2)
+        self.rank = rank
+        self.world_size = max(world_size, 1)
+        self.drop_last = drop_last
+
+        from .. import native
+        self._lib = native.lib() if use_native in (None, True) else None
+        if use_native is True and self._lib is None:
+            raise RuntimeError("native feeder requested but unavailable")
+        self._handle = None
+        self._epoch = 0
+        if self._lib is not None:
+            self._handle = self._lib.pt_feeder_create(
+                path.encode(), seq_len, batch_size, self.num_workers,
+                seed, self.prefetch, rank, self.world_size,
+                1 if drop_last else 0)
+            if not self._handle:
+                raise RuntimeError(f"cannot map token file {path}")
+        else:
+            self._tokens = np.fromfile(path, dtype=np.int32)
+
+    # ------------------------------------------------------------- sizing
+    @property
+    def num_batches(self) -> int:
+        if self._handle:
+            return self._lib.pt_feeder_num_batches(self._handle)
+        total = self._tokens.size // (self.seq_len + 1)
+        mine = len(range(self.rank, total, self.world_size))
+        return mine // self.batch_size if self.drop_last else \
+            -(-mine // self.batch_size)
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    # ---------------------------------------------------------- iteration
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        if self._handle:
+            if self._epoch > 0:
+                self._lib.pt_feeder_next_epoch(self._handle)
+            self._epoch += 1
+            stride = self.seq_len + 1
+            while True:
+                out = np.empty((self.batch_size, stride), dtype=np.int32)
+                ok = self._lib.pt_feeder_next(
+                    self._handle,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+                if not ok:
+                    return
+                yield out[:, :-1].copy(), out[:, 1:].astype(np.int64)
+        else:
+            yield from self._py_iter()
+
+    def _py_iter(self):
+        stride = self.seq_len + 1
+        total = self._tokens.size // stride
+        rng = np.random.RandomState(
+            (self.seed + self._epoch) % (2 ** 31))
+        self._epoch += 1
+        order = rng.permutation(total)[self.rank::self.world_size]
+        nb = self.num_batches
+        for b in range(nb):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(idx) < self.batch_size:  # wrap the final partial batch
+                idx = np.concatenate(
+                    [idx, order[:self.batch_size - len(idx)]])
+            rows = np.stack([self._tokens[i * stride:(i + 1) * stride]
+                             for i in idx])
+            yield rows[:, :-1].copy(), rows[:, 1:].astype(np.int64)
+
+    def __del__(self):
+        h = getattr(self, "_handle", None)
+        if h:
+            try:
+                self._lib.pt_feeder_destroy(h)
+            except Exception:
+                pass
+            self._handle = None

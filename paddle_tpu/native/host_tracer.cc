@@ -1,0 +1,151 @@
+// Host-side event tracer: lock-free per-thread buffers with nanosecond
+// timestamps, drained into chrome-trace-ready records.
+//
+// Reference analog: paddle/fluid/platform/profiler/host_event_recorder.h
+// (HostEventRecorder's per-thread lock-free EventContainer feeding
+// HostTracer) — rebuilt here as a small C library bound via ctypes (no
+// pybind11 in the image). The Python profiler composes this host stream
+// with jax.profiler device traces.
+//
+// Concurrency model: each thread owns a ThreadBuffer (thread_local).
+// Registration of a new thread takes the registry mutex once; recording is
+// mutex-free. pt_collect() takes the mutex, swaps out completed events and
+// returns them in a flat struct array owned by a caller-freed arena.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t start_ns;
+  uint64_t end_ns;
+  uint64_t tid;
+  int64_t mem_bytes;  // optional memory-event payload (0 for spans)
+};
+
+struct ThreadBuffer {
+  std::vector<Event> events;
+  std::vector<Event> open;  // stack of in-flight spans
+  uint64_t tid = 0;
+};
+
+std::mutex g_registry_mu;
+std::vector<ThreadBuffer*> g_buffers;
+std::atomic<bool> g_enabled{false};
+std::atomic<uint64_t> g_next_tid{1};
+
+ThreadBuffer* local_buffer() {
+  thread_local ThreadBuffer* buf = nullptr;
+  if (buf == nullptr) {
+    buf = new ThreadBuffer();
+    buf->tid = g_next_tid.fetch_add(1);
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    g_buffers.push_back(buf);
+  }
+  return buf;
+}
+
+uint64_t now_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+// flat record handed across the C ABI; name is a pointer into the arena
+struct CollectedEvent {
+  const char* name;
+  uint64_t start_ns;
+  uint64_t end_ns;
+  uint64_t tid;
+  int64_t mem_bytes;
+};
+
+struct Arena {
+  std::vector<Event> events;           // owns strings
+  std::vector<CollectedEvent> flat;    // views into events
+};
+
+}  // namespace
+
+extern "C" {
+
+void pt_tracer_enable() { g_enabled.store(true); }
+void pt_tracer_disable() { g_enabled.store(false); }
+int pt_tracer_enabled() { return g_enabled.load() ? 1 : 0; }
+
+uint64_t pt_now_ns() { return now_ns(); }
+
+void pt_record_begin(const char* name) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* buf = local_buffer();
+  Event ev;
+  ev.name = name;
+  ev.start_ns = now_ns();
+  ev.end_ns = 0;
+  ev.tid = buf->tid;
+  ev.mem_bytes = 0;
+  buf->open.push_back(std::move(ev));
+}
+
+void pt_record_end() {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* buf = local_buffer();
+  if (buf->open.empty()) return;
+  Event ev = std::move(buf->open.back());
+  buf->open.pop_back();
+  ev.end_ns = now_ns();
+  buf->events.push_back(std::move(ev));
+}
+
+// instant event with an explicit payload (e.g. allocator stats)
+void pt_record_instant(const char* name, int64_t mem_bytes) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadBuffer* buf = local_buffer();
+  Event ev;
+  ev.name = name;
+  ev.start_ns = now_ns();
+  ev.end_ns = ev.start_ns;
+  ev.tid = buf->tid;
+  ev.mem_bytes = mem_bytes;
+  buf->events.push_back(std::move(ev));
+}
+
+// Drain all completed events. Returns an opaque arena; *out_events /
+// *out_count describe the flat array. Caller must pt_free_events().
+void* pt_collect(CollectedEvent** out_events, uint64_t* out_count) {
+  Arena* arena = new Arena();
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    for (ThreadBuffer* buf : g_buffers) {
+      for (Event& ev : buf->events) {
+        arena->events.push_back(std::move(ev));
+      }
+      buf->events.clear();
+    }
+  }
+  arena->flat.reserve(arena->events.size());
+  for (const Event& ev : arena->events) {
+    CollectedEvent ce;
+    ce.name = ev.name.c_str();
+    ce.start_ns = ev.start_ns;
+    ce.end_ns = ev.end_ns;
+    ce.tid = ev.tid;
+    ce.mem_bytes = ev.mem_bytes;
+    arena->flat.push_back(ce);
+  }
+  *out_events = arena->flat.data();
+  *out_count = arena->flat.size();
+  return arena;
+}
+
+void pt_free_events(void* arena) { delete static_cast<Arena*>(arena); }
+
+}  // extern "C"

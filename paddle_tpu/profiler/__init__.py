@@ -1,0 +1,33 @@
+"""Profiler subsystem.
+
+Reference analog: python/paddle/profiler/profiler.py (Profiler with
+scheduler states :74, export_chrome_tracing :210, RecordEvent
+instrumentation) over the C++ HostTracer/CudaTracer pair
+(paddle/fluid/platform/profiler/). Here:
+
+- host spans come from the native C++ lock-free recorder
+  (paddle_tpu/native/host_tracer.cc) with a pure-Python fallback;
+- device traces come from jax.profiler (XPlane → TensorBoard/Perfetto),
+  started/stopped by the same scheduler states;
+- op-level spans are emitted by core.tensor.dispatch through prof_hook
+  when a Profiler is recording (the reference hooks RecordEvent into its
+  executors the same way).
+
+Usage (reference API shape):
+
+    import paddle_tpu.profiler as profiler
+    p = profiler.Profiler(
+        targets=[profiler.ProfilerTarget.CPU],
+        scheduler=profiler.make_scheduler(closed=1, ready=1, record=3),
+        on_trace_ready=profiler.export_chrome_tracing('./log'))
+    p.start()
+    for it, batch in enumerate(loader()):
+        train_step(batch)
+        p.step()
+    p.stop()
+    p.summary()
+"""
+from .profiler import (Profiler, ProfilerResult, ProfilerState,  # noqa: F401
+                       ProfilerTarget, RecordEvent,
+                       export_chrome_tracing, make_scheduler)
+from .statistic import SortedKeys, summary_table  # noqa: F401

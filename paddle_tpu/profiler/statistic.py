@@ -1,0 +1,62 @@
+"""Event aggregation + summary table (≈ profiler_statistic.py's
+kernel/op summary views)."""
+from __future__ import annotations
+
+import enum
+from collections import defaultdict
+from typing import List, Optional
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    Calls = 3
+
+
+_UNIT = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+
+
+def aggregate(events: List[tuple]):
+    """events: [(name, start_ns, end_ns, tid, mem)] ->
+    {name: dict(calls, total_ns, avg_ns, min_ns, max_ns)}"""
+    stats = defaultdict(lambda: {"calls": 0, "total_ns": 0,
+                                 "min_ns": None, "max_ns": 0})
+    for name, start, end, _tid, _mem in events:
+        dur = max(end - start, 0)
+        s = stats[name]
+        s["calls"] += 1
+        s["total_ns"] += dur
+        s["max_ns"] = max(s["max_ns"], dur)
+        s["min_ns"] = dur if s["min_ns"] is None else min(s["min_ns"], dur)
+    for s in stats.values():
+        s["avg_ns"] = s["total_ns"] / max(s["calls"], 1)
+        s["min_ns"] = s["min_ns"] or 0
+    return dict(stats)
+
+
+def summary_table(events: List[tuple],
+                  sorted_by: Optional[SortedKeys] = None,
+                  time_unit: str = "ms") -> str:
+    stats = aggregate(events)
+    div = _UNIT[time_unit]
+    key = {
+        None: lambda kv: -kv[1]["total_ns"],
+        SortedKeys.CPUTotal: lambda kv: -kv[1]["total_ns"],
+        SortedKeys.CPUAvg: lambda kv: -kv[1]["avg_ns"],
+        SortedKeys.CPUMax: lambda kv: -kv[1]["max_ns"],
+        SortedKeys.Calls: lambda kv: -kv[1]["calls"],
+    }[sorted_by]
+    rows = sorted(stats.items(), key=key)
+    name_w = max([len(n) for n, _ in rows] + [8])
+    header = (f"{'Name':<{name_w}}  {'Calls':>7}  "
+              f"{'Total(' + time_unit + ')':>12}  "
+              f"{'Avg(' + time_unit + ')':>12}  "
+              f"{'Max(' + time_unit + ')':>12}")
+    lines = [header, "-" * len(header)]
+    for name, s in rows:
+        lines.append(
+            f"{name:<{name_w}}  {s['calls']:>7}  "
+            f"{s['total_ns'] / div:>12.4f}  {s['avg_ns'] / div:>12.4f}  "
+            f"{s['max_ns'] / div:>12.4f}")
+    return "\n".join(lines)
