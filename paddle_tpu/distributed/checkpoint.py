@@ -1,0 +1,185 @@
+"""Sharded, async, topology-aware checkpointing (orbax-backed).
+
+Reference analogs:
+- paddle.save/load object tier → framework_io.py (pickle).
+- Sharded/async distributed tier (this module): the reference's
+  per-stage/per-rank shard saves (group_sharded utils,
+  hybrid_parallel_pp_save_load tests) become orbax OCDBT checkpoints of
+  the GLOBAL arrays — every host writes only its addressable shards,
+  restore re-assembles under ANY new mesh/sharding.
+- Cross-strategy resharding (auto_parallel/converter.py: reshard a ckpt
+  saved under one parallel strategy into another) → `with_shardings` on
+  restore: orbax places each array straight into the requested
+  NamedSharding, so dp-saved → tp-restored "conversion" is a placement
+  argument, not a data shuffle pass.
+- Auto-checkpoint (fluid/incubate/checkpoint/auto_checkpoint.py:72:
+  epoch-granular transparent resume) → CheckpointManager(max_to_keep,
+  save_interval) + `resume()`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_raw_tree(obj):
+    """Tensors/np → jax arrays; containers preserved; scalars pass."""
+    if isinstance(obj, Tensor):
+        return obj._data
+    if isinstance(obj, (dict,)):
+        return {k: _to_raw_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_raw_tree(v) for v in obj]  # orbax prefers lists
+    return obj
+
+
+def _wrap_tree(obj):
+    if isinstance(obj, (jax.Array, np.ndarray)):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _wrap_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_wrap_tree(v) for v in obj]
+    return obj
+
+
+def _target_from_shardings(metadata, shardings):
+    """Abstract restore target: checkpoint metadata supplies shape/dtype,
+    the shardings tree supplies placement (the converter.py analog: each
+    leaf restores straight into the NEW strategy's sharding). The
+    shardings tree must cover the full checkpoint tree."""
+
+    metadata = getattr(metadata, "item_metadata", metadata)  # StepMetadata
+
+    def walk(sh, md_node):
+        if isinstance(sh, dict):
+            return {k: walk(v, md_node[k]) for k, v in sh.items()}
+        if isinstance(sh, (list, tuple)):
+            return [walk(v, md_node[i]) for i, v in enumerate(sh)]
+        return jax.ShapeDtypeStruct(tuple(md_node.shape), md_node.dtype,
+                                    sharding=sh)
+
+    return walk(shardings, metadata)
+
+
+class CheckpointManager:
+    """Epoch/step-granular async sharded checkpoints with retention.
+
+    Usage:
+        mgr = CheckpointManager(dir, max_to_keep=3, async_save=True)
+        mgr.save(step, {"model": model.state_dict(),
+                        "opt": opt.state_dict()})
+        ...
+        state = mgr.restore()                 # latest
+        state = mgr.restore(step=7)
+        mgr.wait()                            # block on in-flight saves
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ocp = ocp
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save)
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Dict[str, Any]) -> bool:
+        """Queues (async) or writes a checkpoint of the (possibly
+        sharded) state tree. Returns False if skipped by
+        save_interval_steps."""
+        args = self._ocp.args.StandardSave(_to_raw_tree(state))
+        return self._mgr.save(step, args=args)
+
+    def restore(self, step: Optional[int] = None, shardings=None):
+        """Restore a state tree; `shardings` (same tree structure, leaves
+        = NamedSharding) reshards on the fly — the cross-strategy
+        converter. Returns Tensors."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        if shardings is not None:
+            md = self._mgr.item_metadata(step)
+            target = _target_from_shardings(md, shardings)
+            args = self._ocp.args.StandardRestore(target)
+        else:
+            args = self._ocp.args.StandardRestore()
+        tree = self._mgr.restore(step, args=args)
+        return _wrap_tree(tree)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self.wait()
+        self._mgr.close()
+
+
+# ------------------------------------------------------- one-shot helpers
+
+def save_sharded(state: Dict[str, Any], path: str,
+                 async_save: bool = False):
+    """One-shot sharded save (paddle.save analog for distributed state:
+    every host writes its addressable shards; call from ALL hosts).
+    With async_save=True, returns the checkpointer — call its
+    wait_until_finished() before exiting."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, _to_raw_tree(state), force=True)
+    if not async_save:
+        ckptr.wait_until_finished()
+    return ckptr
+
+
+def load_sharded(path: str, shardings=None):
+    """One-shot restore; `shardings` reshards onto a new strategy
+    (must mirror the full checkpoint tree when given)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if shardings is not None:
+        target = _target_from_shardings(ckptr.metadata(path), shardings)
+        tree = ckptr.restore(path, target)
+    else:
+        tree = ckptr.restore(path)
+    return _wrap_tree(tree)
+
+
+def shardings_for_model(model, mesh=None, strategy=None):
+    """NamedSharding tree matching a model's state_dict under the active
+    mesh + ZeRO strategy — feed to restore(shardings=...) to convert a
+    checkpoint to this strategy (≈ auto_parallel/converter.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from . import topology
+    from .parallel.sharding import ShardingStrategy
+    mesh = mesh or topology.get_mesh()
+    if mesh is None:
+        return None
+    strategy = strategy or ShardingStrategy(stage=0)
+    out = {}
+    params = dict(model.named_parameters())
+    for name, t in model.state_dict().items():
+        base = getattr(t, "spec", None)
+        if name in params:
+            spec = strategy.param_spec(tuple(t.shape), mesh,
+                                       base if base is not None else P())
+        else:
+            spec = base if base is not None else P()
+        out[name] = NamedSharding(mesh, spec)
+    return out

@@ -161,10 +161,12 @@ class DistributedTrainStep:
             m, s = self.mesh, self.strategy
             self._opt_state_tree = []
             for p in params:
-                st = self.optimizer.init_state_for(p)
+                # seed from restored optimizer state when present
+                st = self.optimizer._state.get(id(p)) \
+                    or self.optimizer.init_state_for(p)
                 st = {k: (jax.device_put(
                     v, NamedSharding(m, s.opt_state_spec(
-                        tuple(v.shape), m, _param_base_spec(p))))
+                        tuple(jnp.shape(v)), m, _param_base_spec(p))))
                     if v is not None else None)
                     for k, v in st.items()}
                 self._opt_state_tree.append(st)
@@ -178,6 +180,8 @@ class DistributedTrainStep:
             np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)
         for p, v in zip(params, new_vals):
             p._data = v
+        for p, st in zip(params, self._opt_state_tree):
+            self.optimizer._state[id(p)] = st
         from ...optimizer.lr import LRScheduler
         if isinstance(self.optimizer._lr, LRScheduler) and \
                 self.optimizer._lr._step_each_iter:

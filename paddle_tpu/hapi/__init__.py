@@ -1,0 +1,5 @@
+"""High-level API (≈ python/paddle/hapi): Model.fit/evaluate/predict +
+callbacks."""
+from .callbacks import (Callback, EarlyStopping,  # noqa: F401
+                        LRSchedulerCallback, ModelCheckpoint, ProgBarLogger)
+from .model import Model  # noqa: F401

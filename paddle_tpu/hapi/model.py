@@ -1,0 +1,240 @@
+"""hapi.Model: the Keras-like high-level train/eval/predict loop.
+
+Reference analog: python/paddle/hapi/model.py:1009 (Model.fit :1149,
+evaluate, predict, save/load, prepare) — minus the static-graph adapter
+(capture is jax.jit here, always on: train_batch goes through the fused
+TrainStep, eval/predict through a jitted forward).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .. import framework_io
+from ..core.tensor import Tensor
+from ..io.dataloader import DataLoader
+from ..io.dataset import Dataset
+from ..jit.api import TrainStep, to_static
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import (Callback, CallbackList, EarlyStopping,
+                        LRSchedulerCallback, ModelCheckpoint, ProgBarLogger)
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """Wraps a Layer with train/eval/predict loops (paddle.Model API)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self._eval_fn = None
+        self._save_dir = None
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        if isinstance(loss, Layer):
+            self._loss = lambda out, lbl: loss(out, lbl)
+        else:
+            self._loss = loss
+        self._metrics = _as_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle.metric.Metric, "
+                                f"got {type(m)}")
+        if optimizer is not None and loss is not None:
+            self._train_step = TrainStep(self.network, optimizer,
+                                         self._loss)
+        self._eval_fn = to_static(self.network)
+        return self
+
+    # ------------------------------------------------------- batch methods
+    def train_batch(self, inputs, labels):
+        if self._train_step is None:
+            raise RuntimeError("call prepare(optimizer, loss) first")
+        self.network.train()
+        inputs = [_to_tensor(x) for x in _as_list(inputs)]
+        labels = [_to_tensor(x) for x in _as_list(labels)]
+        loss = self._train_step(*inputs, *labels)
+        return float(loss)
+
+    def eval_batch(self, inputs, labels):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _as_list(inputs)]
+        labels = [_to_tensor(x) for x in _as_list(labels)]
+        out = self._eval_fn(*inputs)
+        loss = self._loss(out, labels[0]) if self._loss else None
+        return out, (float(loss) if loss is not None else None)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_to_tensor(x) for x in _as_list(inputs)]
+        return self._eval_fn(*inputs)
+
+    # -------------------------------------------------------------- loops
+    def _loader(self, data, batch_size, shuffle):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data  # any iterable of (inputs, labels)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+            save_freq=1, verbose=1, shuffle=True, callbacks=None):
+        """≈ hapi model.py:1149 — epochs over train_data with optional
+        periodic eval, checkpointing, logging, early stopping."""
+        loader = self._loader(train_data, batch_size, shuffle)
+        eval_loader = self._loader(eval_data, batch_size, False)
+        self._save_dir = save_dir
+
+        cbs = CallbackList([ProgBarLogger(log_freq, verbose=verbose)]
+                           + _as_list(callbacks))
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cbs.append(LRSchedulerCallback())
+        cbs.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbs.set_params({"epochs": epochs, "steps": steps,
+                        "verbose": verbose})
+
+        cbs.on_train_begin()
+        stop = False
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            losses = []
+            for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                losses.append(loss)
+                cbs.on_train_batch_end(step, {"loss": loss})
+            logs = {"loss": float(np.mean(losses)) if losses else None}
+            cbs.on_epoch_end(epoch, logs)
+
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbs)
+                for cb in cbs.callbacks:
+                    if isinstance(cb, EarlyStopping) and cb.stopped:
+                        stop = True
+            if stop:
+                break
+        cbs.on_train_end()
+        return self
+
+    def _run_eval(self, loader, cbs):
+        cbs.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbs.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            out, loss = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(loss)
+            for m in self._metrics:
+                if hasattr(m, "compute"):
+                    m.update(m.compute(out, _as_list(labels)[0]))
+                else:
+                    m.update(out, _as_list(labels)[0])
+            cbs.on_eval_batch_end(step, {"loss": loss})
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        cbs.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, verbose=1, callbacks=None):
+        loader = self._loader(eval_data, batch_size, False)
+        cbs = CallbackList([ProgBarLogger(verbose=verbose)]
+                           + _as_list(callbacks))
+        cbs.set_model(self)
+        cbs.set_params({"verbose": verbose})
+        return self._run_eval(loader, cbs)
+
+    def predict(self, test_data, batch_size=1, stack_outputs=True):
+        loader = self._loader(test_data, batch_size, False)
+        outs = []
+        for batch in loader:
+            inputs = batch[0] if isinstance(batch, (list, tuple)) and \
+                len(batch) >= 1 else batch
+            out = self.predict_batch(inputs)
+            outs.append(np.asarray(out.numpy() if isinstance(out, Tensor)
+                                   else out))
+        if stack_outputs and outs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) == 2:
+            return batch[0], batch[1]
+        if isinstance(batch, (list, tuple)) and len(batch) > 2:
+            return list(batch[:-1]), batch[-1]
+        raise ValueError("batch must be (inputs, labels)")
+
+    # ------------------------------------------------------------- params
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None):
+        total = sum(int(np.prod(p.shape)) for p in
+                    self.network.parameters())
+        lines = [f"{'Layer':<40}{'Params':>12}", "-" * 52]
+        for name, sub in self.network.named_sublayers():
+            n = sum(int(np.prod(p.shape))
+                    for p in sub.parameters(include_sublayers=False))
+            if n:
+                lines.append(f"{name:<40}{n:>12}")
+        lines.append("-" * 52)
+        lines.append(f"{'Total params':<40}{total:>12}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": total}
+
+    # --------------------------------------------------------------- save
+    def save(self, path: str, training: bool = True):
+        """{path}.pdparams (+ {path}.pdopt when training) — the reference's
+        save layout (hapi model.py save)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework_io.save(self._optimizer.state_dict(),
+                              path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        state = framework_io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(framework_io.load(opt_path))
+        return self

@@ -186,8 +186,11 @@ class TrainStep:
     def __call__(self, *batch):
         params = [p for _, p in self.model.named_parameters()]
         if self._opt_state_tree is None:
-            self._opt_state_tree = [self.optimizer.init_state_for(p)
-                                    for p in params]
+            # seed from the optimizer's own state when present (e.g. a
+            # restored checkpoint via opt.set_state_dict) so resume works
+            self._opt_state_tree = [
+                self.optimizer._state.get(id(p))
+                or self.optimizer.init_state_for(p) for p in params]
         lr = self.optimizer.get_lr()
         self.optimizer._step_count += 1
         raw_batch = tuple(_unwrap(b) for b in batch)
@@ -196,6 +199,10 @@ class TrainStep:
             np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)
         for p, v in zip(params, new_vals):
             p._data = v
+        # mirror the functional state back so optimizer.state_dict()
+        # checkpoints the live accumulators
+        for p, st in zip(params, self._opt_state_tree):
+            self.optimizer._state[id(p)] = st
         from ..optimizer.lr import LRScheduler
         if isinstance(self.optimizer._lr, LRScheduler) and \
                 self.optimizer._lr._step_each_iter:

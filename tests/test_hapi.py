@@ -1,0 +1,117 @@
+"""hapi.Model tests (≈ the reference's test_model.py: fit/evaluate/
+predict loops, callbacks, checkpointing, early stopping)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi import (EarlyStopping, Model, ModelCheckpoint,
+                             ProgBarLogger)
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.nn import functional as F
+
+
+class ToyDataset(Dataset):
+    """Linearly separable 2-class problem; the labeling hyperplane is
+    fixed so different seeds draw train/eval splits of the SAME task."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.standard_normal((n, 8)).astype(np.float32)
+        w = np.random.RandomState(42).standard_normal((8,))
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _model():
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    m = Model(net)
+    m.prepare(
+        optimizer=optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+        loss=lambda out, lbl: F.cross_entropy(out, lbl),
+        metrics=Accuracy())
+    return m
+
+
+class TestModelFit:
+    def test_fit_converges_and_evaluates(self, capsys):
+        paddle.seed(0)
+        m = _model()
+        m.fit(ToyDataset(), eval_data=ToyDataset(seed=1), batch_size=16,
+              epochs=4, verbose=0)
+        logs = m.evaluate(ToyDataset(seed=1), batch_size=16, verbose=0)
+        assert logs["acc"] > 0.8, logs
+        assert logs["loss"] < 0.7
+
+    def test_predict_shapes(self):
+        paddle.seed(0)
+        m = _model()
+        out = m.predict(ToyDataset(n=32), batch_size=8)
+        assert out[0].shape == (32, 2)
+
+    def test_train_batch_scalar_loss(self):
+        paddle.seed(0)
+        m = _model()
+        ds = ToyDataset()
+        loss = m.train_batch(ds.x[:8], ds.y[:8])
+        assert np.isfinite(loss)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        m = _model()
+        ds = ToyDataset()
+        m.train_batch(ds.x[:16], ds.y[:16])
+        path = str(tmp_path / "ckpt" / "model")
+        m.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+
+        paddle.seed(123)
+        m2 = _model()
+        m2.load(path)
+        a = m.predict_batch(ds.x[:4]).numpy()
+        b = m2.predict_batch(ds.x[:4]).numpy()
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_model_checkpoint_callback(self, tmp_path):
+        paddle.seed(0)
+        m = _model()
+        save_dir = str(tmp_path / "ckpts")
+        m.fit(ToyDataset(n=32), batch_size=16, epochs=2, verbose=0,
+              save_dir=save_dir)
+        assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+        assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+
+    def test_early_stopping(self):
+        paddle.seed(0)
+        m = _model()
+        stopper = EarlyStopping(monitor="loss", patience=0, min_delta=10.0)
+        # min_delta so large that no improvement counts: stops after
+        # the second eval
+        epochs_run = []
+
+        class Spy(ProgBarLogger):
+            def on_epoch_begin(self, epoch, logs=None):
+                epochs_run.append(epoch)
+                super().on_epoch_begin(epoch, logs)
+
+        m.fit(ToyDataset(n=32), eval_data=ToyDataset(n=32, seed=1),
+              batch_size=16, epochs=10, verbose=0,
+              callbacks=[stopper, Spy(verbose=0)])
+        assert stopper.stopped
+        assert len(epochs_run) < 10
+
+    def test_summary_counts_params(self, capsys):
+        m = _model()
+        info = m.summary()
+        expect = 8 * 32 + 32 + 32 * 2 + 2
+        assert info["total_params"] == expect
