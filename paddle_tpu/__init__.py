@@ -55,6 +55,7 @@ from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
+from . import static  # noqa: F401,E402
 from .framework_io import load, save  # noqa: F401,E402
 from .jit.api import grad, value_and_grad  # noqa: F401,E402
 

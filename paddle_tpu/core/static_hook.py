@@ -1,0 +1,40 @@
+"""Static-graph build hook: a near-zero-cost global the op dispatcher
+checks so that, inside `paddle_tpu.static.program_guard`, op calls are
+recorded into the current Program instead of (only) executing eagerly.
+
+Reference analog: in static mode the reference's Python op wrappers call
+`LayerHelper.append_op`, mutating the current ProgramDesc
+(python/paddle/tensor/linalg.py:263); here the same effect is achieved by
+one recorder callback installed by the static module, keeping core.tensor
+free of an import cycle (same pattern as core.prof_hook).
+"""
+from __future__ import annotations
+
+enabled = False
+_recorder = None
+_count = 0  # guards may be active on several threads at once
+
+
+def enable(recorder):
+    """recorder(name, impl, treedef, leaves, raw_leaves) ->
+    (handled: bool, out).  When handled, `out` is the wrapped op output and
+    the dispatcher returns it as-is; when not handled (no operand belongs
+    to the program being built) the dispatcher proceeds eagerly.
+    Enable/disable are refcounted: the hook stays installed until every
+    thread's program_guard has exited."""
+    global enabled, _recorder, _count
+    _recorder = recorder
+    _count += 1
+    enabled = True
+
+
+def disable():
+    global enabled, _recorder, _count
+    _count = max(0, _count - 1)
+    if _count == 0:
+        enabled = False
+        _recorder = None
+
+
+def record(name, impl, treedef, leaves, raw_leaves):
+    return _recorder(name, impl, treedef, leaves, raw_leaves)

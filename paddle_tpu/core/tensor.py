@@ -27,6 +27,7 @@ import numpy as np
 from . import dtype as dtype_mod
 from . import flags
 from . import prof_hook
+from . import static_hook
 
 __all__ = [
     "Tensor", "Parameter", "to_tensor", "is_grad_enabled", "no_grad",
@@ -467,6 +468,12 @@ def _dispatch_body(name: str, impl: Callable, args: tuple, kwargs: dict,
                       for l in leaves))
 
     raw_leaves = [l._data if isinstance(l, Tensor) else l for l in leaves]
+
+    if static_hook.enabled and not tracing:
+        handled, out = static_hook.record(name, impl, treedef, leaves,
+                                          raw_leaves)
+        if handled:
+            return out
 
     # amp hook (module fetched via importlib: the package re-exports a
     # class under the same name `auto_cast`)

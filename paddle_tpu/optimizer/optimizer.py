@@ -121,6 +121,31 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        # static mode: append backward + update ops to the current Program
+        # (≈ Optimizer.minimize appending ops via append_backward +
+        # _append_optimize_op in python/paddle/optimizer/optimizer.py)
+        from ..static.program import (Variable, append_backward,
+                                      append_optimizer, in_static_build)
+        if in_static_build() and isinstance(loss, Variable):
+            prog = loss._static_program
+            plist = parameters if parameters is not None else \
+                self._parameter_list
+            names = None
+            if plist is not None:
+                # map eager Parameter objects to their captured var names
+                names = []
+                for p in plist:
+                    if isinstance(p, str):
+                        names.append(p)
+                    else:
+                        n = prog._param_ids.get(id(p))
+                        if n is not None:
+                            names.append(n)
+                names = names or None
+            params_grads = append_backward(loss, parameter_list=names,
+                                           no_grad_set=no_grad_set)
+            append_optimizer(self, params_grads)
+            return None, params_grads
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,135 @@
+"""paddle_tpu.static — the static-graph (Program) API.
+
+Reference analog: `paddle.static` (python/paddle/static/__init__.py):
+Program/program_guard/data/Executor/append_backward plus
+save/load_inference_model. See program.py / executor.py docstrings for
+the TPU-native design (op-list IR replayed under one jax.jit).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .program import (Program, Variable, OpDesc, VarDesc, program_guard,
+                      data, default_main_program, default_startup_program,
+                      append_backward, name_scope, in_static_build)
+from .executor import Executor, Scope, global_scope, CompiledProgram
+from .io import (save_inference_model, load_inference_model,
+                 LoadedInferenceProgram)
+
+
+class InputSpec:
+    """≈ paddle.static.InputSpec: declarative input signature for
+    to_static/jit.save."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name
+
+    def to_sds(self) -> jax.ShapeDtypeStruct:
+        shape = tuple(1 if (s is None or s < 0) else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={list(self.shape)}, "
+                f"dtype={self.dtype}, name={self.name})")
+
+
+class _StaticNN:
+    """static.nn control-flow ops (≈ paddle.static.nn.cond/while_loop
+    lowering to conditional/while ops in the reference's ProgramDesc;
+    here they lower to lax.cond / lax.while_loop inside one recorded op)."""
+
+    @staticmethod
+    def cond(pred, true_fn: Callable, false_fn: Callable):
+        from ..core.tensor import Tensor, dispatch
+
+        # Paddle's cond takes no-arg closures; the closed-over tensors must
+        # become explicit op operands so the recorded Program substitutes
+        # runtime values (the reference does this via sub-block var scoping,
+        # framework::ConditionalBlockOp). We lift Tensor closure cells into
+        # inputs and rebind them while tracing each branch.
+        # slots: (get, set) accessor pairs for each captured Tensor ref —
+        # closure cells AND module globals the branch code reads
+        slots = []
+        tensors = []
+        seen = set()
+        for fn in (true_fn, false_fn):
+            for cell in (fn.__closure__ or ()):
+                try:
+                    v = cell.cell_contents
+                except ValueError:
+                    continue
+                if isinstance(v, Tensor) and id(cell) not in seen:
+                    seen.add(id(cell))
+                    slots.append((
+                        (lambda c=cell: c.cell_contents),
+                        (lambda val, c=cell: setattr(
+                            c, "cell_contents", val))))
+                    tensors.append(v)
+            g = fn.__globals__
+            for nm in fn.__code__.co_names:
+                v = g.get(nm)
+                key = (id(g), nm)
+                if isinstance(v, Tensor) and key not in seen:
+                    seen.add(key)
+                    slots.append((
+                        (lambda g=g, nm=nm: g[nm]),
+                        (lambda val, g=g, nm=nm: g.__setitem__(nm, val))))
+                    tensors.append(v)
+
+        def impl(pred_raw, *cell_vals):
+            def wrap(fn):
+                def inner(vals):
+                    saved = [get() for get, _ in slots]
+                    try:
+                        for (_, setv), v in zip(slots, vals):
+                            setv(Tensor(v))
+                        out = fn()
+                        return jax.tree_util.tree_map(
+                            lambda t: (t._data if isinstance(t, Tensor)
+                                       else t), out,
+                            is_leaf=lambda x: isinstance(x, Tensor))
+                    finally:
+                        for (_, setv), s in zip(slots, saved):
+                            setv(s)
+                return inner
+            return jax.lax.cond(
+                jnp.asarray(pred_raw).astype(bool).reshape(()),
+                wrap(true_fn), wrap(false_fn), tuple(cell_vals))
+
+        return dispatch("cond", impl, (pred, *tensors), {})
+
+    @staticmethod
+    def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars):
+        from ..core.tensor import Tensor, dispatch
+
+        def impl(*raw_vars):
+            def c(vs):
+                out = cond_fn(*[Tensor(v) for v in vs])
+                raw = out._data if isinstance(out, Tensor) else out
+                return jnp.asarray(raw).astype(bool).reshape(())
+
+            def b(vs):
+                out = body_fn(*[Tensor(v) for v in vs])
+                return tuple(
+                    o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                    for o in out)
+
+            return jax.lax.while_loop(c, b, tuple(raw_vars))
+
+        return dispatch("while_loop", impl, tuple(loop_vars), {})
+
+
+nn = _StaticNN()
+
+__all__ = [
+    "Program", "Variable", "OpDesc", "VarDesc", "program_guard", "data",
+    "default_main_program", "default_startup_program", "append_backward",
+    "name_scope", "Executor", "Scope", "global_scope", "CompiledProgram",
+    "save_inference_model", "load_inference_model", "InputSpec", "nn",
+    "in_static_build",
+]

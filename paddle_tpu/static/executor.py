@@ -1,0 +1,150 @@
+"""Static graph Executor.
+
+Reference analog: `Executor.run` (python/paddle/fluid/executor.py:912,1378)
+feeding a ProgramDesc to InterpreterCore
+(paddle/fluid/framework/new_executor/interpretercore.cc:178), which builds
+an op dependency DAG, assigns streams, and schedules ops on workqueues.
+
+TPU-native: the replay of the whole op list is traced ONCE per
+(program-version, feed-shapes) into a single jitted function — XLA's
+scheduler subsumes the dependency DAG/stream machinery, and buffer
+donation of persistent vars gives in-place param updates in HBM. The
+`Scope` is the host-side dict of persistent arrays (params + optimizer
+state), the analog of framework::Scope (paddle/fluid/framework/scope.h).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .program import Program, Variable, default_startup_program, replay
+
+__all__ = ["Executor", "Scope", "global_scope", "CompiledProgram"]
+
+
+class Scope:
+    """name -> raw array store for persistable vars (≈ framework::Scope)."""
+
+    def __init__(self):
+        self.vars: Dict[str, jax.Array] = {}
+
+    def find_var(self, name: str):
+        return self.vars.get(name)
+
+    def var_names(self) -> List[str]:
+        return list(self.vars.keys())
+
+
+_GLOBAL_SCOPE = Scope()
+
+
+def global_scope() -> Scope:
+    return _GLOBAL_SCOPE
+
+
+class CompiledProgram:
+    """Parity shim: the Executor compiles every program; this just lets
+    user code written against the reference API keep working."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+
+
+class Executor:
+    """place is accepted for parity; programs run on jax's default device
+    (set via paddle_tpu.set_device)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self.scope = global_scope()
+        self._cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------ run
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True):
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        if program is None:
+            from .program import default_main_program
+            program = default_main_program()
+        scope = scope or self.scope
+        feed = feed or {}
+
+        # startup-style run: a program with no ops (e.g. the startup
+        # program) just seeds persistables MISSING from the scope — it
+        # must not clobber trained values (running main with no
+        # fetch_list still executes it below, like the reference)
+        if not fetch_list and not program._ops:
+            for name, val in program._param_inits.items():
+                scope.vars.setdefault(name, jnp.asarray(val))
+            return []
+
+        fetch_names = [f._static_name if isinstance(f, Variable) else str(f)
+                       for f in (fetch_list or [])]
+        feed_names = sorted(feed.keys())
+        feed_vals = [jnp.asarray(feed[k].numpy()
+                                 if isinstance(feed[k], Tensor)
+                                 else feed[k]) for k in feed_names]
+
+        persist = [n for n, d in program._vars.items() if d.persistable]
+        # lazily seed persistents missing from the scope
+        for n in persist:
+            if n not in scope.vars:
+                init = program._param_inits.get(n)
+                if init is None:
+                    raise RuntimeError(
+                        f"persistable var {n!r} has no value; run the "
+                        "startup program first")
+                scope.vars[n] = jnp.asarray(init)
+
+        key = (id(program), len(program._ops), tuple(feed_names),
+               tuple(fetch_names), tuple(persist),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._build(program, feed_names, fetch_names, persist)
+            self._cache[key] = fn
+
+        # host-side LR schedule: refresh @LR before, step scheduler after
+        for lrname, opt in program._lr_hooks:
+            scope.vars[lrname] = jnp.asarray(opt.get_lr(), jnp.float32)
+
+        persist_vals = [scope.vars[n] for n in persist]
+        fetches, new_persist = fn(tuple(feed_vals), tuple(persist_vals))
+        for n, v in zip(persist, new_persist):
+            scope.vars[n] = v
+
+        from ..optimizer.lr import LRScheduler
+        for _, opt in program._lr_hooks:
+            if isinstance(opt._lr, LRScheduler) and opt._lr._step_each_iter:
+                opt._lr.step()
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    # ---------------------------------------------------------------- build
+    def _build(self, program, feed_names, fetch_names, persist):
+        def pure(feed_vals, persist_vals):
+            env: Dict[str, Any] = {}
+            env.update(zip(feed_names, feed_vals))
+            env.update(zip(persist, persist_vals))
+            env = replay(program, env)
+            return ([env[n] for n in fetch_names],
+                    [env.get(n, pv) for n, pv in zip(persist, persist_vals)])
+
+        # no buffer donation here: the same param arrays are referenced by
+        # the eager Layer objects and by Program._param_inits (donating
+        # would delete them under the user's feet); the fused/donated
+        # training path is paddle_tpu.jit.TrainStep
+        return jax.jit(pure)
+
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,122 @@
+"""Static inference-model save/load.
+
+Reference analog: `save_inference_model`/`load_inference_model`
+(python/paddle/fluid/io.py) — prune the Program to the feed->fetch
+subgraph, serialize ProgramDesc + persistables; consumed by
+AnalysisPredictor (paddle/fluid/inference/api/analysis_predictor.cc:263).
+
+TPU-native: the pruned program is traced to StableHLO with current
+persistable values baked as inputs, serialized via jax.export; loading
+yields an executable artifact independent of the Python model code.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .executor import Executor, global_scope
+from .program import Program, Variable, prune, replay
+
+__all__ = ["save_inference_model", "load_inference_model",
+           "LoadedInferenceProgram"]
+
+
+def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
+                         fetch_vars: Sequence[Variable],
+                         executor: Executor = None,
+                         program: Program = None) -> None:
+    from jax import export as jexport
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    if program is None:
+        program = feed_vars[0]._static_program if feed_vars else \
+            fetch_vars[0]._static_program
+    scope = (executor.scope if executor is not None else global_scope())
+
+    feed_names = [v._static_name for v in feed_vars]
+    fetch_names = [v._static_name for v in fetch_vars]
+    # prune to the inference subgraph: drops backward/optimizer ops and
+    # any feeds (labels) they alone consume
+    program = prune(program, fetch_names)
+    used = {r for op in program._ops for r in op.input_names}
+    persist = [n for n, d in program._vars.items()
+               if d.persistable and n in used]
+    persist_vals = []
+    for n in persist:
+        v = scope.vars.get(n)
+        if v is None:
+            v = program._param_inits.get(n)
+        if v is None:
+            raise RuntimeError(f"no value for persistable var {n!r}")
+        persist_vals.append(jnp.asarray(v))
+
+    def infer(persist_tuple, *feeds):
+        env: Dict[str, jax.Array] = dict(zip(persist, persist_tuple))
+        env.update(zip(feed_names, feeds))
+        env = replay(program, env)
+        return tuple(env[n] for n in fetch_names)
+
+    # None/-1 feed dims export as symbolic dimensions so the artifact
+    # accepts any size there (the reference's dynamic-shape feed)
+    feed_specs = []
+    scope = jexport.SymbolicScope()
+    sym_i = 0
+    for n in feed_names:
+        d = program._vars[n]
+        if any(s is None or s < 0 for s in d.shape):
+            parts = []
+            for s in d.shape:
+                if s is None or s < 0:
+                    parts.append(f"_d{sym_i}")
+                    sym_i += 1
+                else:
+                    parts.append(str(s))
+            shape = jexport.symbolic_shape(", ".join(parts), scope=scope)
+        else:
+            shape = tuple(d.shape)
+        feed_specs.append(jax.ShapeDtypeStruct(shape, d.dtype))
+    persist_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                          for v in persist_vals)
+    exported = jexport.export(jax.jit(infer))(persist_specs, *feed_specs)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    np.savez(path_prefix + ".pdiparams.npz",
+             **{n: np.asarray(v) for n, v in zip(persist, persist_vals)})
+    with open(path_prefix + ".meta.json", "w") as f:
+        json.dump({"feed_names": feed_names, "fetch_names": fetch_names,
+                   "persist": persist}, f)
+
+
+class LoadedInferenceProgram:
+    """Executable loaded artifact; also accepted by Executor.run."""
+
+    def __init__(self, path_prefix: str):
+        from jax import export as jexport
+        with open(path_prefix + ".pdmodel", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(path_prefix + ".meta.json") as f:
+            meta = json.load(f)
+        self.feed_names: List[str] = meta["feed_names"]
+        self.fetch_names: List[str] = meta["fetch_names"]
+        npz = np.load(path_prefix + ".pdiparams.npz")
+        self._persist_vals = tuple(jnp.asarray(npz[n])
+                                   for n in meta["persist"])
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        feeds = [jnp.asarray(feed[n]) for n in self.feed_names]
+        out = self._exported.call(self._persist_vals, *feeds)
+        return [np.asarray(o) for o in out]
+
+
+def load_inference_model(path_prefix: str, executor: Executor = None):
+    """Returns (program, feed_target_names, fetch_targets) like the
+    reference; `program` is a LoadedInferenceProgram."""
+    prog = LoadedInferenceProgram(path_prefix)
+    return prog, prog.feed_names, prog.fetch_names

@@ -1,0 +1,498 @@
+"""Static graph Program IR.
+
+Reference analog: `ProgramDesc{BlockDesc{OpDesc,VarDesc}}`
+(paddle/fluid/framework/framework.proto, program_desc.cc) built by Python
+op wrappers calling `LayerHelper.append_op` in static mode
+(python/paddle/tensor/linalg.py:263), executed by InterpreterCore
+(paddle/fluid/framework/new_executor/interpretercore.cc:178).
+
+TPU-native design: the Program is a linear op list over named variables —
+each OpDesc holds the op's *pure jax impl* plus symbolic references to its
+operand/result variables. Building happens through the dispatcher's
+static_hook (core/static_hook.py): while a `program_guard` is active every
+op whose operands touch the program executes abstractly on placeholder
+values (exact shape/dtype inference — the InferMeta analog is jax itself)
+AND appends an OpDesc. Execution (static/executor.py) replays the op list
+inside `jax.jit`, so the whole Program lowers to ONE XLA computation —
+XLA plays the role of the reference's dependency-graph scheduler, stream
+assignment, fusion passes and memory planner.
+
+Autodiff: `append_backward` (≈ python/paddle/fluid/backward.py:1727) is a
+Program->Program transform that appends a grad op computing d(loss)/d(param)
+via `jax.grad` over the replayed forward prefix.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import static_hook
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "Program", "OpDesc", "VarDesc", "Variable", "program_guard", "data",
+    "default_main_program", "default_startup_program", "append_backward",
+    "name_scope",
+]
+
+
+class VarDesc:
+    """A named variable slot (≈ framework::VarDesc)."""
+
+    __slots__ = ("name", "shape", "dtype", "is_input", "is_param",
+                 "persistable", "stop_gradient")
+
+    def __init__(self, name: str, shape, dtype, is_input=False,
+                 is_param=False, persistable=False, stop_gradient=True):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.is_input = is_input
+        self.is_param = is_param
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        kind = "param" if self.is_param else (
+            "feed" if self.is_input else "tmp")
+        return f"var {self.name} : {kind} {list(self.shape)} {self.dtype}"
+
+
+class OpDesc:
+    """One recorded op (≈ framework::OpDesc). `arg_refs` mirrors the
+    flattened (args, kwargs) leaf list: each entry is either a variable
+    name (str) or a `Literal` carrying a captured constant."""
+
+    __slots__ = ("type", "impl", "treedef", "arg_refs", "out_names",
+                 "out_treedef")
+
+    def __init__(self, type, impl, treedef, arg_refs, out_names,
+                 out_treedef):
+        self.type = type
+        self.impl = impl
+        self.treedef = treedef
+        self.arg_refs = arg_refs
+        self.out_names = out_names
+        self.out_treedef = out_treedef
+
+    @property
+    def input_names(self) -> List[str]:
+        return [r for r in self.arg_refs if isinstance(r, str)]
+
+    def __repr__(self):
+        ins = ", ".join(self.input_names)
+        outs = ", ".join(self.out_names)
+        return f"{{{outs}}} = {self.type}({ins})"
+
+
+class Literal:
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+class Variable(Tensor):
+    """Build-time symbolic variable. Carries a placeholder value (zeros of
+    the declared shape) so op impls run for exact shape/dtype inference,
+    plus its VarDesc registration in the owning Program."""
+
+    def __init__(self, data, program: "Program", name: str, **kw):
+        super().__init__(data, **kw)
+        self._static_program = program
+        self._static_name = name
+
+    def __repr__(self):
+        d = self._static_program._vars[self._static_name]
+        return f"Variable({d!r})"
+
+
+class Program:
+    """≈ framework::ProgramDesc (single block — control flow lowers to
+    lax.cond/scan inside op impls rather than sub-blocks)."""
+
+    def __init__(self):
+        self._vars: Dict[str, VarDesc] = {}
+        self._ops: List[OpDesc] = []
+        # build-time values: var name -> raw placeholder array
+        self._build_vals: Dict[str, jax.Array] = {}
+        # param var name -> startup (initial) value
+        self._param_inits: Dict[str, jax.Array] = {}
+        # id(Tensor) -> var name for params captured during build
+        self._param_ids: Dict[int, str] = {}
+        # (lr_var_name, optimizer) pairs; Executor refreshes @LR per run
+        self._lr_hooks: List[Tuple[str, Any]] = []
+        self._tmp_counter = 0
+        self.random_seed = None
+
+    # ---------------------------------------------------------------- vars
+    def _unique_name(self, hint: str) -> str:
+        name = f"{hint}_{self._tmp_counter}"
+        self._tmp_counter += 1
+        while name in self._vars:
+            name = f"{hint}_{self._tmp_counter}"
+            self._tmp_counter += 1
+        return name
+
+    def add_input_var(self, name, shape, dtype) -> VarDesc:
+        if name in self._vars:
+            raise ValueError(f"duplicate variable name {name!r}")
+        d = VarDesc(name, shape, dtype, is_input=True)
+        self._vars[name] = d
+        return d
+
+    def capture_param(self, t: Tensor) -> str:
+        """Register a Parameter (or persistable Tensor) the program reads;
+        its current value becomes the startup/init value. Names are
+        globally unique (≈ fluid unique_name.generate) because persistable
+        vars live in the shared global Scope."""
+        key = id(t)
+        if key in self._param_ids:
+            return self._param_ids[key]
+        hint = getattr(t, "name", None) or "param"
+        global _PARAM_UID
+        _PARAM_UID += 1
+        name = f"{hint}.{_PARAM_UID}"
+        self._vars[name] = VarDesc(name, t._data.shape, t._data.dtype,
+                                   is_param=True, persistable=True,
+                                   stop_gradient=t.stop_gradient)
+        self._param_inits[name] = t._data
+        self._param_ids[key] = name
+        return name
+
+    def add_tmp_var(self, value, hint="tmp") -> str:
+        name = self._unique_name(hint)
+        self._vars[name] = VarDesc(name, jnp.shape(value),
+                                   jnp.result_type(value))
+        return name
+
+    # ---------------------------------------------------------------- info
+    @property
+    def ops(self) -> List[OpDesc]:
+        return self._ops
+
+    def list_vars(self) -> List[VarDesc]:
+        return list(self._vars.values())
+
+    def parameters(self) -> List[str]:
+        return [n for n, d in self._vars.items() if d.is_param]
+
+    def feed_vars(self) -> List[str]:
+        return [n for n, d in self._vars.items() if d.is_input]
+
+    def global_block(self) -> "Program":
+        return self  # single-block program; parity shim
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p._vars = dict(self._vars)
+        # for_test prunes training-only ops (≈ Program.clone(for_test=True)
+        # dropping backward/optimize ops, fluid/framework.py)
+        p._ops = [o for o in self._ops
+                  if not (for_test and
+                          o.type in ("backward", "optimizer_update"))]
+        p._build_vals = dict(self._build_vals)
+        p._param_inits = dict(self._param_inits)
+        p._param_ids = dict(self._param_ids)
+        p._lr_hooks = [] if for_test else list(self._lr_hooks)
+        p._tmp_counter = self._tmp_counter
+        p.random_seed = self.random_seed
+        return p
+
+    def __str__(self):
+        lines = [f"Program ({len(self._ops)} ops, {len(self._vars)} vars)"]
+        for d in self._vars.values():
+            lines.append("  " + repr(d))
+        for o in self._ops:
+            lines.append("  " + repr(o))
+        return "\n".join(lines)
+
+    to_string = __str__
+
+
+# ------------------------------------------------------------- build context
+
+_CTX = threading.local()
+
+
+def _current() -> Optional["_BuildContext"]:
+    return getattr(_CTX, "ctx", None)
+
+
+class _BuildContext:
+    def __init__(self, main: Program, startup: Program):
+        self.main = main
+        self.startup = startup
+
+
+def default_main_program() -> Program:
+    ctx = _current()
+    if ctx is not None:
+        return ctx.main
+    global _DEFAULT_MAIN
+    return _DEFAULT_MAIN
+
+
+def default_startup_program() -> Program:
+    ctx = _current()
+    if ctx is not None:
+        return ctx.startup
+    global _DEFAULT_STARTUP
+    return _DEFAULT_STARTUP
+
+
+_DEFAULT_MAIN = Program()
+_DEFAULT_STARTUP = Program()
+_PARAM_UID = 0
+
+
+def _recorder(name, impl, treedef, leaves, raw_leaves):
+    """static_hook callback: record ops whose operands touch the current
+    Program. Ops on unrelated tensors (e.g. initializer math while
+    constructing a Layer inside program_guard) stay eager — the reference
+    routes those to the startup program instead
+    (fluid/initializer.py appends to startup via LayerHelper)."""
+    ctx = _current()
+    if ctx is None:  # hook left enabled erroneously
+        return False, None
+    prog = ctx.main
+
+    touches = any(isinstance(l, Variable) and
+                  l._static_program is prog for l in leaves)
+    if not touches:
+        return False, None
+
+    arg_refs: List[Any] = []
+    for leaf, raw in zip(leaves, raw_leaves):
+        if isinstance(leaf, Variable) and leaf._static_program is prog:
+            arg_refs.append(leaf._static_name)
+        elif isinstance(leaf, Tensor) and (
+                isinstance(leaf, Parameter) or leaf.persistable):
+            arg_refs.append(prog.capture_param(leaf))
+        else:
+            arg_refs.append(Literal(raw))
+
+    # abstract-ish execution on placeholder values (exact shapes/dtypes)
+    rargs, rkwargs = jax.tree_util.tree_unflatten(treedef, list(raw_leaves))
+    out = impl(*rargs, **rkwargs)
+
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    out_names = [prog.add_tmp_var(v, hint=name) for v in out_leaves]
+    prog._ops.append(OpDesc(name, impl, treedef, arg_refs, out_names,
+                            out_treedef))
+
+    wrapped = [Variable(v, prog, n)
+               for v, n in zip(out_leaves, out_names)]
+    for w in wrapped:
+        prog._build_vals[w._static_name] = w._data
+    return True, jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    """≈ paddle.static.program_guard: ops built inside append to
+    `main_program`; parameter initial values land in `startup_program`."""
+    ctx = _BuildContext(main_program,
+                        startup_program or Program())
+    prev = _current()
+    _CTX.ctx = ctx
+    static_hook.enable(_recorder)
+    try:
+        yield
+    finally:
+        _CTX.ctx = prev
+        static_hook.disable()  # refcounted; see core/static_hook.py
+
+
+def in_static_build() -> bool:
+    return _current() is not None
+
+
+def data(name: str, shape, dtype="float32") -> Variable:
+    """Declare a feed placeholder (≈ paddle.static.data). `None`/-1 dims
+    become 1 at build time; the Executor re-traces per concrete shape (the
+    XLA analog of dynamic-shape feed)."""
+    prog = default_main_program()
+    shape = tuple(shape)
+    build_shape = tuple(1 if (s is None or s < 0) else s for s in shape)
+    np_dtype = jnp.dtype(dtype) if not isinstance(dtype, jnp.dtype) else dtype
+    prog.add_input_var(name, shape, np_dtype)
+    placeholder = jnp.zeros(build_shape, np_dtype)
+    v = Variable(placeholder, prog, name)
+    prog._build_vals[name] = placeholder
+    return v
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    """Accepted for parity; variable names are flat (XLA discards names)."""
+    yield
+
+
+# --------------------------------------------------------------- replay core
+
+def replay(program: Program, env: Dict[str, Any]) -> Dict[str, Any]:
+    """Execute the op list over an environment of raw arrays. Pure given
+    `env`; called under jax.jit by the Executor."""
+    for op in program._ops:
+        vals = [env[r] if isinstance(r, str) else r.value
+                for r in op.arg_refs]
+        rargs, rkwargs = jax.tree_util.tree_unflatten(op.treedef, vals)
+        out = op.impl(*rargs, **rkwargs)
+        for n, v in zip(op.out_names, jax.tree_util.tree_flatten(out)[0]):
+            env[n] = v
+    return env
+
+
+def prune(program: Program, fetch_names: Sequence[str]) -> Program:
+    """Keep only ops needed to compute `fetch_names` (≈ Program.prune /
+    fluid/framework/prune.cc used by save_inference_model). Walks the op
+    list backward, keeping ops producing needed vars."""
+    needed = set(fetch_names)
+    kept: List[OpDesc] = []
+    for op in reversed(program._ops):
+        if any(o in needed for o in op.out_names):
+            kept.append(op)
+            needed.update(op.input_names)
+    out = program.clone()
+    out._ops = list(reversed(kept))
+    return out
+
+
+def append_backward(loss, parameter_list: Optional[Sequence[str]] = None,
+                    no_grad_set=None):
+    """Append one grad op computing d(loss)/d(params) over the forward
+    prefix (≈ fluid/backward.py:1727 `append_backward`). Returns
+    [(param_var_name, grad_var_name)] pairs; grad vars are named
+    `<param>@GRAD` like the reference's GradVarName suffix
+    (paddle/fluid/framework/grad_op_desc_maker — kGradVarSuffix)."""
+    if not isinstance(loss, Variable):
+        raise TypeError("append_backward expects a static Variable loss")
+    prog = loss._static_program
+    loss_name = loss._static_name
+    fwd_ops = list(prog._ops)
+
+    params = [n for n in (parameter_list or prog.parameters())
+              if not prog._vars[n].stop_gradient]
+    if no_grad_set:
+        params = [p for p in params if p not in set(no_grad_set)]
+    feeds = prog.feed_vars()
+    fwd_prog = prog.clone()
+    fwd_prog._ops = fwd_ops
+    # every other persistable the forward reads (frozen params, buffers)
+    # is threaded as a runtime operand too, so grads see current scope
+    # values, not build-time inits
+    fwd_reads = {r for op in fwd_ops for r in op.input_names}
+    others = [n for n, d in prog._vars.items()
+              if d.persistable and n in fwd_reads and n not in params]
+
+    def grad_impl(*vals):
+        n_feed = len(feeds)
+        n_par = len(params)
+        env = dict(zip(feeds, vals[:n_feed]))
+        env.update(zip(params, vals[n_feed:n_feed + n_par]))
+        env.update(zip(others, vals[n_feed + n_par:]))
+
+        def loss_of(pvals):
+            e = dict(env)
+            e.update(zip(params, pvals))
+            e = replay(fwd_prog, e)
+            return e[loss_name].astype(jnp.float32).sum()
+
+        return tuple(jax.grad(loss_of)([env[p] for p in params]))
+
+    grad_impl.__name__ = f"grad_of_{loss_name}"
+
+    arg_leaves = [*feeds, *params, *others]
+    treedef = jax.tree_util.tree_flatten((tuple(arg_leaves), {}))[1]
+
+    grad_names = []
+    for p in params:
+        gname = f"{p}@GRAD"
+        d = prog._vars[p]
+        prog._vars[gname] = VarDesc(gname, d.shape, d.dtype)
+        grad_names.append(gname)
+
+    out_treedef = jax.tree_util.tree_flatten(
+        tuple(jnp.zeros(()) for _ in params))[1]
+    prog._ops.append(OpDesc("backward", grad_impl, treedef,
+                            list(arg_leaves), grad_names, out_treedef))
+    return [(p, g) for p, g in zip(params, grad_names)]
+
+
+def append_optimizer(optimizer, params_grads) -> None:
+    """Append the optimizer update as one op writing params (and opt-state
+    vars) in place — the static analog of the reference's per-param
+    sgd/adam ops emitted by Optimizer._append_optimize_op
+    (python/paddle/optimizer/optimizer.py)."""
+    prog = default_main_program()
+    params = [p for p, _ in params_grads]
+    grads = [g for _, g in params_grads]
+
+    # opt-state vars: persistable, initialized to the rule's fresh state.
+    # init_state_for (not _init_state) so multi_precision master weights
+    # materialize from the param's init value instead of staying None.
+    state_names: List[List[Tuple[str, str]]] = []
+    for p in params:
+        d = prog._vars[p]
+        init_val = prog._param_inits.get(p)
+        if init_val is None:
+            init_val = jnp.zeros(d.shape, d.dtype)
+        st = optimizer.init_state_for(init_val)
+        per = []
+        for k, v in st.items():
+            sname = f"{p}@{k}"
+            prog._vars[sname] = VarDesc(sname, jnp.shape(v),
+                                        jnp.result_type(v),
+                                        persistable=True)
+            prog._param_inits[sname] = jnp.asarray(v)
+            per.append((k, sname))
+        state_names.append(per)
+
+    lrname = "@LR"
+    stepname = "@STEP"
+    if lrname not in prog._vars:
+        prog._vars[lrname] = VarDesc(lrname, (), jnp.float32,
+                                     persistable=True)
+        prog._param_inits[lrname] = jnp.asarray(
+            optimizer.get_lr(), jnp.float32)
+        prog._vars[stepname] = VarDesc(stepname, (), jnp.int32,
+                                       persistable=True)
+        prog._param_inits[stepname] = jnp.asarray(0, jnp.int32)
+    # LR schedulers are host-side state: the Executor refreshes @LR from
+    # the optimizer before every run and steps per-iteration schedulers
+    # after (≈ the reference's lr-schedule ops emitted into the program)
+    prog._lr_hooks.append((lrname, optimizer))
+
+    flat_state = [s for per in state_names for _, s in per]
+
+    def update_impl(*vals):
+        i = 0
+        pvals = list(vals[i:i + len(params)]); i += len(params)
+        gvals = list(vals[i:i + len(grads)]); i += len(grads)
+        svals = list(vals[i:i + len(flat_state)]); i += len(flat_state)
+        lr = vals[i]; step = vals[i + 1] + 1
+        states = []
+        k = 0
+        for per in state_names:
+            states.append({key: svals[k + j]
+                           for j, (key, _) in enumerate(per)})
+            k += len(per)
+        new_p, new_s = optimizer.apply_gradients(pvals, gvals, states,
+                                                 lr=lr, step=step)
+        flat_new_s = [new_s[i][key] for i, per in enumerate(state_names)
+                      for key, _ in per]
+        return tuple(new_p) + tuple(flat_new_s) + (step,)
+
+    arg_refs = [*params, *grads, *flat_state, lrname, stepname]
+    treedef = jax.tree_util.tree_flatten((tuple(arg_refs), {}))[1]
+    out_names = [*params, *flat_state, stepname]  # in-place writes
+    out_treedef = jax.tree_util.tree_flatten(
+        tuple(jnp.zeros(()) for _ in out_names))[1]
+    prog._ops.append(OpDesc("optimizer_update", update_impl, treedef,
+                            list(arg_refs), out_names, out_treedef))

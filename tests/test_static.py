@@ -1,0 +1,284 @@
+"""Static graph (Program/Executor) tests.
+
+Mirrors the reference's static-mode tests: build Program via
+program_guard + static.data, run via Executor, train via
+optimizer.minimize, save/load inference model
+(python/paddle/fluid/tests/unittests/test_program.py,
+test_executor_*.py, test_inference_model_io.py analogs).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+def test_build_and_run_forward():
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        x = static.data("x", [4, 3], "float32")
+        y = x * 2.0 + 1.0
+        z = y.sum()
+    assert len(prog.ops) >= 2
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[y, z])
+    np.testing.assert_allclose(out[0], xv * 2 + 1, rtol=1e-6)
+    np.testing.assert_allclose(out[1], (xv * 2 + 1).sum(), rtol=1e-6)
+
+
+def test_layer_in_program_captures_params():
+    paddle.seed(0)
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        lin = nn.Linear(3, 2)
+        x = static.data("x", [5, 3], "float32")
+        out = lin(x)
+    assert len(prog.parameters()) == 2  # weight + bias captured
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    res = exe.run(prog, feed={"x": xv}, fetch_list=[out])[0]
+    # eager reference
+    ref = lin(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(res, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_append_backward_matches_numeric():
+    paddle.seed(1)
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        lin = nn.Linear(4, 1)
+        x = static.data("x", [8, 4], "float32")
+        loss = (lin(x) ** 2).mean()
+        pairs = static.append_backward(loss)
+    assert all(g.endswith("@GRAD") for _, g in pairs)
+    exe = static.Executor()
+    xv = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    grads = exe.run(prog, feed={"x": xv}, fetch_list=[g for _, g in pairs])
+
+    # eager reference: same layer, same loss, tape backward
+    xt = paddle.to_tensor(xv)
+    eager_loss = (lin(xt) ** 2).mean()
+    eager_loss.backward()
+    eager_grads = {n: p.grad.numpy() for n, p in lin.named_parameters()}
+    # match static grads by shape (param order is registration order)
+    for (pname, _), gv in zip(pairs, grads):
+        match = [eg for eg in eager_grads.values() if eg.shape == gv.shape]
+        assert match, f"no eager grad of shape {gv.shape}"
+        np.testing.assert_allclose(gv, match[0], rtol=1e-4, atol=1e-5)
+
+
+def test_minimize_trains():
+    paddle.seed(2)
+    prog = static.Program()
+    startup = static.Program()
+    rng = np.random.RandomState(2)
+    xv = rng.randn(32, 4).astype(np.float32)
+    true_w = rng.randn(4, 1).astype(np.float32)
+    yv = xv @ true_w
+
+    with static.program_guard(prog, startup):
+        lin = nn.Linear(4, 1)
+        x = static.data("x", [32, 4], "float32")
+        y = static.data("y", [32, 1], "float32")
+        loss = ((lin(x) - y) ** 2).mean()
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = [float(exe.run(prog, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+
+def test_adam_minimize_trains():
+    paddle.seed(3)
+    prog = static.Program()
+    startup = static.Program()
+    rng = np.random.RandomState(3)
+    xv = rng.randn(16, 3).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) > 0).astype(np.float32)
+
+    with static.program_guard(prog, startup):
+        net = nn.Sequential(nn.Linear(3, 8), nn.ReLU(), nn.Linear(8, 1))
+        x = static.data("x", [16, 3], "float32")
+        y = static.data("y", [16, 1], "float32")
+        logits = net(x)
+        loss = nn.functional.binary_cross_entropy_with_logits(logits, y)
+        opt = optimizer.Adam(learning_rate=0.05)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = [float(exe.run(prog, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])[0]) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_program_clone_and_str():
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        x = static.data("x", [2, 2], "float32")
+        _ = x + 1.0
+    s = str(prog)
+    assert "var x" in s and "add" in s.lower()
+    c = prog.clone(for_test=True)
+    assert len(c.ops) == len(prog.ops)
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(4)
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        lin = nn.Linear(3, 2)
+        x = static.data("x", [4, 3], "float32")
+        out = nn.functional.softmax(lin(x))
+    exe = static.Executor()
+    exe.run(startup)
+    path = str(tmp_path / "infer_model")
+    static.save_inference_model(path, [x], [out], exe)
+
+    loaded, feed_names, fetch_names = static.load_inference_model(path)
+    assert feed_names == ["x"]
+    xv = np.random.RandomState(4).randn(4, 3).astype(np.float32)
+    got = loaded.run({"x": xv})[0]
+    ref = exe.run(prog, feed={"x": xv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_static_nn_cond_while():
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        x = static.data("x", [1], "float32")
+        y = static.nn.cond(x.sum() > 0,
+                           lambda: x * 2.0, lambda: x - 1.0)
+    exe = static.Executor()
+    pos = exe.run(prog, feed={"x": np.array([3.0], np.float32)},
+                  fetch_list=[y])[0]
+    neg = exe.run(prog, feed={"x": np.array([-3.0], np.float32)},
+                  fetch_list=[y])[0]
+    np.testing.assert_allclose(pos, [6.0])
+    np.testing.assert_allclose(neg, [-4.0])
+
+
+def test_clone_for_test_drops_training_ops():
+    paddle.seed(5)
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        lin = nn.Linear(3, 1)
+        x = static.data("x", [4, 3], "float32")
+        y = static.data("y", [4, 1], "float32")
+        loss = ((lin(x) - y) ** 2).mean()
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    test_prog = prog.clone(for_test=True)
+    assert all(o.type not in ("backward", "optimizer_update")
+               for o in test_prog.ops)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 3), np.float32)
+    yv = np.zeros((4, 1), np.float32)
+    # eval on the test clone twice: loss identical (no training happened)
+    l1 = float(exe.run(test_prog, feed={"x": xv, "y": yv},
+                       fetch_list=[loss])[0])
+    l2 = float(exe.run(test_prog, feed={"x": xv, "y": yv},
+                       fetch_list=[loss])[0])
+    assert l1 == l2
+
+
+def test_run_without_fetch_does_not_wipe_params():
+    paddle.seed(6)
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        lin = nn.Linear(2, 1)
+        x = static.data("x", [4, 2], "float32")
+        y = static.data("y", [4, 1], "float32")
+        loss = ((lin(x) - y) ** 2).mean()
+        optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    feed = {"x": np.ones((4, 2), np.float32),
+            "y": 3 * np.ones((4, 1), np.float32)}
+    for _ in range(5):
+        exe.run(prog, feed=feed, fetch_list=[loss])
+    pname = prog.parameters()[0]
+    trained = np.asarray(static.global_scope().vars[pname]).copy()
+    # run with no fetch_list: executes the program, must NOT reset params
+    exe.run(prog, feed=feed)
+    after = np.asarray(static.global_scope().vars[pname])
+    assert not np.allclose(after, np.asarray(prog._param_inits[pname]))
+    # and re-running startup does not clobber trained values either
+    exe.run(startup)
+    still = np.asarray(static.global_scope().vars[pname])
+    np.testing.assert_allclose(still, after)
+
+
+def test_lr_scheduler_reaches_static_updates():
+    paddle.seed(7)
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        lin = nn.Linear(2, 1)
+        x = static.data("x", [4, 2], "float32")
+        loss = lin(x).mean()
+        sched = optimizer.lr.StepDecay(learning_rate=1.0, step_size=1,
+                                       gamma=0.1)
+        opt = optimizer.SGD(learning_rate=sched)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    feed = {"x": np.ones((4, 2), np.float32)}
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    lr_after_1 = float(np.asarray(static.global_scope().vars["@LR"]))
+    sched.step()  # epoch-granular scheduler: user steps it
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    lr_after_2 = float(np.asarray(static.global_scope().vars["@LR"]))
+    assert lr_after_1 == pytest.approx(1.0)
+    assert lr_after_2 == pytest.approx(0.1)
+
+
+def test_minimize_with_parameter_subset():
+    paddle.seed(8)
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        a = nn.Linear(2, 2)
+        b = nn.Linear(2, 1)
+        x = static.data("x", [4, 2], "float32")
+        loss = b(a(x)).mean()
+        opt = optimizer.SGD(learning_rate=0.5, parameters=b.parameters())
+        opt.minimize(loss)
+    update_ops = [o for o in prog.ops if o.type == "optimizer_update"]
+    assert len(update_ops) == 1
+    exe = static.Executor()
+    exe.run(startup)
+    feed = {"x": np.ones((4, 2), np.float32)}
+    a_name = prog._param_ids[id(a.weight)]
+    b_name = prog._param_ids[id(b.weight)]
+    a_before = np.asarray(static.global_scope().vars.get(a_name)
+                          if static.global_scope().vars.get(a_name)
+                          is not None else prog._param_inits[a_name]).copy()
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    a_after = np.asarray(static.global_scope().vars[a_name])
+    b_after = np.asarray(static.global_scope().vars[b_name])
+    np.testing.assert_allclose(a_before, a_after)  # frozen subset untouched
+    assert not np.allclose(np.asarray(prog._param_inits[b_name]), b_after)
+
+
+def test_eager_unaffected_outside_guard():
+    # building a program must not leak: eager ops after the guard behave
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        x = static.data("x", [2, 2], "float32")
+        _ = x + 1.0
+    out = t * 3.0
+    assert not hasattr(out, "_static_name")
+    np.testing.assert_allclose(out.numpy(), 3 * np.ones((2, 2)))
