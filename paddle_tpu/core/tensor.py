@@ -152,7 +152,8 @@ class Tensor:
     """
 
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
-                 "name", "persistable", "_hooks", "trainable")
+                 "name", "persistable", "_hooks", "trainable", "dist_attr",
+                 "__dict__")
     __array_priority__ = 100  # numpy defers binary ops to us
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,

@@ -1,6 +1,9 @@
+from . import auto_parallel  # noqa: F401
 from . import collective  # noqa: F401
 from . import fleet  # noqa: F401
 from . import topology  # noqa: F401
+from .auto_parallel import (Engine, ProcessMesh, shard_layer,  # noqa: F401
+                            shard_op, shard_tensor)
 from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
                          all_to_all, alltoall_single, broadcast,
                          reduce_scatter, scatter)

@@ -1,0 +1,22 @@
+"""Auto-parallel (semi-automatic SPMD) API.
+
+Reference analog: python/paddle/distributed/auto_parallel/ (~45k LoC):
+annotate tensors with ProcessMesh + dims_mapping (interface.py,
+process_mesh.py), propagate dist attrs (Completer, completion.py:140),
+split the program per rank (Partitioner, partitioner.py:35), insert
+communication at mismatches (Resharder, reshard.py:926), then run on the
+executor; Engine drives fit/evaluate/predict (engine.py:58).
+
+TPU-native: annotation = jax NamedSharding on a named Mesh; the XLA SPMD
+partitioner IS the Completer+Partitioner+Resharder — it propagates
+shardings through the whole jaxpr and inserts ICI/DCN collectives
+(SURVEY §3.6 maps the pipeline 1:1). The Engine therefore reduces to:
+collect annotations -> jit the step with in/out shardings -> run.
+"""
+from .process_mesh import ProcessMesh, get_current_mesh
+from .interface import shard_tensor, shard_op, shard_layer
+from .engine import Engine
+from .cost import estimate_cost
+
+__all__ = ["ProcessMesh", "get_current_mesh", "shard_tensor", "shard_op",
+           "shard_layer", "Engine", "estimate_cost"]

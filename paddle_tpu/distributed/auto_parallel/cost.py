@@ -1,0 +1,34 @@
+"""Cost model: FLOPs/bytes/time estimates for a step function.
+
+Reference analog: auto_parallel/cost/ + cost_model.py — measured per-op
+latencies (static_op_benchmark.json) summed over the partitioned program
+to rank parallel strategies in the tuner.
+
+TPU-native: XLA already computes a cost analysis for every compiled
+executable; we surface it. This is strictly better-grounded than the
+reference's table: it reflects the post-fusion, post-SPMD program."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+__all__ = ["estimate_cost"]
+
+
+def estimate_cost(fn: Callable, *example_args,
+                  peak_flops: Optional[float] = None) -> Dict[str, Any]:
+    """Compile `fn` on example args and return XLA's cost analysis:
+    flops, bytes accessed, and (if `peak_flops` given) a roofline time
+    estimate in seconds."""
+    lowered = jax.jit(fn).lower(*example_args)
+    compiled = lowered.compile()
+    analyses = compiled.cost_analysis()
+    ca = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
+    flops = float(ca.get("flops", 0.0))
+    bytes_accessed = float(ca.get("bytes accessed", 0.0))
+    out = {"flops": flops, "bytes_accessed": bytes_accessed,
+           "utilization_keys": sorted(k for k in ca if "utilization" in k)}
+    if peak_flops:
+        out["roofline_time_s"] = flops / peak_flops
+    return out

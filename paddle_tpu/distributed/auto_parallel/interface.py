@@ -1,0 +1,101 @@
+"""Annotation API: shard_tensor / shard_op / shard_layer.
+
+Reference analog: auto_parallel/interface.py — `shard_tensor(x, mesh,
+dims_mapping)` attaches a DistTensorSpec consumed by the Completer
+(completion.py:140). TPU-native: the annotation IS a NamedSharding;
+eagerly it places the array (jax.device_put), under a trace it becomes
+`with_sharding_constraint` — both feed XLA's SPMD propagation, which
+replaces the reference's completion/partition/reshard passes.
+
+shard_spec format: one entry per tensor dim — a mesh dim name to shard
+along, or None to replicate (≈ dims_mapping index -1).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh, get_current_mesh
+
+__all__ = ["shard_tensor", "shard_op", "shard_layer", "get_dist_attr"]
+
+
+def _to_pspec(shard_spec: Sequence[Optional[str]]) -> P:
+    return P(*[s if s else None for s in shard_spec])
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
+                 shard_spec: Optional[Sequence[Optional[str]]] = None):
+    """Annotate (and place/constrain) `x` with a sharding over the mesh.
+    Returns a Tensor carrying `dist_attr` so the Engine can use it as the
+    parameter/input sharding."""
+    mesh = process_mesh or get_current_mesh()
+    if mesh is None:
+        raise ValueError("no ProcessMesh: pass one or enter `with mesh:`")
+    spec = _to_pspec(shard_spec or [])
+    raw = x._data if isinstance(x, Tensor) else x
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    if _is_tracer(raw):
+        out = jax.lax.with_sharding_constraint(raw, sharding)
+    else:
+        out = jax.device_put(raw, sharding)
+    if isinstance(x, Tensor):
+        x._data = out
+        t = x
+    else:
+        t = Tensor(out)
+    t.dist_attr = {"process_mesh": mesh, "shard_spec": list(shard_spec or [])}
+    return t
+
+
+def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
+             in_shard_specs: Optional[List] = None,
+             out_shard_specs: Optional[List] = None):
+    """Wrap a callable so its inputs/outputs are sharding-constrained
+    (≈ shard_op attaching dist attrs to an op's tensors)."""
+    mesh = process_mesh or get_current_mesh()
+
+    def wrapped(*args, **kwargs):
+        m = mesh or get_current_mesh()
+        if m is None:
+            return op_fn(*args, **kwargs)
+        if in_shard_specs:
+            args = tuple(
+                shard_tensor(a, m, s) if s is not None and
+                isinstance(a, (Tensor, jax.Array)) else a
+                for a, s in zip(args, in_shard_specs)
+            ) + tuple(args[len(in_shard_specs):])
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs:
+            if isinstance(out, (list, tuple)):
+                out = type(out)(
+                    shard_tensor(o, m, s) if s is not None else o
+                    for o, s in zip(out, out_shard_specs))
+            else:
+                out = shard_tensor(out, m, out_shard_specs[0])
+        return out
+
+    return wrapped
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn=None):
+    """Annotate every parameter of `layer`. `shard_fn(name, param, mesh)`
+    returns a shard_spec (list of mesh-dim-or-None) per param; default
+    replicates everything (pure DP)."""
+    for name, p in layer.named_parameters():
+        spec = (shard_fn(name, p, process_mesh) if shard_fn
+                else [None] * len(p.shape))
+        shard_tensor(p, process_mesh, spec)
+    return layer
+
+
+def get_dist_attr(x) -> Optional[dict]:
+    return getattr(x, "dist_attr", None)

@@ -1,0 +1,84 @@
+"""ProcessMesh: the named logical device grid.
+
+Reference analog: auto_parallel.ProcessMesh (process_mesh.py) — an
+N-D array of process ranks with dim names, used as the target of
+dims_mapping annotations. TPU-native: it wraps jax.sharding.Mesh directly;
+"process" = TPU chip, and multi-host meshes come from jax.devices()
+spanning all processes after jax.distributed.initialize.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_current_mesh"]
+
+_STATE = threading.local()
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        """`mesh` is either a nested list of device indices (reference
+        style) or a shape tuple; `dim_names` names each axis (default
+        d0, d1, ...)."""
+        arr = np.asarray(mesh)
+        if arr.ndim == 1 and arr.dtype.kind in "iu" and \
+                process_ids is None and len(arr) <= 8 and \
+                int(np.prod(arr)) == len(jax.devices()) and \
+                not _looks_like_ids(arr):
+            # a shape tuple like (2, 4)
+            shape = tuple(int(s) for s in arr)
+            ids = np.arange(int(np.prod(shape))).reshape(shape)
+        else:
+            ids = arr
+            shape = ids.shape
+        self.shape = tuple(int(s) for s in shape)
+        self.process_ids = ids
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(len(self.shape))]
+        if len(self.dim_names) != len(self.shape):
+            raise ValueError("dim_names must match mesh rank")
+
+        devices = jax.devices()
+        flat = [devices[int(i) % len(devices)]
+                for i in ids.reshape(-1)]
+        self._jax_mesh = Mesh(np.array(flat).reshape(self.shape),
+                              tuple(self.dim_names))
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __enter__(self):
+        stack = getattr(_STATE, "stack", None)
+        if stack is None:
+            stack = _STATE.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+        return False
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dims={self.dim_names})"
+
+
+def _looks_like_ids(arr) -> bool:
+    # [0, 1, ..., n-1] is a 1-D mesh of ids, not a shape
+    return len(arr) > 1 and np.array_equal(arr, np.arange(len(arr)))
+
+
+def get_current_mesh() -> Optional[ProcessMesh]:
+    stack = getattr(_STATE, "stack", None)
+    return stack[-1] if stack else None
