@@ -109,7 +109,7 @@ class DistributedTrainStep:
             out = functional_call(model, pdict, *[Tensor(b) if
                                                   isinstance(b, jax.Array)
                                                   else b for b in batch[:-1]])
-            loss = loss_fn(out, _wrap(batch[-1]))
+            loss = loss_fn(out, jax.tree_util.tree_map(_wrap, batch[-1]))
             return _unwrap(loss)
 
         def grads_of(pvals, *batch):
@@ -172,7 +172,11 @@ class DistributedTrainStep:
                 self._opt_state_tree.append(st)
         if self._jitted is None:
             self._build(tuple(getattr(b, "ndim", 0) for b in batch))
-        raw_batch = tuple(self._shard_batch(_unwrap(b)) for b in batch)
+        raw_batch = tuple(
+            jax.tree_util.tree_map(
+                lambda t: self._shard_batch(_unwrap(t)), b,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            for b in batch)
         lr = self.optimizer.get_lr()
         self.optimizer._step_count += 1
         loss, new_vals, self._opt_state_tree = self._jitted(

@@ -172,7 +172,8 @@ class TrainStep:
             def loss_of(pvals):
                 pdict = dict(zip(self._param_names, pvals))
                 out = functional_call(self.model, pdict, *batch[:-1])
-                loss = self.loss_fn(out, _wrap(batch[-1]))
+                loss = self.loss_fn(
+                    out, jax.tree_util.tree_map(_wrap, batch[-1]))
                 return _unwrap(loss)
 
             loss, grads = jax.value_and_grad(loss_of)(list(param_vals))
@@ -193,7 +194,10 @@ class TrainStep:
                 or self.optimizer.init_state_for(p) for p in params]
         lr = self.optimizer.get_lr()
         self.optimizer._step_count += 1
-        raw_batch = tuple(_unwrap(b) for b in batch)
+        raw_batch = tuple(
+            jax.tree_util.tree_map(
+                _unwrap, b, is_leaf=lambda t: isinstance(t, Tensor))
+            for b in batch)
         loss, new_vals, self._opt_state_tree = self._jitted(
             [p._data for p in params], self._opt_state_tree,
             np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)

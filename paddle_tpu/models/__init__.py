@@ -1,5 +1,11 @@
-from . import gpt, resnet  # noqa: F401
+# Submodules keep their names (models.gpt / models.ernie / models.vit are
+# modules); the ernie()/vit() factories stay on their submodules to avoid
+# shadowing them here.
+from . import ernie, gpt, resnet, vit  # noqa: F401
+from .ernie import (ErnieConfig, ErnieForPretraining,  # noqa: F401
+                    ErnieModel)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from .resnet import (resnet18, resnet34, resnet50, resnet101,  # noqa: F401
                      resnet152)
+from .vit import ViT, ViTConfig  # noqa: F401

@@ -1,0 +1,19 @@
+"""Shared model-building helpers for the model zoo."""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..nn import initializer as I
+from ..nn.layers_common import Linear
+
+
+def spec_linear(in_f, out_f, std, spec_w, spec_b=None, has_bias=True):
+    """Linear with Normal(0, std) init and PartitionSpecs attached to its
+    weights — the building block every model family shards with."""
+    layer = Linear(in_f, out_f,
+                   weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)),
+                   bias_attr=None if has_bias else False)
+    layer.weight.spec = spec_w
+    if has_bias and layer.bias is not None:
+        layer.bias.spec = spec_b if spec_b is not None else P()
+    return layer

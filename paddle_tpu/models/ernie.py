@@ -1,0 +1,292 @@
+"""ERNIE / BERT bidirectional transformer encoder — the BASELINE.md
+config #3 pretraining flagship (capability analog of the reference's
+ERNIE models trained with Fleet; the reference repo itself only carries
+the GPT fixture `python/paddle/fluid/tests/unittests/auto_parallel_gpt_model.py`,
+so this mirrors the public ERNIE-3.0 / BERT architecture on the same
+TPU-first layer kit as models/gpt.py).
+
+TPU-first design: weights carry PartitionSpecs (mp column/row split on
+attention + FFN, vocab-parallel embedding) so one definition runs
+single-chip or hybrid dp x mp x sharding under DistributedTrainStep;
+bidirectional attention goes through F.scaled_dot_product_attention;
+fp32 layernorm accumulation under bf16 autocast."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..distributed.parallel.mp_layers import sharded_constraint
+from ..distributed.parallel.recompute import recompute
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, LayerNorm, Linear
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None   # default 4*hidden
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    hidden_act: str = "gelu"
+    dropout: float = 0.0
+    attention_dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    # ERNIE pretrains with sentence-order prediction (SOP); BERT-style
+    # next-sentence prediction is the same 2-way head with other labels.
+    with_pooler: bool = True
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+from ._common import spec_linear as _linear
+
+
+class ErnieEmbeddings(Layer):
+    """word + position + token_type embeddings -> LayerNorm -> dropout."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.word_embeddings = Embedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)))
+        self.word_embeddings.weight.spec = P("mp", None)  # vocab-parallel
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)))
+        self.position_embeddings.weight.spec = P()
+        self.token_type_embeddings = Embedding(
+            cfg.type_vocab_size, cfg.hidden_size,
+            weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)))
+        self.token_type_embeddings.weight.spec = P()
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_epsilon)
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        from .. import ops
+        pos = ops.creation.arange(s, dtype="int32")
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = ops.creation.zeros([b, s], dtype="int32")
+        x = x + self.token_type_embeddings(token_type_ids)
+        x = sharded_constraint(x, P(("dp", "sharding"), None, None))
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieSelfAttention(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        h, nh = cfg.hidden_size, cfg.num_heads
+        self.num_heads = nh
+        self.head_dim = h // nh
+        std = cfg.initializer_range
+        self.qkv_proj = _linear(h, 3 * h, std, P(None, "mp"), P("mp"))
+        self.out_proj = _linear(h, h, std / math.sqrt(2 * cfg.num_layers),
+                                P("mp", None), P())
+        self.dropout_p = cfg.attention_dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = sharded_constraint(qkv, P(("dp", "sharding"), None, "mp"))
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.dropout_p, training=self.training)
+        return self.out_proj(out.reshape([b, s, h]))
+
+
+class ErnieLayer(Layer):
+    """Post-LN encoder block (BERT/ERNIE layout: residual -> LayerNorm)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.attn = ErnieSelfAttention(cfg)
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.fc1 = _linear(cfg.hidden_size, cfg.ffn_size, std,
+                           P(None, "mp"), P("mp"))
+        self.fc2 = _linear(cfg.ffn_size, cfg.hidden_size,
+                           std / math.sqrt(2 * cfg.num_layers),
+                           P("mp", None), P())
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.dropout = Dropout(cfg.dropout)
+        self.act = cfg.hidden_act
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.dropout(self.attn(x, attn_mask)))
+        h = self.fc1(x)
+        h = F.gelu(h, approximate=True) if self.act == "gelu" else F.relu(h)
+        return self.ln2(x + self.dropout(self.fc2(h)))
+
+
+class ErniePooler(Layer):
+    """[CLS] pooler: first-token hidden -> dense -> tanh."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.dense = _linear(cfg.hidden_size, cfg.hidden_size,
+                             cfg.initializer_range, P(), P())
+
+    def forward(self, x):
+        from .. import ops
+        return ops.math.tanh(self.dense(x[:, 0]))
+
+
+class ErnieModel(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.layers = LayerList([ErnieLayer(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.pooler = ErniePooler(cfg) if cfg.with_pooler else None
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        """Returns (sequence_output, pooled_output-or-None).
+        attn_mask: [b, s] 1/0 padding mask, or a broadcastable additive
+        [b, 1, s, s] mask; converted to additive here."""
+        if attn_mask is not None and len(attn_mask.shape) == 2:
+            import jax.numpy as jnp
+            m = attn_mask._data if isinstance(attn_mask, Tensor) \
+                else attn_mask
+            add = (1.0 - m.astype("float32")) * -1e9
+            attn_mask = Tensor(add[:, None, None, :])
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layers:
+            if self.cfg.use_recompute and self.training:
+                x = recompute(layer, x, attn_mask, policy="save_dots")
+            else:
+                x = layer(x, attn_mask)
+        pooled = self.pooler(x) if self.pooler is not None else None
+        return x, pooled
+
+
+class ErnieMLMHead(Layer):
+    """transform(dense+act+LN) then decode against the tied word
+    embedding (vocab-parallel matmul) + bias."""
+
+    def __init__(self, cfg: ErnieConfig, embed: ErnieEmbeddings):
+        super().__init__()
+        self.transform = _linear(cfg.hidden_size, cfg.hidden_size,
+                                 cfg.initializer_range, P(), P())
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_epsilon)
+        self._embed_ref = [embed]
+        from ..core.tensor import Parameter
+        import numpy as np
+        self.decoder_bias = Parameter(
+            np.zeros([cfg.vocab_size], dtype=np.float32))
+        self.decoder_bias.spec = P("mp")
+
+    def forward(self, x):
+        from .. import ops
+        x = self.layer_norm(F.gelu(self.transform(x), approximate=True))
+        wte = self._embed_ref[0].word_embeddings.weight
+        logits = F.linear(x, ops.linalg.t(wte)) + self.decoder_bias
+        return sharded_constraint(logits, P(("dp", "sharding"), None, "mp"))
+
+
+class ErnieForPretraining(Layer):
+    """MLM + sentence-order (2-way) pretraining heads, joint loss —
+    the ERNIE/BERT pretraining objective (BASELINE config #3)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        if not cfg.with_pooler:
+            raise ValueError("ErnieForPretraining needs the [CLS] pooler "
+                             "for its sentence-order head; set "
+                             "with_pooler=True")
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.mlm_head = ErnieMLMHead(cfg, self.ernie.embeddings)
+        self.sop_head = _linear(cfg.hidden_size, 2,
+                                cfg.initializer_range, P(), P())
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, attn_mask)
+        return self.mlm_head(seq), self.sop_head(pooled)
+
+    def loss(self, outputs, labels):
+        """outputs = (mlm_logits, sop_logits);
+        labels = (mlm_labels with ignore_index -100, sop_labels)."""
+        mlm_logits, sop_logits = outputs
+        mlm_labels, sop_labels = labels
+        mlm = F.cross_entropy(
+            mlm_logits.reshape([-1, mlm_logits.shape[-1]]),
+            mlm_labels.reshape([-1]), ignore_index=-100)
+        sop = F.cross_entropy(sop_logits, sop_labels)
+        return mlm + sop
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        n = self.num_params()
+        att = 12 * self.cfg.num_layers * self.cfg.hidden_size * seq_len
+        return 6 * n + att
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        if not cfg.with_pooler:
+            raise ValueError("ErnieForSequenceClassification classifies "
+                             "the pooled [CLS] state; set with_pooler=True")
+        self.ernie = ErnieModel(cfg)
+        self.dropout = Dropout(cfg.dropout)
+        self.classifier = _linear(cfg.hidden_size, num_classes,
+                                  cfg.initializer_range, P(), P())
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, attn_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# public ERNIE-3.0 / BERT sizes
+CONFIGS = {
+    "ernie-3.0-base": ErnieConfig(hidden_size=768, num_layers=12,
+                                  num_heads=12),
+    "ernie-3.0-medium": ErnieConfig(hidden_size=768, num_layers=6,
+                                    num_heads=12),
+    "ernie-3.0-xbase": ErnieConfig(hidden_size=1024, num_layers=20,
+                                   num_heads=16),
+    "bert-base": ErnieConfig(vocab_size=30522, hidden_size=768,
+                             num_layers=12, num_heads=12,
+                             max_position_embeddings=512,
+                             type_vocab_size=2),
+    "bert-large": ErnieConfig(vocab_size=30522, hidden_size=1024,
+                              num_layers=24, num_heads=16,
+                              max_position_embeddings=512,
+                              type_vocab_size=2),
+    "test-tiny": ErnieConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                             num_heads=4, max_position_embeddings=128),
+}
+
+
+def ernie(name: str = "ernie-3.0-base", **overrides) -> ErnieForPretraining:
+    import dataclasses
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ErnieForPretraining(cfg)
+
+
+def bert(name: str = "bert-base", **overrides) -> ErnieForPretraining:
+    return ernie(name, **overrides)

@@ -60,14 +60,7 @@ class GPTConfig:
         return self.intermediate_size or 4 * self.hidden_size
 
 
-def _linear(in_f, out_f, std, spec_w, spec_b=None, has_bias=True):
-    layer = Linear(in_f, out_f,
-                   weight_attr=I.ParamAttr(initializer=I.Normal(0.0, std)),
-                   bias_attr=None if has_bias else False)
-    layer.weight.spec = spec_w
-    if has_bias and layer.bias is not None:
-        layer.bias.spec = spec_b if spec_b is not None else P()
-    return layer
+from ._common import spec_linear as _linear
 
 
 class GPTAttention(Layer):

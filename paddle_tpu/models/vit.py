@@ -1,0 +1,146 @@
+"""Vision Transformer (BASELINE.md config #5: ViT-L as the
+layout-sensitive vision flagship; capability analog of the reference's
+`python/paddle/vision/models/` model-zoo surface, which predates ViT —
+built here on the same TPU-first kit as models/gpt.py).
+
+Patch embedding is a strided Conv2D (one big MXU matmul after XLA's
+im2col), encoder blocks are pre-LN with mp-sharded attention/FFN."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Parameter
+from ..distributed.parallel.mp_layers import sharded_constraint
+from ..distributed.parallel.recompute import recompute
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..nn.layers_common import Conv2D, Dropout, LayerNorm, Linear
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    in_channels: int = 3
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-6
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+from ._common import spec_linear as _linear
+
+
+class ViTAttention(Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        h, nh = cfg.hidden_size, cfg.num_heads
+        self.num_heads = nh
+        self.head_dim = h // nh
+        std = cfg.initializer_range
+        self.qkv_proj = _linear(h, 3 * h, std, P(None, "mp"), P("mp"))
+        self.out_proj = _linear(h, h, std / math.sqrt(2 * cfg.num_layers),
+                                P("mp", None), P())
+        self.dropout_p = cfg.dropout
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = sharded_constraint(qkv, P(("dp", "sharding"), None, "mp"))
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=False, dropout_p=self.dropout_p,
+            training=self.training)
+        return self.out_proj(out.reshape([b, s, h]))
+
+
+class ViTBlock(Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        ffn = int(cfg.hidden_size * cfg.mlp_ratio)
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = ViTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.fc1 = _linear(cfg.hidden_size, ffn, std, P(None, "mp"), P("mp"))
+        self.fc2 = _linear(ffn, cfg.hidden_size,
+                           std / math.sqrt(2 * cfg.num_layers),
+                           P("mp", None), P())
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        h = F.gelu(self.fc1(self.ln2(x)), approximate=True)
+        return x + self.dropout(self.fc2(h))
+
+
+class ViT(Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.patch_embed = Conv2D(cfg.in_channels, cfg.hidden_size,
+                                  cfg.patch_size, stride=cfg.patch_size)
+        self.cls_token = Parameter(
+            np.zeros([1, 1, cfg.hidden_size], dtype=np.float32))
+        self.pos_embed = Parameter(I.TruncatedNormal(
+            0.0, cfg.initializer_range)(
+            [1, cfg.num_patches + 1, cfg.hidden_size]))
+        self.pos_drop = Dropout(cfg.dropout)
+        self.blocks = LayerList([ViTBlock(cfg)
+                                 for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size,
+                              epsilon=cfg.layer_norm_epsilon)
+        self.head = _linear(cfg.hidden_size, cfg.num_classes,
+                            cfg.initializer_range, P(), P()) \
+            if cfg.num_classes > 0 else None
+
+    def forward(self, x):
+        """x: [b, c, H, W] NCHW (paddle.vision convention)."""
+        from .. import ops
+        x = self.patch_embed(x)                       # [b, h, H/p, W/p]
+        b, h = x.shape[0], x.shape[1]
+        x = x.reshape([b, h, -1]).transpose([0, 2, 1])  # [b, n, h]
+        cls = ops.manipulation.broadcast_to(
+            self.cls_token, [b, 1, h])
+        x = ops.manipulation.concat([cls, x], axis=1) + self.pos_embed
+        x = sharded_constraint(x, P(("dp", "sharding"), None, None))
+        x = self.pos_drop(x)
+        for block in self.blocks:
+            if self.cfg.use_recompute and self.training:
+                x = recompute(block, x, policy="save_dots")
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        return self.head(x[:, 0]) if self.head is not None else x[:, 0]
+
+
+CONFIGS = {
+    "vit-b-16": ViTConfig(hidden_size=768, num_layers=12, num_heads=12),
+    "vit-l-16": ViTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "vit-h-14": ViTConfig(patch_size=14, hidden_size=1280, num_layers=32,
+                          num_heads=16),
+    "test-tiny": ViTConfig(image_size=32, patch_size=8, hidden_size=64,
+                           num_layers=2, num_heads=4, num_classes=10),
+}
+
+
+def vit(name: str = "vit-b-16", **overrides) -> ViT:
+    import dataclasses
+    cfg = dataclasses.replace(CONFIGS[name], **overrides)
+    return ViT(cfg)
