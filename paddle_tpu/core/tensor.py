@@ -151,9 +151,12 @@ class Tensor:
     tensors explicitly marked participate in autograd).
     """
 
+    # NOTE: no "__dict__" here — Tensor is the hottest object type; the
+    # two annotation attributes (sharding spec, auto-parallel dist_attr)
+    # get dedicated slots instead of re-enabling a per-instance dict.
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
                  "name", "persistable", "_hooks", "trainable", "dist_attr",
-                 "__dict__")
+                 "spec")
     __array_priority__ = 100  # numpy defers binary ops to us
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,

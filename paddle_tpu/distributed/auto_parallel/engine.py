@@ -22,7 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
-from ...jit.api import functional_call
+from ...jit.api import functional_call, _unwrap, _wrap
 from .interface import get_dist_attr, _to_pspec
 from .process_mesh import ProcessMesh
 
@@ -98,9 +98,12 @@ class Engine:
 
         def step(param_vals, opt_state, lr, step_no, *batch):
             def loss_of(pvals):
-                out = functional_call(model, dict(zip(names, pvals)),
-                                      *[Tensor(b) for b in batch[:-1]])
-                loss = loss_fn(out, Tensor(batch[-1]))
+                out = functional_call(
+                    model, dict(zip(names, pvals)),
+                    *[jax.tree_util.tree_map(_wrap, b)
+                      for b in batch[:-1]])
+                loss = loss_fn(out, jax.tree_util.tree_map(_wrap,
+                                                           batch[-1]))
                 return loss._data if isinstance(loss, Tensor) else loss
 
             loss, grads = jax.value_and_grad(loss_of)(list(param_vals))
@@ -131,14 +134,27 @@ class Engine:
             t0 = time.perf_counter()
             n_steps = 0
             last_loss = None
+            axis = self._data_axis or mesh.axis_names[0]
+            axis_size = mesh.shape[axis]
             for bi, batch in enumerate(it):
                 if steps_per_epoch is not None and bi >= steps_per_epoch:
                     break
-                raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                       for b in batch]
-                raw = [jax.device_put(
-                           r, self._batch_sharding(r.ndim, mesh))
-                       for r in raw]
+                leaves = jax.tree_util.tree_leaves(
+                    batch, is_leaf=lambda t: isinstance(t, Tensor))
+                lead = _to_array(leaves[0]).shape[0] if leaves else 0
+                if lead % axis_size != 0:
+                    import warnings
+                    warnings.warn(
+                        f"Engine.fit: skipping batch of {lead} samples "
+                        f"not divisible by data axis '{axis}' "
+                        f"(size {axis_size})")
+                    continue
+                raw = [jax.tree_util.tree_map(
+                    lambda t: jax.device_put(
+                        _to_array(t),
+                        self._batch_sharding(_to_array(t).ndim, mesh)),
+                    b, is_leaf=lambda t: isinstance(t, Tensor))
+                    for b in batch]
                 lr = np.float32(self.optimizer.get_lr())
                 self.optimizer._step_count += 1
                 stepno = np.int32(self.optimizer._step_count)
@@ -153,7 +169,15 @@ class Engine:
                     print(f"epoch {epoch} step {bi} "
                           f"loss {float(loss):.4f}")
             dt = time.perf_counter() - t0
-            rec = {"epoch": epoch, "loss": float(last_loss),
+            if n_steps == 0:
+                import warnings
+                warnings.warn(
+                    f"Engine.fit epoch {epoch} yielded no batches "
+                    f"(batch_size larger than the dataset, or a "
+                    f"one-shot iterator already exhausted)")
+            rec = {"epoch": epoch,
+                   "loss": float(last_loss) if last_loss is not None
+                   else None,
                    "steps": n_steps, "time_s": dt}
             self._history.append(rec)
         return self._history
@@ -166,16 +190,20 @@ class Engine:
 
         if self._eval_fn is None:
             def ev(param_vals, *batch):
-                out = functional_call(model, dict(zip(names, param_vals)),
-                                      *[Tensor(b) for b in batch[:-1]])
-                loss = loss_fn(out, Tensor(batch[-1]))
+                out = functional_call(
+                    model, dict(zip(names, param_vals)),
+                    *[jax.tree_util.tree_map(_wrap, b)
+                      for b in batch[:-1]])
+                loss = loss_fn(out, jax.tree_util.tree_map(_wrap,
+                                                           batch[-1]))
                 return loss._data if isinstance(loss, Tensor) else loss
             self._eval_fn = jax.jit(ev)
 
         losses = []
         for batch in _batches(eval_data, batch_size):
-            raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                   for b in batch]
+            raw = [jax.tree_util.tree_map(
+                _to_array, b, is_leaf=lambda t: isinstance(t, Tensor))
+                for b in batch]
             losses.append(float(self._eval_fn(
                 [p._data for p in params], *raw)))
         return {"eval_loss": float(np.mean(losses)) if losses else None}
@@ -188,8 +216,9 @@ class Engine:
 
         if self._pred_fn is None:
             def pd(param_vals, *inputs):
-                out = functional_call(model, dict(zip(names, param_vals)),
-                                      *[Tensor(b) for b in inputs])
+                out = functional_call(
+                    model, dict(zip(names, param_vals)),
+                    *[jax.tree_util.tree_map(_wrap, b) for b in inputs])
                 return jax.tree_util.tree_map(
                     lambda t: t._data if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda x: isinstance(x, Tensor))
@@ -197,10 +226,13 @@ class Engine:
 
         outs = []
         for batch in _batches(test_data, batch_size):
-            raw = [b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                   for b in batch]
-            outs.append(np.asarray(self._pred_fn(
-                [p._data for p in params], *raw)))
+            raw = [jax.tree_util.tree_map(
+                _to_array, b, is_leaf=lambda t: isinstance(t, Tensor))
+                for b in batch]
+            out = self._pred_fn([p._data for p in params], *raw)
+            # model outputs may be a pytree (e.g. ERNIE's (mlm, sop)
+            # logits) — convert leaves, keep the structure
+            outs.append(jax.tree_util.tree_map(np.asarray, out))
         return outs
 
     # ----------------------------------------------------------------- io
@@ -229,8 +261,15 @@ class Engine:
         return self._history
 
 
+def _to_array(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
 def _batches(data, batch_size: Optional[int]):
-    """Normalize data into an iterator of tuples of arrays."""
+    """Normalize data into an iterator of tuples of arrays. The trailing
+    partial batch is yielded too (one extra XLA compilation for the
+    remainder shape, cached across epochs) — samples are never silently
+    dropped."""
     if isinstance(data, tuple) and all(
             isinstance(a, (np.ndarray, jnp.ndarray, Tensor))
             for a in data):
@@ -238,7 +277,7 @@ def _batches(data, batch_size: Optional[int]):
         bs = batch_size or n
         arrs = [a.numpy() if isinstance(a, Tensor) else np.asarray(a)
                 for a in data]
-        for i in range(0, n - bs + 1, bs):
+        for i in range(0, n, bs):
             yield tuple(a[i:i + bs] for a in arrs)
     else:
         for batch in data:

@@ -24,15 +24,17 @@ _STATE = threading.local()
 class ProcessMesh:
     def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
                  process_ids=None):
-        """`mesh` is either a nested list of device indices (reference
-        style) or a shape tuple; `dim_names` names each axis (default
-        d0, d1, ...)."""
+        """`mesh` is a nested list of process (device) ids, reference
+        style. Convenience: a FLAT list is read as a SHAPE exactly when
+        `dim_names` names each of its entries (len(dim_names) ==
+        len(mesh)) — so ProcessMesh([2, 4], dim_names=["dp", "mp"]) is a
+        2x4 grid over devices 0..7 — and as process ids otherwise. The
+        rule depends only on the arguments, never on the host's device
+        count."""
         arr = np.asarray(mesh)
         if arr.ndim == 1 and arr.dtype.kind in "iu" and \
-                process_ids is None and len(arr) <= 8 and \
-                int(np.prod(arr)) == len(jax.devices()) and \
-                not _looks_like_ids(arr):
-            # a shape tuple like (2, 4)
+                process_ids is None and dim_names is not None and \
+                len(dim_names) == len(arr):
             shape = tuple(int(s) for s in arr)
             ids = np.arange(int(np.prod(shape))).reshape(shape)
         else:
@@ -46,8 +48,16 @@ class ProcessMesh:
             raise ValueError("dim_names must match mesh rank")
 
         devices = jax.devices()
-        flat = [devices[int(i) % len(devices)]
-                for i in ids.reshape(-1)]
+        flat_ids = [int(i) for i in ids.reshape(-1)]
+        if len(set(flat_ids)) != len(flat_ids):
+            raise ValueError(
+                f"duplicate process ids in mesh: {sorted(flat_ids)}")
+        bad = [i for i in flat_ids if i < 0 or i >= len(devices)]
+        if bad:
+            raise ValueError(
+                f"process ids {bad} out of range for {len(devices)} "
+                f"devices")
+        flat = [devices[i] for i in flat_ids]
         self._jax_mesh = Mesh(np.array(flat).reshape(self.shape),
                               tuple(self.dim_names))
 
@@ -72,11 +82,6 @@ class ProcessMesh:
 
     def __repr__(self):
         return f"ProcessMesh(shape={self.shape}, dims={self.dim_names})"
-
-
-def _looks_like_ids(arr) -> bool:
-    # [0, 1, ..., n-1] is a 1-D mesh of ids, not a shape
-    return len(arr) > 1 and np.array_equal(arr, np.arange(len(arr)))
 
 
 def get_current_mesh() -> Optional[ProcessMesh]:

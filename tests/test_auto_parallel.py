@@ -118,3 +118,54 @@ def test_estimate_cost():
     cost = ap.estimate_cost(f, a, b)
     # 2*M*N*K flops
     assert cost["flops"] == pytest.approx(2 * 128 * 256 * 64, rel=0.5)
+
+
+def test_process_mesh_flat_list_semantics():
+    """1-D list is a shape iff dim_names covers every entry; otherwise
+    process ids (reference semantics). Never depends on device count."""
+    m = ap.ProcessMesh([2, 4], dim_names=["dp", "mp"])   # shape
+    assert m.shape == (2, 4)
+    m2 = ap.ProcessMesh([2, 4], dim_names=["x"])          # ids {2,4}
+    assert m2.shape == (2,)
+    assert list(np.asarray(m2.process_ids)) == [2, 4]
+    with pytest.raises(ValueError):          # duplicate ids
+        ap.ProcessMesh([[0, 1], [1, 2]], dim_names=["a", "b"])
+    with pytest.raises(ValueError):          # out-of-range ids
+        ap.ProcessMesh(list(range(16)), dim_names=["dp"])
+
+
+def test_engine_empty_epoch_warns_not_crashes():
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    eng = ap.Engine(model=model,
+                    loss=lambda o, l: ((o - l) ** 2).mean(),
+                    optimizer=optimizer.SGD(learning_rate=0.1),
+                    process_mesh=ap.ProcessMesh([8], dim_names=["dp"]))
+    x = np.ones((4, 4), np.float32)   # 4 samples < batch_size 16
+    y = np.zeros((4, 2), np.float32)
+    with pytest.warns(UserWarning):
+        hist = eng.fit((x, y), batch_size=16, epochs=1, verbose=0)
+    assert hist[0]["steps"] == 0 and hist[0]["loss"] is None
+
+
+def test_engine_predict_tuple_outputs_and_partial_batch():
+    paddle.seed(0)
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 2)
+            self.b = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    eng = ap.Engine(model=TwoHead(),
+                    process_mesh=ap.ProcessMesh([8], dim_names=["dp"]))
+    x = np.ones((10, 4), np.float32)  # 10 = 8 + partial 2
+    outs = eng.predict((x,), batch_size=8)
+    assert len(outs) == 2              # full + partial batch, none dropped
+    a0, b0 = outs[0]
+    assert a0.shape == (8, 2) and b0.shape == (8, 3)
+    a1, b1 = outs[1]
+    assert a1.shape == (2, 2) and b1.shape == (2, 3)
