@@ -22,7 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
-from ...jit.api import functional_call, _unwrap, _wrap
+from ...jit.api import functional_call, _wrap
 from .interface import get_dist_attr, _to_pspec
 from .process_mesh import ProcessMesh
 
@@ -137,7 +137,8 @@ class Engine:
             axis = self._data_axis or mesh.axis_names[0]
             axis_size = mesh.shape[axis]
             for bi, batch in enumerate(it):
-                if steps_per_epoch is not None and bi >= steps_per_epoch:
+                if steps_per_epoch is not None and \
+                        n_steps >= steps_per_epoch:
                     break
                 leaves = jax.tree_util.tree_leaves(
                     batch, is_leaf=lambda t: isinstance(t, Tensor))
@@ -149,11 +150,12 @@ class Engine:
                         f"not divisible by data axis '{axis}' "
                         f"(size {axis_size})")
                     continue
+                def _put(t):
+                    arr = _to_array(t)
+                    return jax.device_put(
+                        arr, self._batch_sharding(arr.ndim, mesh))
                 raw = [jax.tree_util.tree_map(
-                    lambda t: jax.device_put(
-                        _to_array(t),
-                        self._batch_sharding(_to_array(t).ndim, mesh)),
-                    b, is_leaf=lambda t: isinstance(t, Tensor))
+                    _put, b, is_leaf=lambda t: isinstance(t, Tensor))
                     for b in batch]
                 lr = np.float32(self.optimizer.get_lr())
                 self.optimizer._step_count += 1
@@ -199,14 +201,21 @@ class Engine:
                 return loss._data if isinstance(loss, Tensor) else loss
             self._eval_fn = jax.jit(ev)
 
-        losses = []
+        losses, weights = [], []
         for batch in _batches(eval_data, batch_size):
             raw = [jax.tree_util.tree_map(
                 _to_array, b, is_leaf=lambda t: isinstance(t, Tensor))
                 for b in batch]
+            leaves = jax.tree_util.tree_leaves(raw)
+            weights.append(int(leaves[0].shape[0]) if leaves
+                           and getattr(leaves[0], "ndim", 0) else 1)
             losses.append(float(self._eval_fn(
                 [p._data for p in params], *raw)))
-        return {"eval_loss": float(np.mean(losses)) if losses else None}
+        if not losses:
+            return {"eval_loss": None}
+        # weight per-batch mean losses by batch size so a trailing
+        # partial batch doesn't bias the average
+        return {"eval_loss": float(np.average(losses, weights=weights))}
 
     # ------------------------------------------------------------- predict
     def predict(self, test_data, batch_size: Optional[int] = None):

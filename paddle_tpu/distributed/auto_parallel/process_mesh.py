@@ -34,7 +34,7 @@ class ProcessMesh:
         arr = np.asarray(mesh)
         if arr.ndim == 1 and arr.dtype.kind in "iu" and \
                 process_ids is None and dim_names is not None and \
-                len(dim_names) == len(arr):
+                len(dim_names) == len(arr) and all(int(s) >= 1 for s in arr):
             shape = tuple(int(s) for s in arr)
             ids = np.arange(int(np.prod(shape))).reshape(shape)
         else:

@@ -17,6 +17,9 @@ def test_process_mesh_shapes():
     assert m.jax_mesh.axis_names == ("dp", "mp")
     m1 = ap.ProcessMesh(list(range(8)), dim_names=["dp"])
     assert m1.shape == (8,)
+    # [0] with one dim name is device id 0, NOT an empty shape-(0,) mesh
+    m0 = ap.ProcessMesh([0], dim_names=["dp"])
+    assert m0.shape == (1,)
 
 
 def test_shard_tensor_places_array():
