@@ -23,5 +23,11 @@ from .parallel.sharding import (ShardingStrategy,  # noqa: F401
 from .topology import (HybridCommunicateGroup, create_mesh,  # noqa: F401
                        get_hybrid_communicate_group, get_mesh,
                        set_hybrid_communicate_group)
+from . import elastic  # noqa: F401
+from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
+from .elastic import ElasticManager  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from .store import TCPStore  # noqa: F401
 
 alltoall = all_to_all

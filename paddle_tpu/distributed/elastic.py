@@ -1,0 +1,101 @@
+"""Elastic training manager.
+
+Reference analog: ElasticManager
+(python/paddle/distributed/fleet/elastic/manager.py:128) — ranks
+register in etcd, the manager watches membership, rewrites the endpoint
+env and restarts workers on scale events within [min_np, max_np]
+(exit codes 101/102, manager.py:32-33).
+
+TPU-native: membership lives in the launcher's TCPStore (no etcd in the
+stack); a scale event means the pod/slice re-formed, so the restarted
+job simply resumes from the latest checkpoint — XLA collectives are
+re-compiled for the new mesh, there are no endpoint lists to patch.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, List, Optional
+
+from .store import TCPStore
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_SCALE_CODE = 102
+_PREFIX = "__elastic"
+
+
+class ElasticManager:
+    def __init__(self, store: TCPStore, job_id: str, np_range,
+                 host: Optional[str] = None,
+                 heartbeat_interval: float = 2.0,
+                 heartbeat_timeout: float = 10.0):
+        """``np_range`` is (min_np, max_np) — the tolerated node count,
+        like the reference's `--np 2:4` syntax."""
+        self.store = store
+        self.job_id = job_id
+        self.min_np, self.max_np = np_range
+        self.host = host or f"{os.uname().nodename}-{os.getpid()}"
+        self.hb_interval = heartbeat_interval
+        self.hb_timeout = heartbeat_timeout
+        self._stop = False
+
+    # ---------------------------------------------------------- membership
+    def _key(self, host: str) -> str:
+        return f"{_PREFIX}/{self.job_id}/nodes/{host}"
+
+    def register(self) -> None:
+        self.store.set(self._key(self.host), time.time())
+
+    def deregister(self) -> None:
+        try:
+            self.store.delete(self._key(self.host))
+        except (TimeoutError, RuntimeError, OSError):
+            pass
+
+    def heartbeat(self) -> None:
+        self.store.set(self._key(self.host), time.time())
+
+    def hosts(self) -> List[str]:
+        prefix = f"{_PREFIX}/{self.job_id}/nodes/"
+        now = time.time()
+        alive = []
+        for k in self.store.keys(prefix):
+            try:
+                ts = float(self.store.get(k, timeout=1.0))
+            except (TimeoutError, RuntimeError):
+                continue
+            if now - ts <= self.hb_timeout:
+                alive.append(k[len(prefix):])
+        return sorted(alive)
+
+    # --------------------------------------------------------------- watch
+    def watch(self, on_scale: Callable[[List[str]], None],
+              poll: float = 0.5,
+              max_events: Optional[int] = None) -> None:
+        """Heartbeat + watch membership; call ``on_scale(hosts)`` when
+        the alive set changes while within [min_np, max_np]. The caller
+        typically restarts the training process with exit code 101 so
+        the launcher's Controller relaunches against the new mesh."""
+        known = self.hosts()
+        events = 0
+        last_hb = 0.0
+        while not self._stop:
+            now = time.monotonic()
+            if now - last_hb >= self.hb_interval:
+                self.heartbeat()
+                last_hb = now
+            cur = self.hosts()
+            if cur != known:
+                # track membership even while outside [min_np, max_np]:
+                # a dip below min_np followed by the same host rejoining
+                # must still fire once the set is viable again
+                known = cur
+                if self.min_np <= len(cur) <= self.max_np:
+                    on_scale(cur)
+                    events += 1
+                    if max_events is not None and events >= max_events:
+                        return
+            time.sleep(poll)
+
+    def stop(self) -> None:
+        self._stop = True

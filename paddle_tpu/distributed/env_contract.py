@@ -1,0 +1,28 @@
+"""The PADDLE_*-style env contract shared by every launch path.
+
+Reference analog: the env each worker receives from
+CollectiveController.build_pod (launch/controllers/collective.py:75) and
+from paddle.distributed.spawn — one definition here so the CLI launcher
+and spawn() cannot drift.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+
+def build_rank_env(rank: int, world_size: int, local_rank: int,
+                   master: str, nnodes: int = 1,
+                   job_id: str = "default") -> Dict[str, str]:
+    return {
+        # paddle-parity names
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world_size),
+        "PADDLE_MASTER": master,
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(nnodes),
+        "PADDLE_JOB_ID": job_id,
+        # names env.init_parallel_env also accepts
+        "COORDINATOR_ADDRESS": master,
+        "NUM_PROCESSES": str(world_size),
+        "PROCESS_ID": str(rank),
+    }

@@ -1,0 +1,7 @@
+"""paddle_tpu.distributed.launch — multi-process training launcher.
+
+Reference analog: python -m paddle.distributed.launch
+(python/paddle/distributed/launch/main.py:18; CollectiveController
+launch/controllers/collective.py:21).
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,151 @@
+"""Pod controller: spawn per-rank worker processes, watch, reap.
+
+Reference analog: CollectiveController.build_pod
+(python/paddle/distributed/launch/controllers/collective.py:32,75,154)
+— crafts PADDLE_TRAINER_ENDPOINTS/PADDLE_MASTER/rank env per worker and
+the watch() poll loop (launch/controllers/controller.py:74).
+
+TPU-native differences: there is no NCCL endpoint list to distribute —
+workers rendezvous through jax.distributed's coordinator (the launcher
+just points everyone at it) — and on a real pod slice the natural layout
+is ONE process per host driving all local chips, so ``nproc_per_node``
+defaults to 1 (raise it only for virtual-CPU testing).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..elastic import ELASTIC_EXIT_CODE, ELASTIC_SCALE_CODE  # noqa: F401
+from ..env_contract import build_rank_env
+
+
+@dataclass
+class JobSpec:
+    script: str
+    script_args: List[str] = field(default_factory=list)
+    nnodes: int = 1
+    node_rank: int = 0
+    nproc_per_node: int = 1
+    master: str = "127.0.0.1:0"  # host:port of the coordinator
+    job_id: str = "default"
+    log_dir: Optional[str] = None
+    envs: Dict[str, str] = field(default_factory=dict)
+    max_restarts: int = 0
+
+
+class Pod:
+    """The set of worker processes owned by this node's controller."""
+
+    def __init__(self, spec: JobSpec):
+        self.spec = spec
+        self.procs: List[subprocess.Popen] = []
+        self.logs: List[object] = []
+
+    @property
+    def world_size(self) -> int:
+        return self.spec.nnodes * self.spec.nproc_per_node
+
+    def rank_env(self, local_rank: int) -> Dict[str, str]:
+        spec = self.spec
+        rank = spec.node_rank * spec.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update(spec.envs)
+        env.update(build_rank_env(rank, self.world_size, local_rank,
+                                  spec.master, nnodes=spec.nnodes,
+                                  job_id=spec.job_id))
+        return env
+
+    def start(self) -> None:
+        spec = self.spec
+        if spec.log_dir:
+            os.makedirs(spec.log_dir, exist_ok=True)
+        for lr in range(spec.nproc_per_node):
+            cmd = [sys.executable, "-u", spec.script, *spec.script_args]
+            if spec.log_dir:
+                rank = spec.node_rank * spec.nproc_per_node + lr
+                log = open(os.path.join(spec.log_dir,
+                                        f"workerlog.{rank}"), "ab")
+                self.logs.append(log)
+                out = log
+            else:
+                out = None
+            self.procs.append(subprocess.Popen(
+                cmd, env=self.rank_env(lr), stdout=out,
+                stderr=subprocess.STDOUT if out else None))
+
+    def poll(self) -> Optional[int]:
+        """None while all run; first non-zero code, or 0 when all done."""
+        codes = [p.poll() for p in self.procs]
+        for c in codes:
+            if c is not None and c != 0:
+                return c
+        if all(c == 0 for c in codes):
+            return 0
+        return None
+
+    def stop(self, sig=signal.SIGTERM, grace: float = 10.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + grace
+        for p in self.procs:
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in self.logs:
+            try:
+                log.close()
+            except OSError:
+                pass
+        self.procs, self.logs = [], []
+
+
+class Controller:
+    """watch() loop: run the pod to completion, restarting on elastic
+    exit codes up to max_restarts."""
+
+    def __init__(self, spec: JobSpec):
+        self.spec = spec
+        self.pod = Pod(spec)
+
+    def run(self) -> int:
+        restarts = 0
+        self.pod.start()
+        prev = {signal.SIGTERM: signal.signal(signal.SIGTERM,
+                                              self._forward),
+                signal.SIGINT: signal.signal(signal.SIGINT,
+                                             self._forward)}
+        try:
+            while True:
+                code = self.pod.poll()
+                if code is None:
+                    time.sleep(0.2)
+                    continue
+                if code in (ELASTIC_EXIT_CODE, ELASTIC_SCALE_CODE) and \
+                        restarts < self.spec.max_restarts:
+                    restarts += 1
+                    self.pod.stop()
+                    self.pod = Pod(self.spec)
+                    self.pod.start()
+                    continue
+                if code != 0:
+                    self.pod.stop()
+                return code
+        finally:
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+
+    def _forward(self, signum, frame):
+        self.pod.stop(sig=signum)
+        raise SystemExit(128 + signum)

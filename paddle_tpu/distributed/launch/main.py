@@ -1,0 +1,70 @@
+"""CLI: python -m paddle_tpu.distributed.launch [opts] script.py [args].
+
+Reference analog: python/paddle/distributed/launch/main.py:18 (argparse
+front end over controllers). The multi-node master is just host:port of
+node 0; jax.distributed's coordination service plays the role the
+reference splits between the HTTP/etcd master (controllers/master.py)
+and the NCCL-id TCPStore exchange.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .controller import Controller, JobSpec
+from ..store import free_port
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a multi-process paddle_tpu training job.")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")),
+                   help="number of nodes (hosts) in the job")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+                   help="rank of this node in [0, nnodes)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes on this node (TPU: 1 process "
+                        "drives all local chips; raise only for "
+                        "virtual-CPU testing)")
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""),
+                   help="host:port of the coordinator (node 0); "
+                        "auto-picked on single-node jobs")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="write per-rank workerlog.N files here")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic restarts allowed on exit codes 101/102")
+    p.add_argument("--devices", type=str, default=None,
+                   help="visible device ids for this node (TPU chips)")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    master = args.master
+    if not master:
+        if args.nnodes > 1:
+            raise SystemExit("--master host:port is required for "
+                             "multi-node jobs")
+        master = f"127.0.0.1:{free_port()}"
+    envs = {}
+    if args.devices is not None:
+        envs["TPU_VISIBLE_DEVICES"] = args.devices
+    spec = JobSpec(script=args.script, script_args=args.script_args,
+                   nnodes=args.nnodes, node_rank=args.node_rank,
+                   nproc_per_node=args.nproc_per_node, master=master,
+                   job_id=args.job_id, log_dir=args.log_dir,
+                   envs=envs, max_restarts=args.max_restarts)
+    return Controller(spec).run()
+
+
+def main() -> int:
+    return launch(sys.argv[1:])

@@ -1,0 +1,160 @@
+"""paddle.distributed.rpc analog — simple worker-to-worker RPC.
+
+Reference: paddle/fluid/distributed/rpc/ (brpc services) +
+python/paddle/distributed/rpc/rpc.py (init_rpc / rpc_sync / rpc_async /
+shutdown over WorkerInfo). Here: stdlib TCP servers, endpoint discovery
+through the rendezvous TCPStore, pickled callables — host-side control
+plane only (tensor traffic belongs to XLA collectives, not RPC).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import traceback
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .store import TCPStore, _recv_msg, _send_msg, free_port
+
+# process-global like the reference (rpc state must be visible from any
+# thread — remote handlers doing nested rpc run on server threads)
+_RPC_STATE: Dict[str, object] = {}
+
+
+def _host_ip(peer_host: str = "8.8.8.8") -> str:
+    """The address other hosts can reach this process at: the source IP
+    of a (connectionless) route toward the store/master."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((peer_host, 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = _recv_msg(self.request)
+            try:
+                result = fn(*args, **kwargs)
+                _send_msg(self.request, ("ok", result))
+            except Exception:
+                _send_msg(self.request, ("error", traceback.format_exc()))
+        except (ConnectionError, OSError, pickle.PickleError):
+            return
+
+
+class _Rpc:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 store: TCPStore):
+        self.name, self.rank, self.world_size = name, rank, world_size
+        self.store = store
+        # bind all interfaces, advertise the cross-host-reachable address
+        # (route toward the master/store host)
+        self.server = _RpcServer(("0.0.0.0", 0), _RpcHandler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        self.pool = ThreadPoolExecutor(max_workers=8)
+        ip = "127.0.0.1" if store.host in ("127.0.0.1", "localhost") \
+            else _host_ip(store.host)
+        info = WorkerInfo(name, rank, ip, self.port)
+        store.set(f"__rpc/worker/{name}", info)
+        store.set(f"__rpc/rank/{rank}", name)
+        store.barrier("rpc_init", world_size)
+        self.workers: Dict[str, WorkerInfo] = {}
+        for r in range(world_size):
+            wname = store.get(f"__rpc/rank/{r}")
+            self.workers[wname] = store.get(f"__rpc/worker/{wname}")
+
+    def call(self, to: str, fn, args, kwargs, timeout: float):
+        info = self.workers[to]
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout) as s:
+            _send_msg(s, (fn, args, kwargs))
+            status, val = _recv_msg(s)
+        if status == "error":
+            raise RuntimeError(f"rpc to {to!r} failed:\n{val}")
+        return val
+
+    def shutdown(self):
+        self.store.barrier("rpc_shutdown", self.world_size)
+        self.server.shutdown()
+        self.server.server_close()
+        self.pool.shutdown(wait=False)
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None,
+             store: Optional[TCPStore] = None) -> None:
+    import os
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) \
+        if rank is None else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) \
+        if world_size is None else world_size
+    if store is None:
+        ep = master_endpoint or os.environ.get("PADDLE_MASTER") or \
+            f"127.0.0.1:{free_port()}"
+        host, port = ep.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=(rank == 0))
+    _RPC_STATE["rpc"] = _Rpc(name, rank, world_size, store)
+
+
+def _rpc() -> _Rpc:
+    rpc = _RPC_STATE.get("rpc")
+    if rpc is None:
+        raise RuntimeError("call paddle_tpu.distributed.rpc.init_rpc first")
+    return rpc
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
+    return _rpc().call(to, fn, args, kwargs or {}, timeout)
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None,
+              timeout: float = 60.0) -> Future:
+    rpc = _rpc()
+    return rpc.pool.submit(rpc.call, to, fn, args, kwargs or {}, timeout)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    rpc = _rpc()
+    if name is None:
+        return rpc.workers[rpc.name]
+    return rpc.workers[name]
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return list(_rpc().workers.values())
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return get_worker_info()
+
+
+def shutdown() -> None:
+    rpc = _RPC_STATE.pop("rpc", None)
+    if rpc is not None:
+        rpc.shutdown()

@@ -1,0 +1,73 @@
+"""paddle.distributed.spawn analog — fork/spawn-based in-script launch.
+
+Reference: python/paddle/distributed/spawn.py:482 — start `nprocs`
+python processes running `func(*args)` with the parallel env prepared,
+as the no-CLI alternative to `paddle.distributed.launch`.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Sequence
+
+from .env_contract import build_rank_env
+from .store import free_port
+
+
+def _worker(func, args, rank, nprocs, master, backend, err_q):
+    os.environ.update(build_rank_env(rank, nprocs, rank, master))
+    if backend == "cpu":
+        # virtual-CPU testing path: one CPU device per process
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        func(*args)
+    except Exception:
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+class SpawnContext:
+    def __init__(self, procs, err_q):
+        self.processes = procs
+        self._err_q = err_q
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        for p in self.processes:
+            p.join(timeout)
+        alive = [p for p in self.processes if p.is_alive()]
+        if alive:
+            return False
+        bad = [p for p in self.processes if p.exitcode != 0]
+        if bad:
+            msg = ""
+            while not self._err_q.empty():
+                rank, tb = self._err_q.get_nowait()
+                msg += f"\n----- rank {rank} -----\n{tb}"
+            raise RuntimeError(
+                f"{len(bad)} spawned process(es) failed "
+                f"(exitcodes {[p.exitcode for p in bad]}){msg}")
+        return True
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          master: Optional[str] = None,
+          backend: Optional[str] = None) -> SpawnContext:
+    """Run ``func(*args)`` in ``nprocs`` fresh processes with the
+    parallel env set. Uses the 'spawn' start method so each child gets
+    its own un-initialized jax backend."""
+    master = master or f"127.0.0.1:{free_port()}"
+    ctx = mp.get_context("spawn")
+    err_q = ctx.Queue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, args, rank, nprocs, master,
+                              backend, err_q),
+                        daemon=False)
+        p.start()
+        procs.append(p)
+    sc = SpawnContext(procs, err_q)
+    if join:
+        sc.join()
+    return sc

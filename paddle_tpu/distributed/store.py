@@ -1,0 +1,237 @@
+"""TCPStore — rendezvous key-value store.
+
+Reference analog: paddle::distributed::TCPStore
+(paddle/fluid/distributed/store/tcp_store.cc; bound in
+pybind/communication.cc) — the master rank listens on a TCP socket and
+every rank set/get/waits keys to bootstrap collectives.
+
+TPU-native role: jax.distributed's coordination service replaces the
+NCCL-id exchange, but the launcher, elastic manager and rpc layer still
+need a tiny shared KV plane (worker registration, endpoint discovery,
+barriers) — this is that plane, pure stdlib, no brpc.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+_LEN = struct.Struct("!I")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("TCPStore peer closed")
+        buf += chunk
+    return buf
+
+
+class _StoreServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr):
+        self.kv: Dict[str, object] = {}
+        self.cond = threading.Condition()
+        super().__init__(addr, _StoreHandler)
+
+
+class _StoreHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: _StoreServer = self.server  # type: ignore[assignment]
+        try:
+            while True:
+                op, *args = _recv_msg(self.request)
+                if op == "set":
+                    key, val = args
+                    with srv.cond:
+                        srv.kv[key] = val
+                        srv.cond.notify_all()
+                    _send_msg(self.request, ("ok", None))
+                elif op == "get":
+                    key, timeout = args
+                    deadline = time.monotonic() + timeout
+                    with srv.cond:
+                        while key not in srv.kv:
+                            left = deadline - time.monotonic()
+                            if left <= 0 or not srv.cond.wait(left):
+                                break
+                        if key in srv.kv:
+                            _send_msg(self.request, ("ok", srv.kv[key]))
+                        else:
+                            _send_msg(self.request, ("timeout", key))
+                elif op == "add":
+                    key, delta = args
+                    with srv.cond:
+                        srv.kv[key] = int(srv.kv.get(key, 0)) + delta
+                        srv.cond.notify_all()
+                        _send_msg(self.request, ("ok", srv.kv[key]))
+                elif op == "delete":
+                    (key,) = args
+                    with srv.cond:
+                        existed = srv.kv.pop(key, None) is not None
+                        srv.cond.notify_all()
+                    _send_msg(self.request, ("ok", existed))
+                elif op == "keys":
+                    prefix = args[0] if args else ""
+                    with srv.cond:
+                        ks = [k for k in srv.kv if k.startswith(prefix)]
+                    _send_msg(self.request, ("ok", ks))
+                elif op == "shutdown":
+                    _send_msg(self.request, ("ok", None))
+                    threading.Thread(target=srv.shutdown,
+                                     daemon=True).start()
+                    return
+                else:
+                    _send_msg(self.request, ("error", f"bad op {op}"))
+        except (ConnectionError, OSError):
+            return
+
+
+class TCPStore:
+    """Client (and, on the master, server) of the rendezvous store.
+
+    ``TCPStore(host, port, is_master=True)`` starts the in-process server
+    thread; every participant (master included) talks to it over TCP.
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 timeout: float = 300.0):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self._server: Optional[_StoreServer] = None
+        if is_master:
+            self._server = _StoreServer((host, port))
+            if port == 0:
+                self.port = self._server.server_address[1]
+            t = threading.Thread(target=self._server.serve_forever,
+                                 daemon=True)
+            t.start()
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------------- conn
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            deadline = time.monotonic() + self.timeout
+            last = None
+            while time.monotonic() < deadline:
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.port), timeout=self.timeout)
+                    self._sock = s
+                    return s
+                except OSError as e:  # master not up yet
+                    last = e
+                    time.sleep(0.05)
+            raise TimeoutError(
+                f"TCPStore: cannot reach {self.host}:{self.port}: {last}")
+        return self._sock
+
+    # ops safe to re-send after a broken pipe; "add" is NOT (a lost
+    # reply would double-count and corrupt barrier generations)
+    _IDEMPOTENT = {"set", "get", "delete", "keys"}
+
+    def _call(self, *msg):
+        with self._lock:
+            sock = self._conn()
+            # the server replies at most at the per-call wait deadline;
+            # pad the socket deadline so the reply always wins the race
+            # and TimeoutError comes from the server's "timeout" status,
+            # not the socket
+            wait = msg[2] if msg[0] == "get" else self.timeout
+            sock.settimeout(float(wait) + 30.0)
+            try:
+                _send_msg(sock, msg)
+                status, val = _recv_msg(sock)
+            except TimeoutError:
+                self._sock = None
+                raise
+            except (ConnectionError, OSError):
+                self._sock = None
+                if msg[0] not in self._IDEMPOTENT:
+                    raise
+                sock = self._conn()  # reconnect once on a broken pipe
+                sock.settimeout(self.timeout + 30.0)
+                _send_msg(sock, msg)
+                status, val = _recv_msg(sock)
+        if status == "timeout":
+            raise TimeoutError(f"TCPStore: wait for key {val!r} timed out")
+        if status == "error":
+            raise RuntimeError(val)
+        return val
+
+    # ---------------------------------------------------------------- api
+    def set(self, key: str, value) -> None:
+        self._call("set", key, value)
+
+    def get(self, key: str, timeout: Optional[float] = None):
+        return self._call("get", key,
+                          self.timeout if timeout is None else timeout)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return self._call("add", key, delta)
+
+    def delete(self, key: str) -> bool:
+        return self._call("delete", key)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return self._call("keys", prefix)
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        for k in keys:
+            self.get(k, timeout)
+
+    def barrier(self, name: str, world_size: int,
+                timeout: Optional[float] = None) -> None:
+        """All `world_size` callers block until everyone arrived."""
+        n = self.add(f"__barrier/{name}/count", 1)
+        gen = (n - 1) // world_size  # reusable barrier generations
+        if n % world_size == 0:
+            self.set(f"__barrier/{name}/release{gen}", True)
+        self.get(f"__barrier/{name}/release{gen}", timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def shutdown_server(self) -> None:
+        if self._server is not None:
+            try:
+                self._call("shutdown")
+            except (TimeoutError, RuntimeError, OSError):
+                pass
+            self._server.server_close()
+            self._server = None
+        self.close()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1,0 +1,227 @@
+"""Launcher / store / spawn / elastic / rpc tests.
+
+Mirrors the reference's pattern of proving distributed plumbing with
+single-host multi-process runs (SURVEY.md §4: TestDistBase
+test_dist_base.py:901 subprocess workers + env contract assertions).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, free_port
+from paddle_tpu.distributed import elastic as el
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ store
+def test_tcp_store_set_get_add():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        store.set("k", {"a": 1})
+        assert store.get("k") == {"a": 1}
+        assert store.add("n", 2) == 2
+        assert store.add("n", 3) == 5
+        assert store.delete("k") is True
+        assert store.delete("k") is False
+        with pytest.raises(TimeoutError):
+            store.get("missing", timeout=0.2)
+    finally:
+        store.shutdown_server()
+
+
+def test_tcp_store_multiclient_wait_and_barrier():
+    master = TCPStore("127.0.0.1", 0, is_master=True)
+    port = master.port
+    results = []
+
+    def client(i):
+        c = TCPStore("127.0.0.1", port)
+        c.barrier("b1", 3, timeout=10.0)
+        results.append(i)
+        c.close()
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        assert results == []  # barrier holds until the 3rd participant
+        master.barrier("b1", 3, timeout=10.0)
+        for t in threads:
+            t.join(10.0)
+        assert sorted(results) == [0, 1]
+    finally:
+        master.shutdown_server()
+
+
+# ------------------------------------------------------------------ launch
+def test_launch_cli_env_contract(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        out = os.environ["OUT_DIR"]
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        info = {k: os.environ.get(k) for k in
+                ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                 "PADDLE_MASTER", "PADDLE_LOCAL_RANK", "PADDLE_JOB_ID")}
+        info["argv"] = sys.argv[1:]
+        with open(os.path.join(out, f"rank{rank}.json"), "w") as f:
+            json.dump(info, f)
+    """))
+    env = dict(os.environ, OUT_DIR=str(tmp_path), PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--job_id", "jtest",
+         "--log_dir", str(tmp_path / "logs"),
+         str(script), "--foo", "bar"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    import json
+    infos = [json.load(open(tmp_path / f"rank{i}.json"))
+             for i in range(2)]
+    assert [i["PADDLE_TRAINER_ID"] for i in infos] == ["0", "1"]
+    assert all(i["PADDLE_TRAINERS_NUM"] == "2" for i in infos)
+    assert all(i["PADDLE_JOB_ID"] == "jtest" for i in infos)
+    assert all(i["argv"] == ["--foo", "bar"] for i in infos)
+    assert (tmp_path / "logs" / "workerlog.0").exists()
+
+
+def test_launch_cli_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 3
+
+
+def test_launch_elastic_restart(tmp_path):
+    # worker exits 101 (elastic restart) once, then succeeds
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        marker = os.environ["MARKER"] + os.environ["PADDLE_TRAINER_ID"]
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(101)
+        sys.exit(0)
+    """))
+    env = dict(os.environ, PYTHONPATH=REPO,
+               MARKER=str(tmp_path / "m"))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restarts", "1", str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+
+# ------------------------------------------------------------------ spawn
+def _spawn_target(out_dir):
+    import json
+    import os
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    with open(os.path.join(out_dir, f"spawn{rank}.json"), "w") as f:
+        json.dump({"rank": rank,
+                   "world": os.environ["PADDLE_TRAINERS_NUM"]}, f)
+
+
+def test_spawn(tmp_path):
+    from paddle_tpu.distributed.spawn import spawn
+    spawn(_spawn_target, args=(str(tmp_path),), nprocs=2)
+    import json
+    infos = [json.load(open(tmp_path / f"spawn{i}.json"))
+             for i in range(2)]
+    assert sorted(i["rank"] for i in infos) == ["0", "1"]
+    assert all(i["world"] == "2" for i in infos)
+
+
+def _spawn_fail(_):
+    raise ValueError("boom")
+
+
+def test_spawn_raises_on_child_failure(tmp_path):
+    from paddle_tpu.distributed.spawn import spawn
+    with pytest.raises(RuntimeError, match="boom"):
+        spawn(_spawn_fail, args=(str(tmp_path),), nprocs=1)
+
+
+def test_launch_multiprocess_jax_distributed(tmp_path):
+    """Two real processes rendezvous via jax.distributed (the TCPStore
+    analog) and run a cross-process allgather — the reference's
+    test_dist_base subprocess-cluster pattern on the TPU stack."""
+    script = tmp_path / "jd_worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {REPO!r})
+        import paddle_tpu.distributed as dist
+        env = dist.init_parallel_env()
+        import jax, jax.numpy as jnp
+        assert jax.process_count() == 2
+        from jax.experimental import multihost_utils
+        got = multihost_utils.process_allgather(
+            jnp.array([jax.process_index()]))
+        assert sorted(int(x) for x in got.ravel()) == [0, 1]
+    """))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(script)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+
+
+# ----------------------------------------------------------------- elastic
+def test_elastic_membership_and_scale_event():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        m1 = el.ElasticManager(store, "job1", (1, 4), host="h1",
+                               heartbeat_timeout=30.0)
+        m2 = el.ElasticManager(store, "job1", (1, 4), host="h2",
+                               heartbeat_timeout=30.0)
+        m1.register()
+        assert m1.hosts() == ["h1"]
+        events = []
+        w = threading.Thread(
+            target=m1.watch,
+            kwargs=dict(on_scale=events.append, poll=0.05, max_events=1),
+            daemon=True)
+        w.start()
+        time.sleep(0.15)
+        m2.register()  # scale-up event
+        w.join(10.0)
+        assert events and events[0] == ["h1", "h2"]
+        m2.deregister()
+        assert m1.hosts() == ["h1"]
+    finally:
+        store.shutdown_server()
+
+
+# --------------------------------------------------------------------- rpc
+def _double(x):
+    return 2 * x
+
+
+def test_rpc_single_worker_roundtrip():
+    from paddle_tpu.distributed import rpc
+    port = free_port()
+    store = TCPStore("127.0.0.1", port, is_master=True)
+    try:
+        rpc.init_rpc("w0", rank=0, world_size=1, store=store)
+        assert rpc.rpc_sync("w0", _double, args=(21,)) == 42
+        fut = rpc.rpc_async("w0", _double, args=(5,))
+        assert fut.result(timeout=10) == 10
+        info = rpc.get_worker_info()
+        assert info.name == "w0" and info.rank == 0
+        rpc.shutdown()
+    finally:
+        store.shutdown_server()
