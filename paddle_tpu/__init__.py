@@ -61,7 +61,7 @@ from .jit.api import grad, value_and_grad  # noqa: F401,E402
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
 _LAZY = {"distributed", "models", "vision", "kernels", "hapi", "profiler",
-         "incubate", "static"}
+         "incubate", "inference", "static"}
 
 
 def __getattr__(name):

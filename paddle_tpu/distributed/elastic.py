@@ -44,7 +44,10 @@ class ElasticManager:
         return f"{_PREFIX}/{self.job_id}/nodes/{host}"
 
     def register(self) -> None:
-        self.store.set(self._key(self.host), time.time())
+        # server-clock stamps: cross-host wall clocks may be skewed by
+        # more than heartbeat_timeout, so liveness must be judged on one
+        # clock — the store server's
+        self.store.set_timestamp(self._key(self.host))
 
     def deregister(self) -> None:
         try:
@@ -53,11 +56,11 @@ class ElasticManager:
             pass
 
     def heartbeat(self) -> None:
-        self.store.set(self._key(self.host), time.time())
+        self.store.set_timestamp(self._key(self.host))
 
     def hosts(self) -> List[str]:
         prefix = f"{_PREFIX}/{self.job_id}/nodes/"
-        now = time.time()
+        now = self.store.now()
         alive = []
         for k in self.store.keys(prefix):
             try:

@@ -115,8 +115,18 @@ def init_rpc(name: str, rank: Optional[int] = None,
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) \
         if world_size is None else world_size
     if store is None:
-        ep = master_endpoint or os.environ.get("PADDLE_MASTER") or \
-            f"127.0.0.1:{free_port()}"
+        # NOTE: PADDLE_MASTER is where init_parallel_env binds the jax
+        # coordination service — the rpc store must NOT reuse that port
+        # (EADDRINUSE on rank 0). Default to master port + 1, override
+        # with PADDLE_RPC_MASTER / master_endpoint.
+        ep = master_endpoint or os.environ.get("PADDLE_RPC_MASTER")
+        if ep is None:
+            base = os.environ.get("PADDLE_MASTER")
+            if base:
+                host, port = base.rsplit(":", 1)
+                ep = f"{host}:{int(port) + 1}"
+            else:
+                ep = f"127.0.0.1:{free_port()}"
         host, port = ep.rsplit(":", 1)
         store = TCPStore(host, int(port), is_master=(rank == 0))
     _RPC_STATE["rpc"] = _Rpc(name, rank, world_size, store)

@@ -33,11 +33,26 @@ class SpawnContext:
         self._err_q = err_q
 
     def join(self, timeout: Optional[float] = None) -> bool:
-        for p in self.processes:
-            p.join(timeout)
-        alive = [p for p in self.processes if p.is_alive()]
-        if alive:
-            return False
+        """Wait for all children; if any child fails while siblings are
+        still blocked (e.g. on the rendezvous), terminate the siblings
+        so the failure surfaces instead of hanging (reference spawn.py
+        does the same)."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            codes = [p.exitcode for p in self.processes]
+            if any(c not in (None, 0) for c in codes):
+                for p in self.processes:
+                    if p.is_alive():
+                        p.terminate()
+                for p in self.processes:
+                    p.join(10.0)
+                break
+            if all(c == 0 for c in codes):
+                break
+            if deadline is not None and _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.05)
         bad = [p for p in self.processes if p.exitcode != 0]
         if bad:
             msg = ""

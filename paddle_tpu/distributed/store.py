@@ -69,21 +69,36 @@ class _StoreHandler(socketserver.BaseRequestHandler):
                 elif op == "get":
                     key, timeout = args
                     deadline = time.monotonic() + timeout
+                    # reply OUTSIDE the lock: a stalled client socket
+                    # must not block every other handler thread
                     with srv.cond:
                         while key not in srv.kv:
                             left = deadline - time.monotonic()
                             if left <= 0 or not srv.cond.wait(left):
                                 break
-                        if key in srv.kv:
-                            _send_msg(self.request, ("ok", srv.kv[key]))
-                        else:
-                            _send_msg(self.request, ("timeout", key))
+                        found = key in srv.kv
+                        val = srv.kv.get(key)
+                    if found:
+                        _send_msg(self.request, ("ok", val))
+                    else:
+                        _send_msg(self.request, ("timeout", key))
                 elif op == "add":
                     key, delta = args
                     with srv.cond:
                         srv.kv[key] = int(srv.kv.get(key, 0)) + delta
+                        val = srv.kv[key]
                         srv.cond.notify_all()
-                        _send_msg(self.request, ("ok", srv.kv[key]))
+                    _send_msg(self.request, ("ok", val))
+                elif op == "setts":
+                    # server-clock timestamp write (elastic heartbeats:
+                    # cross-host wall clocks can't be compared)
+                    (key,) = args
+                    with srv.cond:
+                        srv.kv[key] = time.time()
+                        srv.cond.notify_all()
+                    _send_msg(self.request, ("ok", None))
+                elif op == "now":
+                    _send_msg(self.request, ("ok", time.time()))
                 elif op == "delete":
                     (key,) = args
                     with srv.cond:
@@ -148,7 +163,7 @@ class TCPStore:
 
     # ops safe to re-send after a broken pipe; "add" is NOT (a lost
     # reply would double-count and corrupt barrier generations)
-    _IDEMPOTENT = {"set", "get", "delete", "keys"}
+    _IDEMPOTENT = {"set", "get", "delete", "keys", "setts", "now"}
 
     def _call(self, *msg):
         with self._lock:
@@ -195,6 +210,14 @@ class TCPStore:
 
     def keys(self, prefix: str = "") -> List[str]:
         return self._call("keys", prefix)
+
+    def set_timestamp(self, key: str) -> None:
+        """Write the SERVER's clock under key (skew-free heartbeats)."""
+        self._call("setts", key)
+
+    def now(self) -> float:
+        """The server's current wall clock."""
+        return self._call("now")
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
         for k in keys:

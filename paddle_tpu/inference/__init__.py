@@ -1,0 +1,11 @@
+"""paddle_tpu.inference — deployment/serving path.
+
+Reference analog: paddle.inference (paddle/fluid/inference/api/
+analysis_predictor.cc:263,893,1643 — AnalysisConfig + AnalysisPredictor
++ ZeroCopy tensor handles). TPU-native: the IR-pass pipeline and TRT
+subgraph engines collapse into XLA AOT compilation of an exported
+StableHLO artifact; precision conversion happens at trace time.
+"""
+from .config import Config, PrecisionType  # noqa: F401
+from .predictor import (InferTensor, Predictor,  # noqa: F401
+                        create_predictor)

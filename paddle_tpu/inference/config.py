@@ -1,0 +1,91 @@
+"""AnalysisConfig analog.
+
+Reference: paddle/fluid/inference/api/analysis_config.cc + the
+paddle.inference.Config python surface. Options that configured CUDA
+streams, MKLDNN, or the IR pass list map to XLA equivalents or become
+recorded no-ops (XLA already fuses/plans memory); the ones that matter
+on TPU: model location, precision mode, and the persistent compile
+cache directory (the AOT analog of the inference program cache).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class Config:
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        """`prog_file` may be the path prefix produced by
+        `paddle_tpu.jit.save` or `static.save_inference_model`."""
+        self._model_prefix: Optional[str] = None
+        self._layer = None
+        self._input_spec = None
+        self.precision: str = PrecisionType.Float32
+        self.device: str = "tpu"
+        self._memory_optim = True
+        self._ir_optim = True
+        self._compile_cache_dir: Optional[str] = None
+        self._math_threads = 1
+        if prog_file is not None:
+            self.set_model(prog_file, params_file)
+
+    # ---------------------------------------------------------- model src
+    def set_model(self, prefix: str, params_file: Optional[str] = None):
+        """Point at a saved artifact. Accepts the path prefix used by
+        jit.save (`prefix.stablehlo`) or save_inference_model
+        (`prefix.pdmodel`)."""
+        self._model_prefix = prefix
+        return self
+
+    def from_layer(self, layer, input_spec):
+        """Serve a live Layer (re-traced under this config's precision) —
+        the analog of feeding a Program straight to the predictor."""
+        self._layer = layer
+        self._input_spec = input_spec
+        return self
+
+    def model_dir(self) -> Optional[str]:
+        return os.path.dirname(self._model_prefix) \
+            if self._model_prefix else None
+
+    # ------------------------------------------------------------- knobs
+    def enable_tpu(self, precision: str = PrecisionType.Bfloat16):
+        """≈ enable_use_gpu: select accelerator + serving precision."""
+        self.device = "tpu"
+        self.precision = precision
+        return self
+
+    def disable_gpu(self):
+        self.device = "cpu"
+        return self
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag  # XLA plans memory; recorded for parity
+        return self
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag  # XLA pass pipeline always runs
+        return self
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._math_threads = n
+        return self
+
+    def set_compile_cache_dir(self, path: str):
+        """Persistent XLA compile cache (the AOT 'optimized program'
+        cache the reference keeps per AnalysisPredictor)."""
+        self._compile_cache_dir = path
+        return self
+
+    def summary(self) -> str:
+        return (f"Config(model={self._model_prefix or self._layer}, "
+                f"device={self.device}, precision={self.precision}, "
+                f"memory_optim={self._memory_optim})")

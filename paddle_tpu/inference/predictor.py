@@ -1,0 +1,220 @@
+"""AnalysisPredictor analog: AOT-compiled serving sessions.
+
+Reference call path (SURVEY.md §3.5): CreatePredictor(AnalysisConfig) →
+PrepareProgram → OptimizeInferenceProgram (IR passes, TRT capture) →
+ZeroCopyRun over feed/fetch handles (analysis_predictor.cc:263,509,
+893,1249,1643). TPU-native: "optimize" = XLA compiling the traced /
+deserialized StableHLO once (cached persistently when the config names
+a compile-cache dir); feed/fetch handles keep the ZeroCopy API shape
+(copy_from_cpu / copy_to_cpu) but hand jax device arrays around.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config, PrecisionType
+
+__all__ = ["InferTensor", "Predictor", "create_predictor"]
+
+_COMPILE_CACHE_DIR: Optional[str] = None
+
+
+def _ensure_compile_cache(path: str) -> None:
+    """jax's persistent compile cache is process-global; set it once and
+    refuse to silently re-point it (predictor B must not hijack A's
+    cache dir)."""
+    global _COMPILE_CACHE_DIR
+    if _COMPILE_CACHE_DIR is None:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        _COMPILE_CACHE_DIR = path
+    elif os.path.abspath(path) != os.path.abspath(_COMPILE_CACHE_DIR):
+        import warnings
+        warnings.warn(
+            f"compile cache already at {_COMPILE_CACHE_DIR!r}; the jax "
+            f"cache dir is process-global, ignoring {path!r}")
+
+
+class InferTensor:
+    """ZeroCopyTensor analog (inference/api/details/zero_copy_tensor.cc):
+    a named feed/fetch slot on the predictor."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray) -> None:
+        if not self._is_input:
+            raise RuntimeError(f"{self.name} is an output handle")
+        self._owner._feeds[self.name] = jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            raise RuntimeError(f"{self.name} is an input handle")
+        out = self._owner._outputs.get(self.name)
+        if out is None:
+            raise RuntimeError("run() the predictor first")
+        return np.asarray(out)
+
+    def share_external_data(self, arr) -> None:
+        """Zero-copy feed of an existing device array."""
+        self._owner._feeds[self.name] = arr if isinstance(arr, jax.Array) \
+            else jnp.asarray(arr)
+
+    @property
+    def shape(self):
+        src = self._owner._feeds if self._is_input else self._owner._outputs
+        val = src.get(self.name)
+        return None if val is None else tuple(val.shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self.config = config
+        if config._compile_cache_dir:
+            _ensure_compile_cache(config._compile_cache_dir)
+        self._feeds: Dict[str, jax.Array] = {}
+        self._outputs: Dict[str, jax.Array] = {}
+        if config._layer is not None:
+            self._build_from_layer()
+        elif config._model_prefix is not None:
+            self._build_from_artifact()
+        else:
+            raise ValueError("Config names neither a saved model nor a "
+                             "live layer")
+
+    # ----------------------------------------------------------- sources
+    def _build_from_artifact(self) -> None:
+        prefix = self.config._model_prefix
+        if os.path.exists(prefix + ".pdmodel"):
+            from ..static.io import LoadedInferenceProgram
+            prog = LoadedInferenceProgram(prefix)
+            self._input_names = list(prog.feed_names)
+            self._output_names = list(prog.fetch_names)
+            exported, persist = prog._exported, prog._persist_vals
+
+            def run_fn(feeds: List[jax.Array]):
+                return list(exported.call(persist, *feeds))
+        elif os.path.exists(prefix + ".stablehlo"):
+            from ..jit.save_load import LoadedFunction
+            fn = LoadedFunction(prefix)
+            n_in = fn._meta["n_inputs"]
+            self._input_names = [f"x{i}" for i in range(n_in)]
+            exported, state = fn._exported, fn._state_vals
+
+            def run_fn(feeds: List[jax.Array]):
+                out = exported.call(state, *feeds)
+                leaves = jax.tree_util.tree_leaves(out)
+                return list(leaves)
+
+            self._output_names = None  # discovered on first run
+        else:
+            raise FileNotFoundError(
+                f"no {prefix}.pdmodel or {prefix}.stablehlo")
+        self._run_fn = run_fn
+
+    def _build_from_layer(self) -> None:
+        from ..core.tensor import Tensor
+        from ..jit.api import functional_call
+        from ..jit.save_load import _to_sds
+
+        layer = self.config._layer
+        layer.eval()
+        state = layer.state_dict()
+        names = list(state.keys())
+        vals = [t._data for t in state.values()]
+        prec = self.config.precision
+        if prec in (PrecisionType.Bfloat16, PrecisionType.Half):
+            # mixed-precision convert pass analog
+            # (inference/analysis/passes/convert_to_mixed_precision.cc):
+            # cast float params at load, trace compute in that dtype
+            target = jnp.bfloat16 if prec == PrecisionType.Bfloat16 \
+                else jnp.float16
+            vals = [v.astype(target)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for v in vals]
+        specs = [_to_sds(s) for s in self.config._input_spec]
+        self._input_names = [f"x{i}" for i in range(len(specs))]
+        self._output_names = None
+
+        def fwd(param_vals, *inputs):
+            out = functional_call(layer, dict(zip(names, param_vals)),
+                                  *[Tensor(i) for i in inputs])
+            return [t._data if isinstance(t, Tensor) else t
+                    for t in jax.tree_util.tree_leaves(
+                        out, is_leaf=lambda x: isinstance(x, Tensor))]
+
+        jitted = jax.jit(fwd)
+
+        def run_fn(feeds: List[jax.Array]):
+            cast = []
+            for f, spec in zip(feeds, specs):
+                if prec in (PrecisionType.Bfloat16, PrecisionType.Half) \
+                        and jnp.issubdtype(f.dtype, jnp.floating):
+                    tgt = jnp.bfloat16 if prec == PrecisionType.Bfloat16 \
+                        else jnp.float16
+                    f = f.astype(tgt)
+                cast.append(f)
+            return jitted(vals, *cast)
+
+        self._run_fn = run_fn
+
+    # --------------------------------------------------------------- api
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> InferTensor:
+        if name not in self._input_names:
+            raise KeyError(f"unknown input {name!r}; "
+                           f"have {self._input_names}")
+        return InferTensor(name, self, is_input=True)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """ZeroCopyRun analog; also accepts positional arrays directly
+        (the newer predictor.run(list) API)."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._feeds[n] = jnp.asarray(a)
+        missing = [n for n in self._input_names if n not in self._feeds]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        feeds = [self._feeds[n] for n in self._input_names]
+        outs = self._run_fn(feeds)
+        if self._output_names is None:
+            self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = dict(zip(self._output_names, outs))
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        if self._output_names is None:
+            raise RuntimeError("run() the predictor first")
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> InferTensor:
+        if self._output_names is not None and \
+                name not in self._output_names:
+            raise KeyError(f"unknown output {name!r}; "
+                           f"have {self._output_names}")
+        return InferTensor(name, self, is_input=False)
+
+    def clone(self) -> "Predictor":
+        """A second session over the same compiled artifact/weights
+        (analysis_predictor.cc Clone: shares the program, new scope)."""
+        import copy
+        twin = copy.copy(self)
+        twin._feeds, twin._outputs = {}, {}
+        return twin
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,112 @@
+"""Inference predictor tests (AnalysisPredictor analog).
+
+Mirrors the reference's inference API tests
+(paddle/fluid/inference/tests/api/) — save a model, create a predictor,
+feed via handles, compare outputs against the live model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+
+
+def _small_model():
+    paddle.seed(7)
+    return nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_predictor_from_jit_artifact(tmp_path):
+    model = _small_model()
+    x = paddle.randn([2, 8])
+    ref = model(x).numpy()
+    path = str(tmp_path / "m")
+    paddle.jit.save(model, path, input_spec=[x])
+
+    config = Config(path)
+    config.set_compile_cache_dir(str(tmp_path / "cache"))
+    pred = create_predictor(config)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x.numpy())
+    assert pred.run() is True
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_run_list_api(tmp_path):
+    model = _small_model()
+    x = paddle.randn([3, 8])
+    ref = model(x).numpy()
+    paddle.jit.save(model, str(tmp_path / "m"), input_spec=[x])
+    pred = create_predictor(Config(str(tmp_path / "m")))
+    outs = pred.run([x.numpy()])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_from_layer_bf16():
+    model = _small_model()
+    x = paddle.randn([2, 8])
+    ref = model(x).numpy()
+    config = Config().from_layer(model, input_spec=[x])
+    config.enable_tpu(precision=PrecisionType.Bfloat16)
+    pred = create_predictor(config)
+    outs = pred.run([x.numpy()])
+    # bf16 serving ~ 1e-2 agreement with fp32
+    np.testing.assert_allclose(outs[0].astype(np.float32), ref,
+                               rtol=0.1, atol=0.1)
+
+
+def test_predictor_clone_isolated_feeds(tmp_path):
+    model = _small_model()
+    x1 = paddle.randn([2, 8])
+    x2 = paddle.randn([2, 8])
+    paddle.jit.save(model, str(tmp_path / "m"), input_spec=[x1])
+    p1 = create_predictor(Config(str(tmp_path / "m")))
+    p2 = p1.clone()
+    o1 = p1.run([x1.numpy()])[0]
+    o2 = p2.run([x2.numpy()])[0]
+    np.testing.assert_allclose(o1, model(x1).numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(o2, model(x2).numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_predictor_from_static_inference_model(tmp_path):
+    # static path: build a program, save_inference_model, serve it
+    from paddle_tpu import static
+    paddle.seed(0)
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        lin = nn.Linear(4, 3)
+        x = static.data("x", [None, 4], "float32")
+        y = lin(x)
+    exe = static.Executor()
+    exe.run(startup)
+    prefix = str(tmp_path / "static_m")
+    static.save_inference_model(prefix, [x], [y], executor=exe,
+                                program=main)
+    pred = create_predictor(Config(prefix))
+    xin = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    out = pred.run([xin])[0]
+    ref = exe.run(main, feed={"x": xin}, fetch_list=[y])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_errors(tmp_path):
+    with pytest.raises(ValueError):
+        create_predictor(Config())
+    with pytest.raises(FileNotFoundError):
+        create_predictor(Config(str(tmp_path / "nope")))
+    model = _small_model()
+    x = paddle.randn([2, 8])
+    paddle.jit.save(model, str(tmp_path / "m"), input_spec=[x])
+    pred = create_predictor(Config(str(tmp_path / "m")))
+    with pytest.raises(KeyError):
+        pred.get_input_handle("bogus")
+    with pytest.raises(RuntimeError, match="inputs not set"):
+        pred.run()
