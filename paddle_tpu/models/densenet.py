@@ -1,0 +1,115 @@
+"""DenseNet (≈ python/paddle/vision/models/densenet.py:
+densenet121/161/169/201/264)."""
+from __future__ import annotations
+
+from ..nn.container import Sequential
+from ..nn.layer import Layer
+from ..nn.layers_common import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D,
+                                Conv2D, Linear, MaxPool2D, ReLU)
+from ..ops.manipulation import concat, flatten
+
+
+class DenseLayer(Layer):
+    def __init__(self, c_in, growth_rate, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(c_in)
+        self.conv1 = Conv2D(c_in, bn_size * growth_rate, 1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3,
+                            padding=1, bias_attr=False)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return concat([x, out], axis=1)
+
+
+class DenseBlock(Layer):
+    def __init__(self, num_layers, c_in, growth_rate, bn_size):
+        super().__init__()
+        self.layers = Sequential(*[
+            DenseLayer(c_in + i * growth_rate, growth_rate, bn_size)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        return self.layers(x)
+
+
+class Transition(Layer):
+    def __init__(self, c_in, c_out):
+        super().__init__()
+        self.bn = BatchNorm2D(c_in)
+        self.relu = ReLU()
+        self.conv = Conv2D(c_in, c_out, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_CFGS = {
+    121: (32, (6, 12, 24, 16), 64),
+    161: (48, (6, 12, 36, 24), 96),
+    169: (32, (6, 12, 32, 32), 64),
+    201: (32, (6, 12, 48, 32), 64),
+    264: (32, (6, 12, 64, 48), 64),
+}
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        growth_rate, block_cfg, c0 = _CFGS[layers]
+        self.conv1 = Conv2D(3, c0, 7, stride=2, padding=3,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(c0)
+        self.relu = ReLU()
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        c = c0
+        for i, n in enumerate(block_cfg):
+            blocks.append(DenseBlock(n, c, growth_rate, bn_size))
+            c += n * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(Transition(c, c // 2))
+                c //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_last = BatchNorm2D(c)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.relu(self.bn_last(self.blocks(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def densenet121(**kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(**kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(264, **kw)

@@ -1,0 +1,208 @@
+"""GoogLeNet / InceptionV3 (≈ python/paddle/vision/models/googlenet.py,
+inceptionv3.py)."""
+from __future__ import annotations
+
+from ..nn.container import Sequential
+from ..nn.layer import Layer
+from ..nn.layers_common import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D,
+                                Conv2D, Dropout, Linear, MaxPool2D, ReLU)
+from ..ops.manipulation import concat, flatten
+
+
+class ConvBN(Layer):
+    def __init__(self, c_in, c_out, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(c_in, c_out, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(c_out)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class Inception(Layer):
+    """GoogLeNet inception-v1 block."""
+
+    def __init__(self, c_in, c1, c3r, c3, c5r, c5, pool_proj):
+        super().__init__()
+        self.b1 = ConvBN(c_in, c1, 1)
+        self.b2 = Sequential(ConvBN(c_in, c3r, 1),
+                             ConvBN(c3r, c3, 3, padding=1))
+        self.b3 = Sequential(ConvBN(c_in, c5r, 1),
+                             ConvBN(c5r, c5, 5, padding=2))
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             ConvBN(c_in, pool_proj, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            ConvBN(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            ConvBN(64, 64, 1), ConvBN(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc3 = Sequential(
+            Inception(192, 64, 96, 128, 16, 32, 32),
+            Inception(256, 128, 128, 192, 32, 96, 64),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc4 = Sequential(
+            Inception(480, 192, 96, 208, 16, 48, 64),
+            Inception(512, 160, 112, 224, 24, 64, 64),
+            Inception(512, 128, 128, 256, 24, 64, 64),
+            Inception(512, 112, 144, 288, 32, 64, 64),
+            Inception(528, 256, 160, 320, 32, 128, 128),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc5 = Sequential(
+            Inception(832, 256, 160, 320, 32, 128, 128),
+            Inception(832, 384, 192, 384, 48, 128, 128))
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+# --------------------------------------------------------- inception v3
+class InceptionA(Layer):
+    def __init__(self, c_in, pool_features):
+        super().__init__()
+        self.b1 = ConvBN(c_in, 64, 1)
+        self.b5 = Sequential(ConvBN(c_in, 48, 1),
+                             ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(ConvBN(c_in, 64, 1),
+                             ConvBN(64, 96, 3, padding=1),
+                             ConvBN(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             ConvBN(c_in, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                      axis=1)
+
+
+class InceptionB(Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = ConvBN(c_in, 384, 3, stride=2)
+        self.b3d = Sequential(ConvBN(c_in, 64, 1),
+                              ConvBN(64, 96, 3, padding=1),
+                              ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, c_in, c7):
+        super().__init__()
+        self.b1 = ConvBN(c_in, 192, 1)
+        self.b7 = Sequential(
+            ConvBN(c_in, c7, 1), ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            ConvBN(c_in, c7, 1), ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             ConvBN(c_in, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                      axis=1)
+
+
+class InceptionD(Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = Sequential(ConvBN(c_in, 192, 1),
+                             ConvBN(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            ConvBN(c_in, 192, 1),
+            ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = ConvBN(c_in, 320, 1)
+        self.b3_stem = ConvBN(c_in, 384, 1)
+        self.b3_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = Sequential(ConvBN(c_in, 448, 1),
+                                   ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             ConvBN(c_in, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s), self.b3_b(s)], axis=1),
+                       concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            ConvBN(3, 32, 3, stride=2), ConvBN(32, 32, 3),
+            ConvBN(32, 64, 3, padding=1), MaxPool2D(3, stride=2),
+            ConvBN(64, 80, 1), ConvBN(80, 192, 3),
+            MaxPool2D(3, stride=2))
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160),
+            InceptionC(768, 160), InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)

@@ -1,0 +1,229 @@
+"""MobileNet v1/v2/v3 (≈ python/paddle/vision/models/mobilenetv1.py,
+mobilenetv2.py, mobilenetv3.py). Depthwise convs are grouped convs —
+XLA lowers them to efficient TPU convolutions."""
+from __future__ import annotations
+
+from ..nn.container import Sequential
+from ..nn.layer import Layer
+from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
+                                Dropout, Hardsigmoid, Hardswish, Linear,
+                                ReLU, ReLU6)
+from ..ops.manipulation import flatten
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, c_in, c_out, k, stride=1, groups=1, act=ReLU):
+        super().__init__()
+        self.conv = Conv2D(c_in, c_out, k, stride=stride,
+                           padding=(k - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(c_out)
+        self.act = act() if act is not None else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+# ------------------------------------------------------------------- v1
+class DepthwiseSeparable(Layer):
+    def __init__(self, c_in, c_mid, c_out, stride):
+        super().__init__()
+        self.dw = ConvBNLayer(c_in, c_mid, 3, stride=stride, groups=c_in)
+        self.pw = ConvBNLayer(c_mid, c_out, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: int(c * scale)
+        self.conv1 = ConvBNLayer(3, s(32), 3, stride=2)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        self.blocks = Sequential(*[
+            DepthwiseSeparable(s(ci), s(ci), s(co), st)
+            for ci, co, st in cfg])
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+# ------------------------------------------------------------------- v2
+class InvertedResidual(Layer):
+    def __init__(self, c_in, c_out, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(c_in * expand_ratio))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(c_in, hidden, 1, act=ReLU6))
+        layers += [
+            ConvBNLayer(hidden, hidden, 3, stride=stride, groups=hidden,
+                        act=ReLU6),
+            ConvBNLayer(hidden, c_out, 1, act=None)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+        c_in = _make_divisible(32 * scale)
+        features = [ConvBNLayer(3, c_in, 3, stride=2, act=ReLU6)]
+        for t, c, n, s in cfg:
+            c_out = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(InvertedResidual(
+                    c_in, c_out, s if i == 0 else 1, t))
+                c_in = c_out
+        self.last_c = _make_divisible(1280 * max(1.0, scale))
+        features.append(ConvBNLayer(c_in, self.last_c, 1, act=ReLU6))
+        self.features = Sequential(*features)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(self.last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+# ------------------------------------------------------------------- v3
+class SqueezeExcite(Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        mid = _make_divisible(channels // reduction)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(channels, mid, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(mid, channels, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class V3Block(Layer):
+    def __init__(self, c_in, c_mid, c_out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and c_in == c_out
+        self.expand = ConvBNLayer(c_in, c_mid, 1, act=act) \
+            if c_mid != c_in else None
+        self.dw = ConvBNLayer(c_mid, c_mid, k, stride=stride,
+                              groups=c_mid, act=act)
+        self.se = SqueezeExcite(c_mid) if use_se else None
+        self.pw = ConvBNLayer(c_mid, c_out, 1, act=None)
+
+    def forward(self, x):
+        out = x if self.expand is None else self.expand(x)
+        out = self.dw(out)
+        if self.se is not None:
+            out = self.se(out)
+        out = self.pw(out)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, ReLU, 1), (3, 64, 24, False, ReLU, 2),
+    (3, 72, 24, False, ReLU, 1), (5, 72, 40, True, ReLU, 2),
+    (5, 120, 40, True, ReLU, 1), (5, 120, 40, True, ReLU, 1),
+    (3, 240, 80, False, Hardswish, 2), (3, 200, 80, False, Hardswish, 1),
+    (3, 184, 80, False, Hardswish, 1), (3, 184, 80, False, Hardswish, 1),
+    (3, 480, 112, True, Hardswish, 1), (3, 672, 112, True, Hardswish, 1),
+    (5, 672, 160, True, Hardswish, 2), (5, 960, 160, True, Hardswish, 1),
+    (5, 960, 160, True, Hardswish, 1)]
+_V3_SMALL = [
+    (3, 16, 16, True, ReLU, 2), (3, 72, 24, False, ReLU, 2),
+    (3, 88, 24, False, ReLU, 1), (5, 96, 40, True, Hardswish, 2),
+    (5, 240, 40, True, Hardswish, 1), (5, 240, 40, True, Hardswish, 1),
+    (5, 120, 48, True, Hardswish, 1), (5, 144, 48, True, Hardswish, 1),
+    (5, 288, 96, True, Hardswish, 2), (5, 576, 96, True, Hardswish, 1),
+    (5, 576, 96, True, Hardswish, 1)]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c_in = _make_divisible(16 * scale)
+        layers = [ConvBNLayer(3, c_in, 3, stride=2, act=Hardswish)]
+        for k, exp, c, se, act, s in cfg:
+            c_mid = _make_divisible(exp * scale)
+            c_out = _make_divisible(c * scale)
+            layers.append(V3Block(c_in, c_mid, c_out, k, s, se, act))
+            c_in = c_out
+        c_last = _make_divisible(cfg[-1][1] * scale)
+        layers.append(ConvBNLayer(c_in, c_last, 1, act=Hardswish))
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(c_last, last_c), Hardswish(), Dropout(0.2),
+                Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+def mobilenet_v3_large(scale=1.0, **kw):
+    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kw)
+
+
+def mobilenet_v3_small(scale=1.0, **kw):
+    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kw)

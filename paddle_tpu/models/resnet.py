@@ -36,14 +36,16 @@ class BasicBlock(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(planes)
-        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn2 = BatchNorm2D(planes)
-        self.conv3 = Conv2D(planes, planes * 4, 1, bias_attr=False)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = BatchNorm2D(planes * 4)
         self.downsample = downsample
         self.relu = ReLU()
@@ -59,9 +61,17 @@ class BottleneckBlock(Layer):
 
 
 class ResNet(Layer):
-    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True):
+    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
+                 groups=1, width_per_group=64):
         super().__init__()
+        if not issubclass(block, BottleneckBlock) and \
+                (groups != 1 or width_per_group != 64):
+            raise ValueError(
+                "groups/width_per_group require BottleneckBlock "
+                "(resnet50+); BasicBlock variants do not support them")
         self.inplanes = 64
+        self.groups = groups
+        self.base_width = width_per_group
         self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
         self.bn1 = BatchNorm2D(64)
         self.relu = ReLU()
@@ -84,10 +94,13 @@ class ResNet(Layer):
                 Conv2D(self.inplanes, planes * block.expansion, 1,
                        stride=stride, bias_attr=False),
                 BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        kw = {}
+        if issubclass(block, BottleneckBlock):
+            kw = dict(groups=self.groups, base_width=self.base_width)
+        layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **kw))
         return Sequential(*layers)
 
     def forward(self, x):
@@ -129,3 +142,27 @@ def resnet101(**kw):
 
 def resnet152(**kw):
     return ResNet(*_CFGS[152], **kw)
+
+
+def resnext50_32x4d(**kw):
+    return ResNet(*_CFGS[50], groups=32, width_per_group=4, **kw)
+
+
+def resnext101_32x4d(**kw):
+    return ResNet(*_CFGS[101], groups=32, width_per_group=4, **kw)
+
+
+def resnext101_64x4d(**kw):
+    return ResNet(*_CFGS[101], groups=64, width_per_group=4, **kw)
+
+
+def resnext152_64x4d(**kw):
+    return ResNet(*_CFGS[152], groups=64, width_per_group=4, **kw)
+
+
+def wide_resnet50_2(**kw):
+    return ResNet(*_CFGS[50], width_per_group=128, **kw)
+
+
+def wide_resnet101_2(**kw):
+    return ResNet(*_CFGS[101], width_per_group=128, **kw)

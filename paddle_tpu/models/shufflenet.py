@@ -1,0 +1,110 @@
+"""ShuffleNetV2 (≈ python/paddle/vision/models/shufflenetv2.py).
+Channel shuffle is a reshape/transpose pair — free for XLA."""
+from __future__ import annotations
+
+from ..nn.container import Sequential
+from ..nn.layer import Layer
+from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
+                                Linear, MaxPool2D, ReLU)
+from ..ops.manipulation import concat, flatten, reshape, transpose
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [n, c, h, w])
+
+
+def _conv_bn(c_in, c_out, k, stride=1, groups=1, act=True):
+    layers = [Conv2D(c_in, c_out, k, stride=stride, padding=k // 2,
+                     groups=groups, bias_attr=False), BatchNorm2D(c_out)]
+    if act:
+        layers.append(ReLU())
+    return Sequential(*layers)
+
+
+class ShuffleUnit(Layer):
+    def __init__(self, c_in, c_out, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = c_out // 2
+        if stride > 1:
+            self.branch1 = Sequential(
+                _conv_bn(c_in, c_in, 3, stride=stride, groups=c_in,
+                         act=False),
+                _conv_bn(c_in, branch_c, 1))
+            b2_in = c_in
+        else:
+            self.branch1 = None
+            b2_in = c_in // 2
+        self.branch2 = Sequential(
+            _conv_bn(b2_in, branch_c, 1),
+            _conv_bn(branch_c, branch_c, 3, stride=stride, groups=branch_c,
+                     act=False),
+            _conv_bn(branch_c, branch_c, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.5: [24, 48, 96, 192, 1024],
+    1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024],
+    2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn(3, cfg[0], 3, stride=2)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        c_in = cfg[0]
+        for c_out, repeats in zip(cfg[1:4], (4, 8, 4)):
+            units = [ShuffleUnit(c_in, c_out, 2)]
+            units += [ShuffleUnit(c_out, c_out, 1)
+                      for _ in range(repeats - 1)]
+            stages.append(Sequential(*units))
+            c_in = c_out
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(c_in, cfg[4], 1)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(cfg[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)

@@ -1,0 +1,78 @@
+"""VGG family (≈ python/paddle/vision/models/vgg.py: vgg11/13/16/19
+with optional batch norm)."""
+from __future__ import annotations
+
+from ..nn.container import Sequential
+from ..nn.layer import Layer
+from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
+                                Dropout, Linear, MaxPool2D, ReLU)
+from ..ops.manipulation import flatten
+
+_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+         "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+         512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_features(cfg, batch_norm):
+    layers = []
+    c_in = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(2, stride=2))
+        else:
+            layers.append(Conv2D(c_in, v, 3, padding=1))
+            if batch_norm:
+                layers.append(BatchNorm2D(v))
+            layers.append(ReLU())
+            c_in = v
+    return Sequential(*layers)
+
+
+class VGG(Layer):
+    def __init__(self, features, num_classes=1000, with_pool=True,
+                 dropout=0.5):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(7)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(512 * 7 * 7, 4096), ReLU(), Dropout(dropout),
+                Linear(4096, 4096), ReLU(), Dropout(dropout),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def _vgg(depth, batch_norm=False, **kw):
+    return VGG(_make_features(_CFGS[depth], batch_norm), **kw)
+
+
+def vgg11(batch_norm=False, **kw):
+    return _vgg(11, batch_norm, **kw)
+
+
+def vgg13(batch_norm=False, **kw):
+    return _vgg(13, batch_norm, **kw)
+
+
+def vgg16(batch_norm=False, **kw):
+    return _vgg(16, batch_norm, **kw)
+
+
+def vgg19(batch_norm=False, **kw):
+    return _vgg(19, batch_norm, **kw)

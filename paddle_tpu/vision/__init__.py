@@ -1,0 +1,8 @@
+"""paddle.vision analog: models, transforms, datasets.
+
+Reference: python/paddle/vision/ (13 model families, transforms,
+datasets — SURVEY.md §2.4).
+"""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401

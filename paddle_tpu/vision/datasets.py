@@ -1,0 +1,241 @@
+"""paddle.vision.datasets analog.
+
+Reference: python/paddle/vision/datasets/{mnist,cifar,folder}.py —
+map-style Datasets over standard file formats. This environment has no
+network egress, so `download=True` raises with instructions; datasets
+read standard local files (MNIST idx, CIFAR pickle batches, image
+folders), and FakeData provides a synthetic stand-in for tests and
+pipeline bring-up.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder", "FakeData"]
+
+
+def _no_download(name: str):
+    raise RuntimeError(
+        f"{name}: download is unavailable in this environment; place the "
+        f"standard files locally and pass data_dir/image_path")
+
+
+class MNIST(Dataset):
+    """Reads the standard idx files (train-images-idx3-ubyte[.gz], ...)."""
+
+    _PREFIX = {"train": ("train-images-idx3-ubyte",
+                         "train-labels-idx1-ubyte"),
+               "test": ("t10k-images-idx3-ubyte",
+                        "t10k-labels-idx1-ubyte")}
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "cv2",
+                 data_dir: Optional[str] = None):
+        if image_path is None and data_dir is not None:
+            img, lbl = self._PREFIX[mode]
+            image_path = self._find(data_dir, img)
+            label_path = self._find(data_dir, lbl)
+        if image_path is None:
+            _no_download(type(self).__name__)
+        if label_path is None:
+            raise ValueError(
+                "label_path is required when image_path is given "
+                "(or pass data_dir to discover both)")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        self.transform = transform
+
+    @staticmethod
+    def _find(d: str, stem: str) -> str:
+        for name in (stem, stem + ".gz"):
+            p = os.path.join(d, name)
+            if os.path.exists(p):
+                return p
+        raise FileNotFoundError(f"{stem}[.gz] not found in {d}")
+
+    @staticmethod
+    def _open(path: str):
+        return gzip.open(path, "rb") if path.endswith(".gz") else \
+            open(path, "rb")
+
+    def _read_images(self, path: str) -> np.ndarray:
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad idx3 magic {magic}"
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path: str) -> np.ndarray:
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad idx1 magic {magic}"
+            return np.frombuffer(f.read(n), dtype=np.uint8).copy()
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _CifarBase(Dataset):
+    _NUM_CLASSES = 10
+
+    def __init__(self, data_file: Optional[str] = None,
+                 mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False,
+                 data_dir: Optional[str] = None):
+        if data_file is None and data_dir is None:
+            _no_download(type(self).__name__)
+        root = data_dir or os.path.dirname(data_file)
+        self.transform = transform
+        images, labels = [], []
+        for name in self._batch_names(mode):
+            path = os.path.join(root, name)
+            with open(path, "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            images.append(batch[b"data"])
+            labels += list(batch.get(b"labels",
+                                     batch.get(b"fine_labels", [])))
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)  # HWC
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def _batch_names(self, mode: str) -> List[str]:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+class Cifar10(_CifarBase):
+    def _batch_names(self, mode):
+        return [f"data_batch_{i}" for i in range(1, 6)] \
+            if mode == "train" else ["test_batch"]
+
+
+class Cifar100(_CifarBase):
+    _NUM_CLASSES = 100
+
+    def _batch_names(self, mode):
+        return ["train"] if mode == "train" else ["test"]
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp")
+
+
+def _default_loader(path: str) -> np.ndarray:
+    from PIL import Image
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """root/class_x/img.png layout → (image, class_index) samples
+    (reference: python/paddle/vision/datasets/folder.py)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions: Tuple[str, ...] = _IMG_EXTS,
+                 transform: Optional[Callable] = None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class subdirectories in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    if fn.lower().endswith(extensions):
+                        self.samples.append(
+                            (os.path.join(dirpath, fn),
+                             self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images, no labels (reference folder.py)."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions: Tuple[str, ...] = _IMG_EXTS,
+                 transform: Optional[Callable] = None):
+        self.loader = loader or _default_loader
+        self.transform = transform
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                if fn.lower().endswith(extensions):
+                    self.samples.append(os.path.join(dirpath, fn))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+
+class FakeData(Dataset):
+    """Synthetic dataset for tests/bring-up (deterministic per index)."""
+
+    def __init__(self, size: int = 1000,
+                 image_shape: Tuple[int, ...] = (3, 32, 32),
+                 num_classes: int = 10,
+                 transform: Optional[Callable] = None, seed: int = 0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed * 100003 + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = int(rng.randint(self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label

@@ -1,0 +1,28 @@
+"""Vision model zoo (≈ python/paddle/vision/models/__init__.py).
+
+The implementations live in paddle_tpu.models (shared with the
+benchmark/flagship configs); this namespace mirrors the reference's
+paddle.vision.models surface.
+"""
+from ...models.alexnet import AlexNet, alexnet  # noqa: F401
+from ...models.densenet import (DenseNet, densenet121,  # noqa: F401
+                                densenet161, densenet169, densenet201,
+                                densenet264)
+from ...models.googlenet import (GoogLeNet, InceptionV3,  # noqa: F401
+                                 googlenet, inception_v3)
+from ...models.lenet import LeNet  # noqa: F401
+from ...models.mobilenet import (MobileNetV1, MobileNetV2,  # noqa: F401
+                                 MobileNetV3, mobilenet_v1, mobilenet_v2,
+                                 mobilenet_v3_large, mobilenet_v3_small)
+from ...models.resnet import (ResNet, resnet18, resnet34,  # noqa: F401
+                              resnet50, resnet101, resnet152,
+                              resnext50_32x4d, resnext101_32x4d,
+                              resnext101_64x4d, resnext152_64x4d,
+                              wide_resnet50_2, wide_resnet101_2)
+from ...models.shufflenet import (ShuffleNetV2,  # noqa: F401
+                                  shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                                  shufflenet_v2_x1_5, shufflenet_v2_x2_0)
+from ...models.squeezenet import (SqueezeNet, squeezenet1_0,  # noqa: F401
+                                  squeezenet1_1)
+from ...models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from ...models.vit import ViT, vit  # noqa: F401

@@ -1,0 +1,396 @@
+"""paddle.vision.transforms analog.
+
+Reference: python/paddle/vision/transforms/transforms.py + functional.py
+— BaseTransform subclasses composable via Compose, operating on PIL
+images or numpy arrays. Here everything is numpy (HWC uint8/float) on
+the host — transforms are input-pipeline work and must stay off the
+TPU; ToTensor produces the CHW float array the models expect.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "BaseTransform", "Compose", "ToTensor", "Normalize", "Resize",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+    "RandomVerticalFlip", "RandomResizedCrop", "Pad", "Transpose",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter", "Grayscale", "RandomRotation",
+    "to_tensor", "normalize", "resize", "hflip", "vflip", "crop",
+    "center_crop", "pad",
+]
+
+
+def _to_numpy(img) -> np.ndarray:
+    """Accept numpy HWC or PIL.Image; return numpy HWC."""
+    if isinstance(img, np.ndarray):
+        return img
+    try:
+        from PIL import Image
+        if isinstance(img, Image.Image):
+            return np.asarray(img)
+    except ImportError:
+        pass
+    raise TypeError(f"unsupported image type {type(img)}")
+
+
+# ------------------------------------------------------------ functional
+def to_tensor(img, data_format: str = "CHW") -> np.ndarray:
+    arr = _to_numpy(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def normalize(img: np.ndarray, mean, std,
+              data_format: str = "CHW") -> np.ndarray:
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (img - mean) / std
+
+
+def resize(img: np.ndarray, size, interpolation: str = "bilinear"):
+    """size: int (short side) or (h, w)."""
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        if h <= w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return arr
+    try:
+        from PIL import Image
+        modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                 "bicubic": Image.BICUBIC}
+        mode = modes[interpolation]
+        if arr.dtype == np.uint8 and (arr.ndim == 2 or
+                                      arr.shape[2] in (3, 4)):
+            return np.asarray(Image.fromarray(arr).resize((ow, oh), mode))
+        # float and/or odd channel counts: resample each channel as a
+        # mode-F image so the requested interpolation actually runs
+        src = arr[:, :, None] if arr.ndim == 2 else arr
+        chans = [np.asarray(
+            Image.fromarray(src[:, :, c].astype(np.float32), mode="F")
+            .resize((ow, oh), mode)) for c in range(src.shape[2])]
+        out = np.stack(chans, axis=-1).astype(
+            np.float32 if arr.dtype == np.uint8 else arr.dtype)
+        if arr.dtype == np.uint8:
+            out = np.clip(out, 0, 255).astype(np.uint8)
+        return out[:, :, 0] if arr.ndim == 2 else out
+    except ImportError:
+        pass
+    # numpy fallback: nearest neighbour
+    ys = (np.arange(oh) * h / oh).astype(np.int64).clip(0, h - 1)
+    xs = (np.arange(ow) * w / ow).astype(np.int64).clip(0, w - 1)
+    return arr[ys][:, xs]
+
+
+def hflip(img: np.ndarray) -> np.ndarray:
+    return _to_numpy(img)[:, ::-1].copy()
+
+
+def vflip(img: np.ndarray) -> np.ndarray:
+    return _to_numpy(img)[::-1].copy()
+
+
+def crop(img: np.ndarray, top: int, left: int, height: int,
+         width: int) -> np.ndarray:
+    return _to_numpy(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img: np.ndarray, size) -> np.ndarray:
+    arr = _to_numpy(img)
+    if isinstance(size, numbers.Number):
+        size = (int(size), int(size))
+    th, tw = size
+    h, w = arr.shape[:2]
+    if h < th or w < tw:
+        # pad symmetrically first so the output is always (th, tw)
+        arr = pad(arr, ((tw - w + 1) // 2 if w < tw else 0,
+                        (th - h + 1) // 2 if h < th else 0,
+                        (tw - w) // 2 if w < tw else 0,
+                        (th - h) // 2 if h < th else 0))
+        h, w = arr.shape[:2]
+    top = (h - th) // 2
+    left = (w - tw) // 2
+    return crop(arr, top, left, th, tw)
+
+
+def pad(img: np.ndarray, padding, fill=0) -> np.ndarray:
+    arr = _to_numpy(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4  # left, top, right, bottom
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    widths = [(top, bottom), (left, right)] + \
+        [(0, 0)] * (arr.ndim - 2)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+# ------------------------------------------------------------ transforms
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format: str = "CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format: str = "CHW"):
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation: str = "bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed: bool = False):
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            arr = pad(arr, (0, 0, max(0, tw - w), max(0, th - h)))
+            h, w = arr.shape[:2]
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(arr, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation: str = "bilinear"):
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = random.randint(0, h - ch)
+                left = random.randint(0, w - cw)
+                return resize(crop(arr, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(arr, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0):
+        self.padding = padding
+        self.fill = fill
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order: Tuple[int, ...] = (2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _to_numpy(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value: float):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = arr * f
+        return _clip_like(out, img)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value: float):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return _clip_like(mean + (arr - mean) * f, img)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value: float):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = arr.mean(axis=-1, keepdims=True)
+        return _clip_like(gray + (arr - gray) * f, img)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value: float):
+        assert 0 <= value <= 0.5
+        self.value = value
+
+    def _apply_image(self, img):
+        # cheap hue rotation via channel roll interpolation
+        arr = _to_numpy(img).astype(np.float32)
+        f = random.uniform(-self.value, self.value)
+        rolled = np.roll(arr, 1, axis=-1)
+        return _clip_like(arr * (1 - abs(f)) + rolled * abs(f), img)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts: List[BaseTransform] = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        ts = self.ts[:]
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels: int = 1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        gray = (arr[..., :3] * [0.299, 0.587, 0.114]).sum(-1,
+                                                          keepdims=True)
+        out = np.repeat(gray, self.num_output_channels, axis=-1)
+        return _clip_like(out, img)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        try:
+            from PIL import Image
+            arr = _to_numpy(img)
+            if arr.dtype == np.uint8 and (arr.ndim == 2 or
+                                          arr.shape[2] in (3, 4)):
+                return np.asarray(Image.fromarray(arr).rotate(angle))
+            # float (any range) / odd channels: rotate each channel as a
+            # mode-F image — no value clipping or dtype truncation
+            src = arr[:, :, None] if arr.ndim == 2 else arr
+            chans = [np.asarray(Image.fromarray(
+                src[:, :, c].astype(np.float32), mode="F").rotate(angle))
+                for c in range(src.shape[2])]
+            out = np.stack(chans, axis=-1).astype(
+                np.float32 if arr.dtype == np.uint8 else arr.dtype)
+            if arr.dtype == np.uint8:
+                out = np.clip(out, 0, 255).astype(np.uint8)
+            return out[:, :, 0] if arr.ndim == 2 else out
+        except ImportError:
+            k = int(round(angle / 90.0)) % 4  # coarse fallback
+            return np.rot90(_to_numpy(img), k).copy()
+
+
+def _clip_like(out: np.ndarray, ref) -> np.ndarray:
+    ref_arr = _to_numpy(ref)
+    if ref_arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(ref_arr.dtype)

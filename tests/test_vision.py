@@ -1,0 +1,176 @@
+"""Vision package tests: model zoo forward shapes, transforms,
+datasets (MNIST idx files, CIFAR pickles, folders, FakeData).
+
+Mirrors the reference's test_vision_models.py / test_transforms.py /
+test_datasets.py (python/paddle/tests/)."""
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets as D
+from paddle_tpu.vision import models as M
+from paddle_tpu.vision import transforms as T
+
+
+# ------------------------------------------------------------------ models
+@pytest.mark.parametrize("factory", [
+    M.vgg11, M.alexnet, M.mobilenet_v1, M.mobilenet_v2,
+    M.mobilenet_v3_small, M.mobilenet_v3_large, M.squeezenet1_0,
+    M.shufflenet_v2_x1_0, M.densenet121, M.googlenet,
+    M.resnext50_32x4d, M.wide_resnet50_2,
+])
+def test_model_forward_shape(factory):
+    paddle.seed(0)
+    m = factory(num_classes=5)
+    m.eval()
+    out = m(paddle.randn([2, 3, 96, 96]))
+    assert tuple(out.shape) == (2, 5)
+
+
+def test_inception_v3_forward():
+    m = M.inception_v3(num_classes=4)
+    m.eval()
+    assert tuple(m(paddle.randn([1, 3, 299, 299])).shape) == (1, 4)
+
+
+def test_vgg_batch_norm_variant():
+    m = M.vgg11(batch_norm=True, num_classes=3)
+    m.eval()
+    assert tuple(m(paddle.randn([1, 3, 64, 64])).shape) == (1, 3)
+
+
+# -------------------------------------------------------------- transforms
+def test_to_tensor_and_normalize():
+    img = (np.random.RandomState(0).rand(8, 6, 3) * 255).astype(np.uint8)
+    t = T.Compose([T.ToTensor(),
+                   T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+    out = t(img)
+    assert out.shape == (3, 8, 6)
+    assert out.dtype == np.float32
+    assert out.min() >= -1.0 - 1e-6 and out.max() <= 1.0 + 1e-6
+
+
+def test_resize_center_crop():
+    img = (np.random.RandomState(1).rand(20, 30, 3) * 255).astype(np.uint8)
+    assert T.resize(img, (10, 15)).shape == (10, 15, 3)
+    assert T.resize(img, 10).shape[0] == 10  # short side
+    assert T.center_crop(img, 12).shape == (12, 12, 3)
+
+
+def test_flips_and_pad():
+    img = np.arange(12, dtype=np.uint8).reshape(3, 4, 1)
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(img), img[::-1])
+    padded = T.pad(img, 2)
+    assert padded.shape == (7, 8, 1)
+
+
+def test_random_transforms_shapes():
+    img = (np.random.RandomState(2).rand(32, 32, 3) * 255).astype(np.uint8)
+    assert T.RandomCrop(16)(img).shape == (16, 16, 3)
+    assert T.RandomResizedCrop(24)(img).shape == (24, 24, 3)
+    assert T.RandomHorizontalFlip(1.0)(img).shape == img.shape
+    assert T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img).shape == img.shape
+    assert T.Grayscale(3)(img).shape == img.shape
+    assert T.RandomRotation(30)(img).shape == img.shape
+
+
+# ---------------------------------------------------------------- datasets
+def _write_mnist(tmp_path, n=10, gz=False):
+    rng = np.random.RandomState(0)
+    images = (rng.rand(n, 28, 28) * 255).astype(np.uint8)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    op = (lambda p: gzip.open(p, "wb")) if gz else \
+        (lambda p: open(p, "wb"))
+    suffix = ".gz" if gz else ""
+    with op(os.path.join(tmp_path, "train-images-idx3-ubyte" + suffix)) as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with op(os.path.join(tmp_path, "train-labels-idx1-ubyte" + suffix)) as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return images, labels
+
+
+def test_mnist_idx_files(tmp_path):
+    images, labels = _write_mnist(str(tmp_path))
+    ds = D.MNIST(data_dir=str(tmp_path), mode="train")
+    assert len(ds) == 10
+    img, lbl = ds[3]
+    np.testing.assert_array_equal(img, images[3])
+    assert lbl == int(labels[3])
+
+
+def test_mnist_gz(tmp_path):
+    _write_mnist(str(tmp_path), gz=True)
+    ds = D.MNIST(data_dir=str(tmp_path), mode="train",
+                 transform=T.ToTensor())
+    img, _ = ds[0]
+    assert img.shape == (1, 28, 28)
+
+
+def test_mnist_no_download():
+    with pytest.raises(RuntimeError, match="download"):
+        D.MNIST()
+
+
+def test_cifar10_pickles(tmp_path):
+    rng = np.random.RandomState(0)
+    for i in range(1, 6):
+        data = (rng.rand(4, 3072) * 255).astype(np.uint8)
+        with open(tmp_path / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": data,
+                         b"labels": list(rng.randint(0, 10, 4))}, f)
+    ds = D.Cifar10(data_dir=str(tmp_path), mode="train")
+    assert len(ds) == 20
+    img, lbl = ds[0]
+    assert img.shape == (32, 32, 3)
+    assert 0 <= lbl < 10
+
+
+def test_dataset_folder(tmp_path):
+    from PIL import Image
+    for cls in ("cat", "dog"):
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            arr = (np.random.RandomState(i).rand(8, 8, 3) * 255
+                   ).astype(np.uint8)
+            Image.fromarray(arr).save(tmp_path / cls / f"{i}.png")
+    ds = D.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, target = ds[0]
+    assert img.shape == (8, 8, 3)
+    assert target == 0
+
+
+def test_fake_data_deterministic():
+    ds = D.FakeData(size=5, image_shape=(3, 16, 16), num_classes=4)
+    img1, l1 = ds[2]
+    img2, l2 = ds[2]
+    np.testing.assert_array_equal(img1, img2)
+    assert l1 == l2
+    assert img1.shape == (3, 16, 16)
+
+
+def test_fake_data_trains_with_dataloader():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu import nn, optimizer
+    ds = D.FakeData(size=16, image_shape=(1, 8, 8), num_classes=3)
+    dl = DataLoader(ds, batch_size=8, shuffle=True)
+    paddle.seed(0)
+    model = nn.Sequential(nn.Flatten(), nn.Linear(64, 3))
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    for imgs, labels in dl:  # DataLoader already collates to Tensors
+        loss = ce(model(imgs), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.isfinite(float(loss))
