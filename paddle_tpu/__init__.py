@@ -60,8 +60,8 @@ from .framework_io import load, save  # noqa: F401,E402
 from .jit.api import grad, value_and_grad  # noqa: F401,E402
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
-_LAZY = {"distributed", "models", "vision", "kernels", "hapi", "profiler",
-         "incubate", "inference", "static"}
+_LAZY = {"distributed", "distribution", "models", "vision", "kernels",
+         "hapi", "profiler", "incubate", "inference", "sparse", "static"}
 
 
 def __getattr__(name):

@@ -1,0 +1,20 @@
+"""paddle.distribution analog — probability distributions.
+
+Reference: python/paddle/distribution/ (Distribution base,
+Normal/Uniform/Categorical/Multinomial/Beta/Dirichlet/Bernoulli/
+ExponentialFamily, Transform + TransformedDistribution, kl_divergence
+registry). jax-native: log_prob/entropy are traced math, sample() draws
+eagerly from the global RNG bridge (core/random.py), rsample is the
+reparameterized path where it exists.
+"""
+from .distributions import (Bernoulli, Beta, Categorical,  # noqa: F401
+                            Dirichlet, Distribution, ExponentialFamily,
+                            Exponential, Gamma, Geometric, Gumbel,
+                            Laplace, LogNormal, Multinomial, Normal,
+                            Poisson, StudentT, Uniform)
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (AbsTransform, AffineTransform,  # noqa: F401
+                        ChainTransform, ExpTransform, PowerTransform,
+                        SigmoidTransform, SoftmaxTransform,
+                        StickBreakingTransform, TanhTransform, Transform,
+                        TransformedDistribution)

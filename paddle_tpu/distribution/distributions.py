@@ -1,0 +1,570 @@
+"""Distribution classes (≈ python/paddle/distribution/*.py).
+
+All parameters accept Tensor/array/scalar; results are Tensors. Sampling
+uses jax.random with keys from the global RNG bridge; log_prob/entropy
+are pure jax math (usable under jit via the Tensor facade).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..core import random as grandom
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "ExponentialFamily", "Normal", "Uniform",
+           "Bernoulli", "Categorical", "Multinomial", "Beta",
+           "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+           "Laplace", "LogNormal", "Poisson", "StudentT"]
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _wrap(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _shape(sample_shape) -> tuple:
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, int):
+        return (sample_shape,)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    """Base (≈ distribution/distribution.py Distribution)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-differentiable draw."""
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no reparameterized sampler")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_raw(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _key(self):
+        return grandom.next_key()
+
+
+class ExponentialFamily(Distribution):
+    """Marker base for exponential-family distributions; the Bregman
+    entropy shortcut in the reference is replaced by closed forms."""
+
+
+class Normal(ExponentialFamily):
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc).astype(jnp.float32) \
+            if not jnp.issubdtype(_raw(loc).dtype, jnp.floating) \
+            else _raw(loc)
+        self.scale = _raw(scale).astype(self.loc.dtype) \
+            if not jnp.issubdtype(_raw(scale).dtype, jnp.floating) \
+            else _raw(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(self._key(), shape,
+                                dtype=jnp.result_type(self.loc))
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(out, self.batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high):
+        self.low = _raw(low).astype(jnp.float32)
+        self.high = _raw(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to((self.low + self.high) / 2,
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                      self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                      self.batch_shape))
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs):
+        self.probs = _raw(probs).astype(jnp.float32)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.bernoulli(
+            self._key(), self.probs, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.float32)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            p = _raw(probs).astype(jnp.float32)
+            self.logits = jnp.log(jnp.clip(p, 1e-37, None))
+        else:
+            self.logits = _raw(logits).astype(jnp.float32)
+        self.logits = self.logits - jsp.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs_(self):
+        return jnp.exp(self.logits)
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no scalar mean")
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.categorical(self._key(), self.logits,
+                                            shape=shape))
+
+    def log_prob(self, value):
+        idx = _raw(value).astype(jnp.int32)
+        # broadcast logits over any leading sample dims of `value`
+        logits = jnp.broadcast_to(self.logits,
+                                  idx.shape + self.logits.shape[-1:])
+        return _wrap(jnp.take_along_axis(
+            logits, idx[..., None], axis=-1)[..., 0])
+
+    def probs(self, value):
+        return _wrap(jnp.exp(_raw(self.log_prob(value))))
+
+    def entropy(self):
+        p = self.probs_
+        return _wrap(-(p * self.logits).sum(-1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs):
+        self.total_count = int(total_count)
+        self.probs = _raw(probs).astype(jnp.float32)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+        super().__init__(self.probs.shape[:-1],
+                         self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = _shape(shape)
+        logits = jnp.log(jnp.clip(self.probs, 1e-37, None))
+        # trailing dims of the draw shape must match logits' batch shape
+        draws = jax.random.categorical(
+            self._key(), logits,
+            shape=shape + (self.total_count,) + self.batch_shape)
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=len(shape))
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.float32)
+        logp = jnp.log(jnp.clip(self.probs, 1e-37, None))
+        coeff = jsp.gammaln(jnp.asarray(self.total_count + 1.0)) - \
+            jsp.gammaln(v + 1.0).sum(-1)
+        return _wrap(coeff + (v * logp).sum(-1))
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _raw(alpha).astype(jnp.float32)
+        self.beta = _raw(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (s ** 2 * (s + 1)))
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.beta(self._key(), self.alpha, self.beta,
+                                     shape))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        lbeta = jsp.gammaln(self.alpha) + jsp.gammaln(self.beta) - \
+            jsp.gammaln(self.alpha + self.beta)
+        return _wrap((self.alpha - 1) * jnp.log(v)
+                     + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return _wrap(lbeta - (a - 1) * jsp.digamma(a)
+                     - (b - 1) * jsp.digamma(b)
+                     + (a + b - 2) * jsp.digamma(a + b))
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _raw(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return _wrap(c / c.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        c = self.concentration
+        c0 = c.sum(-1, keepdims=True)
+        m = c / c0
+        return _wrap(m * (1 - m) / (c0 + 1))
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.dirichlet(self._key(),
+                                          self.concentration, shape))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        c = self.concentration
+        norm = jsp.gammaln(c).sum(-1) - jsp.gammaln(c.sum(-1))
+        return _wrap(((c - 1) * jnp.log(v)).sum(-1) - norm)
+
+    def entropy(self):
+        c = self.concentration
+        c0 = c.sum(-1)
+        k = c.shape[-1]
+        lnB = jsp.gammaln(c).sum(-1) - jsp.gammaln(c0)
+        return _wrap(lnB + (c0 - k) * jsp.digamma(c0)
+                     - ((c - 1) * jsp.digamma(c)).sum(-1))
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _raw(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate ** -2)
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.exponential(self._key(), shape)
+                     / self.rate)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = _raw(concentration).astype(jnp.float32)
+        self.rate = _raw(rate).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.gamma(self._key(), self.concentration,
+                                      shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        a, b = self.concentration, self.rate
+        return _wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                     - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _wrap(a - jnp.log(b) + jsp.gammaln(a)
+                     + (1 - a) * jsp.digamma(a))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, 2, ... (failures before success)."""
+
+    def __init__(self, probs):
+        self.probs = _raw(probs).astype(jnp.float32)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(self._key(), shape, minval=1e-7,
+                               maxval=1.0)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        k = _raw(value).astype(jnp.float32)
+        return _wrap(k * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * jnp.float32(0.5772156649))
+
+    @property
+    def variance(self):
+        return _wrap((math.pi ** 2 / 6) * self.scale ** 2)
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        g = jax.random.gumbel(self._key(), shape)
+        return _wrap(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_raw(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        out = jnp.log(self.scale) + 1.0 + jnp.float32(0.5772156649)
+        return _wrap(jnp.broadcast_to(out, self.batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(2 * self.scale ** 2)
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(self.loc + self.scale *
+                     jax.random.laplace(self._key(), shape))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale
+                     - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        out = 1.0 + jnp.log(2 * self.scale)
+        return _wrap(jnp.broadcast_to(out, self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    @property
+    def mean(self):
+        n = self._normal
+        return _wrap(jnp.exp(n.loc + n.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        n = self._normal
+        s2 = n.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * n.loc + s2))
+
+    def rsample(self, shape=()):
+        return _wrap(jnp.exp(_raw(self._normal.rsample(shape))))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        lp = _raw(self._normal.log_prob(jnp.log(v)))
+        return _wrap(lp - jnp.log(v))
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _raw(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.poisson(self._key(), self.rate,
+                                        shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _raw(value).astype(jnp.float32)
+        return _wrap(k * jnp.log(self.rate) - self.rate
+                     - jsp.gammaln(k + 1))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _raw(df).astype(jnp.float32)
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        m = jnp.where(self.df > 1, self.loc, jnp.nan)
+        return _wrap(jnp.broadcast_to(m, self.batch_shape))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2,
+                      self.scale ** 2 * self.df / (self.df - 2),
+                      jnp.nan)
+        return _wrap(jnp.broadcast_to(v, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        t = jax.random.t(self._key(), self.df, shape)
+        return _wrap(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        z = (_raw(value) - self.loc) / self.scale
+        d = self.df
+        return _wrap(jsp.gammaln((d + 1) / 2) - jsp.gammaln(d / 2)
+                     - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                     - (d + 1) / 2 * jnp.log1p(z ** 2 / d))

@@ -1,0 +1,239 @@
+"""Transforms and TransformedDistribution
+(≈ python/paddle/distribution/transform.py — Transform with
+forward/inverse/log_det_jacobian, chained transforms, and
+TransformedDistribution over a base distribution)."""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .distributions import Distribution, _raw, _shape, _wrap
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "SoftmaxTransform",
+           "StickBreakingTransform", "TanhTransform",
+           "TransformedDistribution"]
+
+
+class Transform:
+    """y = f(x), bijective on its domain."""
+
+    #: dims consumed by one event (0 = elementwise)
+    event_dim = 0
+
+    def forward(self, x):
+        return _wrap(self._forward(_raw(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_raw(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._fldj(_raw(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap(-self._fldj(self._inverse(_raw(y))))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks on raw arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    """Not bijective; inverse picks the positive branch."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        raise NotImplementedError("AbsTransform is not bijective")
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _raw(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x,
+                                                      self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jnp.logaddexp(0.0, -2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Not bijective (maps to the simplex); ldj undefined."""
+
+    event_dim = 1
+
+    def _forward(self, x):
+        e = jnp.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform is not bijective")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex (reference transform.py StickBreaking)."""
+
+    event_dim = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1])
+        z = 1 / (1 + jnp.exp(-(x - jnp.log(offset.astype(x.dtype)))))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), zc[..., :-1]], -1)
+        head = z * lead
+        return jnp.concatenate([head, zc[..., -1:]], -1)
+
+    def _inverse(self, y):
+        y_head = y[..., :-1]
+        zc = 1 - jnp.cumsum(y_head, -1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(y_head[..., :1]), zc[..., :-1]], -1)
+        z = y_head / lead
+        # same offset as forward: (K-1) - i for input index i
+        offset = y_head.shape[-1] - jnp.arange(y_head.shape[-1])
+        return jnp.log(z / (1 - z)) + \
+            jnp.log(offset.astype(y.dtype))
+
+    def _fldj(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1])
+        t = x - jnp.log(offset.astype(x.dtype))
+        # sum over the event dim of log sigmoid'(t) + log cumprod terms
+        z = 1 / (1 + jnp.exp(-t))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), zc[..., :-1]], -1)
+        return (jnp.log(z) + jnp.log1p(-z) + jnp.log(lead)).sum(-1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms: List[Transform] = list(transforms)
+        self.event_dim = max((t.event_dim for t in self.transforms),
+                             default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        raw = _raw(x)
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(raw)
+            raw = t._forward(raw)
+        return _wrap(total)
+
+    def inverse_log_det_jacobian(self, y):
+        raw = _raw(y)
+        total = 0.0
+        for t in reversed(self.transforms):
+            raw = t._inverse(raw)
+            total = total - t._fldj(raw)
+        return _wrap(total)
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution,
+                 transforms: Sequence[Transform]):
+        self.base = base
+        self.transform = ChainTransform(list(transforms)) \
+            if not isinstance(transforms, Transform) else transforms
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(_shape(shape))
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(_shape(shape))
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        base_lp = _raw(self.base.log_prob(x))
+        fldj = _raw(self.transform.forward_log_det_jacobian(x))
+        if self.transform.event_dim > 0 and base_lp.ndim >= 1:
+            # event-dim transforms reduce their ldj over the event axis;
+            # match by reducing the base log_prob the same way
+            base_lp = base_lp.sum(-1)
+        return _wrap(base_lp - fldj)

@@ -1,0 +1,6 @@
+"""paddle.incubate analog — experimental subsystems.
+
+Reference: python/paddle/incubate/ (autograd functional prims, asp 2:4
+sparsity, distributed models). Populated incrementally; see submodules.
+"""
+__all__ = []

@@ -1,0 +1,19 @@
+"""paddle.sparse analog — COO/CSR sparse tensors.
+
+Reference: python/paddle/sparse/ (sparse_coo_tensor/sparse_csr_tensor
+creation, unary/binary ops, matmul/masked_matmul, coalesce, nn.ReLU)
+backed by phi sparse kernels (paddle/phi/kernels/sparse/,
+paddle/phi/core/sparse_coo_tensor.h). TPU-native: jax.experimental.sparse
+BCOO/BCSR carry (indices, values) through XLA; TPU kernels densify for
+compute-heavy ops (the MXU has no native gather-scatter sparsity), so
+sparse here is a memory/IO format with correct semantics, not a FLOP
+saver — same trade the reference makes on non-cuSPARSE backends.
+"""
+from . import nn  # noqa: F401
+from .binary import (add, divide, masked_matmul, matmul,  # noqa: F401
+                     multiply, subtract)
+from .creation import (SparseCooTensor, SparseCsrTensor,  # noqa: F401
+                       sparse_coo_tensor, sparse_csr_tensor)
+from .unary import (abs, cast, coalesce, deg2rad, expm1,  # noqa: F401
+                    is_same_shape, neg, pow, rad2deg, relu, sin, sinh,
+                    sqrt, square, tan, tanh)

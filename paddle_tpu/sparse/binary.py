@@ -1,0 +1,80 @@
+"""Sparse binary ops and matmul (≈ python/paddle/sparse/binary.py;
+phi/kernels/sparse/{elementwise,matmul}_kernel.h). Elementwise ops on
+two sparse operands run through BCOO addition / dense fallback; matmul
+contracts sparse x dense on the MXU (jax sparse lowers to
+gather-matmul)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from .creation import (SparseCooTensor, SparseCsrTensor, _SparseBase,
+                       _raw)
+
+__all__ = ["add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul"]
+
+
+def _coo(x: _SparseBase) -> jsparse.BCOO:
+    mat = x._mat
+    return mat.to_bcoo() if isinstance(mat, jsparse.BCSR) else mat
+
+
+def _rewrap(x_like: _SparseBase, coo: jsparse.BCOO):
+    if isinstance(x_like, SparseCsrTensor):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            coo.sum_duplicates(remove_zeros=False)))
+    return SparseCooTensor(coo)
+
+
+def add(x: _SparseBase, y: _SparseBase):
+    out = _coo(x) + _coo(y)
+    return _rewrap(x, out.sum_duplicates(remove_zeros=False))
+
+
+def subtract(x: _SparseBase, y: _SparseBase):
+    yc = _coo(y)
+    out = _coo(x) + jsparse.BCOO((-yc.data, yc.indices), shape=yc.shape)
+    return _rewrap(x, out.sum_duplicates(remove_zeros=False))
+
+
+def multiply(x: _SparseBase, y):
+    """Elementwise; sparse*sparse densifies the intersection (same
+    semantics as the reference's elementwise_mul on coo)."""
+    if isinstance(y, _SparseBase):
+        dense = _coo(x).todense() * _coo(y).todense()
+    else:
+        dense = _coo(x).todense() * _raw(y)
+    return _rewrap(x, jsparse.BCOO.fromdense(dense))
+
+
+def divide(x: _SparseBase, y):
+    if isinstance(y, _SparseBase):
+        dense = _coo(x).todense() / _coo(y).todense()
+    else:
+        dense = _coo(x).todense() / _raw(y)
+    return _rewrap(x, jsparse.BCOO.fromdense(dense))
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense Tensor (reference: sparse.matmul)."""
+    if isinstance(x, _SparseBase):
+        out = _coo(x) @ _raw(y)
+        return Tensor(out)
+    if isinstance(y, _SparseBase):
+        return Tensor(_raw(x) @ _coo(y))
+    raise TypeError("sparse.matmul needs at least one sparse operand")
+
+
+def masked_matmul(x, y, mask: _SparseBase):
+    """(dense x dense) sampled at mask's sparsity pattern
+    (reference: sparse.masked_matmul, cusparse SDDMM analog)."""
+    xd, yd = _raw(x), _raw(y)
+    coo = _coo(mask)
+    rows = coo.indices[:, 0]
+    cols = coo.indices[:, 1]
+    # compute only the sampled dot products: nnz x K gather then reduce
+    vals = (xd[rows, :] * yd[:, cols].T).sum(-1)
+    out = jsparse.BCOO((vals, coo.indices), shape=coo.shape)
+    return _rewrap(mask, out)

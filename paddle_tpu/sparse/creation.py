@@ -1,0 +1,121 @@
+"""Sparse tensor creation (≈ python/paddle/sparse/creation.py;
+phi/core/sparse_coo_tensor.h:1, sparse_csr_tensor.h:1)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor"]
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class _SparseBase:
+    """Shared surface of Coo/Csr wrappers over jax BCOO/BCSR."""
+
+    def __init__(self, mat):
+        self._mat = mat
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    @property
+    def dtype(self):
+        return self._mat.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._mat.nse)
+
+    def values(self) -> Tensor:
+        return Tensor(self._mat.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._mat.todense())
+
+    def numpy(self):
+        return np.asarray(self._mat.todense())
+
+    def astype(self, dtype):
+        return type(self)(self._mat.astype(jnp.dtype(dtype)))
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={self.shape}, "
+                f"nnz={self.nnz}, dtype={self.dtype})")
+
+
+class SparseCooTensor(_SparseBase):
+    def indices(self) -> Tensor:
+        # paddle stores [sparse_dim, nnz]; BCOO stores [nnz, sparse_dim]
+        return Tensor(self._mat.indices.T)
+
+    def is_coalesced(self) -> bool:
+        return bool(self._mat.unique_indices)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(
+            self._mat.sum_duplicates(remove_zeros=False))
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            self._mat.sum_duplicates(remove_zeros=False)))
+
+
+class SparseCsrTensor(_SparseBase):
+    def crows(self) -> Tensor:
+        return Tensor(self._mat.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._mat.indices)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) \
+            -> "SparseCooTensor":
+        return SparseCooTensor(self._mat.to_bcoo())
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None,
+                      stop_gradient: bool = True) -> SparseCooTensor:
+    """indices: [sparse_dim, nnz] (reference layout); values: [nnz, ...]."""
+    idx = _raw(indices).astype(jnp.int32)
+    vals = _raw(values)
+    if dtype is not None:
+        vals = vals.astype(jnp.dtype(dtype) if isinstance(dtype, str)
+                           else dtype)
+    if idx.ndim != 2:
+        raise ValueError(f"indices must be [sparse_dim, nnz], "
+                         f"got shape {idx.shape}")
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=1))) \
+            + tuple(vals.shape[1:])
+    mat = jsparse.BCOO((vals, idx.T), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor(crows, cols, values,
+                      shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None,
+                      stop_gradient: bool = True) -> SparseCsrTensor:
+    indptr = _raw(crows).astype(jnp.int32)
+    indices = _raw(cols).astype(jnp.int32)
+    vals = _raw(values)
+    if dtype is not None:
+        vals = vals.astype(jnp.dtype(dtype) if isinstance(dtype, str)
+                           else dtype)
+    if shape is None:
+        raise ValueError("sparse_csr_tensor requires an explicit shape")
+    mat = jsparse.BCSR((vals, indices, indptr),
+                       shape=tuple(int(s) for s in shape))
+    return SparseCsrTensor(mat)

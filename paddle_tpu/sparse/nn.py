@@ -1,0 +1,38 @@
+"""paddle.sparse.nn analog (≈ python/paddle/sparse/nn/) — layer-style
+wrappers over sparse functional ops."""
+from __future__ import annotations
+
+from . import unary
+
+__all__ = ["ReLU", "Softmax"]
+
+
+class ReLU:
+    def __call__(self, x):
+        return unary.relu(x)
+
+
+class Softmax:
+    """Row-wise softmax over stored values (csr rows; reference
+    sparse/nn/functional/activation.py softmax)."""
+
+    def __init__(self, axis: int = -1):
+        if axis != -1:
+            raise ValueError("sparse softmax supports axis=-1 only")
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        from .creation import SparseCsrTensor
+        dense = x._mat.todense()
+        # softmax over non-zero entries per row, zeros stay zero
+        mask = dense != 0
+        neg_inf = jnp.where(mask, dense, -jnp.inf)
+        sm = jnp.exp(neg_inf - neg_inf.max(-1, keepdims=True))
+        sm = jnp.where(mask, sm, 0)
+        sm = sm / jnp.clip(sm.sum(-1, keepdims=True), 1e-30, None)
+        coo = jsparse.BCOO.fromdense(sm)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(jsparse.BCSR.from_bcoo(coo))
+        from .creation import SparseCooTensor
+        return SparseCooTensor(coo)

@@ -1,0 +1,99 @@
+"""Sparse unary ops (≈ python/paddle/sparse/unary.py; phi kernels
+paddle/phi/kernels/sparse/unary_kernel.h). Zero-preserving ops apply to
+the stored values only — nnz structure is unchanged."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .creation import SparseCooTensor, SparseCsrTensor, _SparseBase
+
+__all__ = ["abs", "cast", "coalesce", "deg2rad", "expm1",
+           "is_same_shape", "neg", "pow", "rad2deg", "relu", "sin",
+           "sinh", "sqrt", "square", "tan", "tanh"]
+
+
+def _map_values(x: _SparseBase, fn) -> _SparseBase:
+    mat = x._mat
+    if hasattr(mat, "indptr"):  # BCSR
+        new = type(mat)((fn(mat.data), mat.indices, mat.indptr),
+                        shape=mat.shape)
+    else:  # BCOO
+        new = type(mat)((fn(mat.data), mat.indices), shape=mat.shape)
+    return type(x)(new)
+
+
+def relu(x):
+    return _map_values(x, lambda v: jnp.maximum(v, 0))
+
+
+def abs(x):  # noqa: A001
+    return _map_values(x, jnp.abs)
+
+
+def neg(x):
+    return _map_values(x, jnp.negative)
+
+
+def sin(x):
+    return _map_values(x, jnp.sin)
+
+
+def sinh(x):
+    return _map_values(x, jnp.sinh)
+
+
+def tan(x):
+    return _map_values(x, jnp.tan)
+
+
+def tanh(x):
+    return _map_values(x, jnp.tanh)
+
+
+def sqrt(x):
+    return _map_values(x, jnp.sqrt)
+
+
+def square(x):
+    return _map_values(x, jnp.square)
+
+
+def expm1(x):
+    return _map_values(x, jnp.expm1)
+
+
+def deg2rad(x):
+    return _map_values(x, jnp.deg2rad)
+
+
+def rad2deg(x):
+    return _map_values(x, jnp.rad2deg)
+
+
+def pow(x, factor):  # noqa: A001
+    return _map_values(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    out = x
+    if value_dtype is not None:
+        out = _map_values(out, lambda v: v.astype(jnp.dtype(value_dtype)))
+    if index_dtype is not None:
+        mat = out._mat
+        idt = jnp.dtype(index_dtype)
+        if hasattr(mat, "indptr"):  # BCSR
+            new = type(mat)((mat.data, mat.indices.astype(idt),
+                             mat.indptr.astype(idt)), shape=mat.shape)
+        else:
+            new = type(mat)((mat.data, mat.indices.astype(idt)),
+                            shape=mat.shape)
+        out = type(out)(new)
+    return out
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return x.coalesce()
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)

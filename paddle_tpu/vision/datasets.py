@@ -74,7 +74,9 @@ class MNIST(Dataset):
             magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
             assert magic == 2051, f"bad idx3 magic {magic}"
             data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
-        return data.reshape(n, rows, cols)
+        # .copy(): frombuffer views are read-only; user transforms may
+        # write in place
+        return data.reshape(n, rows, cols).copy()
 
     def _read_labels(self, path: str) -> np.ndarray:
         with self._open(path) as f:

@@ -38,6 +38,27 @@ def _to_numpy(img) -> np.ndarray:
     raise TypeError(f"unsupported image type {type(img)}")
 
 
+def _pil_op_per_channel(arr: np.ndarray, op) -> np.ndarray:
+    """Apply a PIL operation that only supports native modes to an
+    arbitrary-dtype/channel-count array: uint8 RGB(A)/L go through PIL
+    directly; float or odd channel counts run per channel as mode-F
+    images (no value clipping or dtype truncation), then restore dtype.
+    `op(pil_image) -> pil_image`."""
+    from PIL import Image
+    if arr.dtype == np.uint8 and (arr.ndim == 2 or
+                                  arr.shape[2] in (3, 4)):
+        return np.asarray(op(Image.fromarray(arr)))
+    src = arr[:, :, None] if arr.ndim == 2 else arr
+    chans = [np.asarray(op(Image.fromarray(
+        src[:, :, c].astype(np.float32), mode="F")))
+        for c in range(src.shape[2])]
+    out = np.stack(chans, axis=-1).astype(
+        np.float32 if arr.dtype == np.uint8 else arr.dtype)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out[:, :, 0] if arr.ndim == 2 else out
+
+
 # ------------------------------------------------------------ functional
 def to_tensor(img, data_format: str = "CHW") -> np.ndarray:
     arr = _to_numpy(img)
@@ -80,20 +101,8 @@ def resize(img: np.ndarray, size, interpolation: str = "bilinear"):
         modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
                  "bicubic": Image.BICUBIC}
         mode = modes[interpolation]
-        if arr.dtype == np.uint8 and (arr.ndim == 2 or
-                                      arr.shape[2] in (3, 4)):
-            return np.asarray(Image.fromarray(arr).resize((ow, oh), mode))
-        # float and/or odd channel counts: resample each channel as a
-        # mode-F image so the requested interpolation actually runs
-        src = arr[:, :, None] if arr.ndim == 2 else arr
-        chans = [np.asarray(
-            Image.fromarray(src[:, :, c].astype(np.float32), mode="F")
-            .resize((ow, oh), mode)) for c in range(src.shape[2])]
-        out = np.stack(chans, axis=-1).astype(
-            np.float32 if arr.dtype == np.uint8 else arr.dtype)
-        if arr.dtype == np.uint8:
-            out = np.clip(out, 0, 255).astype(np.uint8)
-        return out[:, :, 0] if arr.ndim == 2 else out
+        return _pil_op_per_channel(
+            arr, lambda im: im.resize((ow, oh), mode))
     except ImportError:
         pass
     # numpy fallback: nearest neighbour
@@ -353,8 +362,11 @@ class Grayscale(BaseTransform):
 
     def _apply_image(self, img):
         arr = _to_numpy(img).astype(np.float32)
-        gray = (arr[..., :3] * [0.299, 0.587, 0.114]).sum(-1,
-                                                          keepdims=True)
+        if arr.ndim == 2 or arr.shape[-1] == 1:
+            gray = arr if arr.ndim == 3 else arr[..., None]
+        else:
+            gray = (arr[..., :3] * [0.299, 0.587, 0.114]).sum(
+                -1, keepdims=True)
         out = np.repeat(gray, self.num_output_channels, axis=-1)
         return _clip_like(out, img)
 
@@ -368,22 +380,8 @@ class RandomRotation(BaseTransform):
     def _apply_image(self, img):
         angle = random.uniform(*self.degrees)
         try:
-            from PIL import Image
-            arr = _to_numpy(img)
-            if arr.dtype == np.uint8 and (arr.ndim == 2 or
-                                          arr.shape[2] in (3, 4)):
-                return np.asarray(Image.fromarray(arr).rotate(angle))
-            # float (any range) / odd channels: rotate each channel as a
-            # mode-F image — no value clipping or dtype truncation
-            src = arr[:, :, None] if arr.ndim == 2 else arr
-            chans = [np.asarray(Image.fromarray(
-                src[:, :, c].astype(np.float32), mode="F").rotate(angle))
-                for c in range(src.shape[2])]
-            out = np.stack(chans, axis=-1).astype(
-                np.float32 if arr.dtype == np.uint8 else arr.dtype)
-            if arr.dtype == np.uint8:
-                out = np.clip(out, 0, 255).astype(np.uint8)
-            return out[:, :, 0] if arr.ndim == 2 else out
+            return _pil_op_per_channel(_to_numpy(img),
+                                       lambda im: im.rotate(angle))
         except ImportError:
             k = int(round(angle / 90.0)) % 4  # coarse fallback
             return np.rot90(_to_numpy(img), k).copy()

@@ -1,0 +1,215 @@
+"""paddle.distribution analog tests: moments, log_prob vs scipy-free
+closed forms, sampling statistics, KL registry, transforms.
+
+Mirrors the reference's test_distribution_*.py
+(python/paddle/fluid/tests/unittests/distribution/)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(42)
+
+
+def test_normal_logprob_entropy_kl():
+    n = D.Normal(loc=1.0, scale=2.0)
+    v = 0.5
+    expect = -((v - 1.0) ** 2) / 8 - math.log(2.0) \
+        - 0.5 * math.log(2 * math.pi)
+    np.testing.assert_allclose(float(n.log_prob(v)), expect, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(n.entropy()), 0.5 + 0.5 * math.log(2 * math.pi)
+        + math.log(2.0), rtol=1e-5)
+    m = D.Normal(loc=0.0, scale=1.0)
+    kl = float(D.kl_divergence(n, m))
+    expect_kl = 0.5 * (4 + 1 - 1 - math.log(4))
+    np.testing.assert_allclose(kl, expect_kl, rtol=1e-5)
+    assert float(D.kl_divergence(n, n)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_normal_sample_moments():
+    n = D.Normal(loc=3.0, scale=0.5)
+    s = n.sample([20000]).numpy()
+    assert abs(s.mean() - 3.0) < 0.05
+    assert abs(s.std() - 0.5) < 0.05
+
+
+def test_uniform():
+    u = D.Uniform(low=-1.0, high=3.0)
+    assert float(u.mean) == pytest.approx(1.0)
+    assert float(u.variance) == pytest.approx(16 / 12)
+    np.testing.assert_allclose(float(u.log_prob(0.0)), -math.log(4))
+    assert np.isneginf(float(u.log_prob(5.0)))
+    s = u.sample([5000]).numpy()
+    assert s.min() >= -1.0 and s.max() < 3.0
+
+
+def test_bernoulli_and_categorical():
+    b = D.Bernoulli(probs=0.3)
+    np.testing.assert_allclose(float(b.mean), 0.3)
+    np.testing.assert_allclose(float(b.log_prob(1.0)), math.log(0.3),
+                               rtol=1e-5)
+    c = D.Categorical(probs=[0.2, 0.3, 0.5])
+    np.testing.assert_allclose(float(c.log_prob(2)), math.log(0.5),
+                               rtol=1e-5)
+    ent = -sum(p * math.log(p) for p in (0.2, 0.3, 0.5))
+    np.testing.assert_allclose(float(c.entropy()), ent, rtol=1e-5)
+    s = c.sample([8000]).numpy()
+    freq = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+    # log_prob over sampled values (broadcast over sample dims)
+    lp = c.log_prob(c.sample([10]))
+    assert lp.numpy().shape == (10,)
+    # batched categorical + batched multinomial sampling
+    cb = D.Categorical(probs=[[0.5, 0.5], [0.1, 0.9]])
+    sb = cb.sample([7])
+    assert sb.numpy().shape == (7, 2)
+    mb = D.Multinomial(6, probs=[[0.5, 0.5], [0.2, 0.8]])
+    smb = mb.sample([3]).numpy()
+    assert smb.shape == (3, 2, 2)
+    np.testing.assert_allclose(smb.sum(-1), 6.0)
+
+
+def test_categorical_requires_one_parameterization():
+    with pytest.raises(ValueError):
+        D.Categorical(logits=[0.0], probs=[1.0])
+    with pytest.raises(ValueError):
+        D.Categorical()
+
+
+def test_beta_dirichlet():
+    be = D.Beta(alpha=2.0, beta=3.0)
+    np.testing.assert_allclose(float(be.mean), 0.4, rtol=1e-6)
+    # log B(2,3) = log(Γ2Γ3/Γ5) = log(1*2/24)
+    lp = float(be.log_prob(0.5))
+    expect = (1) * math.log(0.5) + 2 * math.log(0.5) - math.log(2 / 24)
+    np.testing.assert_allclose(lp, expect, rtol=1e-5)
+    d = D.Dirichlet(concentration=[1.0, 2.0, 3.0])
+    np.testing.assert_allclose(d.mean.numpy(), [1 / 6, 2 / 6, 3 / 6],
+                               rtol=1e-6)
+    s = d.sample([1000]).numpy()
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6],
+                               atol=0.03)
+
+
+def test_multinomial():
+    m = D.Multinomial(10, probs=[0.5, 0.2, 0.3])
+    np.testing.assert_allclose(m.mean.numpy(), [5.0, 2.0, 3.0],
+                               rtol=1e-6)
+    s = m.sample([200]).numpy()
+    np.testing.assert_allclose(s.sum(-1), 10.0)
+    np.testing.assert_allclose(s.mean(0), [5, 2, 3], atol=0.5)
+    # binomial-style exact check: P([10,0,0]) = 0.5^10
+    np.testing.assert_allclose(float(m.log_prob([10.0, 0.0, 0.0])),
+                               10 * math.log(0.5), rtol=1e-4)
+
+
+def test_gamma_exponential_poisson():
+    g = D.Gamma(concentration=3.0, rate=2.0)
+    np.testing.assert_allclose(float(g.mean), 1.5)
+    s = g.sample([20000]).numpy()
+    assert abs(s.mean() - 1.5) < 0.05
+    e = D.Exponential(rate=2.0)
+    np.testing.assert_allclose(float(e.log_prob(1.0)),
+                               math.log(2) - 2, rtol=1e-5)
+    p = D.Poisson(rate=4.0)
+    # P(X=2) = e^-4 4^2/2!
+    np.testing.assert_allclose(float(p.log_prob(2.0)),
+                               -4 + 2 * math.log(4) - math.log(2),
+                               rtol=1e-5)
+
+
+def test_laplace_gumbel_lognormal_studentt():
+    lap = D.Laplace(loc=0.0, scale=1.0)
+    np.testing.assert_allclose(float(lap.log_prob(0.0)), -math.log(2),
+                               rtol=1e-5)
+    gum = D.Gumbel(loc=0.0, scale=1.0)
+    s = gum.sample([20000]).numpy()
+    assert abs(s.mean() - 0.5772) < 0.05
+    ln = D.LogNormal(loc=0.0, scale=0.5)
+    s = ln.rsample([20000]).numpy()
+    np.testing.assert_allclose(s.mean(), math.exp(0.125), atol=0.05)
+    st = D.StudentT(df=5.0)
+    assert float(st.variance) == pytest.approx(5 / 3, rel=1e-5)
+
+
+def test_kl_registry_and_missing():
+    a, b = D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)
+    assert float(D.kl_divergence(a, b)) > 0
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0.0, 1.0), D.Beta(1.0, 1.0))
+
+    # custom registration wins
+    class MyNormal(D.Normal):
+        pass
+
+    @D.register_kl(MyNormal, D.Normal)
+    def _kl_mine(p, q):
+        return paddle.to_tensor(123.0)
+
+    assert float(D.kl_divergence(MyNormal(0.0, 1.0),
+                                 D.Normal(0.0, 1.0))) == 123.0
+
+
+def test_affine_exp_transforms_roundtrip():
+    t = D.AffineTransform(loc=2.0, scale=3.0)
+    x = paddle.to_tensor([0.5, -1.0])
+    y = t.forward(x)
+    np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        t.forward_log_det_jacobian(x).numpy(), np.log(3.0), rtol=1e-6)
+    e = D.ExpTransform()
+    np.testing.assert_allclose(e.inverse(e.forward(x)).numpy(),
+                               x.numpy(), rtol=1e-6)
+    chain = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                              D.ExpTransform()])
+    y2 = chain.forward(x)
+    np.testing.assert_allclose(chain.inverse(y2).numpy(), x.numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        chain.inverse_log_det_jacobian(y2).numpy(),
+        -chain.forward_log_det_jacobian(x).numpy(), rtol=1e-5)
+
+
+def test_transformed_distribution_lognormal_equivalence():
+    base = D.Normal(loc=0.0, scale=0.5)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(loc=0.0, scale=0.5)
+    for v in (0.5, 1.0, 2.5):
+        np.testing.assert_allclose(float(td.log_prob(v)),
+                                   float(ln.log_prob(v)), rtol=1e-5)
+    s = td.sample([10000]).numpy()
+    assert (s > 0).all()
+
+
+def test_sigmoid_tanh_transform_ldj():
+    x = paddle.to_tensor([0.3, -0.7])
+    sg = D.SigmoidTransform()
+    y = sg.forward(x).numpy()
+    # d sigmoid/dx = y(1-y)
+    np.testing.assert_allclose(
+        sg.forward_log_det_jacobian(x).numpy(),
+        np.log(y * (1 - y)), rtol=1e-5)
+    th = D.TanhTransform()
+    yt = th.forward(x).numpy()
+    np.testing.assert_allclose(
+        th.forward_log_det_jacobian(x).numpy(),
+        np.log(1 - yt ** 2), rtol=1e-4)
+
+
+def test_stickbreaking_roundtrip():
+    sb = D.StickBreakingTransform()
+    x = paddle.to_tensor([0.5, -0.3, 0.8])
+    y = sb.forward(x)
+    assert y.numpy().shape == (4,)
+    np.testing.assert_allclose(y.numpy().sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(sb.inverse(y).numpy(), x.numpy(),
+                               rtol=1e-4)

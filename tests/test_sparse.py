@@ -1,0 +1,142 @@
+"""paddle.sparse analog tests (reference:
+python/paddle/fluid/tests/unittests/test_sparse_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _demo_coo():
+    # [[0, 1, 0], [2, 0, 3]]
+    return sparse.sparse_coo_tensor(
+        indices=[[0, 1, 1], [1, 0, 2]], values=[1.0, 2.0, 3.0],
+        shape=[2, 3])
+
+
+def test_coo_create_to_dense():
+    s = _demo_coo()
+    assert s.shape == [2, 3]
+    assert s.nnz == 3
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               [[0, 1, 0], [2, 0, 3]])
+    np.testing.assert_array_equal(np.asarray(s.indices().numpy()),
+                                  [[0, 1, 1], [1, 0, 2]])
+    np.testing.assert_allclose(s.values().numpy(), [1, 2, 3])
+
+
+def test_coo_shape_inference_and_validation():
+    s = sparse.sparse_coo_tensor([[0, 2]], [5.0, 6.0])
+    assert s.shape == [3]
+    with pytest.raises(ValueError):
+        sparse.sparse_coo_tensor([0, 1], [1.0, 2.0])  # not 2-D indices
+
+
+def test_csr_create_and_convert():
+    s = sparse.sparse_csr_tensor(
+        crows=[0, 1, 3], cols=[1, 0, 2], values=[1.0, 2.0, 3.0],
+        shape=[2, 3])
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               [[0, 1, 0], [2, 0, 3]])
+    coo = s.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(),
+                               [[0, 1, 0], [2, 0, 3]])
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(),
+                               [[0, 1, 0], [2, 0, 3]])
+    np.testing.assert_array_equal(np.asarray(back.crows().numpy()),
+                                  [0, 1, 3])
+
+
+def test_coalesce_sums_duplicates():
+    s = sparse.sparse_coo_tensor(
+        indices=[[0, 0], [1, 1]], values=[1.0, 4.0], shape=[2, 2])
+    c = s.coalesce()
+    np.testing.assert_allclose(c.to_dense().numpy(),
+                               [[0, 5], [0, 0]])
+    assert c.nnz == 1
+
+
+def test_unary_ops_preserve_structure():
+    s = _demo_coo()
+    r = sparse.relu(sparse.neg(s))
+    np.testing.assert_allclose(r.to_dense().numpy(), 0.0)
+    sq = sparse.square(s)
+    np.testing.assert_allclose(sq.to_dense().numpy(),
+                               [[0, 1, 0], [4, 0, 9]])
+    assert sq.nnz == 3
+    t = sparse.tanh(s)
+    np.testing.assert_allclose(t.values().numpy(),
+                               np.tanh([1, 2, 3]), rtol=1e-6)
+
+
+def test_binary_add_subtract():
+    a = _demo_coo()
+    b = sparse.sparse_coo_tensor([[0], [0]], [10.0], shape=[2, 3])
+    np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(),
+                               [[10, 1, 0], [2, 0, 3]])
+    np.testing.assert_allclose(sparse.subtract(a, b).to_dense().numpy(),
+                               [[-10, 1, 0], [2, 0, 3]])
+
+
+def test_multiply_divide_scalar_and_sparse():
+    a = _demo_coo()
+    np.testing.assert_allclose(
+        sparse.multiply(a, 2.0).to_dense().numpy(),
+        [[0, 2, 0], [4, 0, 6]])
+    prod = sparse.multiply(a, a)
+    np.testing.assert_allclose(prod.to_dense().numpy(),
+                               [[0, 1, 0], [4, 0, 9]])
+
+
+def test_matmul_sparse_dense():
+    a = _demo_coo()
+    d = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+    out = sparse.matmul(a, d)
+    ref = np.array([[0, 1, 0], [2, 0, 3]], np.float32) @ \
+        np.arange(6, dtype=np.float32).reshape(3, 2)
+    np.testing.assert_allclose(out.numpy(), ref)
+    # dense @ sparse
+    dd = np.random.RandomState(1).randn(4, 2).astype(np.float32)
+    out2 = sparse.matmul(paddle.to_tensor(dd), a)
+    np.testing.assert_allclose(
+        out2.numpy(), dd @ np.array([[0, 1, 0], [2, 0, 3]], np.float32),
+        rtol=1e-5)
+
+
+def test_masked_matmul_matches_dense_at_pattern():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(5, 4).astype(np.float32)
+    mask = sparse.sparse_coo_tensor(
+        indices=[[0, 1, 3], [1, 2, 0]], values=[1.0, 1.0, 1.0],
+        shape=[4, 4])
+    out = sparse.masked_matmul(x, y, mask)
+    dense = x @ y
+    got = out.to_dense().numpy()
+    for r, c in [(0, 1), (1, 2), (3, 0)]:
+        np.testing.assert_allclose(got[r, c], dense[r, c], rtol=1e-5)
+    assert got[0, 0] == 0
+
+
+def test_sparse_nn_relu_softmax():
+    s = sparse.sparse_coo_tensor(
+        indices=[[0, 0, 1], [0, 1, 1]], values=[-1.0, 2.0, 0.5],
+        shape=[2, 2])
+    r = sparse.nn.ReLU()(s)
+    np.testing.assert_allclose(r.to_dense().numpy(),
+                               [[0, 2], [0, 0.5]])
+    sm = sparse.nn.Softmax()(_demo_coo())
+    dense = sm.to_dense().numpy()
+    np.testing.assert_allclose(dense[0, 1], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(dense[1, [0, 2]].sum(), 1.0, rtol=1e-6)
+
+
+def test_is_same_shape_and_cast():
+    a, b = _demo_coo(), _demo_coo()
+    assert sparse.is_same_shape(a, b)
+    c = sparse.cast(a, index_dtype="int32", value_dtype="float16")
+    assert str(c.dtype) == "float16"
+    assert str(c._mat.indices.dtype) == "int32"
+    np.testing.assert_allclose(c.to_dense().numpy().astype(np.float32),
+                               a.to_dense().numpy(), rtol=1e-2)
