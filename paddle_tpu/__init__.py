@@ -61,7 +61,8 @@ from .jit.api import grad, value_and_grad  # noqa: F401,E402
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
 _LAZY = {"distributed", "distribution", "models", "vision", "kernels",
-         "hapi", "profiler", "incubate", "inference", "sparse", "static"}
+         "hapi", "profiler", "incubate", "inference", "quantization",
+         "sparse", "static"}
 
 
 def __getattr__(name):

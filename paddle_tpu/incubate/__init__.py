@@ -3,4 +3,6 @@
 Reference: python/paddle/incubate/ (autograd functional prims, asp 2:4
 sparsity, distributed models). Populated incrementally; see submodules.
 """
-__all__ = []
+from . import asp  # noqa: F401
+
+__all__ = ["asp"]

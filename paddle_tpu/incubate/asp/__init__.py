@@ -1,0 +1,15 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Reference: python/paddle/incubate/asp (fluid/contrib/sparsity/asp.py:
+calculate_density, decorate, prune_model; utils.py mask generation
+check_mask_1d/get_mask_1d). TPU note: the MXU has no N:M sparse mode,
+so 2:4 here preserves Paddle's training/pruning WORKFLOW (masked
+weights + mask maintenance after each optimizer step) with dense
+execution — the masks ride along for deployment to hardware that can
+exploit them.
+"""
+from .asp import (ASPHelper, calculate_density, decorate,  # noqa: F401
+                  prune_model, reset_excluded_layers,
+                  set_excluded_layers)
+from .utils import (check_mask_1d, check_mask_2d,  # noqa: F401
+                    create_mask, get_mask_1d, get_mask_2d_greedy)

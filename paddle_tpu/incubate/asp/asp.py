@@ -1,0 +1,103 @@
+"""ASP workflow: prune_model + decorate (≈ fluid/contrib/sparsity/
+asp.py ASPHelper, prune_model:1, decorate:1)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from ...nn.layers_common import Conv2D, Linear
+from .utils import create_mask
+
+__all__ = ["ASPHelper", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers",
+           "calculate_density"]
+
+
+class ASPHelper:
+    """Computes masks and maintains them after optimizer steps. The
+    mask is stored ON the Parameter (`p._asp_mask`) so mask lifetime
+    tracks the parameter — no global registry to go stale or leak."""
+
+    _supported = (Linear, Conv2D)
+    _excluded: set = set()
+
+    @classmethod
+    def is_supported_layer(cls, layer: Layer, name: str) -> bool:
+        return isinstance(layer, cls._supported) and \
+            name not in cls._excluded and \
+            name.split(".")[-1] not in cls._excluded
+
+    @classmethod
+    def prune_model(cls, model: Layer, n: int = 2, m: int = 4,
+                    mask_algo: str = "mask_1d") -> Dict[str, jnp.ndarray]:
+        masks: Dict[str, jnp.ndarray] = {}
+        for name, layer in model.named_sublayers(include_self=True):
+            if not cls.is_supported_layer(layer, name):
+                continue
+            w = layer.weight
+            arr = np.asarray(w._data)
+            if arr.ndim < 2:
+                continue
+            # N:M groups must run along the REDUCTION dim (that's what
+            # sparse matmul hardware contracts over): Linear weight is
+            # [in, out] -> group along axis 0 (via transpose); conv
+            # weight [out, in, kh, kw] flattens to [out, in*kh*kw] ->
+            # group along the last axis directly
+            if arr.ndim > 2:
+                mat = arr.reshape(arr.shape[0], -1)
+                mask2d = create_mask(mat, func_name=mask_algo, n=n, m=m)
+                mask_np = mask2d.reshape(arr.shape)
+            else:
+                mask_np = create_mask(arr.T, func_name=mask_algo,
+                                      n=n, m=m).T
+            mask = jnp.asarray(mask_np, dtype=w._data.dtype)
+            w._data = w._data * mask
+            w._asp_mask = mask
+            masks[name] = mask
+        return masks
+
+    @classmethod
+    def apply_masks(cls, params: List[Tensor]) -> None:
+        for p in params:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d"):
+    """Compute N:M masks for supported layers, zero the weights, and
+    remember the masks for `decorate`d optimizers."""
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo)
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply sparsity masks after each update
+    (the reference wraps minimize/step the same way)."""
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        ASPHelper.apply_masks(optimizer._parameter_list)
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer
+
+
+def set_excluded_layers(layer_names, main_program=None):
+    ASPHelper._excluded.update(layer_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper._excluded.clear()
+
+
+def calculate_density(mat) -> float:
+    arr = np.asarray(mat._data if isinstance(mat, Tensor) else mat)
+    return float((arr != 0).sum() / arr.size)

@@ -1,0 +1,84 @@
+"""N:M sparsity mask generation (≈ fluid/contrib/sparsity/utils.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_mask_1d", "check_mask_1d", "get_mask_2d_greedy",
+           "check_mask_2d", "create_mask"]
+
+
+def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-|.| entries of every m consecutive elements
+    along the last axis."""
+    arr = np.asarray(mat)
+    shape = arr.shape
+    flat = arr.reshape(-1, shape[-1])
+    cols = shape[-1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = np.abs(flat).reshape(flat.shape[0], -1, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[..., :n], True, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(shape)
+
+
+def check_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-length group along the last axis has at most n
+    non-zeros."""
+    arr = np.asarray(mat)
+    flat = arr.reshape(-1, arr.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = (flat != 0).reshape(flat.shape[0], -1, m)
+    return bool((groups.sum(-1) <= n).all())
+
+
+def get_mask_2d_greedy(mat: np.ndarray, n: int = 2,
+                       m: int = 4) -> np.ndarray:
+    """Greedy 2-D variant: n:m along BOTH the row and column grouping
+    (reference get_mask_2d_greedy). Applies the 1-D rule to rows of
+    each m x m tile, then enforces the column constraint greedily."""
+    arr = np.asarray(mat)
+    if arr.ndim != 2:
+        raise ValueError("get_mask_2d_greedy expects a 2-D matrix")
+    rows, cols = arr.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    padded = np.pad(np.abs(arr), ((0, pr), (0, pc)))
+    mask = np.zeros_like(padded, dtype=bool)
+    for r0 in range(0, padded.shape[0], m):
+        for c0 in range(0, padded.shape[1], m):
+            tile = padded[r0:r0 + m, c0:c0 + m]
+            tmask = np.zeros_like(tile, dtype=bool)
+            # pick entries largest-first subject to n-per-row/col
+            order = np.dstack(np.unravel_index(
+                np.argsort(-tile, axis=None), tile.shape))[0]
+            rcount = np.zeros(m, dtype=int)
+            ccount = np.zeros(m, dtype=int)
+            for r, c in order:
+                if rcount[r] < n and ccount[c] < n:
+                    tmask[r, c] = True
+                    rcount[r] += 1
+                    ccount[c] += 1
+            mask[r0:r0 + m, c0:c0 + m] = tmask
+    return mask[:rows, :cols]
+
+
+def check_mask_2d(mat: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    arr = np.asarray(mat)
+    ok_rows = check_mask_1d(arr, n, m)
+    ok_cols = check_mask_1d(arr.T, n, m)
+    return ok_rows and ok_cols
+
+
+def create_mask(mat: np.ndarray, func_name: str = "mask_1d", n: int = 2,
+                m: int = 4) -> np.ndarray:
+    if func_name in ("mask_1d", "get_mask_1d"):
+        return get_mask_1d(mat, n, m)
+    if func_name in ("mask_2d_greedy", "get_mask_2d_greedy"):
+        return get_mask_2d_greedy(mat, n, m)
+    raise ValueError(f"unknown mask function {func_name!r}")

@@ -1,0 +1,18 @@
+"""paddle_tpu.quantization — QAT and PTQ.
+
+Reference: python/paddle/quantization/ (QuantConfig, QAT, PTQ) and the
+slim stack (python/paddle/fluid/contrib/slim/quantization/
+imperative/qat.py, post_training_quantization.py). TPU-native notes:
+fake-quant is a jax.custom_vjp op (straight-through estimator) that
+works identically on the eager tape and under jit; QAT activation
+scales are computed in-trace (dynamic absmax) so the whole quantized
+train step still compiles to one XLA program; PTQ collects calibration
+ranges eagerly with observers, then freezes them.
+"""
+from .config import QuantConfig  # noqa: F401
+from .fake_quant import (dequantize_int8, fake_quant,  # noqa: F401
+                         fake_quant_channelwise, quantize_int8)
+from .observers import (AbsmaxObserver, AVGObserver,  # noqa: F401
+                        ChannelWiseAbsmaxObserver)
+from .ptq import PTQ  # noqa: F401
+from .qat import QAT, QuantedConv2D, QuantedLinear  # noqa: F401

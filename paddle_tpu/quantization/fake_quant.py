@@ -1,0 +1,102 @@
+"""Fake-quant primitives with straight-through gradients.
+
+Reference analog: fake_quantize_* ops
+(paddle/fluid/operators/fake_quantize_op.cc — quantize-dequantize with
+identity gradient inside the clipped range). The core is a
+jax.custom_vjp (STE) registered through the op registry so the eager
+tape records it and jit traces lower it the same way.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.op_registry import op
+
+__all__ = ["fake_quant", "fake_quant_channelwise", "quantize_int8",
+           "dequantize_int8"]
+
+
+@jax.custom_vjp
+def _fq(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fq(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # STE: pass gradient inside the representable range, zero outside
+    inside = (jnp.abs(x) <= jnp.maximum(scale, 1e-8)).astype(g.dtype)
+    return g * inside, None, None
+
+
+_fq.defvjp(_fq_fwd, _fq_bwd)
+
+
+@op("fake_quant")
+def _fake_quant_impl(x, scale, qmax):
+    if scale is None:
+        scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    s = jnp.asarray(scale, dtype=x.dtype)
+    return _fq(x, s, x.dtype.type(qmax))
+
+
+@op("fake_quant_channelwise")
+def _fake_quant_cw_impl(x, scale, qmax, axis):
+    if scale is None:
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        s = jax.lax.stop_gradient(
+            jnp.max(jnp.abs(x), axis=red, keepdims=True))
+    else:
+        s = jnp.asarray(scale, dtype=x.dtype)
+        if s.ndim == 1:
+            shape = [1] * x.ndim
+            shape[axis] = -1
+            s = s.reshape(shape)
+    return _fq(x, s.astype(x.dtype), x.dtype.type(qmax))
+
+
+def fake_quant(x, scale=None, bits: int = 8):
+    """Per-tensor quantize-dequantize. `scale=None` -> dynamic absmax
+    (computed in-trace, jit-safe)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    if isinstance(scale, Tensor):
+        scale = scale._data
+    return _fake_quant_impl(x, scale=scale, qmax=qmax)
+
+
+def fake_quant_channelwise(x, axis: int = 0, scale=None, bits: int = 8):
+    """Per-channel weight quantize-dequantize (axis = channel dim)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    if isinstance(scale, Tensor):
+        scale = scale._data
+    return _fake_quant_cw_impl(x, scale=scale, qmax=qmax, axis=axis)
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def quantize_int8(x, axis=None):
+    """Real int8 quantization: returns (int8 values, float scales).
+    axis=None -> per-tensor; else per-channel along `axis`."""
+    raw = _raw(x)
+    if axis is None:
+        scale = jnp.maximum(jnp.max(jnp.abs(raw)), 1e-8)
+    else:
+        red = tuple(i for i in range(raw.ndim) if i != axis)
+        scale = jnp.maximum(jnp.max(jnp.abs(raw), axis=red,
+                                    keepdims=True), 1e-8)
+    q = jnp.clip(jnp.round(raw / scale * 127.0), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale):
+    return q.astype(jnp.float32) * scale / 127.0

@@ -1,0 +1,70 @@
+"""Calibration observers (≈ python/paddle/quantization/observers/ and
+slim's post_training_quantization sample collectors). Observers run
+EAGERLY during PTQ calibration — they hold running python/numpy state
+and must not appear inside a jit trace."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["AbsmaxObserver", "AVGObserver", "ChannelWiseAbsmaxObserver"]
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+class AbsmaxObserver:
+    """Running max of |x| (per tensor)."""
+
+    def __init__(self, bits: int = 8):
+        self.bits = bits
+        self._max: float = 0.0
+
+    def observe(self, x) -> None:
+        self._max = max(self._max, float(np.abs(_np(x)).max()))
+
+    @property
+    def scale(self) -> float:
+        return max(self._max, 1e-8)
+
+
+class AVGObserver:
+    """Average of per-batch absmax (reference AVGObserver)."""
+
+    def __init__(self, bits: int = 8):
+        self.bits = bits
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, x) -> None:
+        self._sum += float(np.abs(_np(x)).max())
+        self._n += 1
+
+    @property
+    def scale(self) -> float:
+        return max(self._sum / max(self._n, 1), 1e-8)
+
+
+class ChannelWiseAbsmaxObserver:
+    """Per-output-channel absmax (weights)."""
+
+    def __init__(self, axis: int = 0, bits: int = 8):
+        self.axis = axis
+        self.bits = bits
+        self._max: Optional[np.ndarray] = None
+
+    def observe(self, x) -> None:
+        arr = np.abs(_np(x))
+        red = tuple(i for i in range(arr.ndim) if i != self.axis)
+        m = arr.max(axis=red)
+        self._max = m if self._max is None else np.maximum(self._max, m)
+
+    @property
+    def scale(self) -> np.ndarray:
+        assert self._max is not None, "observer saw no data"
+        return np.maximum(self._max, 1e-8)

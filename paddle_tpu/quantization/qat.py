@@ -1,0 +1,120 @@
+"""Quantization-aware training (≈ python/paddle/quantization/qat.py +
+slim imperative/qat.py ImperativeQuantAware).
+
+QAT.quantize(model) swaps Linear/Conv2D sublayers for Quanted*
+wrappers that fake-quant weights (per-channel) and activations
+(per-tensor, dynamic absmax in-trace) with straight-through gradients.
+The wrapped layer SHARES the original Parameters, so optimizers and
+state_dicts keep working; everything stays jit-compilable."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..nn.layer import Layer
+from ..nn.layers_common import Conv2D, Linear
+from ..nn import functional as F
+from .config import QuantConfig
+from .fake_quant import fake_quant, fake_quant_channelwise
+
+__all__ = ["QAT", "QuantedLinear", "QuantedConv2D"]
+
+
+def _quant_act(x, cfg: QuantConfig):
+    if cfg.activation_quanter is not None:
+        return cfg.activation_quanter(x)
+    return fake_quant(x, bits=cfg.activation_bits)
+
+
+def _quant_weight(w, axis: int, cfg: QuantConfig):
+    if cfg.weight_quanter is not None:
+        return cfg.weight_quanter(w, axis)
+    return fake_quant_channelwise(w, axis=axis, bits=cfg.weight_bits)
+
+
+class QuantedLinear(Layer):
+    def __init__(self, inner: Linear, config: QuantConfig,
+                 q_weight: bool = True, q_act: bool = True):
+        super().__init__()
+        self.inner = inner
+        self._cfg = config
+        self._q_weight = q_weight
+        self._q_act = q_act
+
+    def forward(self, x):
+        if self._q_act:
+            x = _quant_act(x, self._cfg)
+        w = self.inner.weight
+        if self._q_weight:
+            # weight layout [in, out] -> channel axis is 1 (out features)
+            w = _quant_weight(w, 1, self._cfg)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner: Conv2D, config: QuantConfig,
+                 q_weight: bool = True, q_act: bool = True):
+        super().__init__()
+        self.inner = inner
+        self._cfg = config
+        self._q_weight = q_weight
+        self._q_act = q_act
+
+    def forward(self, x):
+        if self._q_act:
+            x = _quant_act(x, self._cfg)
+        inner = self.inner
+        w = inner.weight
+        if self._q_weight:
+            # conv weight [out, in/g, kh, kw] -> channel axis 0
+            w = _quant_weight(w, 0, self._cfg)
+        return F.conv2d(x, w, inner.bias, inner.stride, inner.padding,
+                        inner.dilation, inner.groups, inner.data_format)
+
+
+_WRAPPERS = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+class QAT:
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        """Replace quantizable sublayers in-place (reference
+        ImperativeQuantAware.quantize)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._walk(model, prefix="")
+        return model
+
+    def _walk(self, layer: Layer, prefix: str) -> None:
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            full = f"{prefix}{name}"
+            wrapper = _WRAPPERS.get(type(sub))
+            if wrapper is not None and \
+                    self.config.should_quantize(full, sub):
+                qw, qa = self.config._types[type(sub)]
+                layer._sub_layers[name] = wrapper(sub, self.config,
+                                                  q_weight=qw, q_act=qa)
+            else:
+                self._walk(sub, prefix=full + ".")
+
+    @staticmethod
+    def convert(model: Layer, inplace: bool = True) -> Layer:
+        """Strip Quanted* wrappers back to plain layers (weights keep
+        their trained values; use ptq/int8 export for deployment)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def walk(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                    layer._sub_layers[name] = sub.inner
+                elif sub is not None:
+                    walk(sub)
+
+        walk(model)
+        return model

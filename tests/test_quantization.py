@@ -1,0 +1,198 @@
+"""Quantization (QAT/PTQ) + ASP tests.
+
+Mirrors the reference's test_imperative_qat*.py /
+test_post_training_quantization_*.py / test_asp_*.py
+(python/paddle/fluid/tests/unittests/ and .../asp/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+from paddle_tpu.quantization import (PTQ, QAT, QuantConfig, QuantedConv2D,
+                                     QuantedLinear, dequantize_int8,
+                                     fake_quant, fake_quant_channelwise,
+                                     quantize_int8)
+
+
+# ------------------------------------------------------------- fake quant
+def test_fake_quant_roundtrip_accuracy():
+    paddle.seed(0)
+    x = paddle.randn([64, 64])
+    q = fake_quant(x)
+    err = np.abs(q.numpy() - x.numpy()).max()
+    scale = np.abs(x.numpy()).max()
+    assert err <= scale / 127 + 1e-6  # one quantization step
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.array([0.5, -0.2, 3.0], np.float32))
+    x.stop_gradient = False
+    y = fake_quant(x, scale=1.0)  # 3.0 is outside the range
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0, 0.0])
+
+
+def test_quantize_int8_channelwise():
+    paddle.seed(1)
+    w = paddle.randn([8, 16]).numpy() * np.linspace(
+        0.1, 10, 16)[None, :]
+    q, s = quantize_int8(w, axis=1)
+    assert str(np.asarray(q).dtype) == "int8"
+    deq = np.asarray(dequantize_int8(q, s))
+    rel = np.abs(deq - w).max(0) / np.abs(w).max(0)
+    assert rel.max() < 0.01  # per-channel keeps small channels accurate
+
+
+# -------------------------------------------------------------------- QAT
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_qat_swaps_layers_and_shares_params():
+    model = _mlp()
+    orig_params = {id(p) for p in model.parameters()}
+    QAT().quantize(model)
+    subs = dict(model.named_sublayers())
+    assert any(isinstance(l, QuantedLinear) for l in subs.values())
+    assert {id(p) for p in model.parameters()} == orig_params
+
+
+def test_qat_trains_and_converts():
+    model = _mlp()
+    qat = QAT()
+    qat.quantize(model)
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=model.parameters())
+    mse = nn.MSELoss()
+    x = paddle.randn([32, 8])
+    y = paddle.randn([32, 4])
+    first = None
+    for _ in range(30):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+    QAT.convert(model)
+    assert not any(isinstance(l, QuantedLinear)
+                   for _, l in model.named_sublayers())
+
+
+def test_qat_skip_config():
+    cfg = QuantConfig().skip("2")  # skip the final Linear
+    model = _mlp()
+    QAT(cfg).quantize(model)
+    subs = dict(model.named_sublayers())
+    assert isinstance(subs["0"], QuantedLinear)
+    assert isinstance(subs["2"], nn.Linear)
+
+
+def test_qat_conv2d_forward():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.ReLU())
+    QAT().quantize(model)
+    assert isinstance(dict(model.named_sublayers())["0"], QuantedConv2D)
+    out = model(paddle.randn([2, 3, 8, 8]))
+    assert tuple(out.shape) == (2, 4, 8, 8)
+
+
+# -------------------------------------------------------------------- PTQ
+def test_ptq_calibrate_convert_close_outputs():
+    model = _mlp()
+    model.eval()
+    x = paddle.randn([64, 8])
+    ref = model(x).numpy()
+    ptq = PTQ()
+    ptq.quantize(model)
+    for i in range(4):  # calibration passes
+        model(x)
+    ptq.convert(model)
+    out = model(x).numpy()
+    # int8 quantized model stays close on calibrated data
+    assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
+    # quant_info recorded int8 weights per layer
+    assert len(ptq.quant_info) == 2
+    info = next(iter(ptq.quant_info.values()))
+    assert info["weight_int8"].dtype == np.int8
+    assert info["act_scale"] > 0
+
+
+# -------------------------------------------------------------------- ASP
+def test_asp_mask_1d_and_check():
+    rng = np.random.RandomState(0)
+    mat = rng.randn(16, 32)
+    mask = asp.get_mask_1d(mat, 2, 4)
+    assert asp.check_mask_1d(mat * mask, 2, 4)
+    assert mask.reshape(-1, 4).sum(-1).max() == 2
+    # keeps the 2 largest of each group
+    grp = np.abs(mat.reshape(-1, 4))
+    kept = np.where(mask.reshape(-1, 4), grp, 0)
+    assert (kept.sum(-1) >= np.sort(grp, -1)[:, -2:].sum(-1) - 1e-9).all()
+
+
+def test_asp_conv_prunes_reduction_dim():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Conv2D(8, 8, 3, padding=1))
+    asp.prune_model(m)
+    w = np.asarray(m.parameters()[0].numpy())
+    # groups of 4 must run along in*kh*kw (what sparse matmul contracts)
+    assert asp.check_mask_1d(w.reshape(w.shape[0], -1), 2, 4)
+
+
+def test_ptq_honors_type_flags():
+    cfg = QuantConfig().add_type_config(nn.Linear, weight=True,
+                                        activation=False)
+    m = nn.Sequential(nn.Linear(4, 4))
+    ptq = PTQ(cfg)
+    ptq.quantize(m)
+    m(paddle.randn([2, 4]))
+    ptq.convert(m)
+    assert ptq.quant_info["0"]["act_scale"] is None
+    assert ptq.quant_info["0"]["weight_int8"].dtype == np.int8
+
+
+def test_qat_custom_quanter_used():
+    calls = []
+
+    def my_act(x):
+        calls.append(1)
+        return x
+
+    cfg = QuantConfig(activation=my_act)
+    m = nn.Sequential(nn.Linear(4, 4))
+    QAT(cfg).quantize(m)
+    m(paddle.randn([2, 4]))
+    assert calls
+
+
+def test_asp_mask_2d_greedy():
+    rng = np.random.RandomState(1)
+    mat = rng.randn(8, 8)
+    mask = asp.get_mask_2d_greedy(mat, 2, 4)
+    assert asp.check_mask_2d(mat * mask, 2, 4)
+
+
+def test_asp_prune_and_decorated_optimizer_keeps_sparsity():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 4))
+    asp.prune_model(model)
+    w0 = model.parameters()[0]
+    assert asp.calculate_density(w0) == pytest.approx(0.5, abs=0.01)
+    opt = asp.decorate(optimizer.SGD(learning_rate=0.05,
+                                     parameters=model.parameters()))
+    mse = nn.MSELoss()
+    x = paddle.randn([8, 16])
+    y = paddle.randn([8, 4])
+    for _ in range(5):
+        loss = mse(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern survives optimizer updates
+    assert asp.calculate_density(w0) == pytest.approx(0.5, abs=0.01)
+    arr = np.asarray(w0.numpy())
+    assert asp.check_mask_1d(arr.T, 2, 4)
