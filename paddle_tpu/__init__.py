@@ -60,9 +60,9 @@ from .framework_io import load, save  # noqa: F401,E402
 from .jit.api import grad, value_and_grad  # noqa: F401,E402
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
-_LAZY = {"distributed", "distribution", "models", "vision", "kernels",
-         "hapi", "profiler", "incubate", "inference", "quantization",
-         "sparse", "static"}
+_LAZY = {"distributed", "distribution", "geometric", "models", "vision",
+         "kernels", "hapi", "profiler", "incubate", "inference",
+         "quantization", "sparse", "static"}
 
 
 def __getattr__(name):

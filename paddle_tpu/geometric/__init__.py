@@ -1,0 +1,11 @@
+"""paddle.geometric analog — graph/message-passing ops.
+
+Reference: python/paddle/geometric/ (segment_sum/mean/max/min in
+math.py over phi segment kernels; send_u_recv / send_ue_recv message
+passing in message_passing/send_recv.py over graph_send_recv ops).
+TPU-native: jax.ops.segment_* — XLA lowers them to sorted scatter
+reductions, which is the efficient TPU pattern for GNN aggregation.
+"""
+from .math import (segment_max, segment_mean, segment_min,  # noqa: F401
+                   segment_sum)
+from .message_passing import send_u_recv, send_ue_recv  # noqa: F401

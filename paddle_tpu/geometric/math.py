@@ -1,0 +1,85 @@
+"""Segment reductions (≈ python/paddle/geometric/math.py;
+phi/kernels/segment_pool_kernel.h)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.op_registry import op
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max"]
+
+
+def _nseg(segment_ids, num_segments: Optional[int]):
+    if num_segments is not None:
+        return int(num_segments)
+    ids = segment_ids._data if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    # eager path: concrete max is fine; under jit pass num_segments
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _fill_empty(out, ids, num_segments):
+    """Paddle fills empty segments with 0 (dtype-preserving); jax's
+    segment_min/max leave +/-inf (float) or iinfo extremes (int)."""
+    counts = jax.ops.segment_sum(
+        jnp.ones(ids.shape[0], dtype=jnp.int32), ids,
+        num_segments=num_segments)
+    mask = (counts > 0).reshape((num_segments,) + (1,) * (out.ndim - 1))
+    return jnp.where(mask, out, jnp.zeros((), dtype=out.dtype))
+
+
+@op("segment_sum")
+def _segment_sum_impl(data, segment_ids, num_segments):
+    return jax.ops.segment_sum(data, segment_ids.astype(jnp.int32),
+                               num_segments=num_segments)
+
+
+@op("segment_mean")
+def _segment_mean_impl(data, segment_ids, num_segments):
+    ids = segment_ids.astype(jnp.int32)
+    total = jax.ops.segment_sum(data, ids, num_segments=num_segments)
+    count = jax.ops.segment_sum(jnp.ones_like(data), ids,
+                                num_segments=num_segments)
+    return total / jnp.maximum(count, 1)
+
+
+@op("segment_min")
+def _segment_min_impl(data, segment_ids, num_segments):
+    ids = segment_ids.astype(jnp.int32)
+    out = jax.ops.segment_min(data, ids, num_segments=num_segments)
+    return _fill_empty(out, ids, num_segments)
+
+
+@op("segment_max")
+def _segment_max_impl(data, segment_ids, num_segments):
+    ids = segment_ids.astype(jnp.int32)
+    out = jax.ops.segment_max(data, ids, num_segments=num_segments)
+    return _fill_empty(out, ids, num_segments)
+
+
+def segment_sum(data, segment_ids, num_segments: Optional[int] = None):
+    return _segment_sum_impl(data, segment_ids,
+                             num_segments=_nseg(segment_ids,
+                                                num_segments))
+
+
+def segment_mean(data, segment_ids, num_segments: Optional[int] = None):
+    return _segment_mean_impl(data, segment_ids,
+                              num_segments=_nseg(segment_ids,
+                                                 num_segments))
+
+
+def segment_min(data, segment_ids, num_segments: Optional[int] = None):
+    return _segment_min_impl(data, segment_ids,
+                             num_segments=_nseg(segment_ids,
+                                                num_segments))
+
+
+def segment_max(data, segment_ids, num_segments: Optional[int] = None):
+    return _segment_max_impl(data, segment_ids,
+                             num_segments=_nseg(segment_ids,
+                                                num_segments))

@@ -1,0 +1,73 @@
+"""Graph message passing (≈ python/paddle/geometric/message_passing/
+send_recv.py send_u_recv/send_ue_recv over the graph_send_recv ops,
+paddle/phi/kernels/graph_send_recv_kernel.h)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.op_registry import op
+from .math import (_segment_max_impl, _segment_mean_impl,
+                   _segment_min_impl, _segment_sum_impl)
+
+__all__ = ["send_u_recv", "send_ue_recv"]
+
+_REDUCERS = {"sum": _segment_sum_impl.raw, "mean": _segment_mean_impl.raw,
+             "max": _segment_max_impl.raw, "min": _segment_min_impl.raw}
+
+
+def _segment_reduce(msgs, dst, pool_type, num_nodes):
+    # single source of truth: the registered segment impls from math.py
+    return _REDUCERS[pool_type](msgs, dst, num_nodes)
+
+
+@op("graph_send_u_recv")
+def _send_u_recv_impl(x, src, dst, pool_type, out_size):
+    msgs = jnp.take(x, src.astype(jnp.int32), axis=0)
+    return _segment_reduce(msgs, dst, pool_type, out_size)
+
+
+@op("graph_send_ue_recv")
+def _send_ue_recv_impl(x, e, src, dst, message_op, pool_type, out_size):
+    msgs = jnp.take(x, src.astype(jnp.int32), axis=0)
+    if message_op == "add":
+        msgs = msgs + e
+    elif message_op == "mul":
+        msgs = msgs * e
+    else:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    return _segment_reduce(msgs, dst, pool_type, out_size)
+
+
+def _out_size(dst, x, out_size):
+    if out_size is not None:
+        return int(out_size)
+    # default: number of nodes in x (reference uses max(dst)+1 or x rows)
+    return int(x.shape[0])
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None):
+    """Gather x[src], reduce onto dst (graph aggregation)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(
+            f"reduce_op must be one of {sorted(_REDUCERS)}")
+    return _send_u_recv_impl(x, src_index, dst_index,
+                             pool_type=reduce_op,
+                             out_size=_out_size(dst_index, x, out_size))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum",
+                 out_size: Optional[int] = None):
+    """Like send_u_recv but combines edge features y into the message."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(
+            f"reduce_op must be one of {sorted(_REDUCERS)}")
+    return _send_ue_recv_impl(x, y, src_index, dst_index,
+                              message_op=message_op,
+                              pool_type=reduce_op,
+                              out_size=_out_size(dst_index, x, out_size))

@@ -6,3 +6,6 @@ sparsity, distributed models). Populated incrementally; see submodules.
 from . import asp  # noqa: F401
 
 __all__ = ["asp"]
+from . import autograd  # noqa: F401,E402
+
+__all__.append("autograd")

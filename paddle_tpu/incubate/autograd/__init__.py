@@ -1,0 +1,8 @@
+"""Functional autodiff prims (≈ python/paddle/incubate/autograd/:
+primapi.py forward_grad/grad:22,105, functional.py jvp/vjp/Jacobian/
+Hessian). The reference built a nascent JAX-like jvp/transpose system
+on static graph ops (primops.py/primrules.py); here the real jax
+transforms are the engine and the API mirrors the reference surface
+over the Tensor facade."""
+from .functional import (Hessian, Jacobian, forward_grad,  # noqa: F401
+                         grad, jvp, vjp)

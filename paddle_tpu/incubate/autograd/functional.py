@@ -1,0 +1,192 @@
+"""jvp/vjp/Jacobian/Hessian over the Tensor facade.
+
+Reference: python/paddle/incubate/autograd/functional.py (jvp:1,
+vjp:1, Jacobian, Hessian) and primapi.py (forward_grad:22, grad:105).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "grad", "forward_grad", "Jacobian", "Hessian"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(e) for e in x)
+    return x
+
+
+def _wrap(x):
+    if isinstance(x, jax.Array):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(e) for e in x)
+    return x
+
+
+def _as_tuple(x) -> Tuple:
+    return tuple(x) if isinstance(x, (list, tuple)) else (x,)
+
+
+def _raw_fn(func: Callable, n_args: int) -> Callable:
+    """Lift a Tensor-facade function to raw-array in/out."""
+
+    def raw(*arrays):
+        outs = func(*[Tensor(a) for a in arrays])
+        return _unwrap(outs)
+
+    return raw
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v). `v` defaults to ones
+    (reference functional.jvp semantics)."""
+    xs_t = _as_tuple(xs)
+    raw_xs = tuple(_unwrap(x) for x in xs_t)
+    if v is None:
+        raw_v = tuple(jnp.ones_like(x) for x in raw_xs)
+    else:
+        raw_v = tuple(_unwrap(x) for x in _as_tuple(v))
+    out, tangent = jax.jvp(_raw_fn(func, len(raw_xs)), raw_xs, raw_v)
+    return _wrap(out), _wrap(tangent)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), v^T @ J). `v` defaults to ones."""
+    xs_t = _as_tuple(xs)
+    raw_xs = tuple(_unwrap(x) for x in xs_t)
+    out, pullback = jax.vjp(_raw_fn(func, len(raw_xs)), *raw_xs)
+    if v is None:
+        raw_v = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_t = _as_tuple(v)
+        raw_v = _unwrap(v_t[0]) if len(v_t) == 1 and not \
+            isinstance(out, tuple) else tuple(_unwrap(e) for e in v_t)
+    grads = pullback(raw_v)
+    grads = grads[0] if len(xs_t) == 1 else grads
+    return _wrap(out), _wrap(grads)
+
+
+def grad(func: Callable, xs, v=None):
+    """primapi.grad analog: reverse-mode gradient of (a scalar or
+    v-weighted) output wrt xs."""
+    _, g = vjp(func, xs, v)
+    return g
+
+
+def forward_grad(func: Callable, xs, xs_dot=None):
+    """primapi.forward_grad analog: forward-mode directional grad."""
+    _, t = jvp(func, xs, xs_dot)
+    return t
+
+
+class Jacobian:
+    """Lazy full Jacobian (reference functional.Jacobian — row/col
+    indexable). Computed once via jacrev on first access.
+
+    Multi-input: pass a tuple; func is called as func(*xs) and the
+    per-input Jacobians are flattened and concatenated along the input
+    axis, reference-style ([M, N_total]). Batched mode expects 2-D
+    [B, N] inputs and returns the per-sample [B, out..., N] diagonal.
+    """
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs
+        self._batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        multi = isinstance(self._xs, (list, tuple))
+        raw_xs = tuple(_unwrap(x) for x in _as_tuple(self._xs))
+        raw_f = _raw_fn(self._func, len(raw_xs))
+        if self._batched:
+            if multi:
+                raise NotImplementedError(
+                    "batched Jacobian supports a single input")
+            raw_x = raw_xs[0]
+            if raw_x.ndim != 2:
+                raise NotImplementedError(
+                    "batched Jacobian expects [batch, features] input, "
+                    f"got shape {raw_x.shape}")
+            jac = jax.jacrev(raw_f)(raw_x)  # [B, out..., B, N]
+            idx = jnp.arange(raw_x.shape[0])
+            self._mat = jac[idx, ..., idx, :]  # per-sample diagonal
+            return self._mat
+        jacs = jax.jacrev(raw_f, argnums=tuple(range(len(raw_xs))))(
+            *raw_xs)
+        if multi:
+            # flatten each [out..., in...] block to 2-D and concat the
+            # input axis (reference Jacobian matrix layout)
+            flat = []
+            for j, x in zip(jacs, raw_xs):
+                out_sz = int(jnp.size(j)) // max(int(jnp.size(x)), 1)
+                flat.append(jnp.reshape(j, (out_sz, int(jnp.size(x)))))
+            self._mat = jnp.concatenate(flat, axis=-1)
+        else:
+            self._mat = jacs[0]
+        return self._mat
+
+    def __getitem__(self, key):
+        return Tensor(self._compute()[key])
+
+    @property
+    def shape(self):
+        return tuple(self._compute().shape)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._compute())
+
+
+class Hessian:
+    """Lazy Hessian of a scalar-output function (reference
+    functional.Hessian). Batched mode expects [B, N] input, a
+    per-sample scalar output, and returns the [B, N, N] per-sample
+    blocks."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs
+        self._batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        raw_x = _unwrap(self._xs)
+
+        def scalar(x):
+            out = _unwrap(self._func(Tensor(x)))
+            return jnp.sum(out)  # batched: sum of per-sample scalars
+
+        full = jax.hessian(scalar)(raw_x)
+        if self._batched:
+            if raw_x.ndim != 2:
+                raise NotImplementedError(
+                    "batched Hessian expects [batch, features] input, "
+                    f"got shape {raw_x.shape}")
+            idx = jnp.arange(raw_x.shape[0])
+            full = full[idx, :, idx, :]  # [B, N, N] per-sample blocks
+        self._mat = full
+        return self._mat
+
+    def __getitem__(self, key):
+        return Tensor(self._compute()[key])
+
+    @property
+    def shape(self):
+        return tuple(self._compute().shape)
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._compute())

@@ -1,0 +1,183 @@
+"""incubate.autograd functional prims + geometric ops tests
+(reference: python/paddle/fluid/tests/unittests/autograd/ and
+test_segment_ops.py / test_graph_send_recv_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric
+from paddle_tpu.incubate import autograd as iag
+
+
+# --------------------------------------------------------------- autograd
+def test_jvp_matches_directional_derivative():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    v = paddle.to_tensor(np.array([1.0, 0.0, 0.0], np.float32))
+    out, tangent = iag.jvp(f, x, v)
+    np.testing.assert_allclose(float(out), 14.0)
+    np.testing.assert_allclose(float(tangent), 2.0)  # d/dx0 = 2*x0*v0
+
+
+def test_vjp_and_grad():
+    def f(x):
+        return (x ** 3).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    out, g = iag.vjp(f, x)
+    np.testing.assert_allclose(float(out), 9.0)
+    np.testing.assert_allclose(g.numpy(), [3.0, 12.0])
+    g2 = iag.grad(f, x)
+    np.testing.assert_allclose(g2.numpy(), [3.0, 12.0])
+
+
+def test_forward_grad_default_tangent():
+    def f(x):
+        return 2.0 * x
+
+    x = paddle.to_tensor(np.array([1.0, 5.0], np.float32))
+    t = iag.forward_grad(f, x)
+    np.testing.assert_allclose(t.numpy(), [2.0, 2.0])
+
+
+def test_multi_input_vjp():
+    def f(x, y):
+        return (x * y).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    _, (gx, gy) = iag.vjp(f, (x, y))
+    np.testing.assert_allclose(gx.numpy(), [3.0, 4.0])
+    np.testing.assert_allclose(gy.numpy(), [1.0, 2.0])
+
+
+def test_jacobian_and_hessian():
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    jac = iag.Jacobian(f, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([2.0, 4.0, 6.0]))
+    assert jac.shape == (3, 3)
+    np.testing.assert_allclose(jac[0].numpy(), [2.0, 0.0, 0.0])
+
+    def g(x):
+        return (x ** 3).sum()
+
+    hess = iag.Hessian(g, x)
+    np.testing.assert_allclose(hess.numpy(), np.diag([6.0, 12.0, 18.0]))
+
+
+def test_jacobian_multi_input():
+    def f(a, b):
+        return a * b
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    jac = iag.Jacobian(f, (x, y))
+    # [2 outputs, 4 inputs]: d(a*b)/da = diag(b), d(a*b)/db = diag(a)
+    expect = np.concatenate([np.diag([3.0, 4.0]), np.diag([1.0, 2.0])],
+                            axis=1)
+    np.testing.assert_allclose(jac.numpy(), expect)
+
+
+def test_jacobian_batched_diagonal():
+    def f(x):
+        return x * 2.0
+
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    jac = iag.Jacobian(f, x, is_batched=True)
+    assert jac.shape == (2, 3, 3)
+    np.testing.assert_allclose(jac.numpy(),
+                               np.tile(2 * np.eye(3), (2, 1, 1)))
+    with pytest.raises(NotImplementedError):
+        iag.Jacobian(f, paddle.randn([2, 3, 4]),
+                     is_batched=True).numpy()
+
+
+def test_hessian_batched():
+    def f(x):
+        return (x ** 2).sum(-1)  # per-sample scalar
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3)
+                         .astype(np.float32))
+    hess = iag.Hessian(f, x, is_batched=True)
+    assert hess.shape == (2, 3, 3)
+    np.testing.assert_allclose(hess.numpy(),
+                               np.tile(2 * np.eye(3), (2, 1, 1)),
+                               atol=1e-5)
+
+
+def test_segment_max_int_dtype_empty_fill():
+    data = paddle.to_tensor(np.array([[1], [2]], np.int32))
+    ids = paddle.to_tensor(np.array([0, 2], np.int32))
+    out = geometric.segment_max(data, ids, num_segments=3)
+    assert out.numpy().dtype == np.int32
+    np.testing.assert_array_equal(out.numpy(), [[1], [0], [2]])
+
+
+# -------------------------------------------------------------- geometric
+def test_segment_ops():
+    data = paddle.to_tensor(
+        np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+    np.testing.assert_allclose(
+        geometric.segment_sum(data, ids).numpy(), [[4, 6], [12, 14]])
+    np.testing.assert_allclose(
+        geometric.segment_mean(data, ids).numpy(), [[2, 3], [6, 7]])
+    np.testing.assert_allclose(
+        geometric.segment_max(data, ids).numpy(), [[3, 4], [7, 8]])
+    np.testing.assert_allclose(
+        geometric.segment_min(data, ids).numpy(), [[1, 2], [5, 6]])
+
+
+def test_segment_empty_segment_fills_zero():
+    data = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 2], np.int32))
+    out = geometric.segment_max(data, ids, num_segments=3).numpy()
+    np.testing.assert_allclose(out, [[1.0], [0.0], [2.0]])
+
+
+def test_send_u_recv():
+    x = paddle.to_tensor(
+        np.array([[0.0, 1], [2, 3], [4, 5]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+    # node1 <- x0 + x2 ; node2 <- x1 ; node0 <- x0
+    np.testing.assert_allclose(out, [[0, 1], [4, 6], [2, 3]])
+    out_max = geometric.send_u_recv(x, src, dst,
+                                    reduce_op="max").numpy()
+    np.testing.assert_allclose(out_max, [[0, 1], [4, 5], [2, 3]])
+
+
+def test_send_ue_recv():
+    x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    e = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 0], np.int32))
+    out = geometric.send_ue_recv(x, e, src, dst, message_op="add",
+                                 reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[22.0], [11.0]])
+    out_mul = geometric.send_ue_recv(x, e, src, dst, message_op="mul",
+                                     reduce_op="sum").numpy()
+    np.testing.assert_allclose(out_mul, [[40.0], [10.0]])
+
+
+def test_send_u_recv_grad_flows():
+    x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    x.stop_gradient = False
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 1], np.int32))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0], [1.0], [0.0]])
+
+
+def test_bad_reduce_op():
+    x = paddle.to_tensor(np.zeros((2, 1), np.float32))
+    idx = paddle.to_tensor(np.array([0, 1], np.int32))
+    with pytest.raises(ValueError):
+        geometric.send_u_recv(x, idx, idx, reduce_op="prod")
