@@ -62,7 +62,7 @@ from .jit.api import grad, value_and_grad  # noqa: F401,E402
 # `paddle.distributed`-style access is heavy: import lazily ---------------
 _LAZY = {"distributed", "distribution", "geometric", "models", "vision",
          "kernels", "hapi", "profiler", "incubate", "inference",
-         "quantization", "sparse", "static"}
+         "quantization", "sparse", "static", "utils"}
 
 
 def __getattr__(name):

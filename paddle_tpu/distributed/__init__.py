@@ -23,6 +23,7 @@ from .parallel.sharding import (ShardingStrategy,  # noqa: F401
 from .topology import (HybridCommunicateGroup, create_mesh,  # noqa: F401
                        get_hybrid_communicate_group, get_mesh,
                        set_hybrid_communicate_group)
+from . import auto_checkpoint  # noqa: F401
 from . import elastic  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401

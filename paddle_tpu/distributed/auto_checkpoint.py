@@ -1,0 +1,87 @@
+"""Auto checkpoint — transparent epoch-granular train-loop resume.
+
+Reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:72
+(train_epoch_range generator + AutoCheckpointChecker env config,
+checkpoint_saver.py) — used with elastic so a preempted/restarted job
+resumes at the last completed epoch. Env contract kept:
+PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT enables it,
+PADDLE_JOB_ID keys the checkpoint, PADDLE_EDL_HDFS_CHECKPOINT_PATH
+names the directory (any filesystem path here).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, Optional
+
+from .checkpoint import CheckpointManager
+
+__all__ = ["train_epoch_range", "ExeTrainStatus", "AutoCheckpointChecker"]
+
+
+class AutoCheckpointChecker:
+    def __init__(self):
+        self.running_env = os.environ.get("PADDLE_RUNNING_ENV", "")
+        self.job_id = os.environ.get("PADDLE_JOB_ID", "default")
+        self.ckpt_path = os.environ.get(
+            "PADDLE_EDL_HDFS_CHECKPOINT_PATH",
+            os.environ.get("PADDLE_AUTO_CHECKPOINT_PATH", ""))
+        self.save_interval = int(os.environ.get(
+            "PADDLE_EDL_SAVE_CHECKPOINT_INTER", "1"))
+
+    def get_job_checkpoint_path(self) -> str:
+        return os.path.join(self.ckpt_path, f"job_{self.job_id}")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.ckpt_path) and \
+            self.running_env == "PADDLE_EDL_AUTO_CHECKPOINT"
+
+
+class ExeTrainStatus:
+    """Mutable holder the loop body can stash model/opt state into;
+    whatever is in `.state` is what gets checkpointed each epoch."""
+
+    def __init__(self):
+        self.state: Dict[str, Any] = {}
+
+    def update(self, **kwargs):
+        self.state.update(kwargs)
+
+
+def train_epoch_range(max_epoch_num: int,
+                      save_checkpoint_inter: Optional[int] = None,
+                      checker: Optional[AutoCheckpointChecker] = None,
+                      status: Optional[ExeTrainStatus] = None
+                      ) -> Iterator[int]:
+    """for epoch in train_epoch_range(N): ... — on restart, already
+    completed epochs are skipped and `status.state` is restored from
+    the last epoch checkpoint before the first yielded epoch."""
+    checker = checker or AutoCheckpointChecker()
+    if not checker.enabled:
+        yield from range(max_epoch_num)
+        return
+
+    interval = save_checkpoint_inter if save_checkpoint_inter is not None \
+        else checker.save_interval
+    status = status or ExeTrainStatus()
+    mgr = CheckpointManager(checker.get_job_checkpoint_path(),
+                            max_to_keep=2, async_save=False,
+                            save_interval_steps=1)
+    try:
+        last = mgr.latest_step()
+        start = 0
+        if last is not None:
+            restored = mgr.restore(step=last)
+            if restored is not None:
+                status.state = restored.get("user_state", {})
+            start = int(last) + 1
+        for epoch in range(start, max_epoch_num):
+            yield epoch
+            # epoch completed -> snapshot
+            if (epoch + 1) % max(interval, 1) == 0 or \
+                    epoch == max_epoch_num - 1:
+                mgr.save(epoch, {"user_state": status.state,
+                                 "epoch": epoch})
+        mgr.wait()
+    finally:
+        mgr.close()

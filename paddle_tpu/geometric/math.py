@@ -42,8 +42,11 @@ def _segment_sum_impl(data, segment_ids, num_segments):
 def _segment_mean_impl(data, segment_ids, num_segments):
     ids = segment_ids.astype(jnp.int32)
     total = jax.ops.segment_sum(data, ids, num_segments=num_segments)
-    count = jax.ops.segment_sum(jnp.ones_like(data), ids,
-                                num_segments=num_segments)
+    # counts over a 1-D ones vector, not a full ones_like(data) scatter
+    count = jax.ops.segment_sum(
+        jnp.ones(ids.shape[0], dtype=data.dtype), ids,
+        num_segments=num_segments)
+    count = count.reshape((num_segments,) + (1,) * (data.ndim - 1))
     return total / jnp.maximum(count, 1)
 
 

@@ -42,7 +42,7 @@ def _send_ue_recv_impl(x, e, src, dst, message_op, pool_type, out_size):
     return _segment_reduce(msgs, dst, pool_type, out_size)
 
 
-def _out_size(dst, x, out_size):
+def _out_size(x, out_size):
     if out_size is not None:
         return int(out_size)
     # default: number of nodes in x (reference uses max(dst)+1 or x rows)
@@ -57,7 +57,7 @@ def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
             f"reduce_op must be one of {sorted(_REDUCERS)}")
     return _send_u_recv_impl(x, src_index, dst_index,
                              pool_type=reduce_op,
-                             out_size=_out_size(dst_index, x, out_size))
+                             out_size=_out_size(x, out_size))
 
 
 def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
@@ -70,4 +70,4 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
     return _send_ue_recv_impl(x, y, src_index, dst_index,
                               message_op=message_op,
                               pool_type=reduce_op,
-                              out_size=_out_size(dst_index, x, out_size))
+                              out_size=_out_size(x, out_size))

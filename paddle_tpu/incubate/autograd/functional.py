@@ -35,7 +35,7 @@ def _as_tuple(x) -> Tuple:
     return tuple(x) if isinstance(x, (list, tuple)) else (x,)
 
 
-def _raw_fn(func: Callable, n_args: int) -> Callable:
+def _raw_fn(func: Callable) -> Callable:
     """Lift a Tensor-facade function to raw-array in/out."""
 
     def raw(*arrays):
@@ -54,7 +54,7 @@ def jvp(func: Callable, xs, v=None):
         raw_v = tuple(jnp.ones_like(x) for x in raw_xs)
     else:
         raw_v = tuple(_unwrap(x) for x in _as_tuple(v))
-    out, tangent = jax.jvp(_raw_fn(func, len(raw_xs)), raw_xs, raw_v)
+    out, tangent = jax.jvp(_raw_fn(func), raw_xs, raw_v)
     return _wrap(out), _wrap(tangent)
 
 
@@ -62,7 +62,7 @@ def vjp(func: Callable, xs, v=None):
     """Reverse-mode: returns (func(xs), v^T @ J). `v` defaults to ones."""
     xs_t = _as_tuple(xs)
     raw_xs = tuple(_unwrap(x) for x in xs_t)
-    out, pullback = jax.vjp(_raw_fn(func, len(raw_xs)), *raw_xs)
+    out, pullback = jax.vjp(_raw_fn(func), *raw_xs)
     if v is None:
         raw_v = jax.tree_util.tree_map(jnp.ones_like, out)
     else:
@@ -108,7 +108,7 @@ class Jacobian:
             return self._mat
         multi = isinstance(self._xs, (list, tuple))
         raw_xs = tuple(_unwrap(x) for x in _as_tuple(self._xs))
-        raw_f = _raw_fn(self._func, len(raw_xs))
+        raw_f = _raw_fn(self._func)
         if self._batched:
             if multi:
                 raise NotImplementedError(
@@ -124,16 +124,13 @@ class Jacobian:
             return self._mat
         jacs = jax.jacrev(raw_f, argnums=tuple(range(len(raw_xs))))(
             *raw_xs)
-        if multi:
-            # flatten each [out..., in...] block to 2-D and concat the
-            # input axis (reference Jacobian matrix layout)
-            flat = []
-            for j, x in zip(jacs, raw_xs):
-                out_sz = int(jnp.size(j)) // max(int(jnp.size(x)), 1)
-                flat.append(jnp.reshape(j, (out_sz, int(jnp.size(x)))))
-            self._mat = jnp.concatenate(flat, axis=-1)
-        else:
-            self._mat = jacs[0]
+        # reference matrix layout for bare AND tuple inputs alike:
+        # flatten each [out..., in...] block to 2-D, concat input axes
+        flat = []
+        for j, x in zip(jacs, raw_xs):
+            out_sz = int(jnp.size(j)) // max(int(jnp.size(x)), 1)
+            flat.append(jnp.reshape(j, (out_sz, int(jnp.size(x)))))
+        self._mat = jnp.concatenate(flat, axis=-1)
         return self._mat
 
     def __getitem__(self, key):
@@ -163,21 +160,54 @@ class Hessian:
     def _compute(self):
         if self._mat is not None:
             return self._mat
-        raw_x = _unwrap(self._xs)
+        multi = isinstance(self._xs, (list, tuple))
+        raw_xs = tuple(_unwrap(x) for x in _as_tuple(self._xs))
 
-        def scalar(x):
-            out = _unwrap(self._func(Tensor(x)))
-            return jnp.sum(out)  # batched: sum of per-sample scalars
-
-        full = jax.hessian(scalar)(raw_x)
         if self._batched:
+            if multi:
+                raise NotImplementedError(
+                    "batched Hessian supports a single input")
+            raw_x = raw_xs[0]
             if raw_x.ndim != 2:
                 raise NotImplementedError(
                     "batched Hessian expects [batch, features] input, "
                     f"got shape {raw_x.shape}")
+
+            def scalar(x):
+                out = _unwrap(self._func(Tensor(x)))
+                if int(jnp.size(out)) != raw_x.shape[0]:
+                    raise ValueError(
+                        "batched Hessian needs one scalar per sample; "
+                        f"func returned {jnp.shape(out)} for batch "
+                        f"{raw_x.shape[0]}")
+                return jnp.sum(out)  # cross-sample terms are zero
+
+            full = jax.hessian(scalar)(raw_x)
             idx = jnp.arange(raw_x.shape[0])
-            full = full[idx, :, idx, :]  # [B, N, N] per-sample blocks
-        self._mat = full
+            self._mat = full[idx, :, idx, :]  # [B, N, N] blocks
+            return self._mat
+
+        # non-batched: flatten-concat inputs -> reference [N, N] layout;
+        # func must return ONE scalar
+        sizes = [int(jnp.size(x)) for x in raw_xs]
+        shapes = [jnp.shape(x) for x in raw_xs]
+        offs = [0]
+        for s in sizes:
+            offs.append(offs[-1] + s)
+
+        def scalar(z):
+            parts = [jnp.reshape(z[offs[i]:offs[i + 1]], shapes[i])
+                     for i in range(len(raw_xs))]
+            out = _unwrap(self._func(*[Tensor(p) for p in parts]))
+            if int(jnp.size(out)) != 1:
+                raise ValueError(
+                    "Hessian requires a scalar-output function; got "
+                    f"output shape {jnp.shape(out)} (use is_batched "
+                    "for per-sample scalars)")
+            return jnp.reshape(out, ())
+
+        z0 = jnp.concatenate([jnp.ravel(x) for x in raw_xs])
+        self._mat = jax.hessian(scalar)(z0)
         return self._mat
 
     def __getitem__(self, key):

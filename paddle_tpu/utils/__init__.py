@@ -1,0 +1,3 @@
+"""paddle.utils analog: custom op registration + C++ extensions."""
+from . import cpp_extension  # noqa: F401
+from .custom_op import register_custom_op  # noqa: F401

@@ -1,0 +1,53 @@
+"""Python-level custom op registration.
+
+Reference analog: PD_BUILD_OP / OpMetaInfoBuilder
+(paddle/phi/api/lib/op_meta_info.cc, framework/custom_operator.cc) —
+user ops registered at runtime become first-class ops with autograd.
+TPU-native: the forward is pure jax; an optional backward becomes a
+jax.custom_vjp rule; registration lands in the same op registry as
+built-ins so the eager tape, jit traces, and the profiler see it like
+any other op.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from ..ops.op_registry import OPS, op
+
+__all__ = ["register_custom_op"]
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None,
+                       num_inputs: Optional[int] = None):
+    """Register `forward(*raw_arrays) -> raw_array(s)` as op `name`.
+
+    `backward(grads, *inputs) -> input_grads` (one per differentiable
+    input) installs a custom VJP; omit it to use jax autodiff through
+    the forward. Returns the Tensor-aware callable.
+    """
+    if name in OPS:
+        raise ValueError(f"op {name!r} is already registered")
+    if backward is not None:
+        fwd_core = jax.custom_vjp(forward)
+
+        def fwd_rule(*args):
+            return forward(*args), args
+
+        def bwd_rule(saved, g):
+            grads = backward(g, *saved)
+            if not isinstance(grads, (list, tuple)):
+                grads = (grads,)
+            if len(grads) != len(saved):
+                raise ValueError(
+                    f"custom op {name!r}: backward returned "
+                    f"{len(grads)} grads for {len(saved)} inputs")
+            return tuple(grads)
+
+        fwd_core.defvjp(fwd_rule, bwd_rule)
+        impl = fwd_core
+    else:
+        impl = forward
+    return op(name)(impl)

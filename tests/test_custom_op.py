@@ -1,0 +1,134 @@
+"""Custom op + cpp_extension tests (reference:
+python/paddle/fluid/tests/custom_op/ — custom_relu_op etc.)."""
+import os
+import shutil
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import register_custom_op
+from paddle_tpu.utils import cpp_extension
+
+
+# ------------------------------------------------------------- python ops
+def test_register_custom_op_forward_autodiff():
+    import jax.numpy as jnp
+
+    my_gelu = register_custom_op(
+        "test_my_gelu", lambda x: 0.5 * x * (1 + jnp.tanh(0.7978845608 *
+                                                          (x + 0.044715 * x ** 3))))
+    x = paddle.randn([4, 4])
+    x.stop_gradient = False
+    out = my_gelu(x)
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_register_custom_op_custom_backward():
+    import jax.numpy as jnp
+
+    def fwd(x):
+        return jnp.maximum(x, 0)
+
+    def bwd(g, x):
+        return (g * 3.0 * (x > 0),)  # deliberately x3 to prove it's used
+
+    my_relu = register_custom_op("test_my_relu3", fwd, backward=bwd)
+    x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+    x.stop_gradient = False
+    my_relu(x).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 0.0])
+
+
+def test_register_custom_op_rejects_duplicates():
+    register_custom_op("test_dup_op", lambda x: x)
+    with pytest.raises(ValueError, match="already registered"):
+        register_custom_op("test_dup_op", lambda x: x)
+
+
+# ---------------------------------------------------------- cpp extension
+GXX = shutil.which("g++") is not None
+
+
+@pytest.mark.skipif(not GXX, reason="no g++ in PATH")
+def test_cpp_extension_build_and_run(tmp_path):
+    src = tmp_path / "my_ops.cc"
+    src.write_text(textwrap.dedent("""
+        #include <cstdint>
+        extern "C" void scaled_add(const float** ins,
+                                   const int64_t* sizes, int n_ins,
+                                   float* out, int64_t out_size) {
+            // out = 2*a + b
+            for (int64_t i = 0; i < out_size; ++i)
+                out[i] = 2.0f * ins[0][i] + ins[1][i];
+        }
+        extern "C" void row_sums(const float** ins,
+                                 const int64_t* sizes, int n_ins,
+                                 float* out, int64_t out_size) {
+            int64_t cols = sizes[0] / out_size;
+            for (int64_t r = 0; r < out_size; ++r) {
+                float acc = 0.f;
+                for (int64_t c = 0; c < cols; ++c)
+                    acc += ins[0][r * cols + c];
+                out[r] = acc;
+            }
+        }
+    """))
+    mod = cpp_extension.load("myops", [str(src)])
+    scaled_add = mod.def_op("scaled_add")
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([10.0, 20.0], np.float32))
+    np.testing.assert_allclose(scaled_add(a, b).numpy(), [12.0, 24.0])
+
+    row_sums = mod.def_op("row_sums",
+                          out_shape=lambda s: (s[0],))
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(row_sums(m).numpy(), [3.0, 12.0])
+
+
+@pytest.mark.skipif(not GXX, reason="no g++ in PATH")
+def test_cpp_extension_works_under_jit(tmp_path):
+    src = tmp_path / "jit_ops.cc"
+    src.write_text(textwrap.dedent("""
+        #include <cstdint>
+        extern "C" void plus_one(const float** ins,
+                                 const int64_t* sizes, int n_ins,
+                                 float* out, int64_t out_size) {
+            for (int64_t i = 0; i < out_size; ++i)
+                out[i] = ins[0][i] + 1.0f;
+        }
+    """))
+    mod = cpp_extension.load("jitops", [str(src)])
+    plus_one = mod.def_op("plus_one")
+
+    import jax
+
+    @jax.jit
+    def f(x):
+        return plus_one.raw(x) * 2.0
+
+    out = f(np.array([1.0, 5.0], np.float32))
+    np.testing.assert_allclose(np.asarray(out), [4.0, 12.0])
+
+
+@pytest.mark.skipif(not GXX, reason="no g++ in PATH")
+def test_cpp_extension_build_cache(tmp_path):
+    src = tmp_path / "c.cc"
+    src.write_text("""#include <cstdint>
+extern "C" void noop(const float** ins, const int64_t* sizes,
+                     int n_ins, float* out, int64_t out_size) {}
+""")
+    so1 = cpp_extension._compile("cached", [str(src)])
+    mtime = os.path.getmtime(so1)
+    so2 = cpp_extension._compile("cached", [str(src)])
+    assert so1 == so2 and os.path.getmtime(so2) == mtime
+
+
+def test_cpp_extension_bad_source(tmp_path):
+    src = tmp_path / "bad.cc"
+    src.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="build failed"):
+        cpp_extension.load("bad", [str(src)])

@@ -97,6 +97,32 @@ def test_jacobian_batched_diagonal():
                      is_batched=True).numpy()
 
 
+def test_hessian_multi_input_and_scalar_check():
+    def f(a, b):
+        return (a * b).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    hess = iag.Hessian(f, (x, y))
+    # d2/dadb = I in the off-diagonal blocks
+    expect = np.block([[np.zeros((2, 2)), np.eye(2)],
+                       [np.eye(2), np.zeros((2, 2))]])
+    np.testing.assert_allclose(hess.numpy(), expect, atol=1e-6)
+    with pytest.raises(ValueError, match="scalar"):
+        iag.Hessian(lambda t: t * 2, x).numpy()  # vector output
+
+
+def test_jacobian_layout_consistent_bare_vs_tuple():
+    def f(a):
+        return a * a
+
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+    j1 = iag.Jacobian(f, x)
+    j2 = iag.Jacobian(f, (x,))
+    assert j1.shape == j2.shape == (4, 4)
+    np.testing.assert_allclose(j1.numpy(), j2.numpy())
+
+
 def test_hessian_batched():
     def f(x):
         return (x ** 2).sum(-1)  # per-sample scalar
