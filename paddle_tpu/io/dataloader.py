@@ -4,13 +4,16 @@ multi-process iterator fluid/dataloader/dataloader_iter.py:381).
 TPU-first shape: the loader produces HOST numpy batches and prefetches
 device transfers asynchronously (double buffering) so input pipeline
 overlaps with device compute — the role the reference's shared-memory
-worker queues + pin_memory play for GPUs. Worker parallelism uses a
-thread pool (numpy collation releases the GIL for the heavy copies);
-a multiprocessing mode can be added where transforms are Python-bound.
+worker queues + pin_memory play for GPUs. num_workers=0 prefetches on
+a thread (numpy collation releases the GIL); num_workers>0 fans sample
+loading + collation out to forked worker PROCESSES (the reference's
+_DataLoaderIterMultiProcess, dataloader_iter.py:381) for Python-bound
+transforms, with order-preserving handoff.
 """
 from __future__ import annotations
 
 import collections
+import multiprocessing as mp
 import queue
 import threading
 from typing import Callable, Optional
@@ -39,6 +42,32 @@ def default_collate_fn(batch):
     return batch
 
 
+# ---------------------------------------------------------------- workers
+# fork-context pool: workers inherit the dataset/collate via these
+# globals set in the initializer (same shared-state shape as the
+# reference's worker loop, minus the shared-memory tensor plumbing —
+# numpy batches pickle efficiently)
+_WORKER_STATE: dict = {}
+
+
+def _worker_init(dataset, collate_fn, user_init_fn, id_counter,
+                 num_workers):
+    _WORKER_STATE["ds"] = dataset
+    _WORKER_STATE["collate"] = collate_fn
+    with id_counter.get_lock():
+        # modulo: Pool respawns a crashed worker re-running this init;
+        # ids must stay in [0, num_workers)
+        worker_id = id_counter.value % num_workers
+        id_counter.value += 1
+    if user_init_fn is not None:
+        user_init_fn(worker_id)
+
+
+def _worker_fetch(indices):
+    ds = _WORKER_STATE["ds"]
+    return _WORKER_STATE["collate"]([ds[i] for i in indices])
+
+
 class _PrefetchIterator:
     def __init__(self, loader: "DataLoader"):
         self._loader = loader
@@ -48,6 +77,20 @@ class _PrefetchIterator:
             maxsize=max(2, loader.prefetch_factor))
         self._done = object()
         self._err = None
+        self._stopped = False
+        self._pool = None
+        if loader.num_workers > 0 and self._index_iter is not None:
+            # fork on the CONSUMER thread, before the producer thread
+            # exists and before this iterator touches the device —
+            # forking from a helper thread while JAX dispatch threads
+            # hold locks is how the classic post-fork deadlock happens
+            ctx = mp.get_context("fork")
+            counter = ctx.Value("i", 0)
+            self._pool = ctx.Pool(
+                loader.num_workers, initializer=_worker_init,
+                initargs=(loader.dataset, loader.collate_fn,
+                          loader.worker_init_fn, counter,
+                          loader.num_workers))
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
@@ -69,14 +112,57 @@ class _PrefetchIterator:
                 if batch and not self._loader.drop_last:
                     self._queue.put(self._to_device(
                         self._loader.collate_fn(batch)))
+            elif self._pool is not None:
+                # imap preserves batch order across workers
+                for batch in self._pool.imap(_worker_fetch,
+                                             self._index_iter):
+                    if not self._put(self._to_device(batch)):
+                        return  # consumer abandoned the iterator
             else:
                 for indices in self._index_iter:
-                    self._queue.put(self._to_device(
-                        self._fetch_batch(indices)))
+                    if not self._put(self._to_device(
+                            self._fetch_batch(indices))):
+                        return
         except Exception as e:  # surface in consumer thread
             self._err = e
         finally:
-            self._queue.put(self._done)
+            self._put(self._done)
+            self._shutdown_pool()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the consumer closed us, so an
+        abandoned iterator never leaves this thread (and the worker
+        pool) blocked forever."""
+        while not self._stopped:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _shutdown_pool(self):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.terminate()
+            pool.join()
+
+    def close(self):
+        """Stop the producer and reap worker processes."""
+        self._stopped = True
+        try:  # unblock a producer stuck in put()
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        self._shutdown_pool()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _to_device(self, batch):
         # async host->device: device_put returns immediately, transfer
@@ -118,6 +204,17 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = prefetch_factor
         self.keep_int64 = keep_int64
+        self.num_workers = int(num_workers)
+        self.worker_init_fn = worker_init_fn
+        if self.num_workers > 0 and isinstance(dataset, IterableDataset):
+            raise ValueError(
+                "num_workers > 0 requires a map-style Dataset "
+                "(IterableDataset iteration is inherently sequential)")
+        if self.num_workers > 0 and \
+                "fork" not in mp.get_all_start_methods():
+            raise ValueError(
+                "num_workers > 0 needs the 'fork' start method "
+                "(unavailable on this platform); use num_workers=0")
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
         elif batch_sampler is not None:
