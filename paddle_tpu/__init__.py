@@ -60,9 +60,10 @@ from .framework_io import load, save  # noqa: F401,E402
 from .jit.api import grad, value_and_grad  # noqa: F401,E402
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
-_LAZY = {"distributed", "distribution", "geometric", "models", "vision",
-         "kernels", "hapi", "profiler", "incubate", "inference",
-         "quantization", "sparse", "static", "utils"}
+_LAZY = {"distributed", "distribution", "fft", "geometric", "linalg",
+         "models", "vision", "kernels", "hapi", "onnx", "profiler",
+         "incubate", "inference", "quantization", "signal", "sparse",
+         "static", "text", "utils"}
 
 
 def __getattr__(name):

@@ -1,0 +1,63 @@
+"""paddle.fft analog (python/paddle/fft.py) — XLA lowers jnp.fft to
+the TPU FFT implementation."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.op_registry import op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn",
+           "irfftn", "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
+
+
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+fft = op("fft")(lambda x, n=None, axis=-1, norm="backward":
+                jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm)))
+ifft = op("ifft")(lambda x, n=None, axis=-1, norm="backward":
+                  jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm)))
+rfft = op("rfft")(lambda x, n=None, axis=-1, norm="backward":
+                  jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm)))
+irfft = op("irfft")(lambda x, n=None, axis=-1, norm="backward":
+                    jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm)))
+hfft = op("hfft")(lambda x, n=None, axis=-1, norm="backward":
+                  jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm)))
+ihfft = op("ihfft")(lambda x, n=None, axis=-1, norm="backward":
+                    jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm)))
+fft2 = op("fft2")(lambda x, s=None, axes=(-2, -1), norm="backward":
+                  jnp.fft.fft2(x, s=s, axes=axes, norm=_norm(norm)))
+ifft2 = op("ifft2")(lambda x, s=None, axes=(-2, -1), norm="backward":
+                    jnp.fft.ifft2(x, s=s, axes=axes, norm=_norm(norm)))
+rfft2 = op("rfft2")(lambda x, s=None, axes=(-2, -1), norm="backward":
+                    jnp.fft.rfft2(x, s=s, axes=axes, norm=_norm(norm)))
+irfft2 = op("irfft2")(lambda x, s=None, axes=(-2, -1), norm="backward":
+                      jnp.fft.irfft2(x, s=s, axes=axes,
+                                     norm=_norm(norm)))
+fftn = op("fftn")(lambda x, s=None, axes=None, norm="backward":
+                  jnp.fft.fftn(x, s=s, axes=axes, norm=_norm(norm)))
+ifftn = op("ifftn")(lambda x, s=None, axes=None, norm="backward":
+                    jnp.fft.ifftn(x, s=s, axes=axes, norm=_norm(norm)))
+rfftn = op("rfftn")(lambda x, s=None, axes=None, norm="backward":
+                    jnp.fft.rfftn(x, s=s, axes=axes, norm=_norm(norm)))
+irfftn = op("irfftn")(lambda x, s=None, axes=None, norm="backward":
+                      jnp.fft.irfftn(x, s=s, axes=axes,
+                                     norm=_norm(norm)))
+fftshift = op("fftshift")(lambda x, axes=None:
+                          jnp.fft.fftshift(x, axes=axes))
+ifftshift = op("ifftshift")(lambda x, axes=None:
+                            jnp.fft.ifftshift(x, axes=axes))
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import Tensor
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    from .core.tensor import Tensor
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return Tensor(out.astype(dtype) if dtype else out)

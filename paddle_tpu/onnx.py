@@ -1,0 +1,32 @@
+"""paddle.onnx analog (python/paddle/onnx/export.py wraps paddle2onnx).
+
+TPU-native: the portable serving artifact is serialized StableHLO
+(`jax.export`), not ONNX — XLA consumes it directly and it
+round-trips through paddle_tpu.inference.Predictor. export() therefore
+produces a `{path}.stablehlo` bundle with the same call signature as
+the reference's paddle.onnx.export; true ONNX emission would need the
+(unavailable offline) onnx/paddle2onnx packages and is stubbed with a
+clear error.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: Optional[int] = None, **configs):
+    """Export `layer` as a serving artifact at `path` (StableHLO).
+
+    Mirrors paddle.onnx.export(layer, path, input_spec); the result
+    loads with paddle_tpu.jit.load / inference.Config(path).
+    """
+    if configs.pop("format", "stablehlo") == "onnx":
+        raise RuntimeError(
+            "true ONNX emission requires the onnx/paddle2onnx packages, "
+            "which are unavailable in this environment; the default "
+            "StableHLO artifact serves the same deployment role on TPU")
+    from .jit.save_load import save
+    save(layer, path, input_spec=input_spec)
+    return path + ".stablehlo"

@@ -145,3 +145,20 @@ histogram = op("histogram", differentiable=False)(
 bincount = op("bincount", differentiable=False)(
     lambda x, weights=None, minlength=0:
     jnp.bincount(x, weights=weights, minlength=minlength))
+
+
+multi_dot = op("multi_dot")(lambda xs: jnp.linalg.multi_dot(xs))
+
+
+@op("lu")
+def lu(x, pivot=True):
+    """LU factorization -> (packed LU, pivots) like paddle.linalg.lu:
+    pivots are 1-based (LAPACK convention, matching the reference's
+    lu kernel); pivot=False is not supported on this backend."""
+    import jax.scipy.linalg as jsl  # deferred: pulls in lax_linalg
+    if not pivot:
+        raise NotImplementedError(
+            "lu(pivot=False) is unsupported: XLA's LU always performs "
+            "partial pivoting")
+    lu_mat, piv = jsl.lu_factor(x)
+    return lu_mat, piv + 1

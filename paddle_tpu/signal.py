@@ -1,0 +1,115 @@
+"""paddle.signal analog (python/paddle/signal.py: stft/istft over the
+frame/overlap_add ops)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops.op_registry import op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+@op("frame")
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames along `axis` (paddle.signal.frame)."""
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("frame supports the last axis only")
+    n = x.shape[-1]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length {frame_length} > signal length {n}")
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    frames = x[..., idx]  # [..., num_frames, frame_length]
+    # paddle layout: [..., frame_length, num_frames]
+    return jnp.swapaxes(frames, -1, -2)
+
+
+@op("overlap_add")
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame: [..., frame_length, num_frames] -> signal.
+    ONE scatter-add over the frame index grid (duplicate indices
+    accumulate), not a per-frame python loop."""
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("overlap_add supports the last axis")
+    fl = x.shape[-2]
+    num = x.shape[-1]
+    n = fl + hop_length * (num - 1)
+    idx = (jnp.arange(num) * hop_length)[:, None] + \
+        jnp.arange(fl)[None, :]  # [num, fl]
+    frames = jnp.swapaxes(x, -1, -2)  # [..., num, fl]
+    out = jnp.zeros(x.shape[:-2] + (n,), dtype=x.dtype)
+    return out.at[..., idx].add(frames)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True):
+    """Short-time Fourier transform (paddle.signal.stft semantics:
+    returns [..., n_fft//2+1 (or n_fft), num_frames] complex)."""
+    raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is not None:
+        win = window._data if isinstance(window, Tensor) \
+            else jnp.asarray(window)
+    else:
+        win = jnp.ones((wl,), raw.dtype)
+    if wl < n_fft:  # center-pad window to n_fft
+        pad = n_fft - wl
+        win = jnp.pad(win, (pad // 2, pad - pad // 2))
+    if center:
+        raw = jnp.pad(raw, [(0, 0)] * (raw.ndim - 1) +
+                      [(n_fft // 2, n_fft // 2)], mode=pad_mode)
+    frames = frame.raw(raw, n_fft, hop)  # [..., n_fft, num_frames]
+    frames = frames * win[..., :, None]
+    spec = jnp.fft.rfft(frames, axis=-2) if onesided else \
+        jnp.fft.fft(frames, axis=-2)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, raw.dtype))
+    return Tensor(spec)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: Optional[int] = None,
+          return_complex: bool = False):
+    raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is not None:
+        win = window._data if isinstance(window, Tensor) \
+            else jnp.asarray(window)
+    else:
+        win = jnp.ones((wl,), jnp.float32)
+    if wl < n_fft:
+        pad = n_fft - wl
+        win = jnp.pad(win, (pad // 2, pad - pad // 2))
+    if normalized:
+        raw = raw * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        if return_complex:
+            raise ValueError(
+                "return_complex=True requires onesided=False")
+        frames = jnp.fft.irfft(raw, n=n_fft, axis=-2)
+    else:
+        frames = jnp.fft.ifft(raw, axis=-2)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * win[..., :, None]
+    sig = overlap_add.raw(frames, hop)
+    # window envelope normalization (COLA correction)
+    env = overlap_add.raw(
+        jnp.broadcast_to((win ** 2)[:, None], frames.shape[-2:]), hop)
+    sig = sig / jnp.maximum(env, 1e-10)
+    if center:
+        sig = sig[..., n_fft // 2:sig.shape[-1] - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return Tensor(sig)

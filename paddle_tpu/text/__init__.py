@@ -1,0 +1,4 @@
+"""paddle.text analog (python/paddle/text/) — NLP datasets +
+viterbi_decode/ViterbiDecoder."""
+from . import datasets  # noqa: F401
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401

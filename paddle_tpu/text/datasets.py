@@ -1,0 +1,133 @@
+"""paddle.text.datasets analog (python/paddle/text/datasets/ — Imdb,
+UCIHousing, Conll05st, ...). Zero-egress environment: datasets read
+standard local files; download=True raises with instructions."""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "FakeTextClassification"]
+
+
+def _no_download(name: str):
+    raise RuntimeError(
+        f"{name}: download is unavailable in this environment; place "
+        f"the standard files locally and pass data_file/data_dir")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment from the standard aclImdb tar(.gz) archive or an
+    extracted directory (pos/ and neg/ subdirs of train|test).
+    `cutoff` is a MINIMUM WORD FREQUENCY — words appearing more than
+    `cutoff` times enter the vocabulary (reference
+    python/paddle/text/datasets/imdb.py semantics)."""
+
+    def __init__(self, data_dir: Optional[str] = None,
+                 mode: str = "train", cutoff: int = 150,
+                 download: bool = False):
+        if data_dir is None:
+            _no_download(type(self).__name__)
+        texts, labels = self._read_texts(data_dir, mode)
+        self.docs: List[List[int]] = []
+        self.labels: List[int] = []
+        freq: dict = {}
+        tokenized = [re.findall(r"[a-z']+", t) for t in texts]
+        for toks in tokenized:
+            for w in toks:
+                freq[w] = freq.get(w, 0) + 1
+        # frequency threshold, most-frequent-first ids (reference order)
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = len(self.word_idx)
+        self.word_idx["<unk>"] = unk
+        for toks, label in zip(tokenized, labels):
+            self.docs.append([self.word_idx.get(w, unk) for w in toks])
+            self.labels.append(label)
+
+    @staticmethod
+    def _read_texts(data_dir: str, mode: str):
+        texts, labels = [], []
+        if os.path.isfile(data_dir):  # tar / tar.gz archive
+            pat = re.compile(
+                rf".*/{mode}/(pos|neg)/.*\.txt$")
+            with tarfile.open(data_dir) as tf:
+                for m in sorted(tf.getmembers(), key=lambda m: m.name):
+                    g = pat.match(m.name)
+                    if not g:
+                        continue
+                    texts.append(
+                        tf.extractfile(m).read().decode("utf-8").lower())
+                    labels.append(1 if g.group(1) == "pos" else 0)
+            if not texts:
+                raise FileNotFoundError(
+                    f"no {mode}/pos|neg/*.txt members in {data_dir}")
+            return texts, labels
+        split_dir = os.path.join(data_dir, mode)
+        if not os.path.isdir(split_dir):
+            raise FileNotFoundError(f"{split_dir} not found")
+        for label, sub in ((1, "pos"), (0, "neg")):
+            d = os.path.join(split_dir, sub)
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), encoding="utf-8") as f:
+                    texts.append(f.read().lower())
+                labels.append(label)
+        return texts, labels
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return np.asarray(self.docs[idx], dtype=np.int64), \
+            int(self.labels[idx])
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression from the standard housing.data file
+    (14 whitespace-separated columns)."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 mode: str = "train", download: bool = False):
+        if data_file is None:
+            _no_download(type(self).__name__)
+        raw = np.loadtxt(data_file).astype(np.float32)
+        # reference normalizes features then splits 80/20
+        feats = raw[:, :-1]
+        feats = (feats - feats.mean(0)) / np.maximum(feats.std(0), 1e-6)
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+
+class FakeTextClassification(Dataset):
+    """Synthetic token-sequence classification set for pipeline tests."""
+
+    def __init__(self, size: int = 256, seq_len: int = 32,
+                 vocab_size: int = 1000, num_classes: int = 2,
+                 seed: int = 0):
+        self.size, self.seq_len = size, seq_len
+        self.vocab_size, self.num_classes = vocab_size, num_classes
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed * 7919 + idx)
+        ids = rng.randint(0, self.vocab_size,
+                          self.seq_len).astype(np.int64)
+        return ids, int(rng.randint(self.num_classes))

@@ -1,0 +1,87 @@
+"""Viterbi decoding (≈ python/paddle/text/viterbi_decode.py over
+phi/kernels/viterbi_decode_kernel.h) — the dynamic program is a
+lax.scan over time, so the whole decode compiles to one XLA loop."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.op_registry import op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+@op("viterbi_decode", differentiable=False)
+def _viterbi_impl(potentials, transition, lengths, include_bos_eos_tag):
+    """potentials [B, T, N], transition [N, N], lengths [B].
+    Returns (scores [B], paths [B, T]).
+
+    include_bos_eos_tag follows the reference convention
+    (python/paddle/text/viterbi_decode.py): the LAST tag is BOS/start
+    (its transition row scores BOS->tag) and the SECOND-TO-LAST tag is
+    EOS/stop (its transition column scores tag->EOS) — both are part
+    of the N tags."""
+    B, T, N = potentials.shape
+    if include_bos_eos_tag:
+        start = transition[-1, :]   # BOS row
+        stop = transition[:, -2]    # EOS column
+    else:
+        start = jnp.zeros((N,), potentials.dtype)
+        stop = jnp.zeros((N,), potentials.dtype)
+    trans = transition
+
+    alpha0 = potentials[:, 0] + start[None, :]
+
+    def step(alpha, t):
+        emit = potentials[:, t]  # [B, N]
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, from, to]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        alpha2 = jnp.max(scores, axis=1) + emit
+        # sequences shorter than t keep their old alpha
+        active = (t < lengths)[:, None]
+        alpha2 = jnp.where(active, alpha2, alpha)
+        return alpha2, best_prev
+
+    alpha_fin, backptrs = jax.lax.scan(
+        step, alpha0, jnp.arange(1, T))  # backptrs [T-1, B, N]
+
+    final = alpha_fin + stop[None, :]
+    last_tag = jnp.argmax(final, axis=-1)  # [B]
+    scores = jnp.max(final, axis=-1)
+
+    def backtrack(carry, bp_t):
+        # bp_t [B, N]; carry = (tag, t_index)
+        tag, t = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        # positions beyond a sequence's length keep the same tag
+        prev = jnp.where(t < lengths, prev, tag)
+        return (prev, t - 1), tag
+
+    (first_tag, _), rev_path = jax.lax.scan(
+        backtrack, (last_tag.astype(jnp.int32), jnp.int32(T - 1)),
+        backptrs, reverse=True)
+    # rev_path [T-1, B] are tags at positions 1..T-1
+    path = jnp.concatenate([first_tag[None, :], rev_path], axis=0)
+    return scores, jnp.swapaxes(path, 0, 1)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True):
+    pot = potentials._data if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    if lengths is None:
+        lengths = jnp.full((pot.shape[0],), pot.shape[1], jnp.int32)
+    return _viterbi_impl(potentials, transition_params, lengths,
+                         include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,284 @@
+"""RNN layers, fft/signal/linalg namespaces, text (viterbi, datasets),
+onnx export (reference: test_rnn_op.py / test_fft.py / test_stft_op.py
+/ test_viterbi_decode_op.py analogs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# --------------------------------------------------------------------- RNN
+def test_lstm_cell_step():
+    paddle.seed(0)
+    cell = nn.LSTMCell(4, 8)
+    x = paddle.randn([2, 4])
+    out, (h, c) = cell(x)
+    assert tuple(out.shape) == (2, 8)
+    assert tuple(h.shape) == (2, 8) and tuple(c.shape) == (2, 8)
+    np.testing.assert_allclose(out.numpy(), h.numpy())
+
+
+def test_gru_cell_matches_manual():
+    paddle.seed(1)
+    cell = nn.GRUCell(3, 5)
+    x = paddle.randn([2, 3])
+    h0 = paddle.zeros([2, 5])
+    out, h = cell(x, (h0,))
+    # manual recompute
+    W_ih = cell.weight_ih.numpy()
+    W_hh = cell.weight_hh.numpy()
+    b_ih = cell.bias_ih.numpy()
+    b_hh = cell.bias_hh.numpy()
+    xg = x.numpy() @ W_ih.T + b_ih
+    hg = np.zeros((2, 5 * 3)) + b_hh
+    xr, xz, xc = np.split(xg, 3, -1)
+    hr, hz, hc = np.split(hg, 3, -1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    r, z = sig(xr + hr), sig(xz + hz)
+    c = np.tanh(xc + r * hc)
+    expect = (1 - z) * c
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_sequence_shapes_and_state():
+    paddle.seed(0)
+    lstm = nn.LSTM(input_size=6, hidden_size=8, num_layers=2)
+    x = paddle.randn([3, 5, 6])  # [B, T, C]
+    out, (h, c) = lstm(x)
+    assert tuple(out.shape) == (3, 5, 8)
+    assert tuple(h.shape) == (2, 3, 8)  # [L*D, B, H]
+    assert tuple(c.shape) == (2, 3, 8)
+    # final h of last layer equals last output step
+    np.testing.assert_allclose(out.numpy()[:, -1], h.numpy()[1],
+                               rtol=1e-5)
+
+
+def test_bidirectional_gru():
+    paddle.seed(0)
+    gru = nn.GRU(input_size=4, hidden_size=6, direction="bidirect")
+    x = paddle.randn([2, 7, 4])
+    out, h = gru(x)
+    assert tuple(out.shape) == (2, 7, 12)
+    assert tuple(h.shape) == (2, 2, 6)
+
+
+def test_simple_rnn_time_major_and_initial_state():
+    paddle.seed(0)
+    rnn = nn.SimpleRNN(input_size=3, hidden_size=4, time_major=True)
+    x = paddle.randn([5, 2, 3])  # [T, B, C]
+    h0 = paddle.randn([1, 2, 4])
+    out, h = rnn(x, h0)
+    assert tuple(out.shape) == (5, 2, 4)
+    assert tuple(h.shape) == (1, 2, 4)
+
+
+def test_rnn_wrapper_and_grads():
+    paddle.seed(0)
+    rnn = nn.RNN(nn.LSTMCell(3, 4))
+    x = paddle.randn([2, 6, 3])
+    x.stop_gradient = False
+    out, _ = rnn(x)
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+    for p in rnn.parameters():
+        assert p.grad is not None
+
+
+def test_lstm_trains():
+    paddle.seed(0)
+    from paddle_tpu import optimizer
+    model = nn.Sequential(nn.LSTM(4, 8))
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lstm = nn.LSTM(4, 8)
+            self.fc = nn.Linear(8, 2)
+
+        def forward(self, x):
+            out, _ = self.lstm(x)
+            return self.fc(out[:, -1])
+
+    m = Head()
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+    ce = nn.CrossEntropyLoss()
+    x = paddle.randn([8, 5, 4])
+    y = paddle.to_tensor(np.random.RandomState(0).randint(0, 2, 8))
+    first = None
+    for _ in range(15):
+        loss = ce(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+
+
+# --------------------------------------------------------------- fft/signal
+def test_fft_roundtrip_and_rfft():
+    from paddle_tpu import fft
+    x = paddle.randn([4, 16])
+    X = fft.fft(x)
+    back = fft.ifft(X)
+    np.testing.assert_allclose(np.real(back.numpy()), x.numpy(),
+                               atol=1e-5)
+    R = fft.rfft(x)
+    assert tuple(R.shape) == (4, 9)
+    np.testing.assert_allclose(fft.irfft(R, n=16).numpy(), x.numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        fft.fftfreq(8, d=0.5).numpy(), np.fft.fftfreq(8, d=0.5))
+
+
+def test_fft2_matches_numpy():
+    from paddle_tpu import fft
+    x = paddle.randn([3, 8, 8])
+    np.testing.assert_allclose(fft.fft2(x).numpy(),
+                               np.fft.fft2(x.numpy()), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_stft_istft_roundtrip():
+    from paddle_tpu import signal
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 512).astype(np.float32))
+    win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+    spec = signal.stft(x, n_fft=128, hop_length=32, window=win)
+    assert tuple(spec.shape)[:2] == (2, 65)
+    back = signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                        length=512)
+    # interior reconstructs (edges lose energy to the window)
+    np.testing.assert_allclose(back.numpy()[:, 64:-64],
+                               x.numpy()[:, 64:-64], atol=1e-3)
+
+
+def test_frame_overlap_add_inverse():
+    from paddle_tpu import signal
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32))
+    f = signal.frame(x, frame_length=4, hop_length=4)
+    assert tuple(f.shape) == (4, 4)
+    back = signal.overlap_add(f, hop_length=4)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+
+
+# ------------------------------------------------------------------ linalg
+def test_linalg_namespace():
+    from paddle_tpu import linalg
+    a = paddle.to_tensor(np.array([[2.0, 0], [1, 3]], np.float32))
+    np.testing.assert_allclose(float(linalg.det(a)), 6.0, rtol=1e-5)
+    lu_mat, piv = linalg.lu(a)
+    assert lu_mat.numpy().shape == (2, 2)
+    md = linalg.multi_dot([a, a, a])
+    np.testing.assert_allclose(md.numpy(),
+                               a.numpy() @ a.numpy() @ a.numpy(),
+                               rtol=1e-5)
+
+
+# -------------------------------------------------------------------- text
+def test_viterbi_decode_against_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 4, 3
+    pots = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(pots), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+    # brute force
+    import itertools
+    for b in range(B):
+        best, best_path = -1e9, None
+        for path in itertools.product(range(N), repeat=T):
+            s = pots[b, 0, path[0]]
+            for t in range(1, T):
+                s += trans[path[t - 1], path[t]] + pots[b, t, path[t]]
+            if s > best:
+                best, best_path = s, path
+        np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                   rtol=1e-4)
+        np.testing.assert_array_equal(paths.numpy()[b], best_path)
+
+
+def test_viterbi_bos_eos_convention():
+    """include_bos_eos_tag=True: last tag = BOS row, second-to-last =
+    EOS column, both inside the [N, N] transition (reference layout)."""
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(1)
+    B, T, N = 1, 3, 4  # tags: 0, 1, EOS(2), BOS(3)
+    pots = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    scores, paths = viterbi_decode(paddle.to_tensor(pots),
+                                   paddle.to_tensor(trans),
+                                   include_bos_eos_tag=True)
+    import itertools
+    best, best_path = -1e9, None
+    for path in itertools.product(range(N), repeat=T):
+        s = trans[-1, path[0]] + pots[0, 0, path[0]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + pots[0, t, path[t]]
+        s += trans[path[-1], -2]
+        if s > best:
+            best, best_path = s, path
+    np.testing.assert_allclose(float(scores.numpy()[0]), best,
+                               rtol=1e-4)
+    np.testing.assert_array_equal(paths.numpy()[0], best_path)
+
+
+def test_imdb_tar_and_cutoff(tmp_path):
+    import io
+    import tarfile as tf
+    from paddle_tpu.text import datasets as TD
+    # tiny aclImdb-layout tar: "good" appears 3x, rest once
+    buf = {"aclImdb/train/pos/0.txt": b"good good movie",
+           "aclImdb/train/pos/1.txt": b"good fine",
+           "aclImdb/train/neg/0.txt": b"bad awful"}
+    tar_path = tmp_path / "aclImdb.tar.gz"
+    with tf.open(tar_path, "w:gz") as t:
+        for name, data in buf.items():
+            info = tf.TarInfo(name)
+            info.size = len(data)
+            t.addfile(info, io.BytesIO(data))
+    ds = TD.Imdb(data_dir=str(tar_path), mode="train", cutoff=2)
+    assert len(ds) == 3
+    # only "good" (freq 3 > 2) makes the vocab; everything else is unk
+    assert list(ds.word_idx) == ["good", "<unk>"]
+    ids, label = ds[0]
+    assert label in (0, 1)
+
+
+def test_text_datasets(tmp_path):
+    from paddle_tpu.text import datasets as TD
+    with pytest.raises(RuntimeError, match="download"):
+        TD.Imdb()
+    # UCIHousing from a local file
+    rng = np.random.RandomState(0)
+    data = rng.rand(50, 14).astype(np.float32)
+    np.savetxt(tmp_path / "housing.data", data)
+    tr = TD.UCIHousing(data_file=str(tmp_path / "housing.data"))
+    te = TD.UCIHousing(data_file=str(tmp_path / "housing.data"),
+                       mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    ds = TD.FakeTextClassification(size=8, seq_len=16)
+    ids, label = ds[3]
+    assert ids.shape == (16,) and 0 <= label < 2
+
+
+# -------------------------------------------------------------------- onnx
+def test_onnx_export_stablehlo(tmp_path):
+    from paddle_tpu import onnx as ponnx
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 2))
+    x = paddle.randn([1, 4])
+    out_path = ponnx.export(model, str(tmp_path / "m"), input_spec=[x])
+    assert out_path.endswith(".stablehlo")
+    loaded = paddle.jit.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                               rtol=1e-5)
+    with pytest.raises(RuntimeError, match="ONNX emission"):
+        ponnx.export(model, str(tmp_path / "m2"), input_spec=[x],
+                     format="onnx")
