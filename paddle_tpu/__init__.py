@@ -29,11 +29,16 @@ from .ops.creation import (arange, assign, bernoulli, diag,  # noqa: F401
 from .ops.linalg import (bmm, dot, einsum, matmul, mm, mv, t)  # noqa: F401
 from .ops.manipulation import (broadcast_to, chunk, concat, expand,  # noqa: F401
                                expand_as, flatten, flip, gather, gather_nd,
-                               index_select, masked_select, moveaxis,
-                               nonzero, numel, one_hot, reshape, roll,
+                               index_add, index_fill, index_select,
+                               masked_fill, masked_select, moveaxis,
+                               nonzero, numel, one_hot, put_along_axis,
+                               repeat_interleave, reshape, roll,
                                scatter, scatter_nd, scatter_nd_add, split,
-                               squeeze, stack, tile, topk, transpose, unbind,
-                               unique, unsqueeze, where)
+                               squeeze, stack, take_along_axis, tile,
+                               topk, transpose, unbind, unique, unsqueeze,
+                               where)
+from .ops.manipulation import bucketize, diff, searchsorted  # noqa: F401
+from .ops.math import diagonal, kron, lerp, trace  # noqa: F401
 from .ops.math import (abs, add, all, allclose, any, argmax,  # noqa: F401
                        argmin, cast, ceil, clip, cos, cumprod, cumsum,
                        divide, equal, equal_all, exp, floor, floor_divide,
@@ -58,6 +63,8 @@ from . import optimizer  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .framework_io import load, save  # noqa: F401,E402
 from .jit.api import grad, value_and_grad  # noqa: F401,E402
+from .nn.functional.common import (pixel_shuffle,  # noqa: F401,E402
+                                   pixel_unshuffle)
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
 _LAZY = {"distributed", "distribution", "fft", "geometric", "linalg",

@@ -116,6 +116,21 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
     return x.reshape(n, h * r, w * r, c // (r * r))
 
 
+@op("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    raise NotImplementedError("pixel_unshuffle supports NCHW")
+
+
+# single pad implementation lives in ops.manipulation
+from ...ops.manipulation import pad  # noqa: F401,E402
+
+
 @op("interpolate")
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, data_format="NCHW"):
@@ -155,3 +170,70 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
         padding="VALID", rhs_dilation=tuple(dl),
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return patches.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+
+@op("grid_sample")
+def _grid_sample_impl(x, grid, mode="bilinear", padding_mode="zeros",
+                      align_corners=True):
+    """x [N, C, H, W], grid [N, Hg, Wg, 2] in [-1, 1] (paddle
+    F.grid_sample semantics; phi/kernels/grid_sample_kernel.h)."""
+    n, c, h, w = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1) * (size - 1) / 2
+        return ((coord + 1) * size - 1) / 2
+
+    gx = unnormalize(grid[..., 0], w)  # [N, Hg, Wg]
+    gy = unnormalize(grid[..., 1], h)
+
+    def sample(ix, iy):
+        inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        if padding_mode == "border":
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+        elif padding_mode == "reflection":
+            def reflect(v, size):
+                if align_corners:
+                    span = 2 * (size - 1) if size > 1 else 1
+                    v = jnp.abs(v) % span
+                    return jnp.where(v >= size, span - v, v)
+                span = 2 * size
+                v = jnp.abs(v + 0.5) % span
+                return jnp.clip(
+                    jnp.where(v >= size, span - v, v) - 0.5, 0,
+                    size - 1)
+            ixc = reflect(ix, w).astype(ix.dtype)
+            iyc = reflect(iy, h).astype(iy.dtype)
+        else:  # zeros
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+        batch = jnp.arange(n)[:, None, None]
+        vals = x[batch, :, iyc.astype(jnp.int32),
+                 ixc.astype(jnp.int32)]  # [N, Hg, Wg, C]
+        if padding_mode == "zeros":
+            vals = jnp.where(inb[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = sample(jnp.round(gx), jnp.round(gy))
+    else:  # bilinear
+        x0, y0 = jnp.floor(gx), jnp.floor(gy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - gx) * (y1 - gy)
+        wb = (gx - x0) * (y1 - gy)
+        wc = (x1 - gx) * (gy - y0)
+        wd = (gx - x0) * (gy - y0)
+        out = (sample(x0, y0) * wa[..., None] +
+               sample(x1, y0) * wb[..., None] +
+               sample(x0, y1) * wc[..., None] +
+               sample(x1, y1) * wd[..., None])
+    return jnp.transpose(out, (0, 3, 1, 2))  # [N, C, Hg, Wg]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return _grid_sample_impl(x, grid, mode=mode,
+                             padding_mode=padding_mode,
+                             align_corners=align_corners)
+

@@ -161,7 +161,8 @@ take_along_axis = op("take_along_axis")(
 
 
 @op("put_along_axis")
-def put_along_axis(arr, indices, values, axis, reduce="assign"):
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
     values = jnp.broadcast_to(values, indices.shape) if jnp.ndim(values) else \
         jnp.full(indices.shape, values, arr.dtype)
     mode = {"assign": None, "add": "add", "mul": "multiply",
@@ -169,9 +170,13 @@ def put_along_axis(arr, indices, values, axis, reduce="assign"):
     if mode is None:
         return jnp.put_along_axis(arr, indices, values, axis=axis,
                                   inplace=False)
-    dnums = jnp.put_along_axis(arr, indices,
-                               jnp.take_along_axis(arr, indices, axis),
-                               axis=axis, inplace=False)
+    if not include_self:
+        # touched positions start from the reduce identity, not arr
+        touched = _scatter_add_along(
+            jnp.zeros(arr.shape, jnp.int32), indices,
+            jnp.ones(indices.shape, jnp.int32), axis) > 0
+        identity = 0.0 if mode == "add" else 1.0
+        arr = jnp.where(touched, jnp.asarray(identity, arr.dtype), arr)
     if mode == "add":
         upd = jnp.zeros_like(arr)
         upd = _scatter_add_along(upd, indices, values, axis)
@@ -311,10 +316,26 @@ argsort = op("argsort", differentiable=False)(
     lambda x, axis=-1, descending=False:
     (jnp.argsort(-x, axis=axis) if descending
      else jnp.argsort(x, axis=axis)).astype(jnp.int64))
-searchsorted = op("searchsorted", differentiable=False)(
-    lambda sorted_sequence, values, right=False:
-    jnp.searchsorted(sorted_sequence, values,
-                     side="right" if right else "left").astype(jnp.int64))
+@op("searchsorted", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        # paddle: innermost dims are independent sorted rows
+        flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+        flat_val = values.reshape(-1, values.shape[-1])
+        out = jax.vmap(
+            lambda s, v: jnp.searchsorted(s, v, side=side))(
+            flat_seq, flat_val).reshape(values.shape)
+    # jax indices are int32 natively (int64 needs x64 mode)
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+bucketize = op("bucketize", differentiable=False)(
+    lambda x, sorted_sequence, out_int32=False, right=False:
+    searchsorted.raw(sorted_sequence, x, out_int32=out_int32,
+                     right=right))
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
@@ -394,3 +415,24 @@ def setitem(x, idx, value):
     from ..core.tensor import dispatch
     nidx = _norm_index(idx)
     return dispatch("setitem", lambda a, v: a.at[nidx].set(v), (x, value), {})
+
+
+# ------------------------------------------------------- indexing extras
+@op("index_add")
+def index_add(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index.astype(jnp.int32)
+    return x.at[tuple(idx)].add(value)
+
+
+@op("index_fill")
+def index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index.astype(jnp.int32)
+    return x.at[tuple(idx)].set(jnp.asarray(value, x.dtype))
+
+
+diff = op("diff")(
+    lambda x, n=1, axis=-1, prepend=None, append=None:
+    jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append))
+

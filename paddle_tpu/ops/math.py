@@ -219,3 +219,4 @@ diagonal = op("diagonal")(
 addmm = op("addmm")(
     lambda input, x, y, beta=1.0, alpha=1.0:
     beta * input + alpha * jnp.matmul(x, y))
+

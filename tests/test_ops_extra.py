@@ -1,0 +1,124 @@
+"""Extra tensor ops + functional pad/grid_sample/pixel_shuffle
+(reference: test_take_along_axis_op.py, test_put_along_axis_op.py,
+test_index_add_op.py, test_searchsorted_op.py, test_pad3d_op.py,
+test_grid_sampler_op.py, test_pixel_shuffle.py analogs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_take_put_along_axis():
+    x = _t(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = _t(np.array([[0], [2], [1]], np.int32))
+    got = paddle.take_along_axis(x, idx, 1)
+    np.testing.assert_allclose(got.numpy().ravel(), [0, 6, 9])
+    put = paddle.put_along_axis(x, idx, 99.0, 1)
+    assert put.numpy()[0, 0] == 99 and put.numpy()[1, 2] == 99
+    add = paddle.put_along_axis(x, idx, 1.0, 1, reduce="add")
+    assert add.numpy()[0, 0] == 1.0 and add.numpy()[2, 1] == 10.0
+    # include_self=False: touched slots start from the reduce identity
+    ones = paddle.to_tensor(np.ones((3, 4), np.float32))
+    ex = paddle.put_along_axis(ones, idx, 5.0, 1, reduce="add",
+                               include_self=False)
+    assert ex.numpy()[0, 0] == 5.0 and ex.numpy()[0, 1] == 1.0
+
+
+def test_masked_fill_index_add_index_fill():
+    x = _t(np.zeros((2, 3), np.float32))
+    mask = _t(np.array([[1, 0, 0], [0, 0, 1]], bool))
+    np.testing.assert_allclose(
+        paddle.masked_fill(x, mask, 5.0).numpy(),
+        [[5, 0, 0], [0, 0, 5]])
+    idx = _t(np.array([0, 2], np.int32))
+    out = paddle.index_add(x, idx, 1, _t(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [[1, 0, 1], [1, 0, 1]])
+    out2 = paddle.index_fill(x, idx, 1, 7.0)
+    np.testing.assert_allclose(out2.numpy(), [[7, 0, 7], [7, 0, 7]])
+
+
+def test_repeat_interleave_kron_trace_diagonal_lerp_diff():
+    x = _t(np.array([[1.0, 2], [3, 4]], np.float32))
+    np.testing.assert_allclose(
+        paddle.repeat_interleave(x, 2, axis=0).numpy(),
+        np.repeat(x.numpy(), 2, axis=0))
+    np.testing.assert_allclose(paddle.kron(x, x).numpy(),
+                               np.kron(x.numpy(), x.numpy()))
+    assert float(paddle.trace(x)) == 5.0
+    np.testing.assert_allclose(paddle.diagonal(x).numpy(), [1, 4])
+    np.testing.assert_allclose(
+        paddle.lerp(_t(np.zeros(3, np.float32)),
+                    _t(np.ones(3, np.float32)), 0.25).numpy(), 0.25)
+    np.testing.assert_allclose(
+        paddle.diff(_t(np.array([1.0, 4, 9], np.float32))).numpy(),
+        [3, 5])
+
+
+def test_searchsorted_and_bucketize():
+    seq = _t(np.array([1.0, 3.0, 5.0, 7.0], np.float32))
+    vals = _t(np.array([0.0, 3.0, 8.0], np.float32))
+    np.testing.assert_array_equal(
+        paddle.searchsorted(seq, vals).numpy(), [0, 1, 4])
+    np.testing.assert_array_equal(
+        paddle.searchsorted(seq, vals, right=True).numpy(), [0, 2, 4])
+    np.testing.assert_array_equal(
+        paddle.bucketize(vals, seq).numpy(), [0, 1, 4])
+    # batched rows
+    seq2 = _t(np.array([[1.0, 2, 3], [10, 20, 30]], np.float32))
+    vals2 = _t(np.array([[1.5, 2.5], [15.0, 25.0]], np.float32))
+    np.testing.assert_array_equal(
+        paddle.searchsorted(seq2, vals2).numpy(), [[1, 2], [1, 2]])
+
+
+def test_pixel_shuffle_roundtrip():
+    x = _t(np.random.RandomState(0).rand(2, 8, 3, 3).astype(np.float32))
+    up = paddle.pixel_shuffle(x, 2)
+    assert tuple(up.shape) == (2, 2, 6, 6)
+    back = paddle.pixel_unshuffle(up, 2)
+    np.testing.assert_allclose(back.numpy(), x.numpy())
+
+
+def test_f_pad_modes():
+    x = _t(np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2))
+    assert tuple(F.pad(x, [1, 1, 1, 1]).shape) == (1, 2, 4, 4)
+    ref = np.pad(x.numpy(), [(0, 0), (0, 0), (1, 1), (1, 1)],
+                 mode="reflect")
+    np.testing.assert_allclose(
+        F.pad(x, [1, 1, 1, 1], mode="reflect").numpy(), ref)
+    rep = F.pad(x, [2, 0], mode="replicate")  # 1 spatial pair -> last dim
+    assert tuple(rep.shape) == (1, 2, 2, 4)
+    np.testing.assert_allclose(rep.numpy()[..., 0], x.numpy()[..., 0])
+
+
+def test_grid_sample_identity_and_shift():
+    rng = np.random.RandomState(0)
+    x = _t(rng.rand(1, 3, 5, 5).astype(np.float32))
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    grid = _t(np.stack([xs, ys], -1)[None].astype(np.float32))
+    out = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+    # zeros padding outside
+    far = _t(np.full((1, 2, 2, 2), 3.0, np.float32))
+    out2 = F.grid_sample(x, far, padding_mode="zeros")
+    np.testing.assert_allclose(out2.numpy(), 0.0)
+    # nearest mode
+    outn = F.grid_sample(x, grid, mode="nearest")
+    np.testing.assert_allclose(outn.numpy(), x.numpy(), atol=1e-5)
+
+
+def test_grid_sample_grad_flows():
+    x = _t(np.random.RandomState(1).rand(1, 1, 4, 4).astype(np.float32))
+    x.stop_gradient = False
+    ys, xs = np.meshgrid(np.linspace(-0.5, 0.5, 3),
+                         np.linspace(-0.5, 0.5, 3), indexing="ij")
+    grid = _t(np.stack([xs, ys], -1)[None].astype(np.float32))
+    out = F.grid_sample(x, grid)
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
