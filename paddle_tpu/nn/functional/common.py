@@ -124,7 +124,10 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
         x = x.reshape(n, c, h // r, r, w // r, r)
         x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
         return x.reshape(n, c * r * r, h // r, w // r)
-    raise NotImplementedError("pixel_unshuffle supports NCHW")
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
 
 
 # single pad implementation lives in ops.manipulation

@@ -163,36 +163,46 @@ take_along_axis = op("take_along_axis")(
 @op("put_along_axis")
 def put_along_axis(arr, indices, values, axis, reduce="assign",
                    include_self=True, broadcast=True):
-    values = jnp.broadcast_to(values, indices.shape) if jnp.ndim(values) else \
-        jnp.full(indices.shape, values, arr.dtype)
-    mode = {"assign": None, "add": "add", "mul": "multiply",
-            "multiply": "multiply"}[reduce]
-    if mode is None:
-        return jnp.put_along_axis(arr, indices, values, axis=axis,
-                                  inplace=False)
-    if not include_self:
-        # touched positions start from the reduce identity, not arr
-        touched = _scatter_add_along(
-            jnp.zeros(arr.shape, jnp.int32), indices,
-            jnp.ones(indices.shape, jnp.int32), axis) > 0
-        identity = 0.0 if mode == "add" else 1.0
-        arr = jnp.where(touched, jnp.asarray(identity, arr.dtype), arr)
-    if mode == "add":
-        upd = jnp.zeros_like(arr)
-        upd = _scatter_add_along(upd, indices, values, axis)
-        return arr + upd
-    upd = _scatter_add_along(jnp.zeros_like(arr), indices,
-                             jnp.log(jnp.maximum(values, 1e-30)), axis)
-    return arr * jnp.exp(upd)
+    """paddle.put_along_axis semantics: reduce in assign/add/mul/mean/
+    amax/amin; broadcast=True broadcasts indices over non-axis dims;
+    include_self=False starts touched slots from the reduce identity.
+    Scatter-multiply uses jax's native .at[].multiply (correct for
+    zero/negative values)."""
+    axis = axis % arr.ndim
+    if broadcast:
+        tgt = list(arr.shape)
+        tgt[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, tgt)
+    values = jnp.broadcast_to(values, indices.shape) if jnp.ndim(values) \
+        else jnp.full(indices.shape, values, arr.dtype)
+    values = values.astype(arr.dtype)
+    grids = jnp.meshgrid(*[jnp.arange(n) for n in indices.shape],
+                         indexing="ij")
+    grids[axis] = indices
+    loc = tuple(grids)
+    if reduce == "assign":
+        return arr.at[loc].set(values)
+    touched = jnp.zeros(arr.shape, jnp.int32).at[loc].add(1)
+    hit = touched > 0
 
+    def base_with(identity):
+        if include_self:
+            return arr
+        return jnp.where(hit, jnp.asarray(identity, arr.dtype), arr)
 
-def _scatter_add_along(base, indices, values, axis):
-    axis = axis % base.ndim
-    idx_grids = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape],
-                             indexing="ij")
-    full_idx = list(idx_grids)
-    full_idx[axis] = indices
-    return base.at[tuple(full_idx)].add(values)
+    if reduce in ("add", "sum"):
+        return base_with(0).at[loc].add(values)
+    if reduce in ("mul", "multiply"):
+        return base_with(1).at[loc].multiply(values)
+    if reduce == "mean":
+        sums = base_with(0).at[loc].add(values)
+        counts = touched + (1 if include_self else 0)
+        return jnp.where(hit, sums / jnp.maximum(counts, 1), arr)
+    if reduce == "amax":
+        return base_with(-jnp.inf).at[loc].max(values)
+    if reduce == "amin":
+        return base_with(jnp.inf).at[loc].min(values)
+    raise ValueError(f"unknown reduce {reduce!r}")
 
 
 @op("gather_nd")

@@ -25,4 +25,5 @@ from ...models.shufflenet import (ShuffleNetV2,  # noqa: F401
 from ...models.squeezenet import (SqueezeNet, squeezenet1_0,  # noqa: F401
                                   squeezenet1_1)
 from ...models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from ...models.ppyoloe import PPYOLOE, ppyoloe_m, ppyoloe_s  # noqa: F401
 from ...models.vit import ViT, vit  # noqa: F401

@@ -25,8 +25,25 @@ def test_take_put_along_axis():
     # include_self=False: touched slots start from the reduce identity
     ones = paddle.to_tensor(np.ones((3, 4), np.float32))
     ex = paddle.put_along_axis(ones, idx, 5.0, 1, reduce="add",
-                               include_self=False)
+                               include_self=False, broadcast=False)
     assert ex.numpy()[0, 0] == 5.0 and ex.numpy()[0, 1] == 1.0
+    # mul handles zero/negative values (native scatter-multiply)
+    twos = paddle.to_tensor(np.full((3, 4), 2.0, np.float32))
+    mul = paddle.put_along_axis(twos, idx, -3.0, 1, reduce="mul",
+                                broadcast=False)
+    assert mul.numpy()[0, 0] == -6.0
+    # mean / amax / amin reduce modes
+    mean = paddle.put_along_axis(twos, idx, 4.0, 1, reduce="mean",
+                                 broadcast=False)
+    assert mean.numpy()[0, 0] == 3.0
+    amx = paddle.put_along_axis(twos, idx, 9.0, 1, reduce="amax",
+                                broadcast=False)
+    assert amx.numpy()[0, 0] == 9.0
+    # broadcast=True (paddle default): indices broadcast over rows
+    brd = paddle.put_along_axis(
+        paddle.to_tensor(np.zeros((2, 3), np.float32)),
+        paddle.to_tensor(np.array([[1]], np.int32)), 7.0, 1)
+    np.testing.assert_allclose(brd.numpy(), [[0, 7, 0], [0, 7, 0]])
 
 
 def test_masked_fill_index_add_index_fill():

@@ -1,0 +1,134 @@
+"""PP-YOLOE detector tests (BASELINE config #5; reference:
+PaddleDetection ppyoloe test suite analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import ppyoloe as Y
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = Y.ppyoloe_s(num_classes=4)
+    return m
+
+
+def test_forward_levels(model):
+    model.eval()
+    outs = model(paddle.randn([2, 3, 128, 128]))
+    strides = [o[3] for o in outs]
+    assert strides == [8, 16, 32]
+    for cls, reg, centers, stride in outs:
+        hw = (128 // stride) ** 2
+        assert tuple(cls.shape) == (2, hw, 4)
+        assert tuple(reg.shape) == (2, hw, 4, 17)
+        assert centers.shape == (hw, 2)
+
+
+def test_decode_boxes_geometry(model):
+    model.eval()
+    outs = model(paddle.randn([1, 3, 64, 64]))
+    boxes, scores = Y.decode_boxes(outs)
+    b = np.asarray(boxes)
+    assert b.shape[-1] == 4
+    # boxes are centered on their anchors: x1 <= cx <= x2
+    centers = np.concatenate([np.asarray(o[2]) for o in outs], 0)
+    assert (b[0, :, 0] <= centers[:, 0] + 1e-3).all()
+    assert (b[0, :, 2] >= centers[:, 0] - 1e-3).all()
+    s = np.asarray(scores)
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_loss_finite_and_positive(model):
+    model.train()
+    outs = model(paddle.randn([2, 3, 64, 64]))
+    gt_boxes = paddle.to_tensor(np.array(
+        [[[4.0, 4, 40, 40], [10, 10, 30, 50]],
+         [[8.0, 8, 56, 56], [0, 0, 0, 0]]], np.float32))
+    gt_labels = paddle.to_tensor(np.array([[0, 2], [1, 0]], np.int64))
+    gt_mask = paddle.to_tensor(np.array([[1, 1], [1, 0]], np.float32))
+    loss = model.loss(outs, gt_boxes, gt_labels, gt_mask)
+    val = float(loss)
+    assert np.isfinite(val) and val > 0
+
+
+def test_train_step_reduces_loss():
+    paddle.seed(0)
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit.api import functional_call
+    import jax
+
+    m = Y.PPYOLOE(num_classes=3, width_mult=0.25, depth_mult=0.33)
+    names = [n for n, _ in m.named_parameters()]
+    params = [p for _, p in m.named_parameters()]
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=params)
+
+    imgs = paddle.randn([1, 3, 64, 64])
+    gt_boxes = paddle.to_tensor(
+        np.array([[[8.0, 8, 48, 48]]], np.float32))
+    gt_labels = paddle.to_tensor(np.array([[1]], np.int64))
+    gt_mask = paddle.to_tensor(np.array([[1]], np.float32))
+
+    def loss_fn(param_vals):
+        from paddle_tpu.core.tensor import Tensor
+        outs = functional_call(m, dict(zip(names, param_vals)), imgs)
+        return m.loss(outs, gt_boxes, gt_labels, gt_mask)._data
+
+    vg = jax.jit(jax.value_and_grad(
+        lambda pv: loss_fn(pv)))
+    vals = [p._data for p in params]
+    first = None
+    state = [opt.init_state_for(p._data) for p in params]
+    for step in range(8):
+        lv, grads = vg(vals)
+        vals, state = opt.apply_gradients(vals, grads, state,
+                                          lr=np.float32(1e-3),
+                                          step=np.int32(step + 1))
+        first = first if first is not None else float(lv)
+    assert float(lv) < first
+
+
+def test_nms_and_predict(model):
+    model.eval()
+    res = model.predict(paddle.randn([1, 3, 64, 64]),
+                        score_thresh=0.0, max_dets=10)
+    assert len(res) == 1
+    out = res[0]
+    assert out["boxes"].shape[1] == 4
+    assert len(out["boxes"]) <= 10
+    assert (out["scores"][:-1] >= out["scores"][1:]).all()
+
+
+def test_nms_suppresses_duplicates():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.zeros((3, 2), np.float32)
+    scores[:, 0] = [0.9, 0.8, 0.7]
+    out = Y._nms_single(boxes, scores, 0.1, 0.5, 10)
+    assert len(out["boxes"]) == 2  # overlapping same-class pair merged
+    np.testing.assert_allclose(out["scores"], [0.9, 0.7])
+
+
+def test_repvgg_fuse_preserves_output():
+    paddle.seed(0)
+    blk = Y.RepVggBlock(8, 8)
+    blk.eval()
+    x = paddle.randn([1, 8, 6, 6])
+    before = blk(x).numpy()
+    blk.fuse()
+    after = blk(x).numpy()
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_full_model_fuse():
+    paddle.seed(0)
+    m = Y.PPYOLOE(num_classes=2, width_mult=0.25, depth_mult=0.33)
+    m.eval()
+    x = paddle.randn([1, 3, 64, 64])
+    ref_boxes, ref_scores = Y.decode_boxes(m(x))
+    m.fuse()
+    boxes, scores = Y.decode_boxes(m(x))
+    np.testing.assert_allclose(np.asarray(scores),
+                               np.asarray(ref_scores), rtol=1e-3,
+                               atol=1e-4)
