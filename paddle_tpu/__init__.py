@@ -67,7 +67,7 @@ from .nn.functional.common import (pixel_shuffle,  # noqa: F401,E402
                                    pixel_unshuffle)
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
-_LAZY = {"distributed", "distribution", "fft", "geometric", "linalg",
+_LAZY = {"audio", "distributed", "distribution", "fft", "geometric", "linalg",
          "models", "vision", "kernels", "hapi", "onnx", "profiler",
          "incubate", "inference", "quantization", "signal", "sparse",
          "static", "text", "utils"}

@@ -1,0 +1,11 @@
+"""paddle.audio analog — audio feature extraction.
+
+Reference: python/paddle/audio/ (features/layers.py: Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC; functional.py: hz_to_mel,
+mel_to_hz, compute_fbank_matrix, create_dct, power_to_db). Built on
+paddle_tpu.signal.stft; note the tunneled axon backend lacks complex
+FFT — run feature extraction on the CPU backend or real TPU.
+"""
+from . import functional  # noqa: F401
+from .features import (LogMelSpectrogram, MFCC,  # noqa: F401
+                       MelSpectrogram, Spectrogram)

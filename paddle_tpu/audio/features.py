@@ -1,0 +1,107 @@
+"""Audio feature layers (≈ python/paddle/audio/features/layers.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Spectrogram(Layer):
+    """|STFT|^power over [..., time] waveforms ->
+    [..., n_fft//2+1, num_frames]."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", Tensor(jnp.asarray(
+                get_window(window, self.win_length))))
+
+    def forward(self, x):
+        from ..signal import stft
+        spec = stft(x, n_fft=self.n_fft, hop_length=self.hop_length,
+                    win_length=self.win_length, window=self.window,
+                    center=self.center, pad_mode=self.pad_mode)
+        mag = jnp.abs(_raw(spec))
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor(mag)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power)
+        self.register_buffer(
+            "fbank", Tensor(jnp.asarray(compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max, htk, norm))))
+
+    def forward(self, x):
+        spec = _raw(self.spectrogram(x))  # [..., bins, frames]
+        mel = jnp.einsum("mb,...bt->...mt", _raw(self.fbank), spec)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, n_mels, f_min, f_max)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self.mel(x), self.ref_value, self.amin,
+                           self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                 n_fft: int = 512, hop_length: Optional[int] = None,
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None,
+                 top_db: Optional[float] = None):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, n_mels=n_mels, f_min=f_min,
+            f_max=f_max, top_db=top_db)
+        self.register_buffer(
+            "dct", Tensor(jnp.asarray(create_dct(n_mfcc, n_mels))))
+
+    def forward(self, x):
+        logmel = _raw(self.log_mel(x))  # [..., mels, frames]
+        out = jnp.einsum("mk,...mt->...kt", _raw(self.dct), logmel)
+        return Tensor(out)

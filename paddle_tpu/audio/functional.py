@@ -1,0 +1,120 @@
+"""Audio functional ops (≈ python/paddle/audio/functional/functional.py)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+           "create_dct", "power_to_db", "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Slaney (default) or HTK mel scale, scalar or array."""
+    f = np.asarray(freq, dtype=np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-10)
+                                             / min_log_hz) / logstep,
+                        mels)
+        out = mels
+    return float(out) if np.isscalar(freq) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, dtype=np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = np.where(m >= min_log_mel,
+                         min_log_hz * np.exp(logstep
+                                             * (m - min_log_mel)),
+                         freqs)
+        out = freqs
+    return float(out) if np.isscalar(mel) else out
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0,
+                         f_max: Optional[float] = None,
+                         htk: bool = False,
+                         norm: str = "slaney") -> np.ndarray:
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2.0, n_bins)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_bins))
+    for i in range(n_mels):
+        lo, center, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_freqs - lo) / max(center - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - center, 1e-10)
+        fb[i] = np.clip(np.minimum(up, down), 0, None)
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return fb.astype(np.float32)
+
+
+def create_dct(n_mfcc: int, n_mels: int,
+               norm: Optional[str] = "ortho") -> np.ndarray:
+    """[n_mels, n_mfcc] DCT-II matrix."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return dct.astype(np.float32)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    x = spect._data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec) if isinstance(spect, Tensor) else log_spec
+
+
+def get_window(window: str, win_length: int,
+               fftbins: bool = True) -> np.ndarray:
+    n = win_length
+    if window in ("hann", "hanning"):
+        # periodic (fftbins) vs symmetric
+        m = n if fftbins else n - 1
+        return (0.5 - 0.5 * np.cos(2 * math.pi * np.arange(n) /
+                                   max(m, 1))).astype(np.float32)
+    if window == "hamming":
+        m = n if fftbins else n - 1
+        return (0.54 - 0.46 * np.cos(2 * math.pi * np.arange(n) /
+                                     max(m, 1))).astype(np.float32)
+    if window in ("rect", "rectangular", "boxcar", "ones"):
+        return np.ones(n, np.float32)
+    if window == "blackman":
+        m = n if fftbins else n - 1
+        t = 2 * math.pi * np.arange(n) / max(m, 1)
+        return (0.42 - 0.5 * np.cos(t) +
+                0.08 * np.cos(2 * t)).astype(np.float32)
+    raise ValueError(f"unsupported window {window!r}")

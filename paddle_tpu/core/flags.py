@@ -77,6 +77,10 @@ def set_flags(flags: Dict[str, Any]):
 
 # ---------------------------------------------------------------- core flags
 define_flag("default_dtype", "float32", "Default floating dtype for tensor creation")
+define_flag("use_native_tensor_store", True,
+            "Route paddle.save/load tensor payloads through the native "
+            "parallel CRC-checked blob store (native/tensor_store.cc) "
+            "when the C++ toolchain is available")
 define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf after each eager op "
             "(analog of reference FLAGS_check_nan_inf, "
             "paddle/fluid/framework/details/nan_inf_utils_detail.cc:33)")

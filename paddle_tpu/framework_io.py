@@ -53,17 +53,106 @@ def _restore_bf16(u16, shape):
     return u16.view(jnp.bfloat16).reshape(shape)
 
 
+class _TensorRef:
+    """Placeholder in the pickled structure pointing into the native
+    sidecar blob file ({path}.tensors)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+
+def _extract_payloads(obj, out, prefix="t"):
+    """Replace _TensorPayload leaves with _TensorRef, collecting arrays."""
+    if isinstance(obj, _TensorPayload):
+        key = f"{prefix}{len(out)}"
+        out[key] = obj.array
+        return _TensorRef(key)
+    if isinstance(obj, dict):
+        return {k: _extract_payloads(v, out) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_extract_payloads(v, out) for v in obj)
+    return obj
+
+
+def _use_native() -> bool:
+    from .core import flags
+    if not flags.get_flag("use_native_tensor_store"):
+        return False
+    from .native import tensor_store
+    return tensor_store.available()
+
+
 def save(obj: Any, path: str, protocol: int = 4):
+    """paddle.save: pickled structure; tensor payloads go through the
+    native parallel CRC-checked store ({path}.tensors sidecar) when the
+    toolchain is available (FLAGS_use_native_tensor_store), else they
+    inline into the pickle."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+    ser = _to_serializable(obj)
+    if _use_native():
+        import uuid
+        from .native import tensor_store
+        payloads: dict = {}
+        ser = _extract_payloads(ser, payloads)
+        # pair the pickle and the sidecar with a checkpoint id so a
+        # crash between the two atomic renames can never silently mix
+        # an old structure with new tensors (load verifies the id)
+        ckpt_id = uuid.uuid4().hex
+        blobs = {k: np.ascontiguousarray(
+            v.view(np.uint16) if v.dtype == jnp.bfloat16 else v)
+            for k, v in payloads.items()}
+        blobs["__ckpt_id__"] = np.frombuffer(
+            ckpt_id.encode(), dtype=np.uint8).copy()
+        tensor_store.save_tensors(path + ".tensors", blobs)
+        bf16 = sorted(k for k, v in payloads.items()
+                      if v.dtype == jnp.bfloat16)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump({"__pt_native__": True, "tree": ser,
+                         "bf16_keys": bf16, "ckpt_id": ckpt_id}, f,
+                        protocol=protocol)
+        os.replace(tmp, path)
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(ser, f, protocol=protocol)
+    os.replace(tmp, path)
 
 
 def load(path: str, return_numpy: bool = False):
     with open(path, "rb") as f:
         obj = pickle.load(f)
+    if isinstance(obj, dict) and obj.get("__pt_native__"):
+        from .native import tensor_store
+        arrays = tensor_store.load_tensors(path + ".tensors")
+        want_id = obj.get("ckpt_id")
+        have = arrays.pop("__ckpt_id__", None)
+        have_id = bytes(have.tobytes()).decode() \
+            if have is not None else None
+        if want_id is not None and want_id != have_id:
+            raise IOError(
+                f"checkpoint mismatch: {path!r} and its .tensors "
+                "sidecar are from different save() calls (a writer "
+                "was likely killed mid-save); re-save the checkpoint")
+        bf16 = set(obj.get("bf16_keys", ()))
+
+        def resolve(o):
+            if isinstance(o, _TensorRef):
+                arr = arrays[o.key]
+                if o.key in bf16:
+                    arr = arr.view(jnp.bfloat16)
+                return arr
+            if isinstance(o, dict):
+                return {k: resolve(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                return type(o)(resolve(v) for v in o)
+            return o
+
+        obj = resolve(obj["tree"])
     if return_numpy:
         return obj
 

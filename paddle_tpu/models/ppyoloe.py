@@ -112,8 +112,8 @@ class CSPResStage(Layer):
 class CSPResNet(Layer):
     """Backbone returning strides 8/16/32 features."""
 
-    def __init__(self, widths=(64, 128, 256, 512, 1024),
-                 depths=(1, 2, 2, 1), width_mult=1.0, depth_mult=1.0):
+    def __init__(self, widths=(64, 128, 256, 512),
+                 depths=(1, 2, 2), width_mult=1.0, depth_mult=1.0):
         super().__init__()
         w = [max(8, int(c * width_mult)) for c in widths]
         d = [max(1, round(n * depth_mult)) for n in depths]
@@ -294,7 +294,6 @@ class PPYOLOE(Layer):
         TrainStep/value_and_grad path (the standard detector loop), not
         eager loss.backward().
         """
-        boxes, scores_ = None, None
         cls_all, reg_all, centers_all, strides_all = [], [], [], []
         for cls, reg, centers, stride in head_outs:
             cls_all.append(_raw(cls))

@@ -15,7 +15,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_native.so")
-_SOURCES = ["host_tracer.cc", "token_feeder.cc"]
+_SOURCES = ["host_tracer.cc", "token_feeder.cc", "tensor_store.cc"]
 
 _lock = threading.Lock()
 _lib = None
@@ -81,6 +81,35 @@ def _bind(handle: ctypes.CDLL) -> ctypes.CDLL:
                                       c.POINTER(c.c_int32)]
     handle.pt_feeder_next_epoch.argtypes = [c.c_void_p]
     handle.pt_feeder_destroy.argtypes = [c.c_void_p]
+    # tensor store (checkpoint blobs)
+    handle.pts_writer_open.restype = c.c_void_p
+    handle.pts_writer_open.argtypes = [c.c_char_p, c.c_int]
+    handle.pts_writer_add.restype = c.c_int
+    handle.pts_writer_add.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, c.c_int,
+        c.POINTER(c.c_int64), c.c_void_p, c.c_int64]
+    handle.pts_writer_close.restype = c.c_int
+    handle.pts_writer_close.argtypes = [c.c_void_p]
+    handle.pts_reader_open.restype = c.c_void_p
+    handle.pts_reader_open.argtypes = [c.c_char_p]
+    handle.pts_reader_count.restype = c.c_int64
+    handle.pts_reader_count.argtypes = [c.c_void_p]
+    handle.pts_reader_error.restype = c.c_char_p
+    handle.pts_reader_error.argtypes = [c.c_void_p]
+    handle.pts_reader_name.restype = c.c_char_p
+    handle.pts_reader_name.argtypes = [c.c_void_p, c.c_int64]
+    handle.pts_reader_dtype.restype = c.c_char_p
+    handle.pts_reader_dtype.argtypes = [c.c_void_p, c.c_int64]
+    handle.pts_reader_ndim.restype = c.c_int
+    handle.pts_reader_ndim.argtypes = [c.c_void_p, c.c_int64]
+    handle.pts_reader_shape.argtypes = [c.c_void_p, c.c_int64,
+                                        c.POINTER(c.c_int64)]
+    handle.pts_reader_nbytes.restype = c.c_int64
+    handle.pts_reader_nbytes.argtypes = [c.c_void_p, c.c_int64]
+    handle.pts_reader_read.restype = c.c_int
+    handle.pts_reader_read.argtypes = [c.c_void_p, c.c_int64,
+                                       c.c_void_p]
+    handle.pts_reader_close.argtypes = [c.c_void_p]
     return handle
 
 

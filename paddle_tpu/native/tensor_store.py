@@ -1,0 +1,88 @@
+"""Python wrapper over the native checkpoint tensor store
+(tensor_store.cc). Used by paddle.save/load for the tensor payload when
+the native toolchain is available; falls back to pure pickle otherwise.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+try:  # registers the "bfloat16" dtype name with numpy
+    import ml_dtypes  # noqa: F401
+except ImportError:
+    pass
+
+from . import lib
+
+__all__ = ["save_tensors", "load_tensors", "available"]
+
+
+def available() -> bool:
+    handle = lib()
+    return handle is not None and hasattr(handle, "pts_writer_open")
+
+
+def save_tensors(path: str, tensors: Dict[str, np.ndarray],
+                 num_threads: int = 4) -> None:
+    """Write named arrays with parallel CRC-checked IO + atomic rename."""
+    handle = lib()
+    w = handle.pts_writer_open(path.encode(), num_threads)
+    keepalive: List[np.ndarray] = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        keepalive.append(arr)  # must outlive pts_writer_close
+        shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+        rc = handle.pts_writer_add(
+            w, name.encode(), str(arr.dtype).encode(), arr.ndim, shape,
+            arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+        if rc != 0:
+            raise IOError(f"tensor_store: add({name!r}) failed")
+    if handle.pts_writer_close(w) != 0:
+        raise IOError(f"tensor_store: writing {path!r} failed")
+    del keepalive
+
+
+def load_tensors(path: str) -> Dict[str, np.ndarray]:
+    """Read all arrays; every payload is CRC-verified."""
+    if not available():
+        raise RuntimeError(
+            f"{path!r} was saved with the native tensor store, but the "
+            "C++ toolchain/native build is unavailable here — install "
+            "g++ or re-save with FLAGS_use_native_tensor_store=False")
+    handle = lib()
+    r = handle.pts_reader_open(path.encode())
+    try:
+        n = handle.pts_reader_count(r)
+        if n < 0:
+            err = handle.pts_reader_error(r).decode()
+            raise IOError(f"tensor_store: {path!r}: {err}")
+        out: Dict[str, np.ndarray] = {}
+        for i in range(n):
+            name = handle.pts_reader_name(r, i).decode()
+            dtype = np.dtype(handle.pts_reader_dtype(r, i).decode())
+            ndim = handle.pts_reader_ndim(r, i)
+            shape = (ctypes.c_int64 * max(ndim, 1))()
+            handle.pts_reader_shape(r, i, shape)
+            nbytes = handle.pts_reader_nbytes(r, i)
+            arr = np.empty(tuple(shape[:ndim]), dtype=dtype)
+            if arr.nbytes != nbytes:
+                # the index is not CRC-protected; never let a corrupt
+                # shape/nbytes pair overflow the destination buffer
+                raise IOError(
+                    f"tensor_store: {name!r} index inconsistent "
+                    f"(shape says {arr.nbytes} bytes, record says "
+                    f"{nbytes}) — corrupt checkpoint {path!r}")
+            rc = handle.pts_reader_read(
+                r, i, arr.ctypes.data_as(ctypes.c_void_p))
+            if rc == -2:
+                raise IOError(
+                    f"tensor_store: CRC mismatch for {name!r} "
+                    f"(corrupt checkpoint {path!r})")
+            if rc != 0:
+                raise IOError(f"tensor_store: read({name!r}) failed")
+            out[name] = arr
+        return out
+    finally:
+        handle.pts_reader_close(r)

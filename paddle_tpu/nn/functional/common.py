@@ -110,9 +110,11 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
         x = x.reshape(n, c // (r * r), r, r, h, w)
         x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
         return x.reshape(n, c // (r * r), h * r, w * r)
+    # channels split c-major (c', r1, r2), matching the NCHW path and
+    # the reference's NHWC kernel
     n, h, w, c = x.shape
-    x = x.reshape(n, h, w, r, r, c // (r * r))
-    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    x = x.reshape(n, h, w, c // (r * r), r, r)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
     return x.reshape(n, h * r, w * r, c // (r * r))
 
 
@@ -124,9 +126,10 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
         x = x.reshape(n, c, h // r, r, w // r, r)
         x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
         return x.reshape(n, c * r * r, h // r, w // r)
+    # output channels c-major (c, r1, r2), matching the NCHW path
     n, h, w, c = x.shape
     x = x.reshape(n, h // r, r, w // r, r, c)
-    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
     return x.reshape(n, h // r, w // r, c * r * r)
 
 

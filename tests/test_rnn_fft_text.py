@@ -282,3 +282,42 @@ def test_onnx_export_stablehlo(tmp_path):
     with pytest.raises(RuntimeError, match="ONNX emission"):
         ponnx.export(model, str(tmp_path / "m2"), input_spec=[x],
                      format="onnx")
+
+
+# ------------------------------------------------------------------- audio
+def test_audio_features():
+    from paddle_tpu import audio
+    rng = np.random.RandomState(0)
+    wave = paddle.to_tensor(rng.randn(1, 2048).astype(np.float32))
+    spec = audio.Spectrogram(n_fft=256, hop_length=128)(wave)
+    assert tuple(spec.shape)[1] == 129  # n_fft//2 + 1
+    assert (spec.numpy() >= 0).all()
+    mel = audio.MelSpectrogram(sr=16000, n_fft=256, hop_length=128,
+                               n_mels=32)(wave)
+    assert tuple(mel.shape)[1] == 32
+    logmel = audio.LogMelSpectrogram(sr=16000, n_fft=256,
+                                     hop_length=128, n_mels=32)(wave)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = audio.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                      hop_length=128, n_mels=32)(wave)
+    assert tuple(mfcc.shape)[1] == 13
+
+
+def test_audio_functional():
+    from paddle_tpu.audio import functional as AF
+    # mel scale round trip
+    hz = np.array([100.0, 440.0, 4000.0])
+    np.testing.assert_allclose(AF.mel_to_hz(AF.hz_to_mel(hz)), hz,
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        AF.mel_to_hz(AF.hz_to_mel(hz, htk=True), htk=True), hz,
+        rtol=1e-6)
+    fb = AF.compute_fbank_matrix(16000, 256, n_mels=20)
+    assert fb.shape == (20, 129)
+    assert (fb >= 0).all()
+    dct = AF.create_dct(13, 20)
+    assert dct.shape == (20, 13)
+    # orthonormal columns
+    np.testing.assert_allclose(dct.T @ dct, np.eye(13), atol=1e-5)
+    w = AF.get_window("hann", 64)
+    assert w.shape == (64,) and abs(w[0]) < 1e-6

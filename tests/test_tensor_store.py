@@ -1,0 +1,103 @@
+"""Native tensor store + paddle.save/load integration
+(reference: framework/save_load_util.cc serialization tests analog)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.native import tensor_store
+
+pytestmark = pytest.mark.skipif(not tensor_store.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_store_roundtrip_many_dtypes(tmp_path):
+    rng = np.random.RandomState(0)
+    tensors = {
+        "f32": rng.randn(16, 8).astype(np.float32),
+        "i32": rng.randint(-5, 5, (7,)).astype(np.int32),
+        "u8": rng.randint(0, 255, (3, 3, 3)).astype(np.uint8),
+        "scalar": np.float32(3.5).reshape(()),
+        "big": rng.randn(256, 256).astype(np.float32),
+    }
+    path = str(tmp_path / "blob.tensors")
+    tensor_store.save_tensors(path, tensors, num_threads=3)
+    back = tensor_store.load_tensors(path)
+    assert set(back) == set(tensors)
+    for k in tensors:
+        np.testing.assert_array_equal(back[k], tensors[k])
+        assert back[k].dtype == tensors[k].dtype
+
+
+def test_store_corruption_detected(tmp_path):
+    path = str(tmp_path / "c.tensors")
+    tensor_store.save_tensors(
+        path, {"w": np.ones((32, 32), np.float32)})
+    with open(path, "r+b") as f:
+        f.seek(100)
+        f.write(b"\x13\x37")
+    with pytest.raises(IOError, match="CRC"):
+        tensor_store.load_tensors(path)
+
+
+def test_store_bad_file(tmp_path):
+    p = tmp_path / "junk.tensors"
+    p.write_bytes(b"this is not a checkpoint")
+    with pytest.raises(IOError):
+        tensor_store.load_tensors(str(p))
+
+
+def test_paddle_save_load_native_sidecar(tmp_path):
+    paddle.seed(0)
+    path = str(tmp_path / "model.pdparams")
+    state = {"w": paddle.randn([32, 16]),
+             "opt": {"m": paddle.zeros([32, 16]), "step": 7},
+             "names": ["a", "b"]}
+    paddle.save(state, path)
+    assert os.path.exists(path + ".tensors")
+    back = paddle.load(path)
+    np.testing.assert_allclose(back["w"].numpy(), state["w"].numpy())
+    np.testing.assert_allclose(back["opt"]["m"].numpy(), 0.0)
+    assert back["opt"]["step"] == 7
+    assert back["names"] == ["a", "b"]
+
+
+def test_paddle_save_load_bf16(tmp_path):
+    import jax.numpy as jnp
+    path = str(tmp_path / "bf16.pdparams")
+    src = {"p": paddle.to_tensor(
+        jnp.arange(16, dtype=jnp.float32).reshape(4, 4)
+        .astype(jnp.bfloat16))}
+    paddle.save(src, path)
+    back = paddle.load(path)
+    assert str(back["p"].numpy().dtype) == "bfloat16"
+    np.testing.assert_allclose(
+        np.asarray(back["p"].numpy()).astype(np.float32),
+        np.arange(16, dtype=np.float32).reshape(4, 4))
+
+
+def test_pickle_fallback_still_loads(tmp_path):
+    # files written with the flag off (pure pickle) must keep loading
+    from paddle_tpu.core import flags
+    path = str(tmp_path / "plain.pdparams")
+    flags.set_flags({"FLAGS_use_native_tensor_store": False})
+    try:
+        paddle.save({"w": paddle.ones([4])}, path)
+        assert not os.path.exists(path + ".tensors")
+        back = paddle.load(path)
+        np.testing.assert_allclose(back["w"].numpy(), 1.0)
+    finally:
+        flags.set_flags({"FLAGS_use_native_tensor_store": True})
+
+
+def test_state_dict_roundtrip_through_model(tmp_path):
+    from paddle_tpu import nn
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+    path = str(tmp_path / "m.pdparams")
+    paddle.save(m.state_dict(), path)
+    m2 = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+    m2.set_state_dict(paddle.load(path))
+    x = paddle.randn([3, 8])
+    np.testing.assert_allclose(m2(x).numpy(), m(x).numpy(), rtol=1e-6)
