@@ -73,10 +73,22 @@ _LAZY = {"audio", "distributed", "distribution", "fft", "geometric", "linalg",
          "static", "text", "utils"}
 
 
+_LAZY_ATTRS = {
+    "Model": ("paddle_tpu.hapi.model", "Model"),
+    "summary": ("paddle_tpu.hapi.model_summary", "summary"),
+    "flops": ("paddle_tpu.hapi.model_summary", "flops"),
+}
+
+
 def __getattr__(name):
+    import importlib
     if name in _LAZY:
-        import importlib
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in _LAZY_ATTRS:
+        mod_name, attr = _LAZY_ATTRS[name]
+        val = getattr(importlib.import_module(mod_name), attr)
+        globals()[name] = val
+        return val
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

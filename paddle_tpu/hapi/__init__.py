@@ -3,3 +3,4 @@ callbacks."""
 from .callbacks import (Callback, EarlyStopping,  # noqa: F401
                         LRSchedulerCallback, ModelCheckpoint, ProgBarLogger)
 from .model import Model  # noqa: F401
+from .model_summary import flops, summary  # noqa: F401

@@ -1,0 +1,117 @@
+"""paddle.summary / paddle.flops analogs.
+
+Reference: python/paddle/hapi/model_summary.py (summary table walk) and
+python/paddle/hapi/dynamic_flops.py (per-layer FLOP table). TPU-native
+twist: flops() asks XLA's compiled cost analysis for the real lowered
+FLOP count instead of per-layer hand formulas.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def _example_inputs(input_size, dtypes):
+    sizes = input_size if isinstance(input_size, (list, tuple)) and \
+        input_size and isinstance(input_size[0], (list, tuple)) \
+        else [input_size]
+    dtypes = dtypes or ["float32"] * len(sizes)
+    outs = []
+    for shape, dt in zip(sizes, dtypes):
+        shape = [1 if s is None or (isinstance(s, int) and s < 0) else s
+                 for s in shape]
+        if str(dt).startswith("int"):
+            outs.append(Tensor(np.zeros(shape, dtype=np.dtype(str(dt)))))
+        else:
+            outs.append(Tensor(np.zeros(shape, dtype=np.dtype(str(dt)))))
+    return outs
+
+
+def summary(net: Layer, input_size=None, dtypes=None,
+            input=None) -> dict:
+    """Print a per-layer table; returns {'total_params',
+    'trainable_params'} (reference hapi.summary contract)."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, output):
+            leaves = jax.tree_util.tree_leaves(
+                output, is_leaf=lambda t: isinstance(t, Tensor))
+            shape = list(leaves[0].shape) if leaves else []
+            n_params = int(sum(np.prod(p.shape)
+                               for p in lyr._parameters.values()
+                               if p is not None))
+            rows.append((name or lyr.__class__.__name__,
+                         lyr.__class__.__name__, shape, n_params))
+            return output
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if sub is not None and not sub._sub_layers:
+            hooks.append(sub.register_forward_post_hook(
+                make_hook(name, sub)))
+    try:
+        ins = [input] if input is not None else \
+            _example_inputs(input_size, dtypes)
+        was_training = net.training
+        net.eval()
+        net(*ins)
+        if was_training:
+            net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = int(sum(np.prod(p.shape) for p in net.parameters()))
+    trainable = int(sum(np.prod(p.shape) for p in net.parameters()
+                        if not p.stop_gradient))
+    name_w = max([len(r[0]) for r in rows] + [10]) + 2
+    print(f"{'Layer':<{name_w}}{'Type':<22}{'Output Shape':<20}"
+          f"{'Params':>12}")
+    print("-" * (name_w + 54))
+    for name, typ, shape, n in rows:
+        print(f"{name:<{name_w}}{typ:<22}{str(shape):<20}{n:>12,}")
+    print("-" * (name_w + 54))
+    print(f"Total params: {total:,}  (trainable: {trainable:,})")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net: Layer, input_size=None, dtypes=None,
+          print_detail: bool = False) -> int:
+    """FLOPs of one forward pass, from XLA's compiled cost analysis
+    (counts what actually runs after fusion — the reference's
+    dynamic_flops.py estimates per-layer formulas instead)."""
+    from ..jit.api import functional_call
+    ins = _example_inputs(input_size, dtypes)
+    state = net.state_dict()
+    names = list(state.keys())
+    vals = [t._data for t in state.values()]
+    was_training = net.training
+    net.eval()
+
+    def fwd(param_vals, *raw_ins):
+        out = functional_call(net, dict(zip(names, param_vals)),
+                              *[Tensor(r) for r in raw_ins])
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    raw_ins = [t._data for t in ins]
+    lowered = jax.jit(fwd).lower(vals, *raw_ins)
+    cost = lowered.compile().cost_analysis()
+    if was_training:
+        net.train()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    total = int(cost.get("flops", 0)) if cost else 0
+    if print_detail:
+        print(f"FLOPs (XLA cost analysis): {total:,}")
+    return total

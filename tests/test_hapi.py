@@ -115,3 +115,21 @@ class TestModelFit:
         info = m.summary()
         expect = 8 * 32 + 32 + 32 * 2 + 2
         assert info["total_params"] == expect
+
+
+def test_summary_and_flops():
+    import io
+    import contextlib
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        info = paddle.summary(net, (1, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+    assert info["trainable_params"] == info["total_params"]
+    out = buf.getvalue()
+    assert "Linear" in out and "Total params" in out
+    f = paddle.flops(net, (1, 8))
+    # at least the two matmuls' MACs
+    assert f >= 2 * 8 * 16
+    assert isinstance(paddle.Model, type)
