@@ -19,6 +19,10 @@ __all__ = ["summary", "flops"]
 
 
 def _example_inputs(input_size, dtypes):
+    if input_size is None:
+        raise ValueError(
+            "summary/flops need `input_size` (shape or list of shapes) "
+            "or an example `input`")
     sizes = input_size if isinstance(input_size, (list, tuple)) and \
         input_size and isinstance(input_size[0], (list, tuple)) \
         else [input_size]
@@ -27,10 +31,7 @@ def _example_inputs(input_size, dtypes):
     for shape, dt in zip(sizes, dtypes):
         shape = [1 if s is None or (isinstance(s, int) and s < 0) else s
                  for s in shape]
-        if str(dt).startswith("int"):
-            outs.append(Tensor(np.zeros(shape, dtype=np.dtype(str(dt)))))
-        else:
-            outs.append(Tensor(np.zeros(shape, dtype=np.dtype(str(dt)))))
+        outs.append(Tensor(np.zeros(shape, dtype=np.dtype(str(dt)))))
     return outs
 
 
@@ -55,18 +56,19 @@ def summary(net: Layer, input_size=None, dtypes=None,
         return hook
 
     for name, sub in net.named_sublayers(include_self=False):
-        if sub is not None and not sub._sub_layers:
+        # every layer that owns parameters or is a leaf gets a row
+        if sub is not None and (not sub._sub_layers or sub._parameters):
             hooks.append(sub.register_forward_post_hook(
                 make_hook(name, sub)))
+    was_training = net.training
     try:
         ins = [input] if input is not None else \
             _example_inputs(input_size, dtypes)
-        was_training = net.training
         net.eval()
         net(*ins)
+    finally:
         if was_training:
             net.train()
-    finally:
         for h in hooks:
             h.remove()
 
@@ -96,19 +98,20 @@ def flops(net: Layer, input_size=None, dtypes=None,
     vals = [t._data for t in state.values()]
     was_training = net.training
     net.eval()
+    try:
+        def fwd(param_vals, *raw_ins):
+            out = functional_call(net, dict(zip(names, param_vals)),
+                                  *[Tensor(r) for r in raw_ins])
+            return jax.tree_util.tree_map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
 
-    def fwd(param_vals, *raw_ins):
-        out = functional_call(net, dict(zip(names, param_vals)),
-                              *[Tensor(r) for r in raw_ins])
-        return jax.tree_util.tree_map(
-            lambda t: t._data if isinstance(t, Tensor) else t, out,
-            is_leaf=lambda x: isinstance(x, Tensor))
-
-    raw_ins = [t._data for t in ins]
-    lowered = jax.jit(fwd).lower(vals, *raw_ins)
-    cost = lowered.compile().cost_analysis()
-    if was_training:
-        net.train()
+        raw_ins = [t._data for t in ins]
+        lowered = jax.jit(fwd).lower(vals, *raw_ins)
+        cost = lowered.compile().cost_analysis()
+    finally:
+        if was_training:
+            net.train()
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
     total = int(cost.get("flops", 0)) if cost else 0

@@ -60,6 +60,11 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
     def deco(fn):
         is_layer = isinstance(fn, Layer)
         target = fn.forward if is_layer else fn
+        # dy2static pass: tensor-dependent if/while become
+        # lax.cond/while_loop before jax.jit traces the function
+        if not is_layer:
+            from .dy2static import convert_to_static
+            target = convert_to_static(target)
 
         @functools.partial(jax.jit, static_argnums=static_argnums)
         def jitted(state_vals, arg_vals, kw_vals):

@@ -1,0 +1,299 @@
+"""dy2static: AST conversion of tensor-dependent Python control flow.
+
+Reference analog: python/paddle/fluid/dygraph/dygraph_to_static/ —
+IfElseTransformer/LoopTransformer rewrite user source so `if`/`while`
+over Tensors become control-flow OPS (convert_ifelse/convert_while_loop
+in convert_operators.py), driven by ProgramTranslator.
+
+TPU-native: the target ops are jax.lax.cond / jax.lax.while_loop, so
+converted functions trace into ONE XLA program even when the Python
+control flow depends on runtime tensor values. Plain-Python predicates
+keep eager if/while semantics — the convert_* helpers dispatch on
+whether the predicate is a Tensor/tracer at runtime, exactly like the
+reference's convert_ifelse does.
+
+Scope (documented divergences from the reference's full transformer
+set): `if`/`if-else` and `while` are converted; both branches/the loop
+body must assign compatible (same shape/dtype) values to the variables
+that live past the construct; `for x in tensor` stays Python (use
+paddle.static.nn.while_loop / lax.scan style code for traced loops);
+break/continue inside converted `while` are not supported.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_to_static",
+           "declarative"]
+
+_UNDEF = object()
+
+
+def _is_traced_pred(pred) -> bool:
+    if isinstance(pred, Tensor):
+        return isinstance(pred._data, jax.core.Tracer)
+    return isinstance(pred, jax.core.Tracer)
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _bool(pred) -> bool:
+    return bool(_raw(pred))
+
+
+def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
+                   init_vals: Tuple = ()):
+    """Runtime dispatch for a converted `if` (reference
+    convert_operators.convert_ifelse). Both fns take the current values
+    of the carried variables and return their new tuple."""
+    if not _is_traced_pred(pred):
+        return true_fn(*init_vals) if _bool(pred) \
+            else false_fn(*init_vals)
+
+    # traced: both branches run under lax.cond on RAW leaves. Values
+    # stay raw (to_static already feeds the converted function raw
+    # tracers); mixing wrapped Tensors back in would leak Tensor
+    # objects into jnp indexing inside the trace.
+    def run(fn):
+        def inner(_):
+            outs = fn(*init_vals)
+            return jax.tree_util.tree_map(
+                _raw, outs, is_leaf=lambda t: isinstance(t, Tensor))
+        return inner
+
+    pred_raw = jnp.asarray(_raw(pred)).reshape(())
+    return jax.lax.cond(pred_raw.astype(bool), run(true_fn),
+                        run(false_fn), operand=None)
+
+
+def convert_while_loop(cond_fn: Callable, body_fn: Callable,
+                       loop_vars: Tuple):
+    """Runtime dispatch for a converted `while` (reference
+    convert_operators.convert_while_loop). cond_fn/body_fn take and
+    return the loop-variable tuple."""
+    probe = cond_fn(*loop_vars)
+    if not _is_traced_pred(probe) and not any(
+            isinstance(_raw(v), jax.core.Tracer) for v in loop_vars):
+        vars_ = tuple(loop_vars)
+        while _bool(cond_fn(*vars_)):
+            vars_ = tuple(body_fn(*vars_))
+        return vars_
+
+    def cond(raw_vars):
+        return jnp.asarray(_raw(cond_fn(*raw_vars))).reshape(()) \
+            .astype(bool)
+
+    def body(raw_vars):
+        outs = body_fn(*raw_vars)
+        return tuple(_raw(o) for o in outs)
+
+    raw = tuple(_raw(v) for v in loop_vars)
+    return jax.lax.while_loop(cond, body, raw)
+
+
+# --------------------------------------------------------------- AST pass
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names: List[str] = []
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                node.id not in self.names:
+            self.names.append(node.id)
+
+    def visit_FunctionDef(self, node):  # don't descend into nested defs
+        if node.name not in self.names:
+            self.names.append(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts: Sequence[ast.stmt]) -> List[str]:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _has_jump(stmts: Sequence[ast.stmt]) -> bool:
+    """True if a return/break/continue would cross the construct's
+    boundary. Nested function bodies (incl. __jst helpers from inner
+    conversions) have their own scope and don't count."""
+
+    def walk(node) -> bool:
+        if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return False
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s) for s in stmts)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites tensor-convertible `if` and `while` statements into
+    convert_ifelse / convert_while_loop calls."""
+
+    def _load(self, name):
+        return ast.Name(id=name, ctx=ast.Load())
+
+    def _init_val(self, name):
+        # locals().get(name, _UNDEF): carried vars may be unbound
+        # before the branch (e.g. first assigned inside it)
+        return ast.Call(
+            func=ast.Attribute(
+                value=ast.Call(func=ast.Name(id="locals",
+                                             ctx=ast.Load()),
+                               args=[], keywords=[]),
+                attr="get", ctx=ast.Load()),
+            args=[ast.Constant(value=name),
+                  ast.Name(id="__jst_undef", ctx=ast.Load())],
+            keywords=[])
+
+    def _branch_fn(self, fname, body, out_names):
+        ret = ast.Return(value=ast.Tuple(
+            elts=[self._load(n) for n in out_names], ctx=ast.Load()))
+        # carried vars come in as parameters so assignments inside the
+        # branch never shadow unbound outer locals
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in out_names],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=list(body) + [ret], decorator_list=[])
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        # jumps can't cross a lax.cond boundary — leave those to Python
+        if _has_jump(node.body) or _has_jump(node.orelse):
+            return node
+        out_names = []
+        for n in _assigned(node.body) + _assigned(node.orelse):
+            # __jst_* helper defs from nested conversions are internal
+            if n not in out_names and not n.startswith("__jst"):
+                out_names.append(n)
+        if not out_names:
+            return node  # pure side-effect-free branch: keep python
+        true_fn = self._branch_fn("__jst_true", node.body, out_names)
+        false_fn = self._branch_fn(
+            "__jst_false", node.orelse or [ast.Pass()], out_names)
+        call = ast.Call(
+            func=ast.Name(id="__jst_convert_ifelse", ctx=ast.Load()),
+            args=[node.test, self._load("__jst_true"),
+                  self._load("__jst_false"),
+                  ast.Tuple(elts=[self._init_val(n)
+                                  for n in out_names],
+                            ctx=ast.Load())], keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in out_names], ctx=ast.Store())],
+            value=call)
+        return [true_fn, false_fn, assign]
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if _has_jump(node.body) or node.orelse:
+            return node
+        loop_names = [n for n in _assigned(node.body)
+                      if not n.startswith("__jst")]
+        if not loop_names:
+            return node
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in loop_names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_fn = ast.FunctionDef(
+            name="__jst_cond", args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name="__jst_body", args=args,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[self._load(n) for n in loop_names],
+                ctx=ast.Load()))],
+            decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__jst_convert_while", ctx=ast.Load()),
+            args=[self._load("__jst_cond"), self._load("__jst_body"),
+                  ast.Tuple(elts=[self._load(n) for n in loop_names],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in loop_names], ctx=ast.Store())],
+            value=call)
+        return [cond_fn, body_fn, assign]
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Source-rewrite `fn` so tensor-dependent if/while trace into
+    lax.cond/while_loop (the ProgramTranslator.get_func analog).
+    Falls back to the original function when source is unavailable."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fdef = tree.body[0]
+    # only plain named defs convert: a lambda/comprehension source is
+    # its ENCLOSING statement — re-exec'ing that would replay arbitrary
+    # side effects and never bind fn.__name__
+    if not isinstance(fdef, ast.FunctionDef) or \
+            fdef.name != fn.__name__:
+        return fn
+    # drop decorators so re-exec doesn't recurse through @declarative;
+    # with MULTIPLE stacked decorators that would silently strip the
+    # inner ones — leave such functions unconverted
+    if len(fdef.decorator_list) > 1:
+        return fn
+    fdef.decorator_list = []
+    before = ast.dump(tree)
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    if ast.dump(new_tree) == before:
+        return fn  # nothing convertible: keep the original object
+    code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                   mode="exec")
+    glb = dict(fn.__globals__)
+    # read-only closures survive as globals in the re-executed source
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:  # empty cell
+                return fn
+    glb["__jst_convert_ifelse"] = convert_ifelse
+    glb["__jst_convert_while"] = convert_while_loop
+    glb["__jst_undef"] = _UNDEF
+    exec(code, glb)
+    out = glb[fn.__name__]
+    out = functools.wraps(fn)(out)
+    out.__wrapped_original__ = fn
+    return out
+
+
+def declarative(fn: Callable) -> Callable:
+    """@declarative: convert control flow, then behave like the plain
+    function — combine with paddle.jit.to_static / jax.jit for
+    compilation."""
+    return convert_to_static(fn)

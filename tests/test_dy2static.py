@@ -1,0 +1,129 @@
+"""dy2static AST-conversion tests (reference:
+unittests/dygraph_to_static/test_ifelse.py, test_loop.py analogs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (convert_ifelse, convert_to_static,
+                                      convert_while_loop, declarative)
+
+
+# --------------------------------------------------------- runtime helpers
+def test_convert_ifelse_eager_python_bool():
+    out = convert_ifelse(True, lambda: (1,), lambda: (2,))
+    assert out == (1,)
+    out = convert_ifelse(paddle.to_tensor(0.0) > 1.0,
+                         lambda: (paddle.ones([2]),),
+                         lambda: (paddle.zeros([2]),))
+    np.testing.assert_allclose(out[0].numpy(), 0.0)
+
+
+def test_convert_while_eager():
+    out = convert_while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: (i + 1, s + i),
+        (paddle.to_tensor(0), paddle.to_tensor(0)))
+    assert int(out[1]) == 0 + 1 + 2 + 3 + 4
+
+
+# -------------------------------------------------------------- converted
+def test_declarative_if_traces_under_jit():
+    import jax
+
+    @declarative
+    def f(x):
+        if (x.sum() > 0.0):
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(pos).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(f(neg).numpy(), [-2.0, -3.0])
+
+    # under jax.jit the same function traces to ONE program w/ lax.cond
+    traced = paddle.jit.to_static(f)
+    np.testing.assert_allclose(traced(pos).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(traced(neg).numpy(), [-2.0, -3.0])
+
+
+def test_declarative_while_traces():
+    @declarative
+    def cumsum_until(x, limit):
+        total = paddle.zeros([])
+        i = paddle.zeros([], "int32")
+        while total < limit:
+            total = total + x[i]
+            i = i + 1
+        return total, i
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    total, i = cumsum_until(x, paddle.to_tensor(5.0))
+    assert float(total) == 6.0 and int(i) == 3
+
+    traced = paddle.jit.to_static(cumsum_until)
+    total2, i2 = traced(x, paddle.to_tensor(5.0))
+    assert float(total2) == 6.0 and int(i2) == 3
+
+
+def test_python_if_untouched():
+    @declarative
+    def f(x, flag):
+        if flag:  # plain python bool stays python
+            return x + 1.0
+        return x - 1.0
+
+    x = paddle.to_tensor(np.array([0.0], np.float32))
+    np.testing.assert_allclose(f(x, True).numpy(), [1.0])
+    np.testing.assert_allclose(f(x, False).numpy(), [-1.0])
+
+
+def test_if_with_return_left_to_python():
+    # returns inside branches can't cross lax.cond: stays python and
+    # still works eagerly
+    @declarative
+    def f(x):
+        if float(x.sum()) > 0:
+            return x * 10.0
+        return x
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([1.0], np.float32))).numpy(),
+        [10.0])
+
+
+def test_nested_if_in_while():
+    @declarative
+    def f(n):
+        i = paddle.zeros([], "int32")
+        acc = paddle.zeros([])
+        while i < n:
+            if (i % 2) == 0:
+                acc = acc + 1.0
+            else:
+                acc = acc + 10.0
+            i = i + 1
+        return acc
+
+    out = f(paddle.to_tensor(np.int32(4)))
+    assert float(out) == 22.0  # 1 + 10 + 1 + 10
+    traced = paddle.jit.to_static(f)
+    assert float(traced(paddle.to_tensor(np.int32(4)))) == 22.0
+
+
+def test_closure_function_converts():
+    scale = 3.0
+
+    @declarative
+    def f(x):
+        if (x.sum() > 0.0):
+            y = x * scale
+        else:
+            y = x
+        return y
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([2.0], np.float32))).numpy(),
+        [6.0])
