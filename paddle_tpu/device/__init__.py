@@ -14,3 +14,38 @@ def is_compiled_with_rocm() -> bool:
 
 def is_compiled_with_xpu() -> bool:
     return False
+
+
+def memory_stats(device=None):
+    """Per-device allocator stats (≈ paddle.device.cuda memory APIs over
+    memory/stats.h). `device` may be None (the set_device()-selected
+    device), a 'tpu:N'/'cpu' string, an int index, or a jax device.
+    Returns the PJRT allocator stats dict, or {} when the backend
+    doesn't expose them (e.g. tunneled devices)."""
+    import jax
+    from ..core import device as core_device
+    if device is None:
+        dev = core_device.current_place().jax_device
+    elif isinstance(device, (str, int)):
+        spec = device if isinstance(device, str) else \
+            f"{core_device._parse(core_device.get_device())[0]}:{device}"
+        plat, idx = core_device._parse(spec)
+        devs = [d for d in jax.devices() if d.platform == plat]
+        if idx >= len(devs):
+            raise ValueError(f"device {device!r} out of range "
+                             f"({len(devs)} {plat} devices)")
+        dev = devs[idx]
+    else:
+        dev = device
+    stats = dev.memory_stats()  # None when the backend lacks stats
+    return dict(stats) if stats else {}
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes allocated on the device (0 if unavailable)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None) -> int:
+    """Current bytes in use on the device (0 if unavailable)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))

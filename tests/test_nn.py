@@ -120,3 +120,11 @@ def test_named_parameters_unique():
     m = M()
     names = [n for n, _ in m.named_parameters()]
     assert len(names) == 2  # shared params counted once
+
+
+def test_device_memory_stats_api():
+    import paddle_tpu as paddle
+    stats = paddle.device.memory_stats()
+    assert isinstance(stats, dict)
+    assert paddle.device.max_memory_allocated() >= 0
+    assert paddle.device.memory_allocated() >= 0
