@@ -82,6 +82,11 @@ def _assert_close(out, expected, rtol, atol, tag):
     exp_leaves = expected if isinstance(expected, (list, tuple)) else \
         [expected]
     for o, e in zip(out_leaves, exp_leaves):
+        o_arr, e_arr = np.asarray(o), np.asarray(e)
+        # complex outputs compare as complex (casting to float64 would
+        # silently drop the imaginary part)
+        dt = np.complex128 if (np.iscomplexobj(o_arr) or
+                               np.iscomplexobj(e_arr)) else np.float64
         np.testing.assert_allclose(
-            np.asarray(o, np.float64), np.asarray(e, np.float64),
+            o_arr.astype(dt), e_arr.astype(dt),
             rtol=rtol, atol=atol, err_msg=f"[{tag}] output mismatch")
