@@ -37,8 +37,12 @@ from .ops.manipulation import (broadcast_to, chunk, concat, expand,  # noqa: F40
                                squeeze, stack, take_along_axis, tile,
                                topk, transpose, unbind, unique, unsqueeze,
                                where)
-from .ops.manipulation import bucketize, diff, searchsorted  # noqa: F401
-from .ops.math import diagonal, kron, lerp, trace  # noqa: F401
+from .ops.manipulation import (bucketize, diff,  # noqa: F401
+                               index_sample, searchsorted, take)
+from .ops.math import (addmm, cummax, cummin, diagonal,  # noqa: F401
+                       frac, gcd, heaviside, hypot, inner, kron, lcm,
+                       lerp, logaddexp, logcumsumexp, nanmean,
+                       nanmedian, nansum, outer, trace, vander)
 from .ops.math import (abs, add, all, allclose, any, argmax,  # noqa: F401
                        argmin, cast, ceil, clip, cos, cumprod, cumsum,
                        divide, equal, equal_all, exp, floor, floor_divide,

@@ -446,3 +446,36 @@ diff = op("diff")(
     lambda x, n=1, axis=-1, prepend=None, append=None:
     jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append))
 
+
+
+@op("take")
+def take(x, index, mode="raise"):
+    """Flat-index gather (paddle.take): mode raise (bounds-checked
+    eagerly; clipped under jit where data-dependent raises are
+    impossible), wrap (modulo), clip."""
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = idx % flat.shape[0]
+    elif mode == "clip":
+        idx = jnp.clip(idx, -flat.shape[0], flat.shape[0] - 1)
+    elif mode == "raise":
+        if not isinstance(idx, jax.core.Tracer):
+            import numpy as _np
+            bad = _np.asarray((idx >= flat.shape[0]) |
+                              (idx < -flat.shape[0]))
+            if bad.any():
+                raise IndexError(
+                    f"take: index out of range for {flat.shape[0]} "
+                    "elements")
+        idx = jnp.clip(idx, -flat.shape[0], flat.shape[0] - 1)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    return jnp.take(flat, idx)
+
+
+@op("index_sample")
+def index_sample(x, index):
+    """Per-row gather: out[i, j] = x[i, index[i, j]]
+    (paddle.index_sample)."""
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)

@@ -24,7 +24,6 @@ minimum = op("minimum")(jnp.minimum)
 fmax = op("fmax")(jnp.fmax)
 fmin = op("fmin")(jnp.fmin)
 atan2 = op("atan2")(jnp.arctan2)
-hypot = op("hypot")(lambda x, y: jnp.sqrt(x * x + y * y))
 logaddexp = op("logaddexp")(jnp.logaddexp)
 heaviside = op("heaviside", differentiable=False)(jnp.heaviside)
 lerp = op("lerp")(lambda x, y, w: x + w * (y - x))
@@ -129,10 +128,6 @@ cast = op("cast", differentiable=False)(
 
 cumsum = op("cumsum")(lambda x, axis=None: jnp.cumsum(x, axis=axis))
 cumprod = op("cumprod")(lambda x, dim=None: jnp.cumprod(x, axis=dim))
-cummax = op("cummax", differentiable=False)(
-    lambda x, axis=None: jax.lax.cummax(x, axis=axis if axis is not None else 0))
-cummin = op("cummin", differentiable=False)(
-    lambda x, axis=None: jax.lax.cummin(x, axis=axis if axis is not None else 0))
 @op("logcumsumexp")
 def logcumsumexp(x, axis=None):
     if axis is None:
@@ -220,3 +215,52 @@ addmm = op("addmm")(
     lambda input, x, y, beta=1.0, alpha=1.0:
     beta * input + alpha * jnp.matmul(x, y))
 
+
+
+# -------------------------------------------------- cumulative / nan-aware
+def _cum_extreme(arr, ax, better):
+    """One (value, index) associative scan; ties keep the FIRST
+    occurrence (paddle). Indices are int32 (jax default index width)."""
+    n = arr.shape[ax]
+    idx0 = jnp.arange(n, dtype=jnp.int32).reshape(
+        [-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
+    idx0 = jnp.broadcast_to(idx0, arr.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = better(bv, av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    return jax.lax.associative_scan(combine, (arr, idx0), axis=ax)
+
+
+@op("cummax")
+def cummax(x, axis=None):
+    """Returns (values, indices) like paddle.cummax."""
+    arr = x.reshape(-1) if axis is None else x
+    return _cum_extreme(arr, 0 if axis is None else axis,
+                        lambda b, a: b > a)
+
+
+@op("cummin")
+def cummin(x, axis=None):
+    arr = x.reshape(-1) if axis is None else x
+    return _cum_extreme(arr, 0 if axis is None else axis,
+                        lambda b, a: b < a)
+
+
+nanmean = op("nanmean")(
+    lambda x, axis=None, keepdim=False:
+    jnp.nanmean(x, axis=axis, keepdims=keepdim))
+nansum = op("nansum")(
+    lambda x, axis=None, keepdim=False, dtype=None:
+    jnp.nansum(x, axis=axis, keepdims=keepdim, dtype=dtype))
+nanmedian = op("nanmedian")(
+    lambda x, axis=None, keepdim=False:
+    jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+vander = op("vander")(
+    lambda x, n=None, increasing=False:
+    jnp.vander(x, N=n, increasing=increasing))
+frac = op("frac")(lambda x: x - jnp.trunc(x))
+hypot = op("hypot")(jnp.hypot)

@@ -139,3 +139,46 @@ def test_grid_sample_grad_flows():
     out.sum().backward()
     assert x.grad is not None
     assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_cumulative_and_nan_ops():
+    x = _t(np.array([3.0, 1.0, 4.0, 1.0, 5.0], np.float32))
+    v, i = paddle.cummax(x)
+    np.testing.assert_allclose(v.numpy(), [3, 3, 4, 4, 5])
+    np.testing.assert_array_equal(i.numpy(), [0, 0, 2, 2, 4])
+    v2, i2 = paddle.cummin(x)
+    np.testing.assert_allclose(v2.numpy(), [3, 1, 1, 1, 1])
+    np.testing.assert_array_equal(i2.numpy(), [0, 1, 1, 1, 1])
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(x).numpy(),
+        np.logaddexp.accumulate(x.numpy()), rtol=1e-4)
+    # axis=None on 2-D flattens (paddle semantics)
+    m2 = _t(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    assert paddle.logcumsumexp(m2).numpy().shape == (6,)
+    # take modes
+    t = _t(np.arange(6))
+    np.testing.assert_array_equal(
+        paddle.take(t, _t(np.array([7, -8], np.int32)),
+                    mode="wrap").numpy(), [1, 4])
+    with pytest.raises(IndexError):
+        paddle.take(t, _t(np.array([9], np.int32)))
+    m = _t(np.array([[1.0, np.nan], [2.0, 3.0]], np.float32))
+    assert float(paddle.nanmean(m)) == pytest.approx(2.0)
+    assert float(paddle.nansum(m)) == pytest.approx(6.0)
+    np.testing.assert_allclose(paddle.frac(_t(np.array([1.5, -1.5]))).numpy(),
+                               [0.5, -0.5])
+    np.testing.assert_allclose(
+        paddle.hypot(_t(np.array([3.0])), _t(np.array([4.0]))).numpy(),
+        [5.0])
+
+
+def test_take_and_index_sample():
+    x = _t(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        paddle.take(x, _t(np.array([0, 4], np.int32))).numpy(), [0, 4])
+    np.testing.assert_allclose(
+        paddle.index_sample(x, _t(np.array([[2], [0]], np.int32)))
+        .numpy().ravel(), [2, 3])
+    np.testing.assert_allclose(
+        paddle.vander(_t(np.array([1.0, 2.0], np.float32)), n=3).numpy(),
+        np.vander(np.array([1.0, 2.0]), N=3))
