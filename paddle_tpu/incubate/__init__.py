@@ -9,3 +9,6 @@ __all__ = ["asp"]
 from . import autograd  # noqa: F401,E402
 
 __all__.append("autograd")
+from . import nn  # noqa: F401,E402
+
+__all__.append("nn")

@@ -1,0 +1,14 @@
+"""paddle.incubate.nn analog — fused transformer layers.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward,
+FusedMultiTransformer:997) backed by the hand-fused CUDA ops
+(operators/fused/fused_attention_op.cu, fused_feedforward_op.cu,
+fused_multi_transformer_op.cu). TPU-native: "fused" means the Pallas
+flash-attention kernel plus XLA's fusion of the surrounding
+elementwise/norm work — one Layer maps to the same single-kernel-ish
+schedule the reference hand-writes.
+"""
+from .fused_transformer import (FusedFeedForward,  # noqa: F401
+                                FusedMultiHeadAttention,
+                                FusedMultiTransformer)

@@ -1,0 +1,120 @@
+"""Fused transformer layers (reference fused_transformer.py surface)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn import functional as F
+from ...nn.container import LayerList
+from ...nn.layer import Layer
+from ...nn.layers_common import Dropout, LayerNorm, Linear
+from ...ops.manipulation import reshape, transpose
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedMultiTransformer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with the QKV projection in
+    ONE matmul and attention through
+    F.scaled_dot_product_attention (Pallas flash kernel when eligible)
+    — the schedule fused_attention_op.cu hand-fuses."""
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 dropout_rate: float = 0.0,
+                 attn_dropout_rate: float = 0.0,
+                 normalize_before: bool = False,
+                 need_weights: bool = False, epsilon: float = 1e-5):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                "embed_dim must be divisible by num_heads")
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True materializes the attention matrix "
+                "and defeats the fused path")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv_proj = Linear(embed_dim, 3 * embed_dim)
+        self.out_proj = Linear(embed_dim, embed_dim)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, x, attn_mask=None):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        b, s = x.shape[0], x.shape[1]
+        qkv = reshape(self.qkv_proj(x),
+                      [b, s, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = self.out_proj(reshape(out, [b, s, self.embed_dim]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """LN + two matmuls + activation; XLA fuses the elementwise tail
+    into the matmuls (fused_feedforward_op.cu analog)."""
+
+    def __init__(self, d_model: int, dim_feedforward: int,
+                 dropout_rate: float = 0.1, activation: str = "relu",
+                 normalize_before: bool = False, epsilon: float = 1e-5):
+        super().__init__()
+        self.fc1 = Linear(d_model, dim_feedforward)
+        self.fc2 = Linear(dim_feedforward, d_model)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.normalize_before = normalize_before
+        self.activation = getattr(F, activation)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.norm(x)
+        x = self.fc2(self.dropout(self.activation(self.fc1(x))))
+        out = residual + self.dropout(x)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedMultiTransformer(Layer):
+    """Stack of fused attention+FFN blocks
+    (fused_multi_transformer_op analog; reference
+    incubate/nn/layer/fused_transformer.py:997). Pre-LN like the
+    reference's default inference configuration."""
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 dim_feedforward: int, num_layers: int = 1,
+                 dropout_rate: float = 0.0, activation: str = "gelu",
+                 normalize_before: bool = True, epsilon: float = 1e-5):
+        super().__init__()
+        self.attns = LayerList([
+            FusedMultiHeadAttention(embed_dim, num_heads,
+                                    dropout_rate=dropout_rate,
+                                    attn_dropout_rate=dropout_rate,
+                                    normalize_before=normalize_before,
+                                    epsilon=epsilon)
+            for _ in range(num_layers)])
+        self.ffns = LayerList([
+            FusedFeedForward(embed_dim, dim_feedforward,
+                             dropout_rate=dropout_rate,
+                             activation=activation,
+                             normalize_before=normalize_before,
+                             epsilon=epsilon)
+            for _ in range(num_layers)])
+
+    def forward(self, x, attn_mask=None):
+        for attn, ffn in zip(self.attns, self.ffns):
+            x = ffn(attn(x, attn_mask=attn_mask))
+        return x

@@ -458,7 +458,9 @@ def take(x, index, mode="raise"):
     if mode == "wrap":
         idx = idx % flat.shape[0]
     elif mode == "clip":
-        idx = jnp.clip(idx, -flat.shape[0], flat.shape[0] - 1)
+        # paddle/numpy clip semantics: clamp into [0, n-1] (negative
+        # indices clip to 0, they do not wrap)
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
     elif mode == "raise":
         if not isinstance(idx, jax.core.Tracer):
             import numpy as _np

@@ -254,8 +254,10 @@ nanmean = op("nanmean")(
     lambda x, axis=None, keepdim=False:
     jnp.nanmean(x, axis=axis, keepdims=keepdim))
 nansum = op("nansum")(
-    lambda x, axis=None, keepdim=False, dtype=None:
-    jnp.nansum(x, axis=axis, keepdims=keepdim, dtype=dtype))
+    lambda x, axis=None, dtype=None, keepdim=False:
+    jnp.nansum(x, axis=axis, keepdims=keepdim,
+               dtype=jnp.dtype(dtype) if isinstance(dtype, str)
+               else dtype))
 nanmedian = op("nanmedian")(
     lambda x, axis=None, keepdim=False:
     jnp.nanmedian(x, axis=axis, keepdims=keepdim))
