@@ -131,5 +131,41 @@ __all__ = [
     "default_main_program", "default_startup_program", "append_backward",
     "name_scope", "Executor", "Scope", "global_scope", "CompiledProgram",
     "save_inference_model", "load_inference_model", "InputSpec", "nn",
-    "in_static_build",
+    "in_static_build", "create_array", "array_write", "array_read",
+    "array_length",
 ]
+
+
+# ------------------------------------------------------------ TensorArray
+# Reference: LoDTensorArray + array_write/array_read/array_length ops
+# (paddle/fluid/operators/tensor_array_*): the dynamic tensor list used
+# with static while_loop. TPU-native: a python list in eager/recorded
+# code; inside lax loops use lax.scan/dynamic_update_slice instead
+# (dynamic-length arrays cannot live in a traced carry).
+
+
+def create_array(dtype="float32"):
+    """An empty TensorArray (python-list backed)."""
+    return []
+
+
+def array_write(x, i, array=None):
+    """Write x at index i (>= 0); grows the array like the reference."""
+    if array is None:
+        array = []
+    idx = int(i)
+    if idx < 0:
+        raise ValueError(f"array_write index must be >= 0, got {idx}")
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_length(array):
+    from .. import to_tensor
+    return to_tensor(len(array))  # int32 (jax default index width)

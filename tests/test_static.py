@@ -282,3 +282,13 @@ def test_eager_unaffected_outside_guard():
     out = t * 3.0
     assert not hasattr(out, "_static_name")
     np.testing.assert_allclose(out.numpy(), 3 * np.ones((2, 2)))
+
+
+def test_tensor_array_ops():
+    arr = static.create_array()
+    static.array_write(paddle.ones([2]), 0, arr)
+    static.array_write(paddle.full([2], 5.0), 2, arr)
+    assert int(static.array_length(arr)) == 3
+    np.testing.assert_allclose(static.array_read(arr, 0).numpy(), 1.0)
+    np.testing.assert_allclose(static.array_read(arr, 2).numpy(), 5.0)
+    assert static.array_read(arr, 1) is None
