@@ -46,15 +46,6 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
-    def __post_init__(self):
-        if self.moe_num_experts > 0 and self.use_recompute:
-            # l_aux is carried out of the block as a layer attribute;
-            # jax.checkpoint would leak that tracer out of its scope
-            raise ValueError(
-                "moe_num_experts > 0 is not yet compatible with "
-                "use_recompute: the MoE aux loss cannot escape the "
-                "rematerialized block; disable one of the two")
-
     @property
     def ffn_size(self) -> int:
         return self.intermediate_size or 4 * self.hidden_size
@@ -183,6 +174,21 @@ def _lm_logits(x, head, wte_weight):
     return sharded_constraint(logits, P(("dp", "sharding"), None, "mp"))
 
 
+class _AuxBlock(Layer):
+    """Adapter returning (x, moe_aux) so the aux loss crosses the
+    jax.checkpoint boundary as a RETURN VALUE (an attribute set inside
+    the remat scope would leak its tracer)."""
+
+    def __init__(self, block: "GPTBlock"):
+        super().__init__()
+        self.block = block
+
+    def forward(self, x, attn_mask=None):
+        out = self.block(x, attn_mask)
+        # MoEMLP.forward always sets l_aux to a scalar Tensor
+        return out, self.block.mlp.l_aux
+
+
 class GPTModel(Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -192,13 +198,33 @@ class GPTModel(Layer):
                                  for _ in range(cfg.num_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size,
                               epsilon=cfg.layer_norm_epsilon)
+        if cfg.moe_num_experts > 0:
+            # plain list, NOT a LayerList: the adapters wrap blocks that
+            # are already registered via self.blocks — registering them
+            # again would duplicate every parameter in state_dict
+            self._aux_blocks = [_AuxBlock(b) for b in self.blocks]
+        #: total MoE aux loss of the last recompute-mode forward (same
+        #: trace); None when the plain path ran (read l_aux attrs then)
+        self._moe_aux = None
 
     def forward(self, input_ids, attn_mask=None):
         x = self.embed(input_ids)
-        for block in self.blocks:
-            if self.cfg.use_recompute and self.training:
-                x = recompute(block, x, attn_mask, policy="save_dots")
-            else:
+        self._moe_aux = None
+        moe = self.cfg.moe_num_experts > 0
+        if self.cfg.use_recompute and self.training:
+            aux_total = None
+            for i, block in enumerate(self.blocks):
+                if moe:
+                    x, aux = recompute(self._aux_blocks[i], x, attn_mask,
+                                       policy="save_dots")
+                    aux_total = aux if aux_total is None \
+                        else aux_total + aux
+                else:
+                    x = recompute(block, x, attn_mask,
+                                  policy="save_dots")
+            self._moe_aux = aux_total
+        else:
+            for block in self.blocks:
                 x = block(x, attn_mask)
         return self.ln_f(x)
 
@@ -230,8 +256,13 @@ class GPTForCausalLM(Layer):
             targets.reshape([-1]))
         if self is not None and getattr(self, "cfg", None) is not None \
                 and self.cfg.moe_num_experts > 0:
-            from ..distributed.parallel.moe import aux_loss
-            ce = ce + self.cfg.moe_aux_weight * aux_loss(self)
+            carried = getattr(self.gpt, "_moe_aux", None)
+            if carried is not None:  # recompute path: aux was returned
+                aux = carried
+            else:
+                from ..distributed.parallel.moe import aux_loss
+                aux = aux_loss(self)
+            ce = ce + self.cfg.moe_aux_weight * aux
         return ce
 
     def num_params(self) -> int:

@@ -189,3 +189,41 @@ class TestGPTMoE:
             l = float(step(paddle.to_tensor(ids),
                            paddle.to_tensor(ids.astype(np.int64))))
         assert np.isfinite(l) and l < l0, f"GPT-MoE not training {l0}->{l}"
+
+
+def test_moe_composes_with_recompute():
+    """Aux loss crosses the jax.checkpoint boundary as a return value
+    (previously rejected in GPTConfig.__post_init__)."""
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models.gpt import gpt
+
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy(
+        hybrid_configs={"dp_degree": 2, "sp_degree": 2, "ep_degree": 2})
+    fleet.init(strategy=strategy)
+    model = gpt("test-tiny", use_recompute=True, moe_num_experts=4,
+                moe_capacity_factor=2.0)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    step = fleet.DistributedTrainStep(
+        model, opt, lambda lo, la: model.loss(lo, la))
+    ids = np.random.RandomState(0).randint(0, 512, (4, 32)).astype(
+        np.int32)
+    loss = float(step(paddle.to_tensor(ids),
+                      paddle.to_tensor(ids.astype(np.int64))))
+    assert np.isfinite(loss)
+
+    # aux term contributes (weight 0 gives a smaller loss)
+    paddle.seed(0)
+    m2 = gpt("test-tiny", use_recompute=True, moe_num_experts=4,
+             moe_capacity_factor=2.0, moe_aux_weight=0.0)
+    o2 = optimizer.AdamW(learning_rate=1e-4, parameters=m2.parameters())
+    s2 = fleet.DistributedTrainStep(m2, o2,
+                                    lambda lo, la: m2.loss(lo, la))
+    loss0 = float(s2(paddle.to_tensor(ids),
+                     paddle.to_tensor(ids.astype(np.int64))))
+    assert loss > loss0
+    # adapters must not duplicate parameters
+    names = [n for n, _ in model.named_parameters()]
+    assert len(names) == len(set(names))
