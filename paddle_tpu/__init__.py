@@ -66,12 +66,38 @@ from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .framework_io import load, save  # noqa: F401,E402
+
+
+# -- mode toggles (paddle.enable_static/disable_static; TPU build is
+# dygraph-first — static building happens inside static.program_guard,
+# so these track intent for API parity and in_dynamic_mode()) ----------
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    from .core import static_hook
+    return not _static_mode and not static_hook.enabled
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in the TPU stack (parity shim)
 from .jit.api import grad, value_and_grad  # noqa: F401,E402
 from .nn.functional.common import (pixel_shuffle,  # noqa: F401,E402
                                    pixel_unshuffle)
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
-_LAZY = {"audio", "distributed", "distribution", "fft", "geometric", "linalg",
+_LAZY = {"audio", "distributed", "distribution", "fft", "geometric",
+         "linalg", "version",
          "models", "vision", "kernels", "hapi", "onnx", "profiler",
          "incubate", "inference", "quantization", "signal", "sparse",
          "static", "text", "utils"}

@@ -1,0 +1,58 @@
+"""paddle.version analog (python/paddle/version.py is generated at
+build time with commit/version info)."""
+import subprocess as _sp
+
+from . import __version__ as full_version  # single source of truth
+
+major, minor, patch = full_version.split(".")[:3]
+rc = "0"
+cuda_version = "False"   # no CUDA anywhere in this stack
+cudnn_version = "False"
+xpu_version = "False"
+tpu = True
+
+
+def _commit() -> str:
+    """Commit of the paddle_tpu checkout ITSELF — only trust git if the
+    repo root actually contains this package (a wheel inside someone
+    else's checkout must report 'unknown', not their HEAD)."""
+    import os
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    try:
+        top = _sp.run(["git", "rev-parse", "--show-toplevel"],
+                      cwd=pkg_dir, capture_output=True, text=True,
+                      timeout=5).stdout.strip()
+        if not top or not os.path.dirname(pkg_dir).startswith(top):
+            return "unknown"
+        out = _sp.run(["git", "rev-parse", "HEAD"], cwd=pkg_dir,
+                      capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or "unknown"
+    except (OSError, _sp.TimeoutExpired):
+        return "unknown"
+
+
+_commit_cache = None
+
+
+def __getattr__(name):
+    # commit resolved lazily: no subprocess on plain `paddle.version`
+    global _commit_cache
+    if name == "commit":
+        if _commit_cache is None:
+            _commit_cache = _commit()
+        return _commit_cache
+    raise AttributeError(name)
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {__getattr__('commit')}")
+    print("tpu: True (jax/XLA backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -128,3 +128,16 @@ def test_device_memory_stats_api():
     assert isinstance(stats, dict)
     assert paddle.device.max_memory_allocated() >= 0
     assert paddle.device.memory_allocated() >= 0
+
+
+def test_version_and_mode_toggles():
+    import paddle_tpu as paddle
+    assert paddle.version.full_version
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    assert paddle.get_cudnn_version() is None
