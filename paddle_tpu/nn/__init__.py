@@ -21,3 +21,8 @@ from .transformer import (  # noqa: F401
 from .rnn import (  # noqa: F401
     GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell,
 )
+from .layers_extra import (  # noqa: F401
+    Bilinear, CosineSimilarity, Fold, Identity, Pad1D, Pad2D, Pad3D,
+    PairwiseDistance, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D, ZeroPad2D,
+)

@@ -1,0 +1,270 @@
+"""Additional paddle.nn layers: upsampling, padding, similarity, fold
+(≈ python/paddle/nn/layer/common.py Upsample/Pad*/Identity/Bilinear/
+CosineSimilarity/PairwiseDistance and layer/unfold.py)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..ops.op_registry import op
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["Identity", "Upsample", "UpsamplingNearest2D",
+           "UpsamplingBilinear2D", "Pad1D", "Pad2D", "Pad3D",
+           "ZeroPad2D", "Bilinear", "CosineSimilarity",
+           "PairwiseDistance", "Unfold", "Fold"]
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        from .functional.common import interpolate
+        return interpolate(x, size=self.size,
+                           scale_factor=self.scale_factor,
+                           mode=self.mode,
+                           align_corners=self.align_corners,
+                           data_format=self.data_format)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW", name=None):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="nearest", data_format=data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW", name=None):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="bilinear", align_corners=True,
+                         data_format=data_format)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self.padding = list(padding) if isinstance(
+            padding, (list, tuple)) else [padding] * self._pairs * 2
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ..ops.manipulation import pad as _pad
+        return _pad(x, self.padding, mode=self.mode, value=self.value,
+                    data_format=self.data_format)
+
+
+class Pad1D(_PadNd):
+    _pairs = 1
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad2D(_PadNd):
+    _pairs = 2
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad3D(_PadNd):
+    _pairs = 3
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+@op("bilinear_form")
+def _bilinear_impl(x1, x2, weight, bias):
+    # weight [out, in1, in2]: out_o = x1 W_o x2^T (+ b)
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class Bilinear(Layer):
+    """out = x1^T W x2 + b (paddle.nn.Bilinear)."""
+
+    def __init__(self, in1_features: int, in2_features: int,
+                 out_features: int, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_features,), attr=bias_attr,
+                default_initializer=I.Uniform(-bound, bound),
+                is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x1, x2):
+        return _bilinear_impl(x1, x2, self.weight, self.bias)
+
+
+@op("cosine_similarity")
+def _cos_sim_impl(x1, x2, axis=1, eps=1e-8):
+    dot = (x1 * x2).sum(axis=axis)
+    n1 = jnp.sqrt((x1 * x1).sum(axis=axis))
+    n2 = jnp.sqrt((x2 * x2).sum(axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return _cos_sim_impl(x1, x2, axis=self.axis, eps=self.eps)
+
+
+@op("pairwise_distance")
+def _pairwise_impl(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.power(jnp.power(jnp.abs(d), p).sum(-1, keepdims=keepdim),
+                     1.0 / p)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return _pairwise_impl(x, y, p=self.p, epsilon=self.epsilon,
+                              keepdim=self.keepdim)
+
+
+@op("unfold")
+def _unfold_impl(x, kernel_sizes, strides, paddings, dilations):
+    """im2col: [N, C, H, W] -> [N, C*kh*kw, L] (paddle.nn.functional.
+    unfold; phi/kernels/unfold_kernel.h)."""
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    pt, pl, pb, pr = _pads4(paddings)
+    dh, dw = dilations
+    x = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+    oh = (h + pt + pb - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + pl + pr - dw * (kw - 1) - 1) // sw + 1
+    rows = (jnp.arange(oh) * sh)[:, None] + (jnp.arange(kh) * dh)[None]
+    cols = (jnp.arange(ow) * sw)[:, None] + (jnp.arange(kw) * dw)[None]
+    # gather [N, C, oh, kh, ow, kw]
+    patches = x[:, :, rows[:, :, None, None], cols[None, None, :, :]]
+    # -> [N, C, kh, kw, oh, ow] -> [N, C*kh*kw, oh*ow]
+    patches = jnp.transpose(patches, (0, 1, 3, 5, 2, 4))
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v, v]
+
+
+def _pads4(paddings):
+    """paddle accepts int, [ph, pw], or [top, left, bottom, right]."""
+    if len(paddings) == 2:
+        ph, pw = paddings
+        return ph, pw, ph, pw
+    if len(paddings) == 4:
+        return tuple(paddings)
+    raise ValueError(f"paddings must have 2 or 4 entries, got {paddings}")
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes = _pair(kernel_sizes)
+        self.strides = _pair(strides)
+        self.paddings = _pair(paddings)
+        self.dilations = _pair(dilations)
+
+    def forward(self, x):
+        return _unfold_impl(x, kernel_sizes=self.kernel_sizes,
+                            strides=self.strides,
+                            paddings=self.paddings,
+                            dilations=self.dilations)
+
+
+@op("fold")
+def _fold_impl(x, output_sizes, kernel_sizes, strides, paddings,
+               dilations):
+    """col2im (inverse of unfold, overlaps summed)."""
+    n, ckk, length = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    pt, pl, pb, pr = _pads4(paddings)
+    dh, dw = dilations
+    oh_out, ow_out = output_sizes
+    c = ckk // (kh * kw)
+    hp, wp = oh_out + pt + pb, ow_out + pl + pr
+    oh = (hp - dh * (kh - 1) - 1) // sh + 1
+    ow = (wp - dw * (kw - 1) - 1) // sw + 1
+    patches = x.reshape(n, c, kh, kw, oh, ow)
+    patches = jnp.transpose(patches, (0, 1, 4, 2, 5, 3))
+    rows = (jnp.arange(oh) * sh)[:, None] + (jnp.arange(kh) * dh)[None]
+    cols = (jnp.arange(ow) * sw)[:, None] + (jnp.arange(kw) * dw)[None]
+    out = jnp.zeros((n, c, hp, wp), x.dtype)
+    out = out.at[:, :, rows[:, :, None, None],
+                 cols[None, None, :, :]].add(patches)
+    return out[:, :, pt:hp - pb if pb else hp,
+               pl:wp - pr if pr else wp]
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = _pair(output_sizes)
+        self.kernel_sizes = _pair(kernel_sizes)
+        self.strides = _pair(strides)
+        self.paddings = _pair(paddings)
+        self.dilations = _pair(dilations)
+
+    def forward(self, x):
+        return _fold_impl(x, output_sizes=self.output_sizes,
+                          kernel_sizes=self.kernel_sizes,
+                          strides=self.strides,
+                          paddings=self.paddings,
+                          dilations=self.dilations)

@@ -22,7 +22,9 @@ def _commit() -> str:
         top = _sp.run(["git", "rev-parse", "--show-toplevel"],
                       cwd=pkg_dir, capture_output=True, text=True,
                       timeout=5).stdout.strip()
-        if not top or not os.path.dirname(pkg_dir).startswith(top):
+        # the repo root must be EXACTLY the package's parent dir —
+        # git finds some ancestor repo for any installed wheel too
+        if not top or top != os.path.dirname(pkg_dir):
             return "unknown"
         out = _sp.run(["git", "rev-parse", "HEAD"], cwd=pkg_dir,
                       capture_output=True, text=True, timeout=5)

@@ -141,3 +141,41 @@ def test_version_and_mode_toggles():
         paddle.disable_static()
     assert paddle.in_dynamic_mode()
     assert paddle.get_cudnn_version() is None
+
+
+def test_extra_layers_upsample_pad_bilinear():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn as pnn
+    x = paddle.randn([1, 2, 4, 4])
+    assert tuple(pnn.Upsample(scale_factor=2)(x).shape) == (1, 2, 8, 8)
+    assert tuple(pnn.UpsamplingBilinear2D(size=(6, 6))(x).shape) \
+        == (1, 2, 6, 6)
+    assert tuple(pnn.ZeroPad2D([1, 1, 2, 2])(x).shape) == (1, 2, 8, 6)
+    assert tuple(pnn.Identity()(x).shape) == (1, 2, 4, 4)
+    out = pnn.Bilinear(3, 4, 5)(paddle.randn([2, 3]),
+                                paddle.randn([2, 4]))
+    assert tuple(out.shape) == (2, 5)
+    cs = pnn.CosineSimilarity(axis=1)(paddle.ones([2, 3]),
+                                      paddle.ones([2, 3]))
+    np.testing.assert_allclose(cs.numpy(), 1.0, rtol=1e-6)
+    dist = pnn.PairwiseDistance()(paddle.zeros([2, 3]),
+                                  paddle.ones([2, 3]))
+    np.testing.assert_allclose(dist.numpy(), np.sqrt(3), rtol=1e-4)
+
+
+def test_unfold_fold_match_torch():
+    import torch
+    import paddle_tpu as paddle
+    from paddle_tpu import nn as pnn
+    img = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 2, 6, 6).astype(np.float32))
+    p_uf = pnn.Unfold(kernel_sizes=3, strides=1, paddings=1)(img)
+    t_uf = torch.nn.functional.unfold(torch.tensor(img.numpy()),
+                                      kernel_size=3, stride=1,
+                                      padding=1)
+    np.testing.assert_allclose(p_uf.numpy(), t_uf.numpy(), rtol=1e-5)
+    # non-overlapping fold inverts unfold
+    uf = pnn.Unfold(kernel_sizes=2, strides=2)(img)
+    back = pnn.Fold(output_sizes=(6, 6), kernel_sizes=2,
+                    strides=2)(uf)
+    np.testing.assert_allclose(back.numpy(), img.numpy(), rtol=1e-6)
