@@ -8,6 +8,8 @@ plane only (tensor traffic belongs to XLA collectives, not RPC).
 """
 from __future__ import annotations
 
+import hmac
+import hashlib
 import pickle
 import socket
 import socketserver
@@ -18,7 +20,45 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from .store import TCPStore, _recv_msg, _send_msg, free_port
+from .store import TCPStore, _recv_exact, free_port
+
+
+# RPC is a host-side control plane; cap frames so an unauthenticated
+# peer can't force multi-GiB buffering before the HMAC check rejects it
+_MAX_FRAME = 64 << 20
+
+
+def _send_auth(sock: socket.socket, obj, key: bytes,
+               nonce: bytes, direction: bytes) -> None:
+    """Frame: u32 length | 32-byte HMAC-SHA256(nonce|dir|payload) |
+    payload. The server-chosen per-connection nonce makes captured
+    frames worthless on a new connection (no replay), and the
+    direction byte stops reflecting a request back as a response."""
+    payload = pickle.dumps(obj)
+    if len(payload) > _MAX_FRAME:
+        raise ValueError(
+            f"rpc payload of {len(payload)} bytes exceeds the "
+            f"{_MAX_FRAME}-byte frame limit — ship bulk tensors via "
+            "collectives, not rpc")
+    tag = hmac.new(key, nonce + direction + payload,
+                   hashlib.sha256).digest()
+    sock.sendall(struct.pack("!I", len(payload)) + tag + payload)
+
+
+def _recv_auth(sock: socket.socket, key: bytes,
+               nonce: bytes, direction: bytes):
+    """Verify the HMAC before unpickling — frames from peers that do not
+    hold the job's shared secret never reach pickle.loads."""
+    (n,) = struct.unpack("!I", _recv_exact(sock, 4))
+    if n > _MAX_FRAME:
+        raise ConnectionError("rpc frame exceeds size limit")
+    tag = _recv_exact(sock, 32)
+    payload = _recv_exact(sock, n)
+    want = hmac.new(key, nonce + direction + payload,
+                    hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, want):
+        raise ConnectionError("rpc frame failed HMAC authentication")
+    return pickle.loads(payload)
 
 # process-global like the reference (rpc state must be visible from any
 # thread — remote handlers doing nested rpc run on server threads)
@@ -54,14 +94,21 @@ class _RpcServer(socketserver.ThreadingTCPServer):
 
 class _RpcHandler(socketserver.BaseRequestHandler):
     def handle(self):
+        import secrets
+        key = self.server.auth_key  # type: ignore[attr-defined]
         try:
-            fn, args, kwargs = _recv_msg(self.request)
+            nonce = secrets.token_bytes(16)
+            self.request.sendall(nonce)
+            fn, args, kwargs = _recv_auth(self.request, key, nonce, b"q")
             try:
                 result = fn(*args, **kwargs)
-                _send_msg(self.request, ("ok", result))
+                _send_auth(self.request, ("ok", result), key, nonce, b"p")
             except Exception:
-                _send_msg(self.request, ("error", traceback.format_exc()))
-        except (ConnectionError, OSError, pickle.PickleError):
+                _send_auth(self.request,
+                           ("error", traceback.format_exc()),
+                           key, nonce, b"p")
+        except (ConnectionError, OSError, pickle.PickleError,
+                struct.error):
             return
 
 
@@ -70,9 +117,42 @@ class _Rpc:
                  store: TCPStore):
         self.name, self.rank, self.world_size = name, rank, world_size
         self.store = store
+        # Shared job secret: PADDLE_RPC_SECRET env if the launcher set
+        # one (never touches the wire), else rank 0 generates one and
+        # publishes it through the store for the duration of init only
+        # (the store rides the launch-time trusted rendezvous network;
+        # rank 0 deletes the key right after the init barrier). Every
+        # RPC frame is HMAC-authenticated with it before unpickling.
+        import os as _os
+        import secrets as _secrets
+        env_secret = _os.environ.get("PADDLE_RPC_SECRET")
+        if rank == 0:
+            if env_secret:
+                self.auth_key = env_secret.encode()
+                store.set("__rpc/secret", b"__ENV__")
+            else:
+                self.auth_key = _secrets.token_bytes(32)
+                store.set("__rpc/secret", self.auth_key)
+        else:
+            published = store.get("__rpc/secret")
+            if published == b"__ENV__":
+                if not env_secret:
+                    raise RuntimeError(
+                        "rank 0 was launched with PADDLE_RPC_SECRET "
+                        "but this rank's environment lacks it — export "
+                        "the same secret on every host")
+                self.auth_key = env_secret.encode()
+            else:
+                if env_secret and env_secret.encode() != published:
+                    raise RuntimeError(
+                        "this rank has PADDLE_RPC_SECRET set but rank "
+                        "0 does not — export the same secret on every "
+                        "host (or on none)")
+                self.auth_key = published
         # bind all interfaces, advertise the cross-host-reachable address
         # (route toward the master/store host)
         self.server = _RpcServer(("0.0.0.0", 0), _RpcHandler)
+        self.server.auth_key = self.auth_key  # type: ignore[attr-defined]
         self.port = self.server.server_address[1]
         threading.Thread(target=self.server.serve_forever,
                          daemon=True).start()
@@ -83,6 +163,12 @@ class _Rpc:
         store.set(f"__rpc/worker/{name}", info)
         store.set(f"__rpc/rank/{rank}", name)
         store.barrier("rpc_init", world_size)
+        if rank == 0:
+            # narrow the secret's exposure window to init only
+            try:
+                store.delete("__rpc/secret")
+            except Exception:
+                pass
         self.workers: Dict[str, WorkerInfo] = {}
         for r in range(world_size):
             wname = store.get(f"__rpc/rank/{r}")
@@ -92,8 +178,9 @@ class _Rpc:
         info = self.workers[to]
         with socket.create_connection((info.ip, info.port),
                                       timeout=timeout) as s:
-            _send_msg(s, (fn, args, kwargs))
-            status, val = _recv_msg(s)
+            nonce = _recv_exact(s, 16)
+            _send_auth(s, (fn, args, kwargs), self.auth_key, nonce, b"q")
+            status, val = _recv_auth(s, self.auth_key, nonce, b"p")
         if status == "error":
             raise RuntimeError(f"rpc to {to!r} failed:\n{val}")
         return val

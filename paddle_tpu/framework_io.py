@@ -98,16 +98,15 @@ def save(obj: Any, path: str, protocol: int = 4):
         from .native import tensor_store
         payloads: dict = {}
         ser = _extract_payloads(ser, payloads)
-        # pair the pickle and the sidecar with a checkpoint id so a
-        # crash between the two atomic renames can never silently mix
-        # an old structure with new tensors (load verifies the id)
+        # The sidecar is written under a ckpt_id-suffixed name and the
+        # pickle (which records the id) is published last — a writer
+        # killed at any point leaves the previous pickle + its own
+        # sidecar intact, so the last good checkpoint always loads.
         ckpt_id = uuid.uuid4().hex
         blobs = {k: np.ascontiguousarray(
             v.view(np.uint16) if v.dtype == jnp.bfloat16 else v)
             for k, v in payloads.items()}
-        blobs["__ckpt_id__"] = np.frombuffer(
-            ckpt_id.encode(), dtype=np.uint8).copy()
-        tensor_store.save_tensors(path + ".tensors", blobs)
+        tensor_store.save_tensors(f"{path}.tensors.{ckpt_id}", blobs)
         bf16 = sorted(k for k, v in payloads.items()
                       if v.dtype == jnp.bfloat16)
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -116,6 +115,7 @@ def save(obj: Any, path: str, protocol: int = 4):
                          "bf16_keys": bf16, "ckpt_id": ckpt_id}, f,
                         protocol=protocol)
         os.replace(tmp, path)
+        _gc_stale_sidecars(path, keep_id=ckpt_id)
         return
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
@@ -123,21 +123,59 @@ def save(obj: Any, path: str, protocol: int = 4):
     os.replace(tmp, path)
 
 
+_SIDECAR_GC_GRACE_S = 120.0
+
+
+def _gc_stale_sidecars(path: str, keep_id: str):
+    """Remove sidecars from superseded (or crashed) save() calls.
+
+    Recently-modified sidecars are spared: a concurrent writer to the
+    same path may have written its sidecar but not yet published its
+    pickle, and deleting it would strand that writer's checkpoint. A
+    crash-orphan merely survives until a later save() collects it."""
+    import time
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path) + ".tensors"
+    keep = f"{base}.{keep_id}"
+    cutoff = time.time() - _SIDECAR_GC_GRACE_S
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        # `base` exactly = pre-suffix shared-sidecar layout, also stale
+        if (name.startswith(base + ".") or name == base) and name != keep:
+            full = os.path.join(d, name)
+            try:
+                if os.path.getmtime(full) < cutoff:
+                    os.remove(full)
+            except OSError:
+                pass
+
+
 def load(path: str, return_numpy: bool = False):
     with open(path, "rb") as f:
         obj = pickle.load(f)
     if isinstance(obj, dict) and obj.get("__pt_native__"):
         from .native import tensor_store
-        arrays = tensor_store.load_tensors(path + ".tensors")
         want_id = obj.get("ckpt_id")
+        sidecar = f"{path}.tensors.{want_id}"
+        legacy = not os.path.exists(sidecar)
+        if legacy:
+            # pre-suffix layout: shared sidecar carrying an id blob
+            sidecar = path + ".tensors"
+        arrays = tensor_store.load_tensors(sidecar)
         have = arrays.pop("__ckpt_id__", None)
-        have_id = bytes(have.tobytes()).decode() \
-            if have is not None else None
-        if want_id is not None and want_id != have_id:
-            raise IOError(
-                f"checkpoint mismatch: {path!r} and its .tensors "
-                "sidecar are from different save() calls (a writer "
-                "was likely killed mid-save); re-save the checkpoint")
+        if legacy and want_id is not None:
+            # the suffixed filename IS the id; a legacy shared sidecar
+            # must prove it belongs to this pickle via its id blob
+            have_id = bytes(have.tobytes()).decode() \
+                if have is not None else None
+            if want_id != have_id:
+                raise IOError(
+                    f"checkpoint mismatch: {path!r} and its .tensors "
+                    "sidecar are from different save() calls (a writer "
+                    "was likely killed mid-save); re-save the checkpoint")
         bf16 = set(obj.get("bf16_keys", ()))
 
         def resolve(o):

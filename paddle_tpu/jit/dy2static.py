@@ -35,7 +35,34 @@ from ..core.tensor import Tensor
 __all__ = ["convert_ifelse", "convert_while_loop", "convert_to_static",
            "declarative"]
 
-_UNDEF = object()
+class _UndefType:
+    """Sentinel for a carried variable that was unbound at construct
+    entry. Any real use replays the NameError the unconverted code
+    would have raised (identity checks like `v is _UNDEF` stay safe)."""
+    __slots__ = ()
+
+    @staticmethod
+    def _raise(*_a, **_k):
+        raise NameError(
+            "dy2static: variable referenced before assignment inside "
+            "a converted construct")
+
+    __bool__ = __float__ = __int__ = __index__ = __len__ = _raise
+    __iter__ = __getitem__ = __setitem__ = __call__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = __eq__ = __ne__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
+    __neg__ = __pos__ = __abs__ = __matmul__ = __rmatmul__ = _raise
+    __hash__ = object.__hash__  # defining __eq__ would otherwise kill it
+
+    def __getattr__(self, _name):
+        self._raise()
+
+    def __repr__(self):
+        return "<dy2static undef>"
+
+
+_UNDEF = _UndefType()
 
 
 def _is_traced_pred(pred) -> bool:
@@ -68,6 +95,14 @@ def convert_ifelse(pred, true_fn: Callable, false_fn: Callable,
     def run(fn):
         def inner(_):
             outs = fn(*init_vals)
+            is_leaf = lambda t: isinstance(t, Tensor) or t is _UNDEF
+            if any(o is _UNDEF for o in
+                   jax.tree_util.tree_leaves(outs, is_leaf=is_leaf)):
+                raise ValueError(
+                    "dy2static: a variable carried across a converted "
+                    "tensor-`if` is not assigned on every branch; both "
+                    "branches must bind the same variables when the "
+                    "predicate is traced")
             return jax.tree_util.tree_map(
                 _raw, outs, is_leaf=lambda t: isinstance(t, Tensor))
         return inner
@@ -83,8 +118,15 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
     convert_operators.convert_while_loop). cond_fn/body_fn take and
     return the loop-variable tuple."""
     probe = cond_fn(*loop_vars)
-    if not _is_traced_pred(probe) and not any(
-            isinstance(_raw(v), jax.core.Tracer) for v in loop_vars):
+    has_undef = any(v is _UNDEF for v in loop_vars)
+    if has_undef or (not _is_traced_pred(probe) and not any(
+            isinstance(_raw(v), jax.core.Tracer) for v in loop_vars)):
+        if has_undef and _is_traced_pred(probe):
+            raise ValueError(
+                "dy2static: a converted tensor-`while` carries a "
+                "variable that is unbound before the loop; initialise "
+                "it (same shape/dtype as inside the body) before the "
+                "loop when the condition is traced")
         vars_ = tuple(loop_vars)
         while _bool(cond_fn(*vars_)):
             vars_ = tuple(body_fn(*vars_))
@@ -149,6 +191,16 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     """Rewrites tensor-convertible `if` and `while` statements into
     convert_ifelse / convert_while_loop calls."""
 
+    def __init__(self):
+        super().__init__()
+        # depth > 0 ⇒ this construct's statements end up inside a
+        # generated __jst_* function whose trailing `return (...)`
+        # still loads every carried name — deleting one there would
+        # raise UnboundLocalError at the return. Only the outermost
+        # level un-binds leftover sentinels; inner levels pass _UNDEF
+        # through (it raises NameError on any real use).
+        self._depth = 0
+
     def _load(self, name):
         return ast.Name(id=name, ctx=ast.Load())
 
@@ -165,6 +217,22 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Name(id="__jst_undef", ctx=ast.Load())],
             keywords=[])
 
+    def _undef_cleanup(self, names):
+        # `if n is __jst_undef: del n` per carried name: a branch/loop
+        # that never bound the variable must leave it unbound, so later
+        # use raises NameError exactly like the unconverted code
+        out = []
+        for n in names:
+            out.append(ast.If(
+                test=ast.Compare(
+                    left=self._load(n), ops=[ast.Is()],
+                    comparators=[ast.Name(id="__jst_undef",
+                                          ctx=ast.Load())]),
+                body=[ast.Delete(targets=[
+                    ast.Name(id=n, ctx=ast.Del())])],
+                orelse=[]))
+        return out
+
     def _branch_fn(self, fname, body, out_names):
         ret = ast.Return(value=ast.Tuple(
             elts=[self._load(n) for n in out_names], ctx=ast.Load()))
@@ -179,7 +247,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             body=list(body) + [ret], decorator_list=[])
 
     def visit_If(self, node: ast.If):
-        self.generic_visit(node)
+        self._depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self._depth -= 1
         # jumps can't cross a lax.cond boundary — leave those to Python
         if _has_jump(node.body) or _has_jump(node.orelse):
             return node
@@ -205,10 +277,17 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 elts=[ast.Name(id=n, ctx=ast.Store())
                       for n in out_names], ctx=ast.Store())],
             value=call)
-        return [true_fn, false_fn, assign]
+        stmts = [true_fn, false_fn, assign]
+        if self._depth == 0:
+            stmts += self._undef_cleanup(out_names)
+        return stmts
 
     def visit_While(self, node: ast.While):
-        self.generic_visit(node)
+        self._depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self._depth -= 1
         if _has_jump(node.body) or node.orelse:
             return node
         loop_names = [n for n in _assigned(node.body)
@@ -231,7 +310,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         call = ast.Call(
             func=ast.Name(id="__jst_convert_while", ctx=ast.Load()),
             args=[self._load("__jst_cond"), self._load("__jst_body"),
-                  ast.Tuple(elts=[self._load(n) for n in loop_names],
+                  ast.Tuple(elts=[self._init_val(n)
+                                  for n in loop_names],
                             ctx=ast.Load())],
             keywords=[])
         assign = ast.Assign(
@@ -239,7 +319,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 elts=[ast.Name(id=n, ctx=ast.Store())
                       for n in loop_names], ctx=ast.Store())],
             value=call)
-        return [cond_fn, body_fn, assign]
+        stmts = [cond_fn, body_fn, assign]
+        if self._depth == 0:
+            stmts += self._undef_cleanup(loop_names)
+        return stmts
 
 
 def convert_to_static(fn: Callable) -> Callable:

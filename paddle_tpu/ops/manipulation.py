@@ -196,8 +196,14 @@ def put_along_axis(arr, indices, values, axis, reduce="assign",
         return base_with(1).at[loc].multiply(values)
     if reduce == "mean":
         sums = base_with(0).at[loc].add(values)
-        counts = touched + (1 if include_self else 0)
-        return jnp.where(hit, sums / jnp.maximum(counts, 1), arr)
+        counts = jnp.maximum(touched + (1 if include_self else 0), 1)
+        if jnp.issubdtype(arr.dtype, jnp.integer):
+            # paddle truncates the integer mean toward zero; stay in
+            # the integer domain (float32 would lose >24-bit sums)
+            mean = jnp.sign(sums) * (jnp.abs(sums) // counts)
+        else:
+            mean = (sums / counts).astype(arr.dtype)
+        return jnp.where(hit, mean, arr)
     if reduce == "amax":
         return base_with(-jnp.inf).at[loc].max(values)
     if reduce == "amin":

@@ -127,3 +127,42 @@ def test_closure_function_converts():
     np.testing.assert_allclose(
         f(paddle.to_tensor(np.array([2.0], np.float32))).numpy(),
         [6.0])
+
+
+def test_unbound_var_raises_on_use():
+    # a carried var the taken branch never binds must raise NameError
+    # on later use, not silently bind an internal sentinel
+    @declarative
+    def f(t):
+        if float(t.sum()) < 0:  # eager predicate
+            z = t * 2
+        return z
+
+    with pytest.raises(NameError):
+        f(paddle.ones([2]))
+
+
+def test_unbound_var_in_untaken_branch_is_fine():
+    @declarative
+    def g(t):
+        if float(t.sum()) < 0:
+            z = t * 2
+        return 1
+
+    assert g(paddle.ones([2])) == 1
+
+
+def test_nested_if_var_first_bound_inside_loop():
+    # inner converted `if` first binds y inside a converted while body:
+    # the cleanup must not delete a name the generated loop body still
+    # returns (regression: UnboundLocalError at __jst_body's return)
+    @declarative
+    def f():
+        i = 0
+        while i < 3:
+            if i > 1:
+                y = 5
+            i = i + 1
+        return y
+
+    assert f() == 5

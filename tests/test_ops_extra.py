@@ -39,6 +39,12 @@ def test_take_put_along_axis():
     amx = paddle.put_along_axis(twos, idx, 9.0, 1, reduce="amax",
                                 broadcast=False)
     assert amx.numpy()[0, 0] == 9.0
+    # integer mean keeps input dtype (truncating) instead of promoting
+    ints = paddle.to_tensor(np.full((3, 4), 2, np.int32))
+    imean = paddle.put_along_axis(ints, idx, 5, 1, reduce="mean",
+                                  broadcast=False)
+    assert imean.numpy().dtype == np.int32
+    assert imean.numpy()[0, 0] == 3  # (2 + 5) / 2 truncated
     # broadcast=True (paddle default): indices broadcast over rows
     brd = paddle.put_along_axis(
         paddle.to_tensor(np.zeros((2, 3), np.float32)),

@@ -55,12 +55,36 @@ def test_paddle_save_load_native_sidecar(tmp_path):
              "opt": {"m": paddle.zeros([32, 16]), "step": 7},
              "names": ["a", "b"]}
     paddle.save(state, path)
-    assert os.path.exists(path + ".tensors")
+    sidecars = [f for f in os.listdir(tmp_path)
+                if f.startswith("model.pdparams.tensors.")]
+    assert len(sidecars) == 1
     back = paddle.load(path)
     np.testing.assert_allclose(back["w"].numpy(), state["w"].numpy())
     np.testing.assert_allclose(back["opt"]["m"].numpy(), 0.0)
     assert back["opt"]["step"] == 7
     assert back["names"] == ["a", "b"]
+
+
+def test_crashed_resave_keeps_last_good_checkpoint(tmp_path):
+    # a writer killed after the sidecar write but before the pickle
+    # publish must leave the previous checkpoint loadable
+    path = str(tmp_path / "m.pdparams")
+    paddle.save({"w": paddle.full([4], 1.0)}, path)
+    # simulate the crashed second save(): orphan sidecar, no new pickle
+    orphan = path + ".tensors.deadbeef"
+    tensor_store.save_tensors(
+        orphan, {"t0": np.full((4,), 2.0, np.float32)})
+    back = paddle.load(path)
+    np.testing.assert_allclose(back["w"].numpy(), 1.0)
+    # a successful re-save garbage-collects the orphan once it is past
+    # the concurrent-writer grace window (age it artificially)
+    old = os.path.getmtime(orphan) - 3600
+    os.utime(orphan, (old, old))
+    paddle.save({"w": paddle.full([4], 3.0)}, path)
+    sidecars = [f for f in os.listdir(tmp_path)
+                if f.startswith("m.pdparams.tensors.")]
+    assert "m.pdparams.tensors.deadbeef" not in sidecars
+    np.testing.assert_allclose(paddle.load(path)["w"].numpy(), 3.0)
 
 
 def test_paddle_save_load_bf16(tmp_path):
