@@ -1,11 +1,27 @@
-"""Flagship benchmark: GPT pretraining step throughput + MFU on the local
-chip. Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-vs_baseline = achieved MFU / 0.40 (the north-star ERNIE-3.0 target from
-BASELINE.md; >1.0 beats the target)."""
+"""Benchmarks for the BASELINE.md progression configs.
+
+Default (`python bench.py`): the flagship GPT-2 small pretraining step —
+prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} with
+vs_baseline = achieved MFU / 0.40 (the ERNIE-3.0 north-star target).
+
+Other configs (BASELINE configs #2-#5; `python bench.py <name>`):
+  resnet50      ResNet-50 train step, images/sec (conv/layout path)
+  ernie-base    ERNIE-3.0-Base masked-LM step (sharding-family model)
+  bert-large    BERT-large masked-LM step
+  gpt6.7b-layer one GPT-3-6.7B transformer block (single-chip microbench
+                of the hybrid config; full model needs the 8-way mesh —
+                see __graft_entry__.dryrun_multichip)
+  vit-l         ViT-L/16 train step
+  all           every config; one JSON line each on stderr, flagship on
+                stdout last
+
+MFU for the non-GPT configs uses XLA's own cost model for the compiled
+step (TrainStep.cost_analysis) instead of hand formulas.
+"""
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -25,7 +41,7 @@ def peak_flops(device) -> float:
     return 197e12 if device.platform == "tpu" else 1e12
 
 
-def main():
+def _setup():
     import os
     import jax
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -33,60 +49,273 @@ def main():
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    import paddle_tpu as paddle
-    from paddle_tpu import nn, optimizer
-    from paddle_tpu.models.gpt import gpt
+    import jax as j
+    dev = j.devices()[0]
+    return dev, dev.platform == "tpu"
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
 
-    # sized to fit one v5e chip (16GB HBM) in bf16 with fp32 masters
-    if on_tpu:
-        name, batch, seq = "gpt2-small", 16, 1024
-    else:  # CPU smoke config
-        name, batch, seq = "test-tiny", 2, 64
-
-    paddle.seed(0)
-    model = gpt(name, max_position_embeddings=seq)
-    model.bfloat16() if on_tpu else None
-    opt = optimizer.AdamW(learning_rate=1e-4,
-                          parameters=model.parameters(),
-                          multi_precision=on_tpu)
-    step = paddle.jit.TrainStep(
-        model, opt, lambda logits, labels: model.loss(logits, labels))
-
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, model.cfg.vocab_size, (batch, seq)).astype(np.int32)
-    x = paddle.to_tensor(ids)
-    y = paddle.to_tensor(ids.astype(np.int64))
-
+def _time_steps(step, x, y, iters):
     # warmup (compile). Sync via host transfer of the loss: on the axon
     # remote tunnel block_until_ready can acknowledge before execution
     # completes, and donated param buffers alias inputs — float() is the
     # only reliable fence.
     loss = step(x, y)
     float(loss)
-
-    iters = 20 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(x, y)
-    float(loss)
-    dt = time.perf_counter() - t0
+    final = float(loss)
+    return time.perf_counter() - t0, final
+
+
+def bench_gpt2(dev, on_tpu):
+    import os
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.gpt import gpt
+
+    if on_tpu:
+        name, batch, seq = "gpt2-small", 16, 1024
+    else:  # CPU smoke config
+        name, batch, seq = "test-tiny", 2, 64
+    # HBM-pressure sweeps (BASELINE.md): override shape/remat/offload
+    batch = int(os.environ.get("BENCH_BATCH", batch))
+    seq = int(os.environ.get("BENCH_SEQ", seq))
+    remat = os.environ.get("BENCH_REMAT", "")  # ""/selective/full
+    offload = os.environ.get("BENCH_OFFLOAD", "") == "1"
+
+    paddle.seed(0)
+    model = gpt(name, max_position_embeddings=seq,
+                use_recompute=bool(remat),
+                recompute_granularity=remat or "selective")
+    model.bfloat16() if on_tpu else None
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=on_tpu)
+    step = paddle.jit.TrainStep(
+        model, opt, lambda logits, labels: model.loss(logits, labels),
+        offload_opt_state=offload)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, model.cfg.vocab_size, (batch, seq)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(ids.astype(np.int64))
+
+    iters = 20 if on_tpu else 3
+    dt, loss = _time_steps(step, x, y, iters)
 
     tokens_per_sec = batch * seq * iters / dt
-    flops_per_token = model.flops_per_token(seq)
-    achieved = tokens_per_sec * flops_per_token
-    mfu = achieved / peak_flops(dev)
-
-    print(json.dumps({
+    mfu = tokens_per_sec * model.flops_per_token(seq) / peak_flops(dev)
+    extra = (f", remat={remat}" if remat else "") + \
+        (", offload" if offload else "")
+    return {
         "metric": f"{name} train tokens/sec/chip (b{batch} s{seq}, "
-                  f"MFU={mfu:.3f}, loss={float(loss):.3f}, "
+                  f"MFU={mfu:.3f}, loss={loss:.3f}{extra}, "
                   f"device={dev.device_kind})",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.40, 4),
-    }))
+    }
+
+
+def _mlm_bench(dev, on_tpu, cfg_name, batch, seq, iters=20):
+    """ERNIE/BERT masked-LM + sentence-order pretraining step."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ernie import ernie
+
+    paddle.seed(0)
+    model = ernie(cfg_name if on_tpu else "test-tiny")
+    model.bfloat16() if on_tpu else None
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=on_tpu)
+    step = paddle.jit.TrainStep(
+        model, opt, lambda out, labels: model.loss(out, labels))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, model.cfg.vocab_size,
+                      (batch, seq)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    mlm = ids.astype(np.int64)
+    mlm[rng.rand(*mlm.shape) > 0.15] = -100  # only masked positions score
+    y = (paddle.to_tensor(mlm),
+         paddle.to_tensor(rng.randint(0, 2, (batch,)).astype(np.int64)))
+    xla_flops = float(step.cost_analysis(x, y).get("flops", 0.0))
+    n = iters if on_tpu else 2
+    dt, loss = _time_steps(step, x, y, n)
+    tokens_per_sec = batch * seq * n / dt
+    mfu = (xla_flops * n / dt) / peak_flops(dev)
+    return {
+        "metric": f"{cfg_name} train tokens/sec/chip (b{batch} "
+                  f"s{seq}, MFU={mfu:.3f}, loss={loss:.3f}, "
+                  f"device={dev.device_kind})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+def bench_ernie_base(dev, on_tpu):
+    b, s = (32, 512) if on_tpu else (2, 32)
+    return _mlm_bench(dev, on_tpu, "ernie-3.0-base", b, s)
+
+
+def bench_bert_large(dev, on_tpu):
+    b, s = (16, 512) if on_tpu else (2, 32)
+    return _mlm_bench(dev, on_tpu, "bert-large", b, s)
+
+
+def bench_gpt67_layer(dev, on_tpu):
+    """One transformer block of the GPT-3-6.7B config (BASELINE #4's
+    building block; the full model runs on the 8-way mesh in
+    dryrun_multichip)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models.gpt import CONFIGS, GPTBlock
+    import dataclasses
+
+    cfg = CONFIGS["gpt3-6.7b" if on_tpu else "test-tiny"]
+    paddle.seed(0)
+
+    class OneBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.block = GPTBlock(cfg)
+
+        def forward(self, x):
+            return self.block(x)
+
+    model = OneBlock()
+    model.bfloat16() if on_tpu else None
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=on_tpu)
+    loss_fn = lambda out, labels: (out.astype("float32") ** 2).mean()
+    step = paddle.jit.TrainStep(model, opt, loss_fn)
+    b, s = (8, 2048) if on_tpu else (2, 32)
+    rng = np.random.RandomState(0)
+    h = rng.randn(b, s, cfg.hidden_size).astype(np.float32)
+    x = paddle.to_tensor(h).astype("bfloat16" if on_tpu else "float32")
+    y = paddle.zeros([1])
+    xla_flops = float(step.cost_analysis(x, y).get("flops", 0.0))
+    iters = 30 if on_tpu else 2
+    dt, loss = _time_steps(step, x, y, iters)
+    tokens_per_sec = b * s * iters / dt
+    mfu = (xla_flops * iters / dt) / peak_flops(dev)
+    return {
+        "metric": f"gpt3-6.7b single-layer train tokens/sec/chip "
+                  f"(b{b} s{s}, MFU={mfu:.3f}, "
+                  f"device={dev.device_kind})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+def bench_resnet50(dev, on_tpu):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models.resnet import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.bfloat16() if on_tpu else None
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters(),
+                             multi_precision=on_tpu)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, labels):
+        return ce(logits.astype("float32"), labels)
+
+    step = paddle.jit.TrainStep(model, opt, loss_fn)
+    b, hw = (128, 224) if on_tpu else (2, 32)
+    rng = np.random.RandomState(0)
+    img = rng.randn(b, 3, hw, hw).astype(np.float32)
+    x = paddle.to_tensor(img).astype("bfloat16" if on_tpu else "float32")
+    y = paddle.to_tensor(rng.randint(0, 1000, (b,)).astype(np.int64))
+    xla_flops = float(step.cost_analysis(x, y).get("flops", 0.0))
+    iters = 20 if on_tpu else 2
+    dt, loss = _time_steps(step, x, y, iters)
+    imgs_per_sec = b * iters / dt
+    mfu = (xla_flops * iters / dt) / peak_flops(dev)
+    return {
+        "metric": f"resnet50 train images/sec/chip (b{b} {hw}x{hw}, "
+                  f"MFU={mfu:.3f}, loss={loss:.3f}, "
+                  f"device={dev.device_kind})",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+def bench_vit_l(dev, on_tpu):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models.vit import vit
+
+    paddle.seed(0)
+    model = vit("vit-l-16" if on_tpu else "test-tiny")
+    model.bfloat16() if on_tpu else None
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=on_tpu)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(logits, labels):
+        return ce(logits.astype("float32"), labels)
+
+    step = paddle.jit.TrainStep(model, opt, loss_fn)
+    b = 64 if on_tpu else 2
+    hw = model.cfg.image_size
+    rng = np.random.RandomState(0)
+    img = rng.randn(b, 3, hw, hw).astype(np.float32)
+    x = paddle.to_tensor(img).astype("bfloat16" if on_tpu else "float32")
+    y = paddle.to_tensor(rng.randint(0, model.cfg.num_classes,
+                                     (b,)).astype(np.int64))
+    xla_flops = float(step.cost_analysis(x, y).get("flops", 0.0))
+    iters = 20 if on_tpu else 2
+    dt, loss = _time_steps(step, x, y, iters)
+    imgs_per_sec = b * iters / dt
+    mfu = (xla_flops * iters / dt) / peak_flops(dev)
+    return {
+        "metric": f"vit-l-16 train images/sec/chip (b{b} {hw}x{hw}, "
+                  f"MFU={mfu:.3f}, loss={loss:.3f}, "
+                  f"device={dev.device_kind})",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+BENCHES = {
+    "gpt2": bench_gpt2,
+    "resnet50": bench_resnet50,
+    "ernie-base": bench_ernie_base,
+    "bert-large": bench_bert_large,
+    "gpt6.7b-layer": bench_gpt67_layer,
+    "vit-l": bench_vit_l,
+}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "gpt2"
+    dev, on_tpu = _setup()
+    if which == "all":
+        for name, fn in BENCHES.items():
+            if name == "gpt2":
+                continue
+            try:
+                print(json.dumps(fn(dev, on_tpu)), file=sys.stderr)
+            except Exception as e:  # one failing config must not
+                print(json.dumps({"metric": f"{name} FAILED: {e}"}),
+                      file=sys.stderr)  # silence the flagship line
+        print(json.dumps(bench_gpt2(dev, on_tpu)))
+        return
+    if which not in BENCHES:
+        raise SystemExit(f"unknown bench {which!r}; one of "
+                         f"{sorted(BENCHES)} or 'all'")
+    print(json.dumps(BENCHES[which](dev, on_tpu)))
 
 
 if __name__ == "__main__":

@@ -1,5 +1,6 @@
 from ..core.tensor import enable_grad, is_grad_enabled, no_grad  # noqa: F401
-from .backward_engine import run_backward  # noqa: F401
+from .backward_engine import run_backward, tensor_grad  # noqa: F401
+from .backward_engine import tensor_grad as grad  # noqa: F401
 from .py_layer import PyLayer  # noqa: F401
 
 

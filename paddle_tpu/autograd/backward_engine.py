@@ -1,15 +1,25 @@
-"""Topological backward over the eager tape.
+"""Topological backward over the eager tape, with higher-order support.
 
-Reference analog: egr::Backward / RunBackward
-(paddle/fluid/eager/backward.cc:105,393) — a topological queue over GradNodes
-with GradTensorHolder accumulation and per-tensor hooks. Same algorithm here,
-over `GradNode`s whose grad function is a jax vjp closure.
+Reference analog: egr::Backward / egr::Grad
+(paddle/fluid/eager/backward.cc:105,393) — a topological queue over
+GradNodes with GradTensorHolder accumulation and per-tensor hooks; the
+`grad()` entry restricts execution to the subgraph between outputs and
+inputs and can keep building the graph (create_graph) for double grad
+(exercised by fluid/tests/unittests/test_imperative_double_grad.py).
+
+Same algorithm here over `GradNode`s whose grad function is a jax vjp
+closure. For create_graph=True a node's grads are re-derived as a fresh
+TAPED op (jax.vjp of the node's stored pure function, dispatched through
+the normal op dispatch), so the produced gradients carry their own
+GradNodes — second and higher order compose for free because jax.vjp
+nests to arbitrary order.
 """
 from __future__ import annotations
 
 from collections import defaultdict, deque
-from typing import Optional
+from typing import Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import GradNode, Tensor
@@ -17,6 +27,7 @@ from ..core.tensor import GradNode, Tensor
 
 def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
                  retain_graph: bool = False):
+    """loss.backward(): accumulate into every reachable leaf's .grad."""
     if root.stop_gradient or root._node is None:
         raise RuntimeError(
             "Tensor has no grad graph (stop_gradient=True or no recorded "
@@ -28,84 +39,272 @@ def run_backward(root: Tensor, grad_tensor: Optional[Tensor] = None,
             raise RuntimeError(
                 f"grad_tensor must be given for non-scalar root "
                 f"(shape {root.shape})")
-        seed_ct = jnp.ones(root.data.shape, root.dtype)
+        seed = jnp.ones(root.data.shape, root.dtype)
     else:
-        seed_ct = grad_tensor.data if isinstance(grad_tensor, Tensor) \
+        seed = grad_tensor.data if isinstance(grad_tensor, Tensor) \
             else jnp.asarray(grad_tensor)
+    _engine([root], [seed], targets=None, retain=retain_graph,
+            create=False, accumulate_leaves=True)
 
-    # --- collect reachable graph; count in-degrees (uses of each node) -----
-    indegree: dict[GradNode, int] = defaultdict(int)
+
+def tensor_grad(outputs, inputs, grad_outputs=None,
+                retain_graph: Optional[bool] = None,
+                create_graph: bool = False, only_inputs: bool = True,
+                allow_unused: bool = False, no_grad_vars=None):
+    """paddle.grad(outputs, inputs, ...) — grads of `outputs` w.r.t.
+    `inputs` without touching .grad. With create_graph=True the returned
+    gradients are themselves differentiable (double grad).
+
+    Reference: python/paddle/fluid/dygraph/base.py grad() over
+    eager/backward.cc:393."""
+    if not only_inputs:
+        raise ValueError("only_inputs=False is not supported (matches "
+                         "the reference dygraph restriction)")
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) \
+        else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+        else [inputs]
+    if not outputs or not inputs:
+        raise ValueError("outputs and inputs must be non-empty")
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if len(grad_outputs) != len(outputs):
+        raise ValueError(
+            f"grad_outputs has {len(grad_outputs)} entries for "
+            f"{len(outputs)} outputs")
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    seeds = []
+    for o, go in zip(outputs, grad_outputs):
+        if not isinstance(o, Tensor):
+            raise TypeError("outputs must be Tensors")
+        if go is None:
+            seed = jnp.ones(o.data.shape, o.dtype)
+        else:
+            seed = go.data if isinstance(go, Tensor) else jnp.asarray(go)
+        if create_graph and isinstance(go, Tensor):
+            seeds.append(go)  # keep its graph: d(grad)/d(grad_outputs)
+        else:
+            seeds.append(Tensor(seed) if create_graph else seed)
+    grads = _engine(outputs, seeds, targets=inputs, retain=retain_graph,
+                    create=create_graph, accumulate_leaves=False,
+                    no_grad_vars=no_grad_vars)
+    result = []
+    for t, g in zip(inputs, grads):
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs receives no gradient from "
+                    "outputs (unreachable in the recorded graph); pass "
+                    "allow_unused=True to get None for it")
+            result.append(None)
+        else:
+            result.append(g)
+    return result
+
+
+# ----------------------------------------------------------------- engine
+
+
+def _engine(outputs: Sequence[Tensor], seeds, targets, retain: bool,
+            create: bool, accumulate_leaves: bool, no_grad_vars=None):
+    """Shared topological executor.
+
+    targets=None  -> full backward, leaf .grad accumulation.
+    targets=[...] -> execute only nodes on a path from outputs to a
+                     target; collect per-target cotangent sums.
+    create=True   -> cotangents are Tensors and node grads are computed
+                     by a taped dispatch (gradients stay differentiable).
+    """
+    target_ids = None
+    if targets is not None:
+        target_ids = {id(t): i for i, t in enumerate(targets)}
+    stop_ids = set()
+    if no_grad_vars:
+        stop_ids = {id(t) for t in no_grad_vars}
+
+    # --- reachable node set (outputs -> leaves) ------------------------
     seen = set()
-    stack = [root._node]
-    seen.add(root._node)
+    stack = []
+    for o in outputs:
+        if o._node is not None and o._node not in seen:
+            seen.add(o._node)
+            stack.append(o._node)
     while stack:
         node = stack.pop()
         for t in node.inputs:
+            if id(t) in stop_ids:
+                continue  # no cotangent will flow through this edge
             n = t._node
-            if n is not None:
-                indegree[n] += 1
-                if n not in seen:
-                    seen.add(n)
-                    stack.append(n)
+            if n is not None and n not in seen:
+                seen.add(n)
+                stack.append(n)
 
-    if not retain_graph:
-        for node in seen:
+    # --- active set: nodes that can reach a target ---------------------
+    if target_ids is None:
+        active = seen
+    else:
+        # a node is active iff a target is reachable from it via input
+        # edges: reverse-BFS from direct target touchers through the
+        # consumer relation (iterative — tapes can be 1000s of ops deep)
+        consumers: Dict[GradNode, List[GradNode]] = defaultdict(list)
+        touchers = []
+        for m in seen:
+            direct = False
+            for t in m.inputs:
+                if id(t) in stop_ids:
+                    continue
+                if id(t) in target_ids:
+                    direct = True
+                elif t._node is not None:
+                    consumers[t._node].append(m)
+            if direct:
+                touchers.append(m)
+        active = set(touchers)
+        bfs = deque(touchers)
+        while bfs:
+            n = bfs.popleft()
+            for m in consumers[n]:
+                if m not in active:
+                    active.add(m)
+                    bfs.append(m)
+
+    if not retain and not create:
+        for node in active:
             if node.vjp_fn is None:
                 raise RuntimeError(
                     "Trying to backward through the graph a second time "
                     "(use retain_graph=True on the first backward).")
+    if create:
+        for node in active:
+            if node.closed is None:
+                raise RuntimeError(
+                    f"op '{node.name}' cannot re-derive a differentiable "
+                    "gradient (no stored primal closure); create_graph "
+                    "is unavailable for graphs containing it")
 
-    root._node.add_cotangent(root._out_index, seed_ct)
+    # --- in-degrees over active nodes ----------------------------------
+    indegree: Dict[GradNode, int] = defaultdict(int)
+    for m in active:
+        for t in m.inputs:
+            if id(t) in stop_ids:
+                continue
+            n = t._node
+            if n is not None and n in active:
+                indegree[n] += 1
 
-    ready = deque([n for n in seen if indegree[n] == 0])
+    grad_acc: List = [None] * (len(targets) if targets is not None else 0)
+
+    def to_target(t: Tensor, g):
+        i = target_ids[id(t)]
+        cur = grad_acc[i]
+        grad_acc[i] = g if cur is None else cur + g
+
+    # --- seed the roots -------------------------------------------------
+    for o, seed in zip(outputs, seeds):
+        if target_ids is not None and id(o) in target_ids:
+            to_target(o, seed)
+        if o._node is not None and o._node in active:
+            o._node.add_cotangent(o._out_index, seed)
+
+    ready = deque([n for n in active if indegree[n] == 0])
     processed = 0
     while ready:
         node = ready.popleft()
         processed += 1
-        if retain_graph:
-            vjp_fn, avals = node.vjp_fn, node.out_avals
-            grads = _run_with_retain(node)
+        if create:
+            grads = _fire_create(node)
+        elif retain:
+            grads = _fire_retain(node)
         else:
             grads = node.run_vjp()
         for t, g in zip(node.inputs, grads):
-            g = _apply_hooks(t, g)
+            if id(t) in stop_ids:
+                continue
+            g = _apply_hooks(t, g, create)
+            if target_ids is not None and id(t) in target_ids:
+                to_target(t, g)
             n = t._node
-            if n is None:
-                # leaf: accumulate into .grad
-                if t.grad is None:
-                    t.grad = Tensor(g, stop_gradient=True)
-                else:
-                    t.grad = Tensor(t.grad.data + g, stop_gradient=True)
-            else:
+            if n is not None and n in active:
                 n.add_cotangent(t._out_index, g)
                 indegree[n] -= 1
                 if indegree[n] == 0:
                     ready.append(n)
-    if processed != len(seen):
+            elif n is None and accumulate_leaves:
+                gd = g.data if isinstance(g, Tensor) else g
+                if t.grad is None:
+                    t.grad = Tensor(gd, stop_gradient=True)
+                else:
+                    t.grad = Tensor(t.grad.data + gd, stop_gradient=True)
+    if processed != len(active):
         raise RuntimeError("Cycle detected in autograd graph")
 
+    if target_ids is None:
+        return None
+    out = []
+    for g in grad_acc:
+        if g is None:
+            out.append(None)
+        elif isinstance(g, Tensor):
+            out.append(g if create else Tensor(g.data, stop_gradient=True))
+        else:
+            out.append(Tensor(g, stop_gradient=True))
+    return out
 
-def _run_with_retain(node: GradNode):
-    import jax
+
+def _cts_for(node: GradNode, as_tensor: bool):
     cts = []
     for i in range(node.n_outs):
         ct = node.pending.get(i)
         if ct is None:
             shape, dt = node.out_avals[i]
             ct = jnp.zeros(shape, dt)
+            if as_tensor:
+                ct = Tensor(ct)
+        elif as_tensor and not isinstance(ct, Tensor):
+            ct = Tensor(ct)
+        elif not as_tensor and isinstance(ct, Tensor):
+            ct = ct.data
         cts.append(ct)
-    ct_tree = jax.tree_util.tree_unflatten(node.out_treedef, cts)
-    grads = node.vjp_fn(ct_tree)
     node.pending.clear()
-    return grads
+    return cts
 
 
-def _apply_hooks(t: Tensor, g):
+def _fire_retain(node: GradNode):
+    cts = _cts_for(node, as_tensor=False)
+    ct_tree = jax.tree_util.tree_unflatten(node.out_treedef, cts)
+    return node.vjp_fn(ct_tree)
+
+
+def _fire_create(node: GradNode):
+    """Re-derive this node's gradients as a TAPED op so they are
+    themselves differentiable. jax.vjp re-runs the forward — double
+    backward trades compute for composability, like the reference
+    re-running grad-op construction under create_graph."""
+    from ..core.tensor import dispatch
+    cts = _cts_for(node, as_tensor=True)
+    closed, treedef, n_in = node.closed, node.out_treedef, len(node.inputs)
+
+    def grad_impl(*vals):
+        primals, ct_leaves = vals[:n_in], vals[n_in:]
+        ct_tree = jax.tree_util.tree_unflatten(treedef, ct_leaves)
+        _, vjp_fn = jax.vjp(closed, *primals)
+        return tuple(vjp_fn(ct_tree))
+
+    out = dispatch("grad::" + node.name, grad_impl,
+                   tuple(node.inputs) + tuple(cts), {})
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def _apply_hooks(t: Tensor, g, create: bool):
     if not t._hooks:
         return g
-    gt = Tensor(g, stop_gradient=True)
+    gt = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
     for hook in t._hooks:
         res = hook(gt)
         if res is not None:
             gt = res if isinstance(res, Tensor) else Tensor(res)
-    return gt.data
+    return gt if create else gt.data

@@ -73,6 +73,13 @@ def set_flags(flags: Dict[str, Any]):
             if defn.validator is not None and not defn.validator(val):
                 raise ValueError(f"Invalid value {value!r} for flag {name}")
             _VALUES[key] = val
+            if key == "check_nan_inf_in_program":
+                # in-program nan checking: XLA itself traps the first
+                # NaN primitive output (no per-op host sync, works
+                # inside jit/TrainStep) — the debug_nans analog of the
+                # reference's CUDA-side nan_inf_utils_detail.cu scan
+                import jax
+                jax.config.update("jax_debug_nans", bool(val))
 
 
 # ---------------------------------------------------------------- core flags
@@ -83,7 +90,14 @@ define_flag("use_native_tensor_store", True,
             "when the C++ toolchain is available")
 define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf after each eager op "
             "(analog of reference FLAGS_check_nan_inf, "
-            "paddle/fluid/framework/details/nan_inf_utils_detail.cc:33)")
+            "paddle/fluid/framework/details/nan_inf_utils_detail.cc:33). "
+            "Host-syncs every eager op; for jitted/TrainStep code use "
+            "check_nan_inf_in_program instead")
+define_flag("check_nan_inf_in_program", False,
+            "Trap NaNs inside compiled programs via jax debug_nans — no "
+            "per-op host sync; raises FloatingPointError at the first "
+            "NaN-producing primitive (in-program analog of "
+            "FLAGS_check_nan_inf)")
 define_flag("eager_op_profile", False, "Record per-op host timing in eager mode")
 define_flag("jit_cache_dir", "", "Persistent compile cache directory ('' = disabled)")
 define_flag("seed", 0, "Global RNG seed (0 = nondeterministic)")

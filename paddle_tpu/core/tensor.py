@@ -86,19 +86,27 @@ def enable_grad():
 class GradNode:
     """One recorded differentiable op (≈ egr::GradNodeBase,
     paddle/fluid/eager/grad_node_info.h:168). Holds the jax vjp closure and
-    edges to the differentiable inputs."""
+    edges to the differentiable inputs.
+
+    `closed` is the op's pure function of the differentiable inputs (all
+    other leaves captured by value). It enables higher-order autograd:
+    a create_graph backward re-derives the grads as a fresh TAPED op
+    (jax.vjp inside a dispatched call), so d(grad)/d(input) is itself
+    recorded — the analog of the reference's double-grad node chain
+    (paddle/fluid/eager/backward.cc:393 with create_graph)."""
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_treedef", "n_outs",
-                 "pending", "out_avals")
+                 "pending", "out_avals", "closed")
 
     def __init__(self, name: str, vjp_fn, inputs: Sequence["Tensor"],
-                 out_treedef, n_outs: int, out_avals):
+                 out_treedef, n_outs: int, out_avals, closed=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)          # differentiable input Tensors
         self.out_treedef = out_treedef
         self.n_outs = n_outs
         self.out_avals = out_avals          # (shape, dtype) per output leaf
+        self.closed = closed                # pure fn(*diff_vals) -> out
         self.pending: Dict[int, Any] = {}   # out index -> accumulated cotangent
 
     def add_cotangent(self, index: int, ct):
@@ -156,7 +164,7 @@ class Tensor:
     # get dedicated slots instead of re-enabling a per-instance dict.
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
                  "name", "persistable", "_hooks", "trainable", "dist_attr",
-                 "spec")
+                 "spec", "_uid")
     __array_priority__ = 100  # numpy defers binary ops to us
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,
@@ -387,15 +395,45 @@ class Tensor:
         from .. import ops
         return ops.manipulation.getitem(self, idx)
 
+    def _snapshot(self) -> "Tensor":
+        """Frozen view of this tensor's CURRENT value + grad record.
+        In-place ops must point their recorded node at a snapshot, not
+        at the mutated object itself — otherwise the node's input IS
+        its own output and the backward walk sees a self-loop."""
+        t = Tensor.__new__(Tensor)
+        t._data = self._data
+        t.stop_gradient = self.stop_gradient
+        t.grad = None
+        t._node = self._node
+        t._out_index = self._out_index
+        t.name = self.name
+        t.persistable = False
+        t._hooks = []
+        t.trainable = self.trainable
+        return t
+
+    def _adopt(self, out: "Tensor"):
+        """In-place semantics: adopt `out`'s value AND grad record; the
+        recorded node keeps differentiating w.r.t. the pre-mutation
+        value via a snapshot."""
+        node = out._node
+        if node is not None:
+            snap = None
+            for i, t in enumerate(node.inputs):
+                if t is self:
+                    snap = snap or self._snapshot()
+                    node.inputs[i] = snap
+        self._data = out._data
+        self._node = out._node
+        self._out_index = out._out_index
+        self.stop_gradient = out.stop_gradient
+
     def __setitem__(self, idx, value):
         from .. import ops
         out = ops.manipulation.setitem(self, idx, value)
         # in-place semantics: adopt the result's value AND its grad record,
         # so `x[i] = v; loss(x).backward()` differentiates through scatter.
-        self._data = out._data
-        self._node = out._node
-        self._out_index = out._out_index
-        self.stop_gradient = out.stop_gradient
+        self._adopt(out)
 
     # -- method-style op aliases (populated by ops package at import) -------
     # e.g. t.sum(), t.reshape(), t.astype() — see ops/__init__.py
@@ -488,7 +526,13 @@ def _dispatch_body(name: str, impl: Callable, args: tuple, kwargs: dict,
 
     if not record:
         rargs, rkwargs = jax.tree_util.tree_unflatten(treedef, raw_leaves)
-        out = impl(*rargs, **rkwargs)
+        if _has_check(name):
+            _run_enforce(name, rargs, rkwargs, raw_leaves)
+        try:
+            out = impl(*rargs, **rkwargs)
+        except (TypeError, ValueError, IndexError) as e:
+            from . import enforce as _enf
+            raise _enf.augment_error(e, name, raw_leaves) from e
         if flags.get_flag("check_nan_inf") and not tracing:
             _check_nan_inf(name, out)
         return _wrap_outputs(out, node=None)
@@ -506,14 +550,32 @@ def _dispatch_body(name: str, impl: Callable, args: tuple, kwargs: dict,
 
     # diff inputs take their (possibly amp-cast) values from raw_leaves so
     # autocast applies on the grad-recording path too
-    out, vjp_fn = jax.vjp(closed, *[raw_leaves[i] for i in diff_idx])
+    if _has_check(name):
+        rargs, rkwargs = jax.tree_util.tree_unflatten(treedef, raw_leaves)
+        _run_enforce(name, rargs, rkwargs, raw_leaves)
+    try:
+        out, vjp_fn = jax.vjp(closed, *[raw_leaves[i] for i in diff_idx])
+    except (TypeError, ValueError, IndexError) as e:
+        from . import enforce as _enf
+        raise _enf.augment_error(e, name, raw_leaves) from e
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     avals = [(o.shape, o.dtype) for o in out_leaves]
     node = GradNode(name, vjp_fn, diff_tensors, out_treedef,
-                    len(out_leaves), avals)
+                    len(out_leaves), avals, closed=closed)
     if flags.get_flag("check_nan_inf"):
         _check_nan_inf(name, out)
     return _wrap_outputs(out, node=node)
+
+
+def _has_check(name) -> bool:
+    from . import enforce as _enf
+    return _enf.get_check(name) is not None
+
+
+def _run_enforce(name, rargs, rkwargs, raw_leaves):
+    """Run the op's registered InferMeta-style validator (enforce.py)."""
+    from . import enforce as _enf
+    _enf.run_check(name, *rargs, **rkwargs)
 
 
 def _wrap_outputs(out, node):

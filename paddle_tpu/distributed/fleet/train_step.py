@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...optimizer.optimizer import opt_key as _opt_key
 from ...core.tensor import Tensor
 from ...jit.api import functional_call, _unwrap, _wrap
 from ...nn.layer import Layer
@@ -162,7 +163,7 @@ class DistributedTrainStep:
             self._opt_state_tree = []
             for p in params:
                 # seed from restored optimizer state when present
-                st = self.optimizer._state.get(id(p)) \
+                st = self.optimizer._state.get(_opt_key(p)) \
                     or self.optimizer.init_state_for(p)
                 st = {k: (jax.device_put(
                     v, NamedSharding(m, s.opt_state_spec(
@@ -185,7 +186,7 @@ class DistributedTrainStep:
         for p, v in zip(params, new_vals):
             p._data = v
         for p, st in zip(params, self._opt_state_tree):
-            self.optimizer._state[id(p)] = st
+            self.optimizer._state[_opt_key(p)] = st
         from ...optimizer.lr import LRScheduler
         if isinstance(self.optimizer._lr, LRScheduler) and \
                 self.optimizer._lr._step_each_iter:

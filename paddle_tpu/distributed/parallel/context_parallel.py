@@ -181,6 +181,15 @@ def ulysses_attention(q, k, v, causal=False, scale=None,
     if q.shape[2] % sp != 0:
         raise ValueError(
             f"ulysses needs heads {q.shape[2]} divisible by sp={sp}")
+    # GQA: k/v are all_to_all'd on the head axis too, so the kv-head
+    # count must also divide sp — catch it here with a real message
+    # instead of a mid-trace reshape failure
+    for name, t in (("key", k), ("value", v)):
+        if t.shape[2] % sp != 0:
+            raise ValueError(
+                f"ulysses needs {name} heads {t.shape[2]} divisible by "
+                f"sp={sp}; for GQA either repeat kv heads to a multiple "
+                f"of sp or use ring_attention (no head-axis exchange)")
     bspec = _data_spec_entry(mesh, q.shape[0])
     spec = P(bspec, axis_name, None, None)
     fn = shard_map(

@@ -2,28 +2,37 @@
 
 Reference analog: python/paddle/distributed/fleet/meta_parallel/
 parallel_layers/pp_layers.py:56,76,206 (`LayerDesc`, `PipelineLayer` stage
-partitioning with shared-weight groups) and meta_parallel/
-pipeline_parallel.py:117-198 (`PipelineParallel.forward_backward_pipeline`,
-the Megatron 1F1B schedule) with P2P handoff in
-pp_utils/p2p_communication.py:344.
+partitioning with shared-weight groups, seg_method segmentation) and
+meta_parallel/pipeline_parallel.py:117-198,457 (`PipelineParallel`
+1F1B + `PipelineParallelWithInterleave` virtual stages) with P2P handoff
+in pp_utils/p2p_communication.py:344.
 
 TPU-native redesign: instead of per-rank processes exchanging activations
 over NCCL P2P with a host-driven 1F1B state machine, the whole pipeline is
 ONE SPMD program:
 
-  * every pipeline stage holds the SAME computation (a homogeneous
-    transformer trunk) with its own weights; the weights of all stages are
-    stacked along a leading dim sharded `P('pp')`;
-  * a `lax.scan` over `num_microbatches + num_stages - 1` ticks runs the
-    classic pipeline schedule: at each tick every stage computes its block
-    on its current activation, then the activations rotate one hop along
-    the ring via `lax.ppermute` (the ICI-neighbor analog of P2P send/recv);
+  * the homogeneous trunk's blocks are stacked at BLOCK granularity:
+    params live in one array with leading dims [S, v, maxB] (stage,
+    virtual chunk, blocks-per-unit) sharded `P('pp')` on the stage dim;
+  * a `lax.scan` over the schedule's ticks runs the pipeline: at each
+    tick every stage applies its current unit (an inner masked scan over
+    its blocks), then activations rotate one hop along the ring via
+    `lax.ppermute` (the ICI-neighbor analog of P2P send/recv);
+  * **interleaved virtual stages** (`interleave=v`, the
+    PipelineParallelWithInterleave analog): each device hosts v chunks;
+    virtual microbatches flow chunk-major through the ring v times, so
+    the bubble drops from (S-1)/(M+S-1) to (S-1)/(vM+S-1);
+  * **unbalanced partition** (`seg_sizes`, the seg_method analog): units
+    may hold different numbers of blocks; the inner scan masks the
+    padding, so a 7-block trunk on 4 stages is [2,2,2,1] instead of an
+    error;
   * `shard_map` is *manual only over 'pp'* (`axis_names={'pp'}`) — dp/
     sharding/mp stay in GSPMD auto mode, so tensor-parallel layers and
     batch sharding inside each stage keep working unchanged;
   * backward is just `jax.grad` through the scan — XLA schedules the
     backward pipeline (the 1F1B memory behaviour is recovered with
-    `jax.checkpoint` on the stage body instead of a hand-written schedule).
+    `jax.checkpoint` on the block body instead of a hand-written
+    schedule).
 
 The embedding / final-norm / lm-head ("pre"/"post" segments) run
 replicated across the pp axis: they are outside the homogeneous trunk, and
@@ -31,9 +40,9 @@ on TPU recomputing them on every stage is cheaper than serializing the
 mesh (they are a tiny fraction of FLOPs; XLA dedupes the params via
 sharding anyway).
 
-Bubble accounting matches GPipe: (S-1)/(M+S-1) of trunk compute is wasted;
-choose num_microbatches >= 4*S to amortize (same guidance as the
-reference's 1F1B).
+Bubble accounting: (S-1)/(vM+S-1) of trunk compute is wasted; choose
+num_microbatches >= 4*S (or interleave v) to amortize — the same
+guidance as the reference's 1F1B/interleave pair.
 """
 from __future__ import annotations
 
@@ -143,7 +152,9 @@ class PipelineLayer(Layer):
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  loss_fn: Optional[Callable] = None,
                  num_microbatches: Optional[int] = None,
-                 use_recompute: bool = False, topology_=None):
+                 use_recompute: bool = False, topology_=None,
+                 interleave: int = 1,
+                 seg_sizes: Optional[Sequence[int]] = None):
         super().__init__()
         shared: Dict[str, Layer] = {}
         seen: set = set()
@@ -172,42 +183,73 @@ class PipelineLayer(Layer):
             num_stages = (hcg.get_pipe_parallel_world_size()
                           if hcg is not None else 1)
         self.num_stages = int(num_stages)
+        self.interleave = int(interleave)
+        if self.interleave < 1:
+            raise ValueError(f"interleave must be >= 1, got {interleave}")
         self.loss_fn = loss_fn
         self.num_microbatches = num_microbatches
         self.use_recompute = use_recompute
 
         t0, t1 = _find_trunk(built)
         trunk = built[t0:t1]
-        if self.num_stages > 1:
-            if len(trunk) % self.num_stages != 0:
-                raise ValueError(
-                    f"trunk of {len(trunk)} homogeneous layers not divisible"
-                    f" by num_stages={self.num_stages}")
-        per_stage = max(len(trunk) // max(self.num_stages, 1), 1)
+        S, v = self.num_stages, self.interleave
+        U = S * v  # virtual units, traversal order u = chunk*S + stage
+        if S > 1:
+            if seg_sizes is not None:
+                seg_sizes = [int(s) for s in seg_sizes]
+                if len(seg_sizes) != U or sum(seg_sizes) != len(trunk):
+                    raise ValueError(
+                        f"seg_sizes {seg_sizes} must have {U} entries "
+                        f"summing to the trunk length {len(trunk)}")
+                if any(s < 0 for s in seg_sizes):
+                    raise ValueError("seg_sizes entries must be >= 0")
+            else:
+                # uniform with remainder to the FIRST units (the
+                # reference's seg_method='uniform' segmentation)
+                base_n, rem = divmod(len(trunk), U)
+                seg_sizes = [base_n + (1 if u < rem else 0)
+                             for u in range(U)]
+                if base_n == 0 and rem == 0:
+                    raise ValueError("empty trunk cannot be pipelined")
+        self.seg_sizes = seg_sizes
 
         self.pre = Sequential(*built[:t0])
         self.post = Sequential(*built[t1:])
 
-        # one stage = `per_stage` consecutive trunk blocks
-        units = [Sequential(*trunk[k * per_stage:(k + 1) * per_stage])
-                 for k in range(self.num_stages)] or [Sequential()]
-        # template holds the structure; its param VALUES are never used
-        # after stacking. Plain-list stash avoids sublayer registration
-        # (stacked Parameters below are the real trainable state).
-        self._unit_template = [units[0]]
-        self._unit_state_names = list(units[0].state_dict().keys())
+        # template holds the block structure; its param VALUES are never
+        # used after stacking. Plain-list stash avoids sublayer
+        # registration (stacked Parameters below are the real state).
+        self._block_template = [trunk[0] if trunk else Sequential()]
+        self._block_state_names = (
+            list(trunk[0].state_dict().keys()) if trunk else [])
 
-        # stack each param/buffer across stages -> leading 'pp' dim
+        # stack every block's params/buffers -> [S, v, maxB, ...] with
+        # the stage dim sharded P('pp'); padding blocks (unbalanced
+        # units) reuse block 0's values and are masked in the inner scan
         self._stacked_names: Dict[str, str] = {}
-        if self.num_stages > 1:
-            tmpl_state = units[0].state_dict()
-            param_names = {n for n, _ in units[0].named_parameters()}
-            for name in self._unit_state_names:
-                vals = [u.state_dict()[name]._data for u in units]
-                stacked = jnp.stack(vals, axis=0)
+        if S > 1:
+            maxB = max(seg_sizes) if seg_sizes else 1
+            self._max_blocks = maxB
+            offs = np.concatenate([[0], np.cumsum(seg_sizes)])
+            tmpl_state = trunk[0].state_dict()
+            param_names = {n for n, _ in trunk[0].named_parameters()}
+            for name in self._block_state_names:
+                rows = []
+                for s in range(S):
+                    chunk_rows = []
+                    for c in range(v):
+                        u = c * S + s
+                        blocks = trunk[offs[u]:offs[u + 1]]
+                        vals = [b.state_dict()[name]._data
+                                for b in blocks]
+                        while len(vals) < maxB:  # padding (masked off)
+                            vals.append(tmpl_state[name]._data)
+                        chunk_rows.append(jnp.stack(vals, axis=0))
+                    rows.append(jnp.stack(chunk_rows, axis=0))
+                stacked = jnp.stack(rows, axis=0)  # [S, v, maxB, ...]
                 base = getattr(tmpl_state[name], "spec", P())
-                spec = P("pp", *tuple(base))
-                reg = _sanitize("stage_stack." + name)
+                spec = P("pp", None, None, *tuple(base))
+                reg = _sanitize("block_stack." + name)
                 self._stacked_names[name] = reg
                 if name in param_names:
                     p = Parameter(stacked)
@@ -217,9 +259,13 @@ class PipelineLayer(Layer):
                     t = Tensor(stacked)
                     t.spec = spec
                     self.register_buffer(reg, t)
+            # per-[stage, chunk] real-block counts, rides shard_map
+            self._seg_counts = np.array(
+                [[seg_sizes[c * S + s] for c in range(v)]
+                 for s in range(S)], dtype=np.int32)
         else:
-            # degenerate: single stage, keep the unit as a normal sublayer
-            self.stage0 = units[0]
+            # degenerate: single stage, keep the trunk as a sublayer
+            self.stage0 = Sequential(*trunk)
 
     # ------------------------------------------------------------------ util
     def _microbatches(self, batch: int) -> int:
@@ -229,15 +275,32 @@ class PipelineLayer(Layer):
                              f"num_microbatches {m}")
         return m
 
-    def _unit_call(self, state_vals: Dict[str, Any], x: jax.Array):
+    def _unit_call(self, names, pstacks: Sequence[jax.Array], cnt,
+                   x: jax.Array):
+        """Apply one unit = inner scan over its <= maxB blocks; padding
+        blocks (j >= cnt) pass the activation through unchanged."""
         from ...jit.api import functional_call
-        unit = self._unit_template[0]
-        body = lambda arr: functional_call(
-            unit, {k: v for k, v in state_vals.items()}, Tensor(arr))._data
+        block = self._block_template[0]
+
+        def block_body(pvals, arr):
+            return functional_call(
+                block, {k: v for k, v in zip(names, pvals)},
+                Tensor(arr))._data
+
         if self.use_recompute and self.training:
-            body = jax.checkpoint(
-                body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-        return body(x)
+            block_body = jax.checkpoint(
+                block_body,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+
+        def step(arr, sl):
+            pvals, j = sl
+            out = block_body(pvals, arr)
+            return jnp.where(j < cnt, out, arr), None
+
+        x, _ = jax.lax.scan(
+            step, x, (list(pstacks), jnp.arange(pstacks[0].shape[0])))
+        return x
 
     @staticmethod
     def _run_segment(seg: Sequential, *inputs):
@@ -267,6 +330,11 @@ class PipelineLayer(Layer):
         raw = x._data if isinstance(x, Tensor) else x
         b = raw.shape[0]
         m = self._microbatches(b)
+        if self.interleave > 1 and m < self.num_stages:
+            raise ValueError(
+                f"interleaved pipeline needs num_microbatches ({m}) >= "
+                f"num_stages ({self.num_stages}) so a chunk's output has "
+                f"left the ring before its next chunk enters")
         mb = raw.reshape((m, b // m) + raw.shape[1:])
 
         names = list(self._stacked_names.keys())
@@ -279,54 +347,86 @@ class PipelineLayer(Layer):
         specs = [P("pp") for _ in regs]
 
         out = _spmd_pipeline(
-            self._unit_call, names, stacked_vals, specs, mb, mesh,
-            self.num_stages)
+            self._unit_call, names, stacked_vals, specs,
+            jnp.asarray(self._seg_counts), mb, mesh,
+            self.num_stages, self.interleave)
         out = out.reshape((b,) + out.shape[2:])
         return self.post(Tensor(out) if isinstance(x, Tensor) else out)
 
 
-def _spmd_pipeline(unit_call, names, stacked_vals, specs, mb, mesh,
-                   num_stages: int):
-    """The collective pipeline loop (the 1F1B/GPipe schedule as one SPMD
-    program; ≈ pipeline_parallel.py:117 forward_backward_pipeline)."""
+def _spmd_pipeline(unit_call, names, stacked_vals, specs, seg_counts,
+                   mb, mesh, num_stages: int, interleave: int = 1):
+    """The collective circular-pipeline loop.
+
+    Schedule (the SPMD form of pipeline_parallel.py:117 1F1B and :457
+    interleave): virtual microbatch k = chunk*M + mu flows chunk-major
+    through the S-stage ring; device s at tick t works on k = t - s with
+    its chunk-(k // M) unit. Chunk c's input for mu is chunk c-1's
+    output, which left stage S-1 at tick (k - M) + S - 1 <= t - 1 (needs
+    M >= S) and was banked in stage 0's `inter` buffer on arrival.
+    Ticks = v*M + S - 1, so the bubble is (S-1)/(vM+S-1)."""
     S = num_stages
+    v = interleave
     M = mb.shape[0]
-    steps = M + S - 1
+    steps = v * M + S - 1
     ring = [(i, (i + 1) % S) for i in range(S)]
 
-    def per_device(mb_local, *param_slices):
+    def per_device(mb_local, cnt_local, *param_slices):
         stage = jax.lax.axis_index("pp")
-        # shard_map gives each device a [1, ...] slice of the stack
-        pvals = {n: v[0] for n, v in zip(names, param_slices)}
+        # shard_map gives each device a [1, v, maxB, ...] slice
+        stacks = [val[0] for val in param_slices]   # [v, maxB, ...]
+        cnts = cnt_local[0]                         # [v]
 
         def tick(carry, t):
-            act, outs = carry
-            feed = jax.lax.dynamic_index_in_dim(
-                mb_local, jnp.minimum(t, M - 1), 0, keepdims=False)
+            act, inter, outs = carry
+            # bank the ring arrival (stage S-1's tick t-1 output) —
+            # only stage 0 ever reads it, as chunk c>0 input
+            k_arr = t - S
+            mu_arr = jnp.clip(k_arr, 0, v * M - 1) % M
+            bank = (k_arr >= 0) & (k_arr // M < v - 1)
+            inter = jnp.where(
+                bank,
+                jax.lax.dynamic_update_index_in_dim(inter, act, mu_arr, 0),
+                inter)
+
+            k = t - stage
+            valid = (k >= 0) & (k < v * M)
+            kc = jnp.clip(k, 0, v * M - 1)
+            c = kc // M
+            mu = kc % M
+            feed0 = jax.lax.dynamic_index_in_dim(mb_local, mu, 0,
+                                                 keepdims=False)
+            feedc = jax.lax.dynamic_index_in_dim(inter, mu, 0,
+                                                 keepdims=False)
+            feed = jnp.where(c == 0, feed0, feedc)
             inp = jnp.where(stage == 0, feed, act)
-            out = unit_call(pvals, inp)
-            # stage S-1's output for microbatch t-(S-1); earlier (bubble)
-            # writes land clipped at index 0 and are overwritten at the
-            # first real tick, so an unconditional write is correct.
-            cidx = jnp.clip(t - (S - 1), 0, M - 1)
-            outs = jax.lax.dynamic_update_index_in_dim(outs, out, cidx, 0)
+            pstacks = [jax.lax.dynamic_index_in_dim(sv, c, 0,
+                                                    keepdims=False)
+                       for sv in stacks]
+            out = unit_call(names, pstacks, cnts[c], inp)
+            is_final = (stage == S - 1) & valid & (c == v - 1)
+            outs = jnp.where(
+                is_final,
+                jax.lax.dynamic_update_index_in_dim(outs, out, mu, 0),
+                outs)
             act = jax.lax.ppermute(out, "pp", ring)
-            return (act, outs), None
+            return (act, inter, outs), None
 
         init = jax.lax.pcast(
-            (jnp.zeros_like(mb_local[0]), jnp.zeros_like(mb_local)),
+            (jnp.zeros_like(mb_local[0]), jnp.zeros_like(mb_local),
+             jnp.zeros_like(mb_local)),
             ("pp",), to="varying")
-        (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(steps))
+        (_, _, outs), _ = jax.lax.scan(tick, init, jnp.arange(steps))
         # [1, M, mb, ...] local -> global leading dim S over 'pp'; only
         # stage S-1's slice is real, sliced out by the caller.
         return outs[None]
 
     fn = jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(P(),) + tuple(specs),
+        in_specs=(P(), P("pp")) + tuple(specs),
         out_specs=P("pp"),
         axis_names={"pp"})
-    all_stage_outs = fn(mb, *stacked_vals)
+    all_stage_outs = fn(mb, seg_counts, *stacked_vals)
     return all_stage_outs[S - 1]
 
 

@@ -28,13 +28,84 @@ from jax.sharding import Mesh
 AXIS_ORDER = ("dp", "sharding", "pp", "sp", "ep", "mp")
 
 
+def _slice_groups(devices) -> List[List]:
+    """Group devices by TPU slice (multi-slice pods expose
+    `slice_index` on each device; anything else is one group)."""
+    groups: Dict = {}
+    for d in devices:
+        key = getattr(d, "slice_index", None)
+        groups.setdefault(key if key is not None else 0, []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def create_hybrid_device_mesh(degrees: Dict[str, int],
+                              devices: Optional[Sequence] = None,
+                              slices: Optional[Sequence[Sequence]] = None,
+                              dcn_axis: str = "dp") -> Mesh:
+    """DCN-aware mesh: `dcn_axis` (dp by default) is the ONLY axis that
+    crosses slice boundaries; every other axis lives inside one slice so
+    its collectives ride ICI. This is the explicit analog of the
+    reference's hierarchical ProcessGroupHeter (inner NCCL ring per node
+    + outer Gloo ring across nodes, ProcessGroupHeter.h:128-134): here
+    the inner ring is an ICI slice and the outer ring is DCN.
+
+    `slices` overrides slice discovery (testing / virtual meshes); the
+    default groups by each device's `slice_index`.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    groups = [list(g) for g in slices] if slices is not None \
+        else _slice_groups(devices)
+    n_slices = len(groups)
+    names = [a for a in AXIS_ORDER if a in degrees]
+    for a in degrees:
+        if a not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {a!r} (of {AXIS_ORDER})")
+    shape = [degrees[a] for a in names]
+    total = int(np.prod(shape))
+    if total != len(devices):
+        raise ValueError(
+            f"degree product {total} != {len(devices)} devices")
+    if n_slices == 1:
+        return Mesh(np.array(devices).reshape(shape), tuple(names))
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(f"unequal slice sizes {sorted(sizes)}; "
+                         "a hybrid mesh needs homogeneous slices")
+    dcn_degree = degrees.get(dcn_axis, 1)
+    if dcn_degree % n_slices != 0:
+        raise ValueError(
+            f"{dcn_axis} degree {dcn_degree} must be a multiple of the "
+            f"slice count {n_slices} — only {dcn_axis!r} may span DCN; "
+            "raise it or fold the other axes into one slice")
+    per_slice_dcn = dcn_degree // n_slices
+    inner = [degrees[a] for a in names if a != dcn_axis]
+    per_slice = per_slice_dcn * int(np.prod(inner)) if inner \
+        else per_slice_dcn
+    if per_slice != len(groups[0]):
+        raise ValueError(
+            f"per-slice layout {per_slice} != slice size "
+            f"{len(groups[0])} (degrees={degrees}, slices={n_slices})")
+    # slice-major along the DCN axis: rows [s*per_dcn, (s+1)*per_dcn)
+    # of `dcn_axis` come wholly from slice s, so each non-dcn
+    # hyperplane is intra-slice and only dcn-axis collectives cross DCN
+    dcn_pos = names.index(dcn_axis)
+    blocks = []
+    for g in groups:
+        block_shape = list(shape)
+        block_shape[dcn_pos] = per_slice_dcn
+        blocks.append(np.array(g).reshape(block_shape))
+    arr = np.concatenate(blocks, axis=dcn_pos)
+    return Mesh(arr, tuple(names))
+
+
 class HybridCommunicateGroup:
     """Builds and owns the device mesh for hybrid parallelism."""
 
     def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
                  pp_degree: int = 1, sharding_degree: int = 1,
                  sp_degree: int = 1, ep_degree: int = 1,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 slices: Optional[Sequence[Sequence]] = None):
         devices = list(devices if devices is not None else jax.devices())
         degrees = {"dp": dp_degree, "sharding": sharding_degree,
                    "pp": pp_degree, "sp": sp_degree, "ep": ep_degree,
@@ -56,8 +127,8 @@ class HybridCommunicateGroup:
                     f"degree product {total} != {len(devices)} devices "
                     f"(degrees={degrees}); adjust hybrid_configs")
         self.degrees: Dict[str, int] = degrees
-        shape = [degrees[a] for a in AXIS_ORDER]
-        self.mesh = Mesh(np.array(devices).reshape(shape), AXIS_ORDER)
+        self.mesh = create_hybrid_device_mesh(
+            dict(degrees), devices=devices, slices=slices)
 
     # --- paddle-parity accessors (fleet/base/topology.py API) -------------
     def get_data_parallel_world_size(self) -> int:

@@ -1,0 +1,232 @@
+"""Top-level framework utilities and parity shims.
+
+Reference: python/paddle/framework/ + assorted top-level exports in
+python/paddle/__init__.py (is_tensor/iinfo/set_printoptions/Places/
+DataParallel/LazyGuard/batch/...). TPU-native notes inline; CUDA-named
+APIs are parity shims that map onto the single-device-family reality.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core import dtype as dtype_mod
+from .core import random as random_mod
+from .core.device import Place
+from .core.tensor import Tensor
+
+
+# -------------------------------------------------------- type predicates
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _dtype_of(x):
+    if isinstance(x, Tensor):
+        return x.dtype
+    return jnp.asarray(x).dtype
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.floating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(_dtype_of(x), jnp.integer)
+
+
+def rank(x) -> Tensor:
+    return Tensor(jnp.asarray(
+        x.ndim if isinstance(x, Tensor) else jnp.ndim(x), jnp.int32))
+
+
+def tolist(x):
+    return x.tolist() if isinstance(x, Tensor) else np.asarray(x).tolist()
+
+
+def is_empty(x) -> Tensor:
+    n = x.size if isinstance(x, Tensor) else jnp.size(x)
+    return Tensor(jnp.asarray(n == 0))
+
+
+# --------------------------------------------------------- dtype queries
+class iinfo:
+    """paddle.iinfo parity (numpy-backed)."""
+
+    def __init__(self, dtype):
+        info = np.iinfo(np.dtype(dtype_mod.convert_dtype(dtype)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+class finfo:
+    """paddle.finfo parity (ml_dtypes-aware for bfloat16)."""
+
+    def __init__(self, dtype):
+        import ml_dtypes
+        d = dtype_mod.convert_dtype(dtype)
+        info = ml_dtypes.finfo(d) if d == jnp.bfloat16 else np.finfo(d)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(getattr(info, "smallest_normal",
+                                             info.tiny))
+        self.bits = int(info.bits)
+        self.dtype = str(d)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Bridge to numpy printoptions (Tensor repr prints via numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# ------------------------------------------------------------ RNG shims
+def get_cuda_rng_state():
+    """Parity shim: the single accelerator RNG state (jax key)."""
+    return random_mod.get_state()
+
+
+def set_cuda_rng_state(state):
+    random_mod.set_state(state)
+
+
+def disable_signal_handler():
+    """No-op parity shim: jax installs no signal handlers to disable."""
+
+
+# ------------------------------------------------------------ Place shims
+class CPUPlace(Place):
+    def __init__(self):
+        import jax
+        cpus = [d for d in jax.devices("cpu")] if _has_platform("cpu") \
+            else jax.devices()
+        super().__init__(cpus[0])
+
+
+class CUDAPlace(Place):
+    """Parity shim: maps to the accelerator device (TPU here)."""
+
+    def __init__(self, device_id: int = 0):
+        import jax
+        devs = jax.devices()
+        super().__init__(devs[device_id % len(devs)])
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class NPUPlace(CUDAPlace):
+    pass
+
+
+def _has_platform(name: str) -> bool:
+    import jax
+    try:
+        jax.devices(name)
+        return True
+    except RuntimeError:
+        return False
+
+
+# ------------------------------------------------------------- wrappers
+class LazyGuard:
+    """Parity shim for paddle.LazyGuard (delayed parameter init). Layers
+    here initialize eagerly but cheaply (jax arrays are lazy buffers),
+    so the guard is a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch (reference python/paddle/batch.py): wrap a sample
+    reader into a batch reader."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def check_shape(shape):
+    """paddle.check_shape (reference tensor/random.py): validate a shape
+    argument for creation ops."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if isinstance(s, Tensor):
+            continue
+        if int(s) < -1 or int(s) == 0:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+class DataParallel:
+    """paddle.DataParallel parity (reference
+    python/paddle/fluid/dygraph/parallel.py:457). TPU-native data
+    parallelism is a sharding annotation, not a wrapper — gradients are
+    reduced by XLA when the train step runs under a dp-sharded mesh
+    (distributed.fleet.train_step). This wrapper keeps user code
+    portable: it delegates everything to the inner layer and exposes the
+    reference's no-sync/scale-loss API as no-ops."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)

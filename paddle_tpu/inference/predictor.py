@@ -141,26 +141,65 @@ class Predictor:
             vals = [v.astype(target)
                     if jnp.issubdtype(v.dtype, jnp.floating) else v
                     for v in vals]
+        scales: Dict[str, jax.Array] = {}
+        if prec == PrecisionType.Int8:
+            # int8 serving (the reference's PTQ deployment,
+            # slim/quantization/post_training_quantization.py):
+            # Linear/Conv weights live in HBM as int8 + per-channel
+            # scales; dequant happens INSIDE the compiled program where
+            # XLA fuses it into the matmul/conv read. Activations run
+            # bf16 (weight-only int8 — the practical TPU mode; a PTQ'd
+            # model additionally fake-quants activations with its
+            # calibrated scales). Works for PTQ-converted models and as
+            # dynamic weight-only quantization for plain models.
+            from ..nn.layers_common import Conv2D, Linear
+            from ..quantization.fake_quant import quantize_int8
+            axes: Dict[str, int] = {}
+            for lname, sub in layer.named_sublayers():
+                if isinstance(sub, Linear):
+                    axes[f"{lname}.weight"] = 1
+                elif isinstance(sub, Conv2D):
+                    axes[f"{lname}.weight"] = 0
+            new_vals = []
+            for n, v in zip(names, vals):
+                if n in axes and jnp.issubdtype(v.dtype, jnp.floating):
+                    q, s = quantize_int8(v, axis=axes[n])
+                    new_vals.append(q)
+                    # q = round(x / s * 127)  =>  x ≈ q * (s / 127)
+                    scales[n] = jnp.asarray(s, jnp.float32) / 127.0
+                elif jnp.issubdtype(v.dtype, jnp.floating):
+                    new_vals.append(v.astype(jnp.bfloat16))
+                else:
+                    new_vals.append(v)
+            vals = new_vals
         specs = [_to_sds(s) for s in self.config._input_spec]
         self._input_names = [f"x{i}" for i in range(len(specs))]
         self._output_names = None
 
         def fwd(param_vals, *inputs):
-            out = functional_call(layer, dict(zip(names, param_vals)),
+            dequant = []
+            for n, v in zip(names, param_vals):
+                if n in scales:
+                    v = v.astype(jnp.bfloat16) * \
+                        scales[n].astype(jnp.bfloat16)
+                dequant.append(v)
+            out = functional_call(layer, dict(zip(names, dequant)),
                                   *[Tensor(i) for i in inputs])
             return [t._data if isinstance(t, Tensor) else t
                     for t in jax.tree_util.tree_leaves(
                         out, is_leaf=lambda x: isinstance(x, Tensor))]
 
         jitted = jax.jit(fwd)
+        low_prec = (PrecisionType.Bfloat16, PrecisionType.Half,
+                    PrecisionType.Int8)
 
         def run_fn(feeds: List[jax.Array]):
             cast = []
             for f, spec in zip(feeds, specs):
-                if prec in (PrecisionType.Bfloat16, PrecisionType.Half) \
-                        and jnp.issubdtype(f.dtype, jnp.floating):
-                    tgt = jnp.bfloat16 if prec == PrecisionType.Bfloat16 \
-                        else jnp.float16
+                if prec in low_prec and \
+                        jnp.issubdtype(f.dtype, jnp.floating):
+                    tgt = jnp.float16 if prec == PrecisionType.Half \
+                        else jnp.bfloat16
                     f = f.astype(tgt)
                 cast.append(f)
             return jitted(vals, *cast)

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from ..core.tensor import Parameter, Tensor, no_grad
+from ..optimizer.optimizer import opt_key as _opt_key
 from ..nn.layer import Layer
 
 
@@ -107,11 +108,25 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
 jit = to_static  # alias
 
 
-def grad(fn: Callable, argnums=0, has_aux: bool = False):
-    """Functional gradient of a Tensor-level function (jax.grad with Tensor
-    marshalling). This is the jit-compatible autodiff; the eager tape's
-    .backward() is the dygraph one."""
+def grad(*fargs, **fkwargs):
+    """Dual-personality `paddle.grad`:
 
+    - grad(fn, argnums=0, has_aux=False) -> functional transform
+      (jax.grad with Tensor marshalling), the jit-compatible autodiff.
+    - grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+      create_graph=False, only_inputs=True, allow_unused=False,
+      no_grad_vars=None) -> reference dygraph API
+      (python/paddle/fluid/dygraph/base.py grad()): tape-based grads of
+      output Tensors w.r.t. input Tensors, incl. create_graph=True for
+      double grad. Delegates to autograd.backward_engine.tensor_grad.
+    """
+    if fargs and callable(fargs[0]) and not isinstance(fargs[0], Tensor):
+        return _functional_grad(*fargs, **fkwargs)
+    from ..autograd.backward_engine import tensor_grad
+    return tensor_grad(*fargs, **fkwargs)
+
+
+def _functional_grad(fn: Callable, argnums=0, has_aux: bool = False):
     def wrapped(*args, **kwargs):
         def pure(*raw_args):
             targs = jax.tree_util.tree_map(_wrap, raw_args)
@@ -162,11 +177,19 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
-                 donate: bool = True, sharding=None):
+                 donate: bool = True, sharding=None,
+                 offload_opt_state: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self._sharding = sharding
+        # offload_opt_state: park optimizer moments in host memory
+        # (pinned_host) between steps — HBM relief for big-batch /
+        # long-seq configs at the cost of PCIe streaming per step (the
+        # reference's sharding offload, group_sharded_storage.py).
+        # Falls back silently where the backend lacks memory kinds.
+        self._offload = offload_opt_state
+        self._host_shardings = None
 
         self._param_names = [n for n, _ in model.named_parameters()]
         self._opt_state_tree = None
@@ -187,7 +210,48 @@ class TrainStep:
             return loss, new_params, new_state
 
         donate_argnums = (0, 1) if donate else ()
+        self._step_fn = step_fn
+        self._donate_argnums = donate_argnums
         self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+
+    def _setup_offload(self):
+        """Re-jit with the opt state parked in pinned host memory: the
+        step transfers moments host->HBM, updates, and writes them back
+        host-side, so they are never HBM-resident between steps."""
+        leaves = jax.tree_util.tree_leaves(self._opt_state_tree)
+        dev = next(iter(leaves[0].devices())) if leaves \
+            else jax.devices()[0]
+        if dev.platform != "tpu":
+            self._offload = False  # only TPU has a distinct host space
+            return
+        try:
+            host = jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="pinned_host")
+            devmem = jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="device")
+            state_sh = jax.tree_util.tree_map(
+                lambda _: host, self._opt_state_tree)
+            inner = self._step_fn
+
+            def offload_step(param_vals, opt_state, lr, step_no, *batch):
+                opt_dev = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, devmem), opt_state)
+                loss, new_params, new_state = inner(
+                    param_vals, opt_dev, lr, step_no, *batch)
+                new_host = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, host), new_state)
+                return loss, new_params, new_host
+
+            self._jitted = jax.jit(
+                offload_step, donate_argnums=self._donate_argnums)
+            self._opt_state_tree = jax.device_put(
+                self._opt_state_tree, state_sh)
+            self._host_shardings = state_sh
+        except Exception:
+            # backend without memory-kind support: resident-state path
+            self._jitted = jax.jit(
+                self._step_fn, donate_argnums=self._donate_argnums)
+            self._offload = False
 
     def __call__(self, *batch):
         params = [p for _, p in self.model.named_parameters()]
@@ -195,8 +259,10 @@ class TrainStep:
             # seed from the optimizer's own state when present (e.g. a
             # restored checkpoint via opt.set_state_dict) so resume works
             self._opt_state_tree = [
-                self.optimizer._state.get(id(p))
+                self.optimizer._state.get(_opt_key(p))
                 or self.optimizer.init_state_for(p) for p in params]
+            if self._offload:
+                self._setup_offload()
         lr = self.optimizer.get_lr()
         self.optimizer._step_count += 1
         raw_batch = tuple(
@@ -211,9 +277,34 @@ class TrainStep:
         # mirror the functional state back so optimizer.state_dict()
         # checkpoints the live accumulators
         for p, st in zip(params, self._opt_state_tree):
-            self.optimizer._state[id(p)] = st
+            self.optimizer._state[_opt_key(p)] = st
         from ..optimizer.lr import LRScheduler
         if isinstance(self.optimizer._lr, LRScheduler) and \
                 self.optimizer._lr._step_each_iter:
             self.optimizer._lr.step()
         return _wrap(loss)
+
+    def cost_analysis(self, *batch):
+        """XLA's cost model for the compiled step on these inputs
+        (['flops'], bytes accessed, ...) — bench.py derives MFU from it
+        instead of hand-maintained per-model formulas (the reference's
+        op cost-model table, cost_model/static_op_benchmark.json, is a
+        measured equivalent)."""
+        params = [p for _, p in self.model.named_parameters()]
+        if self._opt_state_tree is None:
+            self._opt_state_tree = [
+                self.optimizer._state.get(_opt_key(p))
+                or self.optimizer.init_state_for(p) for p in params]
+            if self._offload:
+                # keep offload active even when cost_analysis seeds the
+                # state before the first real step
+                self._setup_offload()
+        raw_batch = tuple(
+            jax.tree_util.tree_map(
+                _unwrap, b, is_leaf=lambda t: isinstance(t, Tensor))
+            for b in batch)
+        lowered = self._jitted.lower(
+            [p._data for p in params], self._opt_state_tree,
+            np.float32(self.optimizer.get_lr()),
+            np.int32(self.optimizer._step_count + 1), *raw_batch)
+        return lowered.compile().cost_analysis()

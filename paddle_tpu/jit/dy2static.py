@@ -12,12 +12,14 @@ keep eager if/while semantics — the convert_* helpers dispatch on
 whether the predicate is a Tensor/tracer at runtime, exactly like the
 reference's convert_ifelse does.
 
-Scope (documented divergences from the reference's full transformer
-set): `if`/`if-else` and `while` are converted; both branches/the loop
-body must assign compatible (same shape/dtype) values to the variables
-that live past the construct; `for x in tensor` stays Python (use
-paddle.static.nn.while_loop / lax.scan style code for traced loops);
-break/continue inside converted `while` are not supported.
+Scope: `if`/`if-else`, `while`, and `for` (over tensors / indexables /
+`range`, incl. a traced trip count) are converted, with break/continue
+rewritten to carried flags (the reference's loop_transformer.py +
+break_continue_transformer.py pair). Both branches / the loop body must
+assign compatible (same shape/dtype) values to the variables that live
+past the construct; `return` inside a converted construct stays Python.
+`for` over non-indexables (generators, zip, dicts) falls back to the
+original Python loop at runtime.
 """
 from __future__ import annotations
 
@@ -144,6 +146,72 @@ def convert_while_loop(cond_fn: Callable, body_fn: Callable,
     return jax.lax.while_loop(cond, body, raw)
 
 
+def _any_traced(*vals) -> bool:
+    return any(isinstance(_raw(v), jax.core.Tracer) for v in vals)
+
+
+def convert_logical_and(a, b):
+    """Runtime `and` that stays traceable (loop conditions combine the
+    user test with the break flag)."""
+    if _any_traced(a, b):
+        return jnp.logical_and(jnp.asarray(_raw(a)).astype(bool),
+                               jnp.asarray(_raw(b)).astype(bool))
+    return _bool(a) and _bool(b)
+
+
+def convert_logical_not(a):
+    if _any_traced(a):
+        return jnp.logical_not(jnp.asarray(_raw(a)).astype(bool))
+    return not _bool(a)
+
+
+def convert_no_jump(brk, cnt):
+    """True while neither break nor continue has fired this iteration."""
+    if _any_traced(brk, cnt):
+        return jnp.logical_not(jnp.logical_or(
+            jnp.asarray(_raw(brk)).astype(bool),
+            jnp.asarray(_raw(cnt)).astype(bool)))
+    return not (_bool(brk) or _bool(cnt))
+
+
+def convert_indexable(it) -> bool:
+    """Should the for-loop desugar take over this iterable? Only
+    Tensor/jax arrays: their trip count is a STATIC shape and indexing
+    with a traced counter lowers to dynamic_slice. Python sequences
+    (list/tuple/range-object/ndarray) keep the original Python loop —
+    it unrolls cleanly under tracing, and indexing them with a traced
+    counter would be impossible anyway."""
+    return isinstance(it, (Tensor, jax.Array))
+
+
+def convert_len(it):
+    if isinstance(it, Tensor):
+        return it.shape[0]
+    if isinstance(it, jax.Array):
+        return it.shape[0]
+    return len(it)
+
+
+def convert_getitem(it, i):
+    if isinstance(it, (list, tuple, range)):
+        return it[int(i)] if not _any_traced(i) else it[i]
+    return it[i]
+
+
+def convert_range_len(start, stop, step):
+    """Trip count of range(start, stop, step); works for traced
+    operands of either step sign (ceil division toward the step's
+    direction, clamped at zero)."""
+    if _any_traced(start, stop, step):
+        s0 = jnp.asarray(_raw(start))
+        s1 = jnp.asarray(_raw(stop))
+        st = jnp.asarray(_raw(step))
+        adj = jnp.where(st > 0, st - 1, st + 1)
+        return jnp.maximum(0, (s1 - s0 + adj) // st)
+    return len(range(int(_raw(start)), int(_raw(stop)),
+                     int(_raw(step))))
+
+
 # --------------------------------------------------------------- AST pass
 class _AssignedNames(ast.NodeVisitor):
     def __init__(self):
@@ -187,6 +255,40 @@ def _has_jump(stmts: Sequence[ast.stmt]) -> bool:
     return any(walk(s) for s in stmts)
 
 
+def _has_return(stmts: Sequence[ast.stmt]) -> bool:
+    def walk(node) -> bool:
+        if isinstance(node, ast.Return):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return False
+        return any(walk(c) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s) for s in stmts)
+
+
+def _stmt_has_break_continue(node) -> bool:
+    """break/continue belonging to THIS loop level (not crossing nested
+    loops or function bodies)."""
+    if isinstance(node, (ast.Break, ast.Continue)):
+        return True
+    if isinstance(node, (ast.For, ast.While, ast.FunctionDef,
+                         ast.AsyncFunctionDef, ast.Lambda)):
+        return False
+    return any(_stmt_has_break_continue(c)
+               for c in ast.iter_child_nodes(node))
+
+
+# carried generated variables use the __jstv_ prefix so the loop-var
+# collectors keep them (plain __jst_* names are helper FUNCTIONS and
+# are filtered out); per-loop numbering keeps nested loops' flags apart
+
+
+def _carried(names):
+    return [n for n in names
+            if not n.startswith("__jst") or n.startswith("__jstv")]
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     """Rewrites tensor-convertible `if` and `while` statements into
     convert_ifelse / convert_while_loop calls."""
@@ -200,6 +302,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         # level un-binds leftover sentinels; inner levels pass _UNDEF
         # through (it raises NameError on any real use).
         self._depth = 0
+        self._uid = 0  # per-construct counter for generated var names
 
     def _load(self, name):
         return ast.Name(id=name, ctx=ast.Load())
@@ -256,9 +359,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if _has_jump(node.body) or _has_jump(node.orelse):
             return node
         out_names = []
-        for n in _assigned(node.body) + _assigned(node.orelse):
-            # __jst_* helper defs from nested conversions are internal
-            if n not in out_names and not n.startswith("__jst"):
+        for n in _carried(_assigned(node.body) + _assigned(node.orelse)):
+            # __jst_* helper defs from nested conversions are internal;
+            # __jstv_* carried flags/counters stay
+            if n not in out_names:
                 out_names.append(n)
         if not out_names:
             return node  # pure side-effect-free branch: keep python
@@ -282,18 +386,86 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             stmts += self._undef_cleanup(out_names)
         return stmts
 
+    # ---- break/continue -> carried flags (reference
+    # break_continue_transformer.py). Statements after a potential
+    # jump point are wrapped in `if __jst_no_jump(brk, cnt):` guards;
+    # dead code directly after a bare break/continue is dropped.
+    def _rewrite_jumps(self, stmts, brk, cnt):
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(self._assign_name(brk, ast.Constant(True)))
+                return out
+            if isinstance(s, ast.Continue):
+                out.append(self._assign_name(cnt, ast.Constant(True)))
+                return out
+            if isinstance(s, ast.If) and _stmt_has_break_continue(s):
+                out.append(ast.If(
+                    test=s.test,
+                    body=self._rewrite_jumps(s.body, brk, cnt),
+                    orelse=self._rewrite_jumps(s.orelse, brk, cnt)
+                    if s.orelse else []))
+                rest = stmts[i + 1:]
+                if rest:
+                    out.append(ast.If(
+                        test=ast.Call(
+                            func=self._load("__jst_no_jump"),
+                            args=[self._load(brk), self._load(cnt)],
+                            keywords=[]),
+                        body=self._rewrite_jumps(rest, brk, cnt),
+                        orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+    def _assign_name(self, name, value):
+        return ast.Assign(
+            targets=[ast.Name(id=name, ctx=ast.Store())], value=value)
+
     def visit_While(self, node: ast.While):
+        if _has_return(node.body) or node.orelse:
+            self._depth += 1
+            try:
+                self.generic_visit(node)
+            finally:
+                self._depth -= 1
+            return node
+        pre: list = []
+        if any(_stmt_has_break_continue(s) for s in node.body):
+            self._uid += 1
+            brk = f"__jstv_brk{self._uid}"
+            cnt = f"__jstv_cnt{self._uid}"
+            new_body = [self._assign_name(cnt, ast.Constant(False))] + \
+                self._rewrite_jumps(list(node.body), brk, cnt)
+            if any(_stmt_has_break_continue(s) for s in new_body):
+                # a break/continue under with/try/... survived the
+                # rewrite — moving it into a generated function would
+                # be a SyntaxError; leave the loop to Python
+                self._depth += 1
+                try:
+                    self.generic_visit(node)
+                finally:
+                    self._depth -= 1
+                return node
+            pre = [self._assign_name(brk, ast.Constant(False)),
+                   self._assign_name(cnt, ast.Constant(False))]
+            node = ast.While(
+                test=ast.Call(
+                    func=self._load("__jst_and"),
+                    args=[ast.Call(func=self._load("__jst_not"),
+                                   args=[self._load(brk)], keywords=[]),
+                          node.test],
+                    keywords=[]),
+                body=new_body,
+                orelse=[])
         self._depth += 1
         try:
             self.generic_visit(node)
         finally:
             self._depth -= 1
-        if _has_jump(node.body) or node.orelse:
-            return node
-        loop_names = [n for n in _assigned(node.body)
-                      if not n.startswith("__jst")]
+        loop_names = _carried(_assigned(node.body))
         if not loop_names:
-            return node
+            return pre + [node] if pre else node
         args = ast.arguments(
             posonlyargs=[],
             args=[ast.arg(arg=n) for n in loop_names],
@@ -319,10 +491,146 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 elts=[ast.Name(id=n, ctx=ast.Store())
                       for n in loop_names], ctx=ast.Store())],
             value=call)
-        stmts = [cond_fn, body_fn, assign]
+        stmts = pre + [cond_fn, body_fn, assign]
         if self._depth == 0:
             stmts += self._undef_cleanup(loop_names)
         return stmts
+
+    # ---- `for` -> while desugar (reference loop_transformer.py) -------
+    def visit_For(self, node: ast.For):
+        if node.orelse or _has_return(node.body):
+            self._depth += 1
+            try:
+                self.generic_visit(node)
+            finally:
+                self._depth -= 1
+            return node
+        import copy
+        orig = copy.deepcopy(node)
+
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and 1 <= len(node.iter.args) <= 3
+                    and not node.iter.keywords)
+        self._uid += 1
+        u = self._uid
+        i_name, n_name, it_name = (f"__jstv_i{u}", f"__jstv_n{u}",
+                                   f"__jstv_it{u}")
+
+        def _call(fn, args):
+            return ast.Call(func=self._load(fn), args=args, keywords=[])
+
+        if is_range:
+            a = node.iter.args
+            start = a[0] if len(a) >= 2 else ast.Constant(0)
+            stop = a[1] if len(a) >= 2 else a[0]
+            step = a[2] if len(a) == 3 else ast.Constant(1)
+            s_name, e_name = f"__jstv_start{u}", f"__jstv_step{u}"
+            o_name = f"__jstv_stop{u}"
+            pre = [
+                self._assign_name(s_name, start),
+                self._assign_name(o_name, stop),
+                self._assign_name(e_name, step),
+                self._assign_name(n_name, _call(
+                    "__jst_range_len",
+                    [self._load(s_name), self._load(o_name),
+                     self._load(e_name)])),
+            ]
+            # traced trip count -> while desugar (ONE lax.while_loop);
+            # python-int trip count -> keep the original Python loop,
+            # which unrolls under tracing and lets the body index
+            # python containers with the concrete counter
+            item = ast.BinOp(
+                left=self._load(s_name), op=ast.Add(),
+                right=ast.BinOp(left=self._load(i_name), op=ast.Mult(),
+                                right=self._load(e_name)))
+            loop = ast.While(
+                test=ast.Compare(left=self._load(i_name), ops=[ast.Lt()],
+                                 comparators=[self._load(n_name)]),
+                body=[ast.Assign(targets=[node.target], value=item),
+                      self._assign_name(i_name, ast.BinOp(
+                          left=self._load(i_name), op=ast.Add(),
+                          right=ast.Constant(1)))] + list(node.body),
+                orelse=[])
+            visited = self.visit(loop)
+            traced_branch = [
+                self._assign_name(i_name, ast.Constant(0)),
+                ast.Assign(targets=[copy.deepcopy(node.target)],
+                           value=self._load(s_name)),
+            ] + (visited if isinstance(visited, list) else [visited])
+            self._depth += 1
+            try:
+                fb_body = []
+                for s in orig.body:
+                    v = self.visit(s)
+                    fb_body.extend(v if isinstance(v, list) else [v])
+            finally:
+                self._depth -= 1
+            eager_for = ast.For(
+                target=orig.target,
+                iter=ast.Call(func=ast.Name(id="range", ctx=ast.Load()),
+                              args=[self._load(s_name),
+                                    self._load(o_name),
+                                    self._load(e_name)],
+                              keywords=[]),
+                body=fb_body, orelse=[])
+            dispatch_if = ast.If(
+                test=ast.Call(
+                    func=ast.Name(id="isinstance", ctx=ast.Load()),
+                    args=[self._load(n_name),
+                          ast.Name(id="int", ctx=ast.Load())],
+                    keywords=[]),
+                body=[eager_for], orelse=traced_branch)
+            return pre + [dispatch_if]
+
+        # generic iterable: runtime dispatch — desugar only when the
+        # value is indexable (tensor/array/list/tuple/range); anything
+        # else (generator, zip, dict) keeps the original Python loop
+        pre = [
+            self._assign_name(it_name, node.iter),
+            ast.If(
+                test=_call("__jst_indexable", [self._load(it_name)]),
+                body=[self._assign_name(
+                    n_name, _call("__jst_len", [self._load(it_name)]))],
+                orelse=[self._assign_name(n_name, ast.Constant(0))]),
+            self._assign_name(i_name, ast.Constant(0)),
+        ]
+        item = _call("__jst_getitem",
+                     [self._load(it_name), self._load(i_name)])
+        loop = ast.While(
+            test=ast.Compare(left=self._load(i_name), ops=[ast.Lt()],
+                             comparators=[self._load(n_name)]),
+            body=[ast.Assign(targets=[node.target], value=item),
+                  self._assign_name(i_name, ast.BinOp(
+                      left=self._load(i_name), op=ast.Add(),
+                      right=ast.Constant(1)))] + list(node.body),
+            orelse=[])
+        init_tgt = ast.If(  # typed target init (n is a python int here)
+            test=ast.Compare(left=self._load(n_name), ops=[ast.Gt()],
+                             comparators=[ast.Constant(0)]),
+            body=[ast.Assign(
+                targets=[copy.deepcopy(node.target)],
+                value=_call("__jst_getitem",
+                            [self._load(it_name), ast.Constant(0)]))],
+            orelse=[])
+        visited = self.visit(loop)
+        loop_stmts = visited if isinstance(visited, list) else [visited]
+        # visit the fallback body too (nested tensor-ifs still convert)
+        self._depth += 1
+        try:
+            fb_body = []
+            for s in orig.body:
+                v = self.visit(s)
+                fb_body.extend(v if isinstance(v, list) else [v])
+        finally:
+            self._depth -= 1
+        fallback = ast.For(target=orig.target, iter=self._load(it_name),
+                           body=fb_body, orelse=[])
+        dispatch_if = ast.If(
+            test=_call("__jst_indexable", [self._load(it_name)]),
+            body=[init_tgt] + loop_stmts, orelse=[fallback])
+        return pre + [dispatch_if]
 
 
 def convert_to_static(fn: Callable) -> Callable:
@@ -368,6 +676,13 @@ def convert_to_static(fn: Callable) -> Callable:
     glb["__jst_convert_ifelse"] = convert_ifelse
     glb["__jst_convert_while"] = convert_while_loop
     glb["__jst_undef"] = _UNDEF
+    glb["__jst_and"] = convert_logical_and
+    glb["__jst_not"] = convert_logical_not
+    glb["__jst_no_jump"] = convert_no_jump
+    glb["__jst_indexable"] = convert_indexable
+    glb["__jst_len"] = convert_len
+    glb["__jst_getitem"] = convert_getitem
+    glb["__jst_range_len"] = convert_range_len
     exec(code, glb)
     out = glb[fn.__name__]
     out = functools.wraps(fn)(out)

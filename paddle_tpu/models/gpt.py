@@ -38,6 +38,11 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
     use_recompute: bool = False
+    #: remat policy when use_recompute: "selective" saves matmul
+    #: outputs (save_dots_no_batch — cheap backward, moderate memory),
+    #: "full" saves nothing (max memory relief, ~1.3x trunk FLOPs).
+    #: ≈ the reference's recompute_granularity (full/core_attn)
+    recompute_granularity: str = "selective"
     tie_word_embeddings: bool = True
     sequence_parallel: bool = False   # shard seq dim over 'sp' +
     # ring attention (NEW vs the reference — SURVEY §5 long-context story)
@@ -212,16 +217,20 @@ class GPTModel(Layer):
         self._moe_aux = None
         moe = self.cfg.moe_num_experts > 0
         if self.cfg.use_recompute and self.training:
+            policy = {"selective": "save_dots_no_batch",
+                      "core_attn": "save_dots",
+                      "full": "full"}.get(
+                self.cfg.recompute_granularity, "save_dots_no_batch")
             aux_total = None
             for i, block in enumerate(self.blocks):
                 if moe:
                     x, aux = recompute(self._aux_blocks[i], x, attn_mask,
-                                       policy="save_dots")
+                                       policy=policy)
                     aux_total = aux if aux_total is None \
                         else aux_total + aux
                 else:
                     x = recompute(block, x, attn_mask,
-                                  policy="save_dots")
+                                  policy=policy)
             self._moe_aux = aux_total
         else:
             for block in self.blocks:
@@ -327,7 +336,8 @@ class GPTHeadPipe(Layer):
 
 
 def gpt_pipe(name: str = "gpt2-small", num_stages: Optional[int] = None,
-             num_microbatches: Optional[int] = None, **overrides):
+             num_microbatches: Optional[int] = None, interleave: int = 1,
+             seg_sizes=None, **overrides):
     """Pipeline-parallel GPT: [embed | blocks... | norm+head] as a
     PipelineLayer over the 'pp' mesh axis (≈ GPTForCausalLMPipe)."""
     import dataclasses
@@ -347,7 +357,8 @@ def gpt_pipe(name: str = "gpt2-small", num_stages: Optional[int] = None,
     model = PipelineLayer(
         layers, num_stages=num_stages,
         num_microbatches=num_microbatches,
-        use_recompute=cfg.use_recompute,
+        use_recompute=cfg.use_recompute, interleave=interleave,
+        seg_sizes=seg_sizes,
         loss_fn=lambda logits, labels: GPTForCausalLM.loss(
             None, logits, labels))
     model.cfg = cfg

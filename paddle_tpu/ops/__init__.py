@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 from . import creation, linalg, manipulation, math
+from . import validators  # registers InferMeta-style checks (enforce)
 from .op_registry import OPS, get_op, op
 from ..core.tensor import Tensor
 

@@ -197,3 +197,38 @@ def multinomial(x, num_samples=1, replacement=False):
                               replace=replacement, p=row / row.sum())
             for k, row in zip(keys, arr)])
     return Tensor(out.astype(jnp.int64))
+
+
+# ---- round-2 op surface completion (VERDICT Missing #3) ----------------
+# reference: python/paddle/tensor/random.py (standard_normal,
+# randint_like, poisson), python/paddle/tensor/creation.py
+# (create_parameter via LayerHelper)
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    shp = tuple(x.shape)
+    d = dtype or (x.dtype if isinstance(x, Tensor) else None)
+    return randint(low, high, shp, d)
+
+
+def poisson(x):
+    arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    key = random_mod.next_key()
+    return Tensor(jax.random.poisson(key, arr).astype(arr.dtype))
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter: a free-standing Parameter built from an
+    initializer (LayerHelper.create_parameter analog)."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as init_mod
+    d = dtype_mod.convert_dtype(dtype)
+    if default_initializer is None:
+        default_initializer = (init_mod.Constant(0.0) if is_bias
+                               else init_mod.XavierNormal())
+    data = default_initializer(_shape(shape), d)
+    return Parameter(data, name=name)

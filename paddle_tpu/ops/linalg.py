@@ -162,3 +162,15 @@ def lu(x, pivot=True):
             "partial pivoting")
     lu_mat, piv = jsl.lu_factor(x)
     return lu_mat, piv + 1
+
+
+@op("tensordot")
+def tensordot(x, y, axes=2):
+    """paddle.tensordot (reference python/paddle/tensor/manipulation.py
+    tensordot): int, [ax_list_x, ax_list_y], or pair-of-lists axes."""
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else (a,)
+                     for a in axes)
+        if len(axes) == 1:
+            axes = (axes[0], axes[0])
+    return jnp.tensordot(x, y, axes=axes)

@@ -1,0 +1,333 @@
+"""InferMeta-style validators for the most-used ops.
+
+Reference: paddle/phi/infermeta/{unary,binary,ternary,multiary}.cc —
+every kernel validates operand shapes/dtypes and raises
+PADDLE_ENFORCE_* with expected-vs-got messages. Here validators are
+registered per op name (core/enforce.py) and run at the dispatch
+boundary before the jax impl, so users get an op-named shape message
+instead of a raw XLA traceback. Checks read only static shape/dtype —
+they are free under tracing (run once at trace time).
+"""
+from __future__ import annotations
+
+from ..core.enforce import enforce, infer_check
+
+__all__ = []
+
+
+def _shape(x):
+    return tuple(getattr(x, "shape", ()))
+
+
+def _ndim(x):
+    return len(_shape(x))
+
+
+def _broadcastable(a, b) -> bool:
+    for x, y in zip(reversed(a), reversed(b)):
+        if x != 1 and y != 1 and x != y:
+            return False
+    return True
+
+
+def _check_axis(op, axis, ndim, allow_scalar_like=True):
+    lo = -ndim if ndim else -1
+    hi = max(ndim - 1, 0)
+    enforce(lo <= axis <= hi, op,
+            f"axis must be in [{lo}, {hi}] for a {ndim}-d operand, "
+            f"got {axis}")
+
+
+def _binary_broadcast(op):
+    @infer_check(op)
+    def check(x, y, *a, **k):
+        sx, sy = _shape(x), _shape(y)
+        enforce(_broadcastable(sx, sy), op,
+                f"operands could not be broadcast together: "
+                f"x{list(sx)} vs y{list(sy)}")
+    return check
+
+
+for _name in ("add", "subtract", "multiply", "divide", "maximum",
+              "minimum", "pow", "remainder", "floor_divide", "atan2",
+              "fmax", "fmin", "heaviside", "logaddexp", "hypot"):
+    _binary_broadcast(_name)
+
+
+@infer_check("matmul")
+def _matmul(x, y, transpose_x=False, transpose_y=False, *a, **k):
+    sx, sy = _shape(x), _shape(y)
+    enforce(len(sx) >= 1 and len(sy) >= 1, "matmul",
+            f"operands need ndim >= 1, got x{list(sx)} y{list(sy)}")
+    if len(sx) >= 2 and len(sy) >= 2:
+        kx = sx[-1] if not transpose_x else sx[-2]
+        ky = sy[-2] if not transpose_y else sy[-1]
+        enforce(kx == ky, "matmul",
+                f"inner dims must match: x{list(sx)}"
+                f"{'^T' if transpose_x else ''} @ y{list(sy)}"
+                f"{'^T' if transpose_y else ''} -> {kx} != {ky}")
+        bx, by = sx[:-2], sy[:-2]
+        enforce(_broadcastable(bx, by), "matmul",
+                f"batch dims not broadcastable: {list(bx)} vs {list(by)}")
+
+
+@infer_check("bmm")
+def _bmm(x, y, *a, **k):
+    sx, sy = _shape(x), _shape(y)
+    enforce(len(sx) == 3 and len(sy) == 3, "bmm",
+            f"bmm needs two 3-d operands, got x{list(sx)} y{list(sy)}")
+    enforce(sx[0] == sy[0], "bmm",
+            f"batch sizes differ: {sx[0]} vs {sy[0]}")
+    enforce(sx[2] == sy[1], "bmm",
+            f"inner dims must match: {sx[2]} != {sy[1]}")
+
+
+@infer_check("mv")
+def _mv(x, vec, *a, **k):
+    sx, sv = _shape(x), _shape(vec)
+    enforce(len(sx) == 2 and len(sv) == 1, "mv",
+            f"mv needs (matrix, vector), got x{list(sx)} vec{list(sv)}")
+    enforce(sx[1] == sv[0], "mv",
+            f"matrix cols {sx[1]} != vector size {sv[0]}")
+
+
+@infer_check("concat")
+def _concat(xs, axis=0, *a, **k):
+    if not isinstance(xs, (list, tuple)) or not xs:
+        return
+    nd = _ndim(xs[0])
+    _check_axis("concat", int(axis), nd)
+    ax = int(axis) % max(nd, 1)
+    base = list(_shape(xs[0]))
+    for i, t in enumerate(xs[1:], 1):
+        s = list(_shape(t))
+        enforce(len(s) == nd, "concat",
+                f"input {i} has rank {len(s)}, expected {nd}")
+        ok = all(s[d] == base[d] for d in range(nd) if d != ax)
+        enforce(ok, "concat",
+                f"input {i} shape {s} mismatches input 0 shape {base} "
+                f"outside concat axis {ax}")
+
+
+@infer_check("stack")
+def _stack(xs, axis=0, *a, **k):
+    if not isinstance(xs, (list, tuple)) or not xs:
+        return
+    base = _shape(xs[0])
+    for i, t in enumerate(xs[1:], 1):
+        enforce(_shape(t) == base, "stack",
+                f"input {i} shape {list(_shape(t))} != input 0 shape "
+                f"{list(base)} (stack needs identical shapes)")
+
+
+@infer_check("reshape")
+def _reshape(x, shape, *a, **k):
+    import numpy as np
+    tgt = [int(s) for s in (shape.tolist() if hasattr(shape, "tolist")
+                            else shape)]
+    enforce(tgt.count(-1) <= 1, "reshape",
+            f"at most one -1 allowed in target shape, got {tgt}")
+    n = int(np.prod(_shape(x))) if _shape(x) else 1
+    fixed = int(np.prod([s for s in tgt if s != -1])) if tgt else 1
+    if -1 in tgt:
+        enforce(fixed != 0 and n % fixed == 0, "reshape",
+                f"cannot infer -1: {n} elements not divisible by "
+                f"{fixed} (shape {list(_shape(x))} -> {tgt})")
+    else:
+        enforce(fixed == n, "reshape",
+                f"element count mismatch: {list(_shape(x))} has {n} "
+                f"elements, target {tgt} has {fixed}")
+
+
+@infer_check("softmax")
+def _softmax(x, axis=-1, *a, **k):
+    _check_axis("softmax", int(axis), max(_ndim(x), 1))
+
+
+@infer_check("log_softmax")
+def _log_softmax(x, axis=-1, *a, **k):
+    _check_axis("log_softmax", int(axis), max(_ndim(x), 1))
+
+
+@infer_check("gather")
+def _gather(x, index, axis=0, *a, **k):
+    _check_axis("gather", int(axis), max(_ndim(x), 1))
+    enforce(_ndim(index) <= 2, "gather",
+            f"index must be 0/1/2-d, got {_ndim(index)}-d")
+
+
+@infer_check("index_select")
+def _index_select(x, index, axis=0, *a, **k):
+    _check_axis("index_select", int(axis), max(_ndim(x), 1))
+
+
+@infer_check("take_along_axis")
+def _take_along_axis(arr, indices, axis, *a, **k):
+    _check_axis("take_along_axis", int(axis), max(_ndim(arr), 1))
+    enforce(_ndim(indices) == _ndim(arr), "take_along_axis",
+            f"indices rank {_ndim(indices)} must equal array rank "
+            f"{_ndim(arr)}")
+
+
+@infer_check("one_hot")
+def _one_hot(x, num_classes, *a, **k):
+    enforce(int(num_classes) > 0, "one_hot",
+            f"num_classes must be positive, got {num_classes}")
+
+
+@infer_check("topk")
+def _topk(x, k=1, axis=-1, *a, **kw):
+    nd = max(_ndim(x), 1)
+    _check_axis("topk", int(axis), nd)
+    dim = _shape(x)[int(axis) % nd] if _shape(x) else 1
+    enforce(0 < int(k) <= dim, "topk",
+            f"k must be in [1, {dim}] for axis size {dim}, got {k}")
+
+
+@infer_check("squeeze")
+def _squeeze(x, axis=None, *a, **k):
+    if axis is None:
+        return
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    for ax in axes:
+        _check_axis("squeeze", int(ax), max(_ndim(x), 1))
+
+
+@infer_check("unsqueeze")
+def _unsqueeze(x, axis, *a, **k):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    out_rank = _ndim(x) + len(axes)
+    for ax in axes:
+        enforce(-out_rank <= int(ax) < out_rank, "unsqueeze",
+                f"axis {ax} out of range for output rank {out_rank}")
+
+
+@infer_check("transpose")
+def _transpose(x, perm, *a, **k):
+    p = [int(v) for v in perm]
+    enforce(sorted(p) == list(range(_ndim(x))), "transpose",
+            f"perm {p} must be a permutation of 0..{_ndim(x) - 1} "
+            f"for a {_ndim(x)}-d operand")
+
+
+@infer_check("embedding")
+def _embedding(x, weight, padding_idx=None, *a, **k):
+    enforce(_ndim(weight) == 2, "embedding",
+            f"weight must be 2-d [vocab, dim], got {list(_shape(weight))}")
+
+
+@infer_check("linear")
+def _linear(x, weight, bias=None, *a, **k):
+    sx, sw = _shape(x), _shape(weight)
+    enforce(len(sw) == 2, "linear",
+            f"weight must be 2-d [in, out], got {list(sw)}")
+    enforce(sx and sx[-1] == sw[0], "linear",
+            f"input features {sx[-1] if sx else '?'} != weight rows "
+            f"{sw[0]} (x{list(sx)} @ w{list(sw)})")
+    if bias is not None:
+        sb = _shape(bias)
+        enforce(sb in ((sw[1],), ()), "linear",
+                f"bias shape {list(sb)} != [{sw[1]}]")
+
+
+@infer_check("conv2d")
+def _conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+            groups=1, data_format="NCHW", *a, **k):
+    sx, sw = _shape(x), _shape(weight)
+    enforce(len(sx) == 4, "conv2d",
+            f"input must be 4-d {data_format}, got {list(sx)}")
+    enforce(len(sw) == 4, "conv2d",
+            f"weight must be 4-d [out_c, in_c/groups, kh, kw], "
+            f"got {list(sw)}")
+    ch_axis = 1 if str(data_format).upper().startswith("NC") else -1
+    in_c = sx[ch_axis]
+    enforce(in_c == sw[1] * groups, "conv2d",
+            f"in_channels {in_c} ({data_format}) != weight in_c/groups "
+            f"{sw[1]} * groups {groups}")
+    enforce(sw[0] % groups == 0, "conv2d",
+            f"out_channels {sw[0]} not divisible by groups {groups}")
+
+
+@infer_check("layer_norm")
+def _layer_norm(x, normalized_shape=None, weight=None, bias=None,
+                epsilon=1e-5, *a, **k):
+    if normalized_shape is None:
+        return
+    ns = ([int(normalized_shape)]
+          if not isinstance(normalized_shape, (list, tuple))
+          else [int(v) for v in normalized_shape])
+    sx = list(_shape(x))
+    enforce(sx[-len(ns):] == ns, "layer_norm",
+            f"normalized_shape {ns} must match input trailing dims "
+            f"{sx[-len(ns):]} (input {sx})")
+
+
+@infer_check("cross_entropy")
+def _cross_entropy(logits, label, *a, **k):
+    sl, sy = _shape(logits), _shape(label)
+    enforce(len(sl) >= 1, "cross_entropy",
+            f"logits need >=1 dims, got {list(sl)}")
+    if len(sy) == len(sl) - 1:
+        enforce(sy == sl[:-1], "cross_entropy",
+                f"label shape {list(sy)} must equal logits shape minus "
+                f"class dim {list(sl[:-1])}")
+
+
+@infer_check("where")
+def _where(cond, x=None, y=None, *a, **k):
+    if x is None or y is None:
+        return
+    enforce(_broadcastable(_shape(x), _shape(y)), "where",
+            f"x{list(_shape(x))} and y{list(_shape(y))} not "
+            f"broadcastable")
+    enforce(_broadcastable(_shape(cond), _shape(x)), "where",
+            f"condition{list(_shape(cond))} not broadcastable with "
+            f"x{list(_shape(x))}")
+
+
+@infer_check("expand")
+def _expand(x, shape, *a, **k):
+    tgt = [int(s) for s in (shape.tolist() if hasattr(shape, "tolist")
+                            else shape)]
+    sx = _shape(x)
+    enforce(len(tgt) >= len(sx), "expand",
+            f"target rank {len(tgt)} < input rank {len(sx)}")
+    diff = len(tgt) - len(sx)
+    for i, s in enumerate(tgt):
+        if i < diff or s == -1:
+            continue
+        enforce(sx[i - diff] in (1, s), "expand",
+                f"dim {i}: cannot expand {sx[i - diff]} -> {s} "
+                f"(x{list(sx)} -> {tgt})")
+
+
+@infer_check("tile")
+def _tile(x, repeat_times, *a, **k):
+    reps = [int(r) for r in (repeat_times.tolist()
+                             if hasattr(repeat_times, "tolist")
+                             else repeat_times)]
+    enforce(all(r > 0 for r in reps), "tile",
+            f"repeat_times must be positive, got {reps}")
+
+
+@infer_check("flatten")
+def _flatten(x, start_axis=0, stop_axis=-1, *a, **k):
+    nd = max(_ndim(x), 1)
+    _check_axis("flatten", int(start_axis), nd)
+    _check_axis("flatten", int(stop_axis), nd)
+    enforce(int(start_axis) % nd <= int(stop_axis) % nd, "flatten",
+            f"start_axis {start_axis} must be <= stop_axis {stop_axis}")
+
+
+@infer_check("cumsum")
+def _cumsum(x, axis=None, *a, **k):
+    if axis is not None:
+        _check_axis("cumsum", int(axis), max(_ndim(x), 1))
+
+
+@infer_check("put_along_axis")
+def _put_along_axis(arr, indices, values, axis, *a, **k):
+    _check_axis("put_along_axis", int(axis), max(_ndim(arr), 1))
+    enforce(_ndim(indices) == _ndim(arr), "put_along_axis",
+            f"indices rank {_ndim(indices)} must equal array rank "
+            f"{_ndim(arr)}")

@@ -10,8 +10,10 @@ from .optimizer import Optimizer
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None,
-                 weight_decay=None, grad_clip=None, name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision)
 
     def _update(self, p, g, state, lr, step):
         return p - lr * g, state
@@ -20,21 +22,31 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._momentum = momentum
         self._nesterov = use_nesterov
 
     def _init_state(self, shape, dtype):
-        return {"velocity": jnp.zeros(shape, dtype)}
+        st = {"velocity": jnp.zeros(shape, jnp.float32)}
+        if self.multi_precision and jnp.dtype(dtype) in (
+                jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+            st["master"] = None  # filled lazily from the param
+        return st
 
     def _update(self, p, g, state, lr, step):
-        v = self._momentum * state["velocity"] + g
-        if self._nesterov:
-            p_new = p - lr * (g + self._momentum * v)
-        else:
-            p_new = p - lr * v
-        return p_new, {"velocity": v}
+        v = self._momentum * state["velocity"] + g.astype(jnp.float32)
+        upd = lr * ((g.astype(jnp.float32) + self._momentum * v)
+                    if self._nesterov else v)
+        new_state = {"velocity": v}
+        if "master" in state:
+            master = state["master"] if state["master"] is not None \
+                else p.astype(jnp.float32)
+            master = master - upd
+            new_state["master"] = master
+            return master.astype(p.dtype), new_state
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), new_state
 
 
 class Adagrad(Optimizer):

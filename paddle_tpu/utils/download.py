@@ -1,0 +1,77 @@
+"""paddle.utils.download analog, gated for zero-egress environments.
+
+Reference: python/paddle/utils/download.py (get_weights_path_from_url /
+get_path_from_url: fetch + md5 + cache under ~/.cache/paddle). This
+environment has no network egress, so the functions resolve ONLY from
+the local cache (or a mirror directory named by PADDLE_TPU_DOWNLOAD_DIR)
+and raise with instructions otherwise — the API shape and cache layout
+match, so code written against the reference keeps working wherever a
+cache has been provisioned.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+DATA_HOME = osp.expanduser("~/.cache/paddle/dataset")
+
+
+def _md5check(fullname: str, md5sum: str = None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _decompress(fname: str) -> str:
+    d = osp.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            tf.extractall(d)
+            names = tf.getnames()
+    elif zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            zf.extractall(d)
+            names = zf.namelist()
+    else:
+        return fname
+    root = names[0].split("/")[0] if names else ""
+    return osp.join(d, root)
+
+
+def get_path_from_url(url: str, root_dir: str = DATA_HOME,
+                      md5sum: str = None, check_exist: bool = True,
+                      decompress: bool = True) -> str:
+    """Resolve `url` to a local path. Looks in (1) the cache layout the
+    reference would have populated, (2) $PADDLE_TPU_DOWNLOAD_DIR acting
+    as a pre-provisioned mirror. No network IO ever happens here."""
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if osp.exists(fullname) and _md5check(fullname, md5sum):
+        return _decompress(fullname) if decompress else fullname
+    mirror = os.environ.get("PADDLE_TPU_DOWNLOAD_DIR")
+    if mirror:
+        cand = osp.join(mirror, fname)
+        if osp.exists(cand) and _md5check(cand, md5sum):
+            os.makedirs(root_dir, exist_ok=True)
+            shutil.copy(cand, fullname)
+            return _decompress(fullname) if decompress else fullname
+    raise RuntimeError(
+        f"cannot fetch {url!r}: this environment has no network egress. "
+        f"Provision the file at {fullname!r} (or set "
+        f"PADDLE_TPU_DOWNLOAD_DIR to a directory containing {fname!r}) "
+        f"and retry.")
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum, decompress=False)

@@ -20,7 +20,8 @@ import numpy as np
 from ..io.dataset import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
-           "DatasetFolder", "ImageFolder", "FakeData"]
+           "DatasetFolder", "ImageFolder", "FakeData", "Flowers",
+           "VOC2012"]
 
 
 def _no_download(name: str):
@@ -241,3 +242,106 @@ class FakeData(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, label
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference
+    python/paddle/vision/datasets/flowers.py): jpg folder +
+    imagelabels.mat + setid.mat, split by setid indices. Files resolve
+    through utils.download (local cache / PADDLE_TPU_DOWNLOAD_DIR
+    mirror; no egress)."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file: Optional[str] = None,
+                 label_file: Optional[str] = None,
+                 setid_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = True, backend: str = "numpy"):
+        if mode not in self._SPLIT_KEY:
+            raise ValueError(f"mode must be train/valid/test, got {mode}")
+        if data_file is None or label_file is None or setid_file is None:
+            if not download:
+                _no_download(type(self).__name__)
+            from ..utils.download import get_path_from_url
+            base = "https://paddlemodels.bj.bcebos.com/flowers/"
+            data_file = data_file or get_path_from_url(base + "102flowers.tgz")
+            label_file = label_file or get_path_from_url(
+                base + "imagelabels.mat", decompress=False)
+            setid_file = setid_file or get_path_from_url(
+                base + "setid.mat", decompress=False)
+        import scipy.io as sio
+        labels = sio.loadmat(label_file)["labels"].ravel()  # 1-based
+        ids = sio.loadmat(setid_file)[self._SPLIT_KEY[mode]].ravel()
+        if not os.path.isdir(data_file):
+            raise RuntimeError(
+                f"Flowers data_file must be the extracted jpg directory "
+                f"(or a dir containing jpg/), got {data_file!r}")
+        sub = os.path.join(data_file, "jpg")
+        jpg_dir = sub if os.path.isdir(sub) else data_file
+        self._items = [(os.path.join(jpg_dir,
+                                     f"image_{int(i):05d}.jpg"),
+                        int(labels[int(i) - 1]) - 1) for i in ids]
+        self.transform = transform
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, idx):
+        path, label = self._items[idx]
+        img = _default_loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    python/paddle/vision/datasets/voc2012.py): JPEGImages +
+    SegmentationClass indexed by ImageSets/Segmentation/{mode}.txt."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = True, backend: str = "numpy"):
+        if mode not in ("train", "valid", "trainval"):
+            raise ValueError(
+                f"mode must be train/valid/trainval, got {mode}")
+        if data_file is None:
+            if not download:
+                _no_download(type(self).__name__)
+            from ..utils.download import get_path_from_url
+            data_file = get_path_from_url(
+                "https://dataset.bj.bcebos.com/voc/VOCtrainval_11-May-2012.tar")
+        root = data_file
+        for sub in ("VOCdevkit/VOC2012", "VOC2012", ""):
+            cand = os.path.join(root, sub) if sub else root
+            if os.path.isdir(os.path.join(cand, "JPEGImages")):
+                root = cand
+                break
+        else:
+            raise RuntimeError(f"no VOC2012 layout under {data_file!r}")
+        name = {"train": "train", "valid": "val",
+                "trainval": "trainval"}[mode]
+        lst = os.path.join(root, "ImageSets", "Segmentation",
+                           f"{name}.txt")
+        with open(lst) as f:
+            stems = [line.strip() for line in f if line.strip()]
+        self._items = [
+            (os.path.join(root, "JPEGImages", s + ".jpg"),
+             os.path.join(root, "SegmentationClass", s + ".png"))
+            for s in stems]
+        self.transform = transform
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, idx):
+        img_path, seg_path = self._items[idx]
+        img = _default_loader(img_path)
+        from PIL import Image
+        with Image.open(seg_path) as seg_img:
+            seg = np.asarray(seg_img)  # palette indices = class ids
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, seg

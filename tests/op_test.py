@@ -35,6 +35,64 @@ def check_output(op_fn: Callable, np_ref: Callable, inputs: Sequence,
         _assert_close(out_jit, expected, rtol, atol, "jit")
 
 
+# per-op bf16 tolerance whitelist (reference analog:
+# unittests/white_list/op_accuracy_white_list.py — ops allowed looser
+# low-precision error bounds). bf16 eps ~ 7.8e-3; default bound ~4 ulp.
+BF16_TOL_WHITELIST = {
+    "default": (3e-2, 3e-2),
+    "exp": (6e-2, 6e-2), "expm1": (6e-2, 6e-2),
+    "cumprod": (8e-2, 8e-2), "logsumexp": (6e-2, 6e-2),
+    "softmax": (2e-2, 2e-2), "matmul": (6e-2, 6e-1),
+    "tanh": (2e-2, 2e-2), "erf": (2e-2, 2e-2),
+    "var": (8e-2, 8e-2), "std": (6e-2, 6e-2),
+    "mean": (2e-2, 2e-2), "sum": (6e-2, 4e-1),
+    "addmm": (6e-2, 6e-1), "kron": (4e-2, 4e-2),
+    "logit": (8e-2, 8e-2), "log1p": (4e-2, 4e-2),
+}
+
+
+def check_output_bf16(op_fn: Callable, np_ref: Callable,
+                      inputs: Sequence, kwargs=None, name: str = None,
+                      check_jit: bool = True):
+    """Low-precision golden check: float inputs cast to bfloat16, op runs
+    in bf16, result compared (as f32) to the f32 numpy reference under
+    the per-op whitelist tolerance."""
+    import jax.numpy as jnp
+    kwargs = kwargs or {}
+    rtol, atol = BF16_TOL_WHITELIST.get(
+        name or getattr(op_fn, "op_name", ""),
+        BF16_TOL_WHITELIST["default"])
+    arrays = [np.asarray(i) for i in inputs]
+    expected = np_ref(*[a.astype(np.float32)
+                        if np.issubdtype(a.dtype, np.floating) else a
+                        for a in arrays], **kwargs)
+    tensors = []
+    for a in arrays:
+        t = paddle.to_tensor(a)
+        if np.issubdtype(a.dtype, np.floating):
+            t = t.astype("bfloat16")
+        tensors.append(t)
+    out = op_fn(*tensors, **kwargs)
+    leaves = jax.tree_util.tree_leaves(_unwrap_tree(out))
+    exp_leaves = expected if isinstance(expected, (list, tuple)) else \
+        [expected]
+    for o, e in zip(leaves, exp_leaves):
+        np.testing.assert_allclose(
+            np.asarray(o).astype(np.float32),
+            np.asarray(e).astype(np.float32), rtol=rtol, atol=atol,
+            err_msg=f"[bf16] output mismatch for {name or op_fn}")
+    if check_jit:
+        jitted = jax.jit(lambda *raw: _unwrap_tree(
+            op_fn(*[Tensor(r) for r in raw], **kwargs)))
+        out_jit = jitted(*[t.data for t in tensors])
+        for o, e in zip(jax.tree_util.tree_leaves(_unwrap_tree(out_jit)),
+                        exp_leaves):
+            np.testing.assert_allclose(
+                np.asarray(o).astype(np.float32),
+                np.asarray(e).astype(np.float32), rtol=rtol, atol=atol,
+                err_msg=f"[bf16-jit] output mismatch for {name or op_fn}")
+
+
 def check_grad(op_fn: Callable, inputs: Sequence, grad_idx=0, kwargs=None,
                eps=1e-3, rtol=1e-2, atol=1e-3, reduce_to_scalar=True):
     """Compare tape gradients to central finite differences (float64 on CPU

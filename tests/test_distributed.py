@@ -272,3 +272,61 @@ def test_rng_tracker_differs_across_folds():
     with tracker.rng_state("model_parallel_rng") as k2:
         pass
     assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+def test_hybrid_dcn_mesh_dp_outermost_over_slices():
+    """create_hybrid_device_mesh: only the dcn axis (dp) crosses slice
+    boundaries — every other axis's hyperplanes are intra-slice (the
+    ProcessGroupHeter property, ProcessGroupHeter.h:128-134)."""
+    from paddle_tpu.distributed.topology import create_hybrid_device_mesh
+    devs = jax.devices()[:8]
+    slices = [devs[:4], devs[4:]]  # simulate a 2-slice pod
+    slice_of = {id(d): s for s, grp in enumerate(slices) for d in grp}
+    mesh = create_hybrid_device_mesh(
+        {"dp": 4, "mp": 2}, devices=devs, slices=slices)
+    arr = mesh.devices  # [dp=4, mp=2]
+    assert arr.shape == (4, 2)
+    # each mp row (fixed dp index) stays inside ONE slice
+    for i in range(4):
+        row_slices = {slice_of[id(d)] for d in arr[i]}
+        assert len(row_slices) == 1
+    # dp spans both slices
+    assert {slice_of[id(d)] for d in arr[:, 0]} == {0, 1}
+    # slice-major along dp: first half of dp rows = slice 0
+    assert all(slice_of[id(d)] == 0 for d in arr[:2].ravel())
+    assert all(slice_of[id(d)] == 1 for d in arr[2:].ravel())
+
+
+def test_hybrid_dcn_mesh_rejects_non_dp_span():
+    from paddle_tpu.distributed.topology import create_hybrid_device_mesh
+    devs = jax.devices()[:8]
+    slices = [devs[:4], devs[4:]]
+    # mp=8 would have to cross DCN -> explicit error, not silent layout
+    with pytest.raises(ValueError, match="multiple of the slice count"):
+        create_hybrid_device_mesh({"dp": 1, "mp": 8},
+                                  devices=devs, slices=slices)
+
+
+def test_hcg_builds_through_dcn_builder():
+    from paddle_tpu.distributed.topology import HybridCommunicateGroup
+    devs = jax.devices()[:8]
+    hcg = HybridCommunicateGroup(dp_degree=2, mp_degree=4, devices=devs,
+                                 slices=[devs[:4], devs[4:]])
+    assert hcg.mesh.shape["dp"] == 2 and hcg.mesh.shape["mp"] == 4
+
+
+def test_ulysses_gqa_kv_head_validation():
+    from paddle_tpu.distributed.parallel.context_parallel import (
+        ulysses_attention)
+    from paddle_tpu.distributed.topology import (
+        HybridCommunicateGroup, set_hybrid_communicate_group)
+    hcg = HybridCommunicateGroup(sp_degree=8)
+    set_hybrid_communicate_group(hcg)
+    try:
+        import jax.numpy as jnp
+        q = jnp.zeros((1, 16, 8, 4))
+        kv = jnp.zeros((1, 16, 2, 4))  # 2 kv heads < sp=8
+        with pytest.raises(ValueError, match="key heads 2"):
+            ulysses_attention(q, kv, kv, axis_name="sp")
+    finally:
+        set_hybrid_communicate_group(None)

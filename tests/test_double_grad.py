@@ -1,0 +1,157 @@
+"""paddle.grad(outputs, inputs) + higher-order autograd.
+
+Reference semantics: python/paddle/fluid/dygraph/base.py grad() over
+eager/backward.cc:393, exercised by
+fluid/tests/unittests/test_imperative_double_grad.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _x(vals, stop_gradient=False):
+    t = paddle.to_tensor(np.asarray(vals, np.float32))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def test_first_order_grad_matches_backward():
+    x = _x([1.0, 2.0, 3.0])
+    y = (x * x).sum()
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [2.0, 4.0, 6.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+    assert gx.stop_gradient  # create_graph=False -> detached result
+
+
+def test_nonscalar_output_default_seed_ones():
+    x = _x([1.0, 2.0])
+    y = x * 3.0
+    (gx,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [3.0, 3.0])
+
+
+def test_grad_outputs_seed():
+    x = _x([1.0, 2.0])
+    y = x * x
+    seed = paddle.to_tensor(np.array([10.0, 100.0], np.float32))
+    (gx,) = paddle.grad(y, [x], grad_outputs=[seed])
+    np.testing.assert_allclose(gx.numpy(), [20.0, 400.0])
+
+
+def test_double_grad_create_graph():
+    # d/dx (x^2) = 2x; d/dx sum((2x)^2) = 8x
+    x = _x([1.0, 2.0, 3.0])
+    y = (x * x).sum()
+    (dx,) = paddle.grad(y, [x], create_graph=True)
+    assert not dx.stop_gradient
+    np.testing.assert_allclose(dx.numpy(), [2.0, 4.0, 6.0])
+    loss = (dx * dx).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 16.0, 24.0])
+
+
+def test_double_grad_via_second_grad_call():
+    x = _x([2.0])
+    y = (x ** 3).sum()
+    (dx,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(dx.numpy(), [12.0])  # 3x^2
+    (ddx,) = paddle.grad(dx, [x], create_graph=True)
+    np.testing.assert_allclose(ddx.numpy(), [12.0])  # 6x
+    (dddx,) = paddle.grad(ddx, [x])
+    np.testing.assert_allclose(dddx.numpy(), [6.0])  # third order
+
+
+def test_double_grad_through_matmul():
+    a = _x([[1.0, 2.0], [3.0, 4.0]])
+    b = _x([[1.0], [1.0]])
+    y = paddle.matmul(a, b).sum()
+    (da,) = paddle.grad(y, [a], create_graph=True)
+    # d/db sum(da * const) where da = ones @ b.T depends on b
+    loss = (da * da).sum()
+    (db,) = paddle.grad(loss, [b])
+    # da[i,j] = b[j]; loss = 2*(b0^2 + b1^2); dloss/db = 4b
+    np.testing.assert_allclose(db.numpy(), [[4.0], [4.0]])
+
+
+def test_gradient_penalty_pattern():
+    # WGAN-GP style: penalty on ||d out/d in||^2 trains the layer
+    paddle.seed(0)
+    from paddle_tpu import nn
+    lin = nn.Linear(4, 1)
+    x = _x(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    out = lin(x).sum()
+    (gx,) = paddle.grad(out, [x], create_graph=True)
+    penalty = ((gx * gx).sum() - 1.0) ** 2
+    penalty.backward()
+    w_grad = lin.weight.grad
+    assert w_grad is not None
+    assert float(paddle.abs(w_grad).sum()) > 0.0
+
+
+def test_unused_input_raises_and_allow_unused():
+    x = _x([1.0])
+    z = _x([1.0])
+    y = (x * 2.0).sum()
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [z])
+    gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert gz is None
+
+
+def test_grad_wrt_intermediate():
+    x = _x([1.0, 2.0])
+    h = x * 3.0
+    y = (h * h).sum()
+    (gh,) = paddle.grad(y, [h])
+    np.testing.assert_allclose(gh.numpy(), [6.0, 12.0])  # 2h
+
+
+def test_no_grad_vars_blocks_path():
+    x = _x([1.0, 2.0])
+    h = x * 2.0
+    y = (h * x).sum()  # y = 2x^2, total dy/dx = 4x
+    (gx,) = paddle.grad(y, [x], no_grad_vars=[h])
+    # path through h removed: only the direct x factor remains (= h = 2x)
+    np.testing.assert_allclose(gx.numpy(), [2.0, 4.0])
+
+
+def test_retain_graph_false_frees():
+    x = _x([1.0])
+    y = (x * x).sum()
+    paddle.grad(y, [x])
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x])
+
+
+def test_retain_graph_true_allows_second_pass():
+    x = _x([1.0, 2.0])
+    y = (x * x).sum()
+    (g1,) = paddle.grad(y, [x], retain_graph=True)
+    (g2,) = paddle.grad(y, [x])
+    np.testing.assert_allclose(g1.numpy(), g2.numpy())
+
+
+def test_multiple_outputs_accumulate():
+    x = _x([1.0, 2.0])
+    y1 = (x * x).sum()
+    y2 = (x * 3.0).sum()
+    (gx,) = paddle.grad([y1, y2], [x])
+    np.testing.assert_allclose(gx.numpy(), [5.0, 7.0])  # 2x + 3
+
+
+def test_functional_grad_still_works():
+    f = paddle.grad(lambda t: (t * t).sum())
+    g = f(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+
+def test_backward_engine_unchanged_full_backward():
+    x = _x([1.0, 2.0])
+    w = _x([3.0, 4.0])
+    y = (x * w).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+    np.testing.assert_allclose(w.grad.numpy(), [1.0, 2.0])

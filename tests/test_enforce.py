@@ -1,0 +1,114 @@
+"""Enforce-grade error reporting (VERDICT Next #8).
+
+Reference: paddle/phi/core/enforce.h PADDLE_ENFORCE_* +
+infermeta validations (paddle/phi/infermeta/binary.cc) — common misuse
+must produce an op-named expected-vs-got message, not a raw XLA
+traceback."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.enforce import EnforceError
+
+
+def test_matmul_shape_mismatch_message():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([4, 5])
+    with pytest.raises(EnforceError, match=r"matmul.*inner dims.*3 != 4"):
+        paddle.matmul(a, b)
+
+
+def test_matmul_transpose_aware():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([5, 3])
+    # valid with transpose_y
+    assert paddle.matmul(a, b, transpose_y=True).shape == [2, 5]
+    with pytest.raises(EnforceError, match="matmul"):
+        paddle.matmul(a, b)
+
+
+def test_binary_broadcast_message():
+    x = paddle.ones([2, 3])
+    y = paddle.ones([4])
+    with pytest.raises(EnforceError,
+                       match=r"add.*broadcast.*\[2, 3\].*\[4\]"):
+        paddle.add(x, y)
+
+
+def test_concat_rank_and_shape():
+    with pytest.raises(EnforceError, match=r"concat.*axis"):
+        paddle.concat([paddle.ones([2, 2])], axis=5)
+    with pytest.raises(EnforceError, match=r"concat.*mismatches"):
+        paddle.concat([paddle.ones([2, 2]), paddle.ones([2, 3])], axis=0)
+
+
+def test_reshape_count_mismatch():
+    with pytest.raises(EnforceError, match=r"reshape.*6 elements"):
+        paddle.reshape(paddle.ones([2, 3]), [4, 2])
+    with pytest.raises(EnforceError, match=r"reshape.*one -1"):
+        paddle.reshape(paddle.ones([2, 3]), [-1, -1])
+
+
+def test_softmax_axis_range():
+    import paddle_tpu.nn.functional as F
+    with pytest.raises(EnforceError, match=r"softmax.*axis"):
+        F.softmax(paddle.ones([2, 3]), axis=4)
+
+
+def test_linear_feature_mismatch():
+    import paddle_tpu.nn.functional as F
+    x = paddle.ones([2, 7])
+    w = paddle.ones([3, 4])
+    with pytest.raises(EnforceError, match=r"linear.*7 != weight rows 3"):
+        F.linear(x, w)
+
+
+def test_transpose_bad_perm():
+    with pytest.raises(EnforceError, match=r"transpose.*permutation"):
+        paddle.transpose(paddle.ones([2, 3]), perm=[0, 0])
+
+
+def test_topk_k_range():
+    with pytest.raises(EnforceError, match=r"topk.*k must be"):
+        paddle.topk(paddle.ones([3]), k=9)
+
+
+def test_expand_invalid_dim():
+    with pytest.raises(EnforceError, match=r"expand.*cannot expand"):
+        paddle.expand(paddle.ones([2, 3]), [2, 5])
+
+
+def test_stack_needs_same_shapes():
+    with pytest.raises(EnforceError, match=r"stack.*identical"):
+        paddle.stack([paddle.ones([2]), paddle.ones([3])])
+
+
+def test_bmm_messages():
+    with pytest.raises(EnforceError, match=r"bmm.*3-d"):
+        paddle.bmm(paddle.ones([2, 2]), paddle.ones([2, 2]))
+    with pytest.raises(EnforceError, match=r"bmm.*batch"):
+        paddle.bmm(paddle.ones([2, 3, 4]), paddle.ones([5, 4, 3]))
+
+
+def test_conv2d_channel_mismatch():
+    import paddle_tpu.nn.functional as F
+    x = paddle.ones([1, 3, 8, 8])
+    w = paddle.ones([4, 5, 3, 3])  # expects in_c 5 != 3
+    with pytest.raises(EnforceError, match=r"conv2d.*in_channels 3"):
+        F.conv2d(x, w)
+
+
+def test_generic_augment_names_op_and_operands():
+    # an op without a dedicated validator still gets op-named context
+    with pytest.raises((TypeError, ValueError), match=r"op 'cross'"):
+        paddle.cross(paddle.ones([2, 2]), paddle.ones([5]))
+
+
+def test_valid_calls_unaffected():
+    # enforce must not reject correct programs
+    assert paddle.matmul(paddle.ones([2, 3]), paddle.ones([3, 4])).shape \
+        == [2, 4]
+    assert paddle.concat([paddle.ones([1, 2]), paddle.ones([3, 2])],
+                         axis=0).shape == [4, 2]
+    assert paddle.reshape(paddle.ones([2, 3]), [-1]).shape == [6]
+    assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]

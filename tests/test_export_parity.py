@@ -1,0 +1,199 @@
+"""Automated top-level export parity vs the reference
+(python/paddle/__init__.py __all__, frozen in
+data_ref_paddle_exports.txt). VERDICT round-1 Missing #3 / Next #5:
+every name the reference exports at paddle.* must resolve here, with
+<10 justified exceptions."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+_HERE = os.path.dirname(__file__)
+
+# justified exceptions would be listed here with reasons; currently none
+EXCEPTIONS: dict = {}
+
+
+def test_top_level_export_parity():
+    ref = set(open(os.path.join(_HERE,
+                                "data_ref_paddle_exports.txt")).read().split())
+    missing = sorted(n for n in ref
+                     if not hasattr(paddle, n) and n not in EXCEPTIONS)
+    assert not missing, f"missing top-level exports: {missing}"
+    assert len(EXCEPTIONS) < 10
+
+
+# ---- golden tests for the ops added in the round-2 completion pass ----
+
+def test_tensordot():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    b = np.arange(24, dtype=np.float32).reshape(3, 4, 2)
+    got = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b), 2)
+    np.testing.assert_allclose(got.numpy(), np.tensordot(a, b, 2))
+    got2 = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                            [[1, 2], [0, 1]])
+    np.testing.assert_allclose(got2.numpy(),
+                               np.tensordot(a, b, ([1, 2], [0, 1])))
+
+
+def test_amax_amin_top_level():
+    x = paddle.to_tensor(np.array([[1.0, 5.0], [3.0, 2.0]], np.float32))
+    assert float(paddle.amax(x)) == 5.0
+    assert float(paddle.amin(x)) == 1.0
+    np.testing.assert_allclose(paddle.amax(x, axis=0).numpy(), [3.0, 5.0])
+
+
+def test_mode_kthvalue():
+    x = np.array([[2, 2, 3], [1, 3, 3]], np.float32)
+    vals, idx = paddle.mode(paddle.to_tensor(x))
+    np.testing.assert_allclose(vals.numpy(), [2.0, 3.0])
+    v, i = paddle.kthvalue(paddle.to_tensor(x), 2)
+    np.testing.assert_allclose(v.numpy(), [2.0, 3.0])
+
+
+def test_logit_sgn_frexp():
+    x = paddle.to_tensor(np.array([0.25, 0.5, 0.75], np.float32))
+    np.testing.assert_allclose(
+        paddle.logit(x).numpy(),
+        np.log(np.array([0.25, 0.5, 0.75]) /
+               (1 - np.array([0.25, 0.5, 0.75]))), rtol=1e-6)
+    s = paddle.sgn(paddle.to_tensor(np.array([-2.0, 0.0, 5.0],
+                                             np.float32)))
+    np.testing.assert_allclose(s.numpy(), [-1.0, 0.0, 1.0])
+    m, e = paddle.frexp(paddle.to_tensor(np.array([8.0], np.float32)))
+    np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), [8.0])
+
+
+def test_add_n_renorm():
+    xs = [paddle.full([2], float(i)) for i in range(1, 4)]
+    np.testing.assert_allclose(paddle.add_n(xs).numpy(), [6.0, 6.0])
+    x = np.array([[3.0, 4.0], [6.0, 8.0]], np.float32)
+    out = paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0, max_norm=5.0)
+    # row 0 norm 5 kept; row 1 norm 10 scaled to 5
+    np.testing.assert_allclose(out.numpy()[1], [3.0, 4.0], rtol=1e-4)
+
+
+def test_unique_consecutive():
+    x = paddle.to_tensor(np.array([1, 1, 2, 2, 2, 3, 1, 1], np.int32))
+    out, inv, counts = paddle.unique_consecutive(
+        x, return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3, 1])
+    np.testing.assert_array_equal(counts.numpy(), [2, 3, 1, 2])
+    np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 1, 2, 3, 3])
+
+
+def test_unstack_vsplit_reverse():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    parts = paddle.unstack(paddle.to_tensor(x), axis=0)
+    assert len(parts) == 4
+    np.testing.assert_allclose(parts[2].numpy(), x[2])
+    a, b = paddle.vsplit(paddle.to_tensor(x), 2)
+    np.testing.assert_allclose(a.numpy(), x[:2])
+    np.testing.assert_allclose(
+        paddle.reverse(paddle.to_tensor(x), axis=0).numpy(), x[::-1])
+
+
+def test_slice_strided_slice_crop():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(
+        paddle.slice(t, axes=[0, 1], starts=[1, 2],
+                     ends=[3, 5]).numpy(), x[1:3, 2:5])
+    np.testing.assert_allclose(
+        paddle.strided_slice(t, axes=[1], starts=[0], ends=[6],
+                             strides=[2]).numpy(), x[:, ::2])
+    np.testing.assert_allclose(
+        paddle.crop(t, shape=[2, 3], offsets=[1, 1]).numpy(),
+        x[1:3, 1:4])
+
+
+def test_complex_surface():
+    re = np.array([1.0, 2.0], np.float32)
+    im = np.array([3.0, 4.0], np.float32)
+    c = paddle.complex(paddle.to_tensor(re), paddle.to_tensor(im))
+    assert paddle.is_complex(c)
+    np.testing.assert_allclose(paddle.real(c).numpy(), re)
+    np.testing.assert_allclose(paddle.imag(c).numpy(), im)
+    ri = paddle.as_real(c)
+    np.testing.assert_allclose(ri.numpy()[:, 0], re)
+    c2 = paddle.as_complex(ri)
+    np.testing.assert_allclose(paddle.imag(c2).numpy(), im)
+
+
+def test_inplace_variants_adopt_and_tape():
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    paddle.reshape_(x, [3, 2])
+    assert x.shape == [3, 2]
+    y = paddle.to_tensor(np.ones((4,), np.float32))
+    y.stop_gradient = False
+    z = y * 2.0
+    paddle.tanh_(z)
+    loss = z.sum()
+    loss.backward()
+    expected = (1 - np.tanh(2.0) ** 2) * 2.0
+    np.testing.assert_allclose(y.grad.numpy(),
+                               np.full((4,), expected), rtol=1e-5)
+
+
+def test_shard_index():
+    x = paddle.to_tensor(np.array([1, 6, 11, 15], np.int64))
+    out = paddle.shard_index(x, index_num=20, nshards=2, shard_id=0)
+    np.testing.assert_array_equal(out.numpy(), [1, 6, -1, -1])
+    out1 = paddle.shard_index(x, index_num=20, nshards=2, shard_id=1)
+    np.testing.assert_array_equal(out1.numpy(), [-1, -1, 1, 5])
+
+
+def test_framework_utils():
+    t = paddle.ones([2, 3])
+    assert paddle.is_tensor(t) and not paddle.is_tensor(np.ones(3))
+    assert paddle.is_floating_point(t)
+    assert paddle.is_integer(paddle.to_tensor(np.int32(1)))
+    assert int(paddle.rank(t)) == 2
+    np.testing.assert_array_equal(paddle.shape(t).numpy(), [2, 3])
+    assert paddle.tolist(t) == [[1.0, 1.0, 1.0]] * 2
+    assert paddle.iinfo("int32").max == 2**31 - 1
+    assert paddle.finfo("bfloat16").bits == 16
+    assert not bool(paddle.is_empty(t))
+
+
+def test_random_surface():
+    paddle.seed(7)
+    s = paddle.standard_normal([1000])
+    assert abs(float(s.mean())) < 0.2
+    r = paddle.randint_like(paddle.ones([5], "int64"), 0, 10)
+    assert r.shape == [5]
+    lam = paddle.full([2000], 4.0)
+    p = paddle.poisson(lam)
+    assert abs(float(p.mean()) - 4.0) < 0.3
+
+
+def test_place_and_wrappers():
+    from paddle_tpu import nn
+    p = paddle.CPUPlace()
+    assert p.is_cpu_place() or p.platform in ("cpu", "tpu")
+    m = paddle.DataParallel(nn.Linear(3, 2))
+    out = m(paddle.ones([1, 3]))
+    assert out.shape == [1, 2]
+    with paddle.LazyGuard():
+        nn.Linear(2, 2)
+    reader = paddle.batch(lambda: iter(range(5)), batch_size=2)
+    assert list(reader()) == [[0, 1], [2, 3], [4]]
+
+
+def test_create_parameter():
+    p = paddle.create_parameter([4, 3], "float32")
+    assert isinstance(p, paddle.Parameter)
+    assert p.shape == [4, 3] and not p.stop_gradient
+
+
+def test_setitem_inplace_no_tape_self_loop():
+    # regression: adopting a recorded node onto the SAME tensor object
+    # used to make the node its own input (backward saw a "cycle")
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    x.stop_gradient = False
+    t = x * 2.0
+    t[0] = 5.0
+    t.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])

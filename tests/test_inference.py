@@ -110,3 +110,57 @@ def test_predictor_errors(tmp_path):
         pred.get_input_handle("bogus")
     with pytest.raises(RuntimeError, match="inputs not set"):
         pred.run()
+
+
+def test_predictor_int8_weight_serving():
+    """Int8 serving path (VERDICT r1 Next #9): weights held as int8 +
+    per-channel scales, dequant inside the compiled program; outputs
+    must stay close to the fp32 predictor's."""
+    paddle.seed(0)
+    from paddle_tpu.models.lenet import LeNet
+    m = LeNet(num_classes=10)
+    m.eval()
+    x = np.random.RandomState(0).randn(4, 1, 28, 28).astype(np.float32)
+
+    spec = [paddle.to_tensor(x)]
+    ref = create_predictor(Config().from_layer(m, spec))
+    ref_out = ref.run([x])[0]
+
+    cfg = Config().from_layer(m, spec)
+    cfg.enable_tpu(PrecisionType.Int8)
+    pred = create_predictor(cfg)
+    out = pred.run([x])[0]
+    assert out.shape == ref_out.shape
+    # int8 weights + bf16 activations: small bounded drift, same top-1
+    assert np.abs(out.astype(np.float32) - ref_out).max() < 0.15, \
+        np.abs(out.astype(np.float32) - ref_out).max()
+    np.testing.assert_array_equal(out.argmax(-1), ref_out.argmax(-1))
+
+
+def test_predictor_int8_after_ptq():
+    """PTQ calibrate -> convert -> int8 predictor (the reference's
+    post_training_quantization.py deployment flow)."""
+    paddle.seed(1)
+    from paddle_tpu.quantization import PTQ
+    m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    m.eval()
+    rng = np.random.RandomState(1)
+    calib = rng.randn(64, 16).astype(np.float32)
+    x = rng.randn(8, 16).astype(np.float32)
+    ref_out = m(paddle.to_tensor(x)).numpy()
+
+    ptq = PTQ()
+    q = ptq.quantize(m, inplace=False)
+    q.eval()
+    q(paddle.to_tensor(calib))  # calibration pass
+    q = ptq.convert(q)
+    assert ptq.quant_info  # scales recorded for export
+
+    spec = [paddle.to_tensor(x)]
+    cfg = Config().from_layer(q, spec)
+    cfg.enable_tpu(PrecisionType.Int8)
+    pred = create_predictor(cfg)
+    out = pred.run([x])[0]
+    err = np.abs(out.astype(np.float32) - ref_out).max()
+    scale = np.abs(ref_out).max()
+    assert err < 0.1 * scale + 0.1, (err, scale)

@@ -1,0 +1,127 @@
+"""bf16 golden dtype sweep (VERDICT r1 Next #6).
+
+Reference analog: unittests/op_test.py check_output_with_place over
+bf16 places + white_list tolerances. TPU's native dtype is bfloat16 —
+every core op must produce whitelist-bounded results in bf16, eagerly
+AND under jit, or numeric regressions (flash attention, fused norms)
+would ship silently. Extra finite-difference grad coverage rides along
+(VERDICT weak #3).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output_bf16
+
+rng = np.random.RandomState(0)
+A23 = rng.randn(2, 3).astype(np.float32)
+B23 = rng.randn(2, 3).astype(np.float32)
+A34 = rng.randn(3, 4).astype(np.float32)
+POS = (np.abs(rng.randn(2, 3)) + 0.1).astype(np.float32)
+UNIT = rng.rand(2, 3).astype(np.float32) * 0.8 + 0.1
+
+SWEEP = [
+    # (name, fn, numpy ref, inputs, kwargs)
+    ("add", paddle.add, np.add, [A23, B23], {}),
+    ("subtract", paddle.subtract, np.subtract, [A23, B23], {}),
+    ("multiply", paddle.multiply, np.multiply, [A23, B23], {}),
+    ("divide", paddle.divide, np.divide, [A23, POS], {}),
+    ("maximum", paddle.maximum, np.maximum, [A23, B23], {}),
+    ("exp", paddle.exp, np.exp, [A23], {}),
+    ("log", paddle.log, np.log, [POS], {}),
+    ("log1p", paddle.log1p, np.log1p, [POS], {}),
+    ("sqrt", paddle.sqrt, np.sqrt, [POS], {}),
+    ("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x), [POS], {}),
+    ("tanh", paddle.tanh, np.tanh, [A23], {}),
+    ("sin", paddle.sin, np.sin, [A23], {}),
+    ("cos", paddle.cos, np.cos, [A23], {}),
+    ("erf", paddle.erf,
+     lambda x: np.vectorize(__import__("math").erf)(x).astype(np.float32),
+     [A23], {}),
+    ("abs", paddle.abs, np.abs, [A23], {}),
+    ("square", paddle.square, np.square, [A23], {}),
+    ("sigmoid", F.sigmoid, lambda x: 1 / (1 + np.exp(-x)), [A23], {}),
+    ("logit", paddle.logit,
+     lambda x: np.log(x / (1 - x)), [UNIT], {}),
+    ("sum", paddle.sum, lambda x: np.sum(x), [A23], {}),
+    ("mean", paddle.mean, lambda x: np.mean(x), [A23], {}),
+    ("max", paddle.max, lambda x: np.max(x), [A23], {}),
+    ("min", paddle.min, lambda x: np.min(x), [A23], {}),
+    ("std", paddle.std,
+     lambda x: np.std(x, ddof=1), [A23], {}),
+    ("var", paddle.var,
+     lambda x: np.var(x, ddof=1), [A23], {}),
+    ("logsumexp", paddle.logsumexp,
+     lambda x: np.log(np.sum(np.exp(x))), [A23], {}),
+    ("cumsum", paddle.cumsum,
+     lambda x, axis=None: np.cumsum(x, axis), [A23], {"axis": 1}),
+    ("cumprod", paddle.cumprod,
+     lambda x, dim=None: np.cumprod(x, dim), [A23], {"dim": 1}),
+    ("matmul", paddle.matmul, np.matmul, [A23, A34], {}),
+    ("addmm", paddle.addmm,
+     lambda i, x, y: i + x @ y,
+     [rng.randn(2, 4).astype(np.float32), A23, A34], {}),
+    ("kron", paddle.kron, np.kron, [A23, B23], {}),
+    ("clip", paddle.clip,
+     lambda x, min=None, max=None: np.clip(x, min, max),
+     [A23], {"min": -0.5, "max": 0.5}),
+    ("floor", paddle.floor, np.floor, [A23], {}),
+    ("ceil", paddle.ceil, np.ceil, [A23], {}),
+    ("sign", paddle.sign, np.sign, [A23], {}),
+    ("reciprocal", paddle.reciprocal, lambda x: 1.0 / x, [POS], {}),
+    ("softmax", F.softmax,
+     lambda x: np.exp(x - x.max(-1, keepdims=True)) /
+     np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True),
+     [A23], {}),
+    ("relu", F.relu, lambda x: np.maximum(x, 0), [A23], {}),
+    ("gelu", F.gelu,
+     lambda x: 0.5 * x * (1 + np.vectorize(__import__("math").erf)(
+         x / np.sqrt(2)).astype(np.float32)), [A23], {}),
+    ("transpose", paddle.transpose,
+     lambda x, perm: np.transpose(x, perm), [A23], {"perm": [1, 0]}),
+    ("concat", lambda *xs, axis: paddle.concat(list(xs), axis=axis),
+     lambda *xs, axis: np.concatenate(xs, axis), [A23, B23], {"axis": 0}),
+    ("where", paddle.where,
+     lambda c, x, y: np.where(c, x, y),
+     [A23 > 0, A23, B23], {}),
+    ("pow", paddle.pow, lambda x, y: np.power(x, y), [POS, B23], {}),
+    ("lerp", paddle.lerp,
+     lambda x, y, w: x + w * (y - x), [A23, B23, np.float32(0.3)], {}),
+]
+
+
+@pytest.mark.parametrize(
+    "name,fn,ref,inputs,kwargs", SWEEP, ids=[s[0] for s in SWEEP])
+def test_bf16_golden(name, fn, ref, inputs, kwargs):
+    check_output_bf16(fn, ref, inputs, kwargs=kwargs, name=name)
+
+
+# ---- extra finite-difference grad coverage (fp32) ---------------------
+
+GRAD_OPS = [
+    ("mul_grad", lambda x, y: (x * y), [A23, B23]),
+    ("div_grad", lambda x, y: (x / y), [A23, POS]),
+    ("tanh_grad", lambda x: paddle.tanh(x), [A23]),
+    ("exp_grad", lambda x: paddle.exp(x), [A23 * 0.3]),
+    ("log_grad", lambda x: paddle.log(x), [POS]),
+    ("sqrt_grad", lambda x: paddle.sqrt(x), [POS]),
+    ("matmul_grad", lambda x, y: paddle.matmul(x, y), [A23, A34]),
+    ("softmax_grad", lambda x: F.softmax(x), [A23]),
+    ("gelu_grad", lambda x: F.gelu(x), [A23]),
+    ("sigmoid_grad", lambda x: F.sigmoid(x), [A23]),
+    ("logsumexp_grad", lambda x: paddle.logsumexp(x), [A23]),
+    ("mean_grad", lambda x: paddle.mean(x), [A23]),
+    ("lerp_grad",
+     lambda x, y: paddle.lerp(x, y, paddle.full([], 0.3)), [A23, B23]),
+    ("kron_grad", lambda x, y: paddle.kron(x, y), [A23, B23]),
+    ("renorm_grad",
+     lambda x: paddle.renorm(x, p=2.0, axis=0, max_norm=1.0), [A23]),
+    ("logit_grad", lambda x: paddle.logit(x), [UNIT]),
+]
+
+
+@pytest.mark.parametrize("name,fn,inputs", GRAD_OPS,
+                         ids=[g[0] for g in GRAD_OPS])
+def test_finite_difference_grads(name, fn, inputs):
+    check_grad(fn, inputs)

@@ -112,9 +112,10 @@ def test_optimizer_state_dict_roundtrip():
     opt2 = optimizer.Adam(parameters=[w2])
     opt2.set_state_dict(sd)
     assert opt2._step_count == 1
+    from paddle_tpu.optimizer.optimizer import opt_key
     np.testing.assert_allclose(
-        np.asarray(opt2._state[id(w2)]["moment1"]),
-        np.asarray(opt._state[id(w)]["moment1"]))
+        np.asarray(opt2._state[opt_key(w2)]["moment1"]),
+        np.asarray(opt._state[opt_key(w)]["moment1"]))
 
 
 def test_scheduler_with_optimizer():

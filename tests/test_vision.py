@@ -226,3 +226,63 @@ def test_dataloader_iterable_rejection():
 
     with pytest.raises(ValueError, match="map-style"):
         DataLoader(It(), batch_size=2, num_workers=2)
+
+
+def test_flowers_dataset_local(tmp_path):
+    import scipy.io as sio
+    from PIL import Image
+    jpg = tmp_path / "jpg"
+    jpg.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(1, 7):
+        Image.fromarray(rng.randint(0, 255, (8, 8, 3), np.uint8)).save(
+            jpg / f"image_{i:05d}.jpg")
+    sio.savemat(tmp_path / "imagelabels.mat",
+                {"labels": np.array([[1, 2, 3, 1, 2, 3]])})
+    sio.savemat(tmp_path / "setid.mat",
+                {"trnid": np.array([[1, 2, 3, 4]]),
+                 "valid": np.array([[5]]), "tstid": np.array([[6]])})
+    from paddle_tpu.vision.datasets import Flowers
+    ds = Flowers(data_file=str(jpg),
+                 label_file=str(tmp_path / "imagelabels.mat"),
+                 setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(ds) == 4
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label == 0
+
+
+def test_voc2012_dataset_local(tmp_path):
+    from PIL import Image
+    root = tmp_path / "VOCdevkit" / "VOC2012"
+    (root / "JPEGImages").mkdir(parents=True)
+    (root / "SegmentationClass").mkdir()
+    (root / "ImageSets" / "Segmentation").mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for stem in ("2007_000001", "2007_000002"):
+        Image.fromarray(rng.randint(0, 255, (6, 6, 3), np.uint8)).save(
+            root / "JPEGImages" / f"{stem}.jpg")
+        seg = Image.fromarray(rng.randint(0, 20, (6, 6), np.uint8),
+                              mode="P")
+        seg.save(root / "SegmentationClass" / f"{stem}.png")
+    (root / "ImageSets" / "Segmentation" / "train.txt").write_text(
+        "2007_000001\n2007_000002\n")
+    from paddle_tpu.vision.datasets import VOC2012
+    ds = VOC2012(data_file=str(tmp_path), mode="train")
+    assert len(ds) == 2
+    img, seg = ds[0]
+    assert img.shape == (6, 6, 3) and seg.shape == (6, 6)
+
+
+def test_download_mirror_resolution(tmp_path, monkeypatch):
+    from paddle_tpu.utils.download import get_path_from_url
+    mirror = tmp_path / "mirror"
+    mirror.mkdir()
+    (mirror / "weights.bin").write_bytes(b"abc")
+    monkeypatch.setenv("PADDLE_TPU_DOWNLOAD_DIR", str(mirror))
+    out = get_path_from_url("https://example.com/x/weights.bin",
+                            root_dir=str(tmp_path / "cache"),
+                            decompress=False)
+    assert open(out, "rb").read() == b"abc"
+    with pytest.raises(RuntimeError, match="no network egress"):
+        get_path_from_url("https://example.com/x/missing.bin",
+                          root_dir=str(tmp_path / "cache"))
