@@ -83,11 +83,17 @@ def bench_gpt2(dev, on_tpu):
     seq = int(os.environ.get("BENCH_SEQ", seq))
     remat = os.environ.get("BENCH_REMAT", "")  # ""/selective/full
     offload = os.environ.get("BENCH_OFFLOAD", "") == "1"
+    # chunked fused LM-head+CE is the default: it never materializes
+    # the [B, S, vocab] logits and wins ~10% MFU at s1024, ~16% at
+    # s2048 (see BASELINE.md sweeps). BENCH_FUSED=0 opts out.
+    fused = os.environ.get("BENCH_FUSED", "1") == "1"
+    chunk = int(os.environ.get("BENCH_CHUNK", "256"))
 
     paddle.seed(0)
     model = gpt(name, max_position_embeddings=seq,
                 use_recompute=bool(remat),
-                recompute_granularity=remat or "selective")
+                recompute_granularity=remat or "selective",
+                fused_lm_loss=fused, lm_loss_chunk=chunk)
     model.bfloat16() if on_tpu else None
     opt = optimizer.AdamW(learning_rate=1e-4,
                           parameters=model.parameters(),
@@ -107,7 +113,8 @@ def bench_gpt2(dev, on_tpu):
     tokens_per_sec = batch * seq * iters / dt
     mfu = tokens_per_sec * model.flops_per_token(seq) / peak_flops(dev)
     extra = (f", remat={remat}" if remat else "") + \
-        (", offload" if offload else "")
+        (", offload" if offload else "") + \
+        (", fused_loss" if fused else "")
     return {
         "metric": f"{name} train tokens/sec/chip (b{batch} s{seq}, "
                   f"MFU={mfu:.3f}, loss={loss:.3f}{extra}, "

@@ -65,6 +65,8 @@ from .ops.math import (acos, acosh, add_n, amax, amin, angle,  # noqa: F401
                        nanquantile, neg, not_equal, quantile, rad2deg,
                        real, reciprocal, renorm, sgn, sinh, stanh, tan)
 from .ops.math import mod as floor_mod  # noqa: F401
+from .ops.manipulation import (diag_embed, fill_diagonal,  # noqa: F401
+                               fill_diagonal_tensor)
 from .ops.manipulation import (argsort, as_complex, as_real,  # noqa: F401
                                broadcast_shape, broadcast_tensors,
                                complex, crop, index_add_, reshape_,

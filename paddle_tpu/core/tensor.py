@@ -421,7 +421,8 @@ class Tensor:
             snap = None
             for i, t in enumerate(node.inputs):
                 if t is self:
-                    snap = snap or self._snapshot()
+                    if snap is None:
+                        snap = self._snapshot()
                     node.inputs[i] = snap
         self._data = out._data
         self._node = out._node

@@ -378,27 +378,36 @@ def _spmd_pipeline(unit_call, names, stacked_vals, specs, seg_counts,
         cnts = cnt_local[0]                         # [v]
 
         def tick(carry, t):
-            act, inter, outs = carry
-            # bank the ring arrival (stage S-1's tick t-1 output) —
-            # only stage 0 ever reads it, as chunk c>0 input
-            k_arr = t - S
-            mu_arr = jnp.clip(k_arr, 0, v * M - 1) % M
-            bank = (k_arr >= 0) & (k_arr // M < v - 1)
-            inter = jnp.where(
-                bank,
-                jax.lax.dynamic_update_index_in_dim(inter, act, mu_arr, 0),
-                inter)
+            # `inter` (chunk c-1 outputs banked for chunk c's entry) is
+            # carried only when interleaving — at v=1 it would be an
+            # extra full-microbatch HBM buffer that is provably never
+            # read
+            if v > 1:
+                act, inter, outs = carry
+                # bank the ring arrival (stage S-1's tick t-1 output) —
+                # only stage 0 ever reads it, as chunk c>0 input
+                k_arr = t - S
+                mu_arr = jnp.clip(k_arr, 0, v * M - 1) % M
+                bank = (k_arr >= 0) & (k_arr // M < v - 1)
+                inter = jnp.where(
+                    bank,
+                    jax.lax.dynamic_update_index_in_dim(inter, act,
+                                                        mu_arr, 0),
+                    inter)
+            else:
+                act, outs = carry
 
             k = t - stage
             valid = (k >= 0) & (k < v * M)
             kc = jnp.clip(k, 0, v * M - 1)
             c = kc // M
             mu = kc % M
-            feed0 = jax.lax.dynamic_index_in_dim(mb_local, mu, 0,
-                                                 keepdims=False)
-            feedc = jax.lax.dynamic_index_in_dim(inter, mu, 0,
-                                                 keepdims=False)
-            feed = jnp.where(c == 0, feed0, feedc)
+            feed = jax.lax.dynamic_index_in_dim(mb_local, mu, 0,
+                                                keepdims=False)
+            if v > 1:
+                feedc = jax.lax.dynamic_index_in_dim(inter, mu, 0,
+                                                     keepdims=False)
+                feed = jnp.where(c == 0, feed, feedc)
             inp = jnp.where(stage == 0, feed, act)
             pstacks = [jax.lax.dynamic_index_in_dim(sv, c, 0,
                                                     keepdims=False)
@@ -410,13 +419,13 @@ def _spmd_pipeline(unit_call, names, stacked_vals, specs, seg_counts,
                 jax.lax.dynamic_update_index_in_dim(outs, out, mu, 0),
                 outs)
             act = jax.lax.ppermute(out, "pp", ring)
-            return (act, inter, outs), None
+            return ((act, inter, outs) if v > 1 else (act, outs)), None
 
-        init = jax.lax.pcast(
-            (jnp.zeros_like(mb_local[0]), jnp.zeros_like(mb_local),
-             jnp.zeros_like(mb_local)),
-            ("pp",), to="varying")
-        (_, _, outs), _ = jax.lax.scan(tick, init, jnp.arange(steps))
+        carry0 = (jnp.zeros_like(mb_local[0]), jnp.zeros_like(mb_local),
+                  jnp.zeros_like(mb_local)) if v > 1 else             (jnp.zeros_like(mb_local[0]), jnp.zeros_like(mb_local))
+        init = jax.lax.pcast(carry0, ("pp",), to="varying")
+        final_carry, _ = jax.lax.scan(tick, init, jnp.arange(steps))
+        outs = final_carry[-1]
         # [1, M, mb, ...] local -> global leading dim S over 'pp'; only
         # stage S-1's slice is real, sliced out by the caller.
         return outs[None]

@@ -12,3 +12,4 @@ __all__.append("autograd")
 from . import nn  # noqa: F401,E402
 
 __all__.append("nn")
+from . import optimizer  # noqa: F401

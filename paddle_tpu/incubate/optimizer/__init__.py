@@ -1,0 +1,168 @@
+"""paddle.incubate.optimizer analogs.
+
+Reference: python/paddle/incubate/optimizer/{lookahead,modelaverage}.py
+(+ distributed_fused_lamb.py — on TPU the plain Lamb already compiles to
+one fused XLA program under TrainStep, so no separate fused variant is
+needed; optimizer/optimizers.py Lamb is the analog).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Parameter, Tensor
+from ...optimizer.optimizer import Optimizer, opt_key
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """Lookahead wrapper (reference lookahead.py): every k inner steps,
+    slow weights move alpha of the way toward the fast weights and the
+    fast weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be within [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be a positive integer, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._k_count = 0
+        self._parameter_list = inner_optimizer._parameter_list
+        # slow weights snapshot the INITIAL params (reference
+        # lookahead.py keeps slow_params from construction), so the
+        # first k-step sync genuinely pulls back toward the start point
+        self._slow: Dict[int, jnp.ndarray] = {
+            opt_key(p): p.data for p in (self._parameter_list or [])
+            if isinstance(p, Parameter)}
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k != 0:
+            return
+        for p in (self._parameter_list or []):
+            if not isinstance(p, Parameter) or not p.trainable:
+                continue
+            key = opt_key(p)
+            slow = self._slow.get(key)
+            if slow is None:  # param added after construction
+                slow = p.data
+            slow = slow + self.alpha * (p.data - slow)
+            self._slow[key] = slow
+            p._replace_data(slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        sd = {"inner": self.inner_optimizer.state_dict(),
+              "_k_count": self._k_count}
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                s = self._slow.get(opt_key(p))
+                if s is not None:
+                    sd[f"slow_{i}"] = np.asarray(s)
+        return sd
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd.get("inner", {}))
+        self._k_count = int(sd.get("_k_count", 0))
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                if f"slow_{i}" in sd:
+                    self._slow[opt_key(p)] = jnp.asarray(sd[f"slow_{i}"])
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameter values for evaluation (reference
+    modelaverage.py): accumulate sums each step; apply() swaps averaged
+    weights in, restore() swaps the live ones back."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters: Optional[List] = None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        super().__init__(parameters=parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._cnt: Dict[int, int] = {}
+        self._old_sum: Dict[int, jnp.ndarray] = {}
+        self._old_cnt: Dict[int, int] = {}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._applied = False
+
+    def step(self):
+        # two-buffer rolling window (the reference's sum/old_sum +
+        # num_accumulates rotation): the live sum rotates into old_sum
+        # when it reaches max_average_window, so apply() averages over
+        # the most recent [max_w, 2*max_w) steps
+        for p in (self._parameter_list or []):
+            if not isinstance(p, Parameter) or not p.trainable:
+                continue
+            key = opt_key(p)
+            cur = self._sum.get(key)
+            self._sum[key] = p.data if cur is None else cur + p.data
+            self._cnt[key] = self._cnt.get(key, 0) + 1
+            if self._cnt[key] >= self.max_w:
+                self._old_sum[key] = self._sum.pop(key)
+                self._old_cnt[key] = self._cnt.pop(key)
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap averaged params in (context-manager friendly)."""
+        for p in (self._parameter_list or []):
+            key = opt_key(p)
+            total = None
+            n = 0
+            if key in self._old_sum:
+                total = self._old_sum[key]
+                n += self._old_cnt[key]
+            if key in self._sum:
+                total = self._sum[key] if total is None \
+                    else total + self._sum[key]
+                n += self._cnt[key]
+            if total is not None and n >= max(1, min(self.min_w,
+                                                     self.max_w)):
+                # reference gate: too few accumulates -> keep live
+                # weights rather than swap in a high-variance average
+                self._backup[key] = p.data
+                p._replace_data(total / n)
+        self._applied = True
+
+        class _Ctx:
+            def __enter__(s):
+                return s
+
+            def __exit__(s, *exc):
+                if need_restore:
+                    self.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in (self._parameter_list or []):
+            key = opt_key(p)
+            if key in self._backup:
+                p._replace_data(self._backup.pop(key))
+        self._applied = False

@@ -43,6 +43,13 @@ class GPTConfig:
     #: "full" saves nothing (max memory relief, ~1.3x trunk FLOPs).
     #: ≈ the reference's recompute_granularity (full/core_attn)
     recompute_granularity: str = "selective"
+    #: fuse the LM head into the loss, scanned over sequence chunks so
+    #: the [B, S, vocab] logits are never materialized — the dominant
+    #: HBM cost at long seq (B16 s2048 logits alone are 3.3 GB bf16).
+    #: forward() then returns the final hidden states; loss() applies
+    #: the chunked head+CE (rematerialized per chunk in backward)
+    fused_lm_loss: bool = False
+    lm_loss_chunk: int = 256
     tie_word_embeddings: bool = True
     sequence_parallel: bool = False   # shard seq dim over 'sp' +
     # ring attention (NEW vs the reference — SURVEY §5 long-context story)
@@ -252,17 +259,80 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, attn_mask=None):
         h = self.gpt(input_ids, attn_mask)
+        if self.cfg.fused_lm_loss:
+            # ship the head weight WITH the output (cloned while any
+            # functional_call binding is live) so loss() sees the
+            # traced/current value — reading self...weight there would
+            # bake a stale constant into compiled train steps and drop
+            # the head-weight gradient
+            w = self.lm_head.weight if self.lm_head is not None \
+                else self.gpt.embed.wte.weight
+            return h, w.clone()
         return _lm_logits(h, self.lm_head,
                           self.gpt.embed.wte.weight)
+
+    def _fused_loss(self, hidden, labels, w):
+        """Chunked LM-head + cross-entropy: scan sequence chunks, each
+        chunk's logits live only inside its (rematerialized) scan step.
+        HBM for logits drops from S*V to chunk*V per microbatch.
+        `w` is the head weight ([in, V] untied / [V, in] tied wte),
+        passed as a traced operand so its gradient flows."""
+        import jax
+
+        h = hidden
+        y = labels
+        tied = self.lm_head is None
+        hs = h[:, :-1, :]
+        ys = y[:, 1:]
+        b, s1, hd = hs.shape
+        chunk = min(self.cfg.lm_loss_chunk, s1)
+        n_chunks = -(-s1 // chunk)
+        pad = n_chunks * chunk - s1
+        hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+        ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=-1)
+        hs = hs.reshape(b, n_chunks, chunk, hd).transpose(1, 0, 2, 3)
+        ys = ys.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+
+        def chunk_ce(hc, yc):
+            wmat = w.T if tied else w
+            logits = (hc @ wmat.astype(hc.dtype)).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            yc_safe = jnp.maximum(yc, 0)
+            gold = jnp.take_along_axis(
+                logits, yc_safe[..., None], axis=-1)[..., 0]
+            valid = (yc >= 0).astype(jnp.float32)
+            return jnp.sum((lse - gold) * valid), jnp.sum(valid)
+
+        def body(carry, xs):
+            hc, yc = xs
+            ssum, cnt = jax.checkpoint(chunk_ce)(hc, yc)
+            return (carry[0] + ssum, carry[1] + cnt), None
+
+        (total, count), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (hs, ys))
+        return total / jnp.maximum(count, 1.0)
 
     def loss(self, logits, labels):
         """Shifted LM loss (mean over non-shifted tokens) + MoE aux loss
         when experts are active (read in the same trace as forward)."""
-        shifted = logits[:, :-1, :]
-        targets = labels[:, 1:]
-        ce = F.cross_entropy(
-            shifted.reshape([-1, shifted.shape[-1]]),
-            targets.reshape([-1]))
+        fused = (self is not None
+                 and getattr(self, "cfg", None) is not None
+                 and self.cfg.fused_lm_loss)
+        if fused:
+            from ..core.tensor import dispatch
+            hidden, w = logits  # forward returned (hidden, head_weight)
+            # routed through dispatch so the eager tape records it and
+            # the head weight is a differentiable operand
+            ce = dispatch("fused_lm_loss",
+                          lambda h, y, wv: self._fused_loss(h, y, wv),
+                          (hidden, labels, w), {})
+        else:
+            shifted = logits[:, :-1, :]
+            targets = labels[:, 1:]
+            ce = F.cross_entropy(
+                shifted.reshape([-1, shifted.shape[-1]]),
+                targets.reshape([-1]))
         if self is not None and getattr(self, "cfg", None) is not None \
                 and self.cfg.moe_num_experts > 0:
             carried = getattr(self.gpt, "_moe_aux", None)

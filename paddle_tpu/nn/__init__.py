@@ -26,3 +26,11 @@ from .layers_extra import (  # noqa: F401
     PairwiseDistance, Unfold, Upsample, UpsamplingBilinear2D,
     UpsamplingNearest2D, ZeroPad2D,
 )
+
+from . import utils  # noqa: F401
+
+from .layers_wrap import *  # noqa: F401,F403
+from .rnn import BiRNN, RNNCellBase  # noqa: F401
+from ..optimizer.grad_clip import (ClipGradByGlobalNorm,  # noqa: F401
+                                   ClipGradByNorm, ClipGradByValue)
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401

@@ -1,0 +1,139 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Reference: python/paddle/nn/decode.py (BeamSearchDecoder with the
+initialize/step/finalize protocol; dynamic_decode driving it until all
+beams finish). TPU-native notes: the decode loop is host-driven in
+eager mode (each step is a compiled cell call); the per-step beam
+bookkeeping is pure jnp, and the final backtrace reuses the gather_tree
+op. Scores are length-ordinary log-probs (no penalty), matching the
+reference default.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class BeamSearchDecoder:
+    """Beam-search wrapper around an RNN cell (reference decode.py
+    BeamSearchDecoder). `embedding_fn` maps token ids -> cell inputs;
+    `output_fn` maps cell outputs -> vocabulary logits."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # --- protocol ------------------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(_raw(s), self.beam_size, axis=0),
+            initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        leaf = jax.tree_util.tree_leaves(states)[0]
+        bk = leaf.shape[0]
+        b = bk // self.beam_size
+        tokens = jnp.full((bk,), self.start_token, jnp.int32)
+        # beam 0 starts live, others start at -inf so step 1 expands
+        # only the root (the reference's kInitialValueOfCell trick)
+        log_probs = jnp.where(
+            jnp.arange(self.beam_size)[None, :] == 0, 0.0, -1e9
+        ) * jnp.ones((b, 1))
+        finished = jnp.zeros((b, self.beam_size), bool)
+        return tokens, states, (log_probs, finished)
+
+    def _embed(self, tokens):
+        if self.embedding_fn is None:
+            return tokens
+        out = self.embedding_fn(Tensor(tokens))
+        return _raw(out)
+
+    def step(self, time, tokens, states, beam_state):
+        log_probs, finished = beam_state
+        b, k = log_probs.shape
+        inputs = self._embed(tokens)
+        cell_out, next_states = self.cell(Tensor(inputs), states)
+        logits = _raw(self.output_fn(cell_out)
+                      if self.output_fn is not None else cell_out)
+        v = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1).reshape(b, k, v)
+        # finished beams only extend with end_token at no cost
+        end_only = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], end_only[None, None, :],
+                            step_lp)
+        total = log_probs[..., None] + step_lp          # [B, K, V]
+        flat = total.reshape(b, k * v)
+        top_lp, top_idx = jax.lax.top_k(flat, k)
+        parent = top_idx // v                            # [B, K]
+        token = (top_idx % v).astype(jnp.int32)
+        finished = jnp.take_along_axis(finished, parent, axis=1) | \
+            (token == self.end_token)
+
+        def reorder(s):
+            sr = _raw(s).reshape((b, k) + _raw(s).shape[1:])
+            gathered = jnp.take_along_axis(
+                sr, parent.reshape((b, k) + (1,) * (sr.ndim - 2)),
+                axis=1)
+            return gathered.reshape((b * k,) + sr.shape[2:])
+
+        next_states = jax.tree_util.tree_map(
+            reorder, next_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        return (token.reshape(-1), parent, next_states,
+                (top_lp, finished))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num: int = 100,
+                   output_time_major: bool = False, impute_finished=False,
+                   is_test: bool = False, return_length: bool = False,
+                   **kwargs):
+    """Run `decoder` until every beam emits end_token or max_step_num
+    (reference decode.py dynamic_decode). Returns (ids, scores) — ids
+    [B, T, beam] (or time-major), plus lengths when return_length."""
+    tokens, states, beam_state = decoder.initialize(inits)
+    b = beam_state[0].shape[0]
+    k = decoder.beam_size
+    step_tokens = []
+    step_parents = []
+    t = 0
+    while t < max_step_num:
+        tokens, parent, states, beam_state = decoder.step(
+            t, tokens, states, beam_state)
+        step_tokens.append(tokens.reshape(b, k))
+        step_parents.append(parent)
+        t += 1
+        if bool(jnp.all(beam_state[1])):
+            break
+    ids = jnp.stack(step_tokens)                    # [T, B, K]
+    parents = jnp.stack(step_parents)               # [T, B, K]
+    from ..ops.manipulation import gather_tree
+    full = _raw(gather_tree(Tensor(ids), Tensor(parents)))
+    log_probs, finished = beam_state
+    # sequence length = first end_token position + 1 (or T)
+    is_end = full == decoder.end_token
+    any_end = is_end.any(axis=0)
+    first_end = jnp.argmax(is_end, axis=0)
+    lengths = jnp.where(any_end, first_end + 1, full.shape[0])
+    if not output_time_major:
+        full = jnp.transpose(full, (1, 0, 2))       # [B, T, K]
+    outs = (Tensor(full), Tensor(log_probs))
+    if return_length:
+        return outs + (Tensor(lengths.astype(jnp.int64)),)
+    return outs

@@ -243,3 +243,28 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                              padding_mode=padding_mode,
                              align_corners=align_corners)
 
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """2-d affine sampling grid (reference vision affine_grid op over
+    phi affine_grid kernel): theta [N, 2, 3] -> grid [N, H, W, 2] in
+    [-1, 1] coords, consumable by grid_sample."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor, dispatch
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.tolist()
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def impl(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+
+    return dispatch("affine_grid", impl, (theta,), {})

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...ops.op_registry import op
 
@@ -192,3 +193,111 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     loss = optax.ctc_loss(lp, logitpad.astype(lp.dtype), labels,
                           labelpad.astype(lp.dtype), blank_id=blank)
     return _reduce(loss, reduction)
+
+
+# ---- round-2 wave 2: remaining loss surface ----------------------------
+# reference: python/paddle/nn/functional/loss.py soft_margin_loss /
+# multi_margin_loss / multi_label_soft_margin_loss /
+# triplet_margin_with_distance_loss / hsigmoid_loss
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+@op("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean"):
+    """log(1 + exp(-label * input)), label in {-1, 1}."""
+    z = -label.astype(input.dtype) * input
+    # stable softplus form: log(1 + e^z) = max(z, 0) + log1p(e^-|z|)
+    val = jnp.maximum(z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return _reduce(val, reduction)
+
+
+@op("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    y = label.astype(input.dtype)
+    term = y * jax.nn.log_sigmoid(input) + \
+        (1 - y) * jax.nn.log_sigmoid(-input)
+    if weight is not None:
+        term = term * weight
+    val = -jnp.mean(term, axis=-1)
+    return _reduce(val, reduction)
+
+
+@op("multi_margin_loss")
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    n, c = input.shape
+    gold = jnp.take_along_axis(input,
+                               label[:, None].astype(jnp.int32),
+                               axis=1)
+    diff = jnp.maximum(margin - gold + input, 0.0)
+    if p != 1:
+        diff = diff ** p
+    if weight is not None:
+        diff = diff * jnp.take(weight, label.astype(jnp.int32))[:, None]
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    val = jnp.sum(diff * mask, axis=1) / c
+    return _reduce(val, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin=1.0, swap=False,
+                                      reduction="mean"):
+    """Triplet loss with a custom distance callable (reference
+    loss.py triplet_margin_with_distance_loss)."""
+    from ...core.tensor import Tensor, dispatch
+    user_fn = distance_function is not None
+
+    def impl(a, p, n):
+        def dist(u, v):
+            if not user_fn:  # default L2 distance on raw arrays
+                return jnp.sqrt(
+                    jnp.sum(jnp.square(u - v), axis=-1) + 1e-12)
+            d = distance_function(
+                Tensor(u) if not isinstance(u, Tensor) else u,
+                Tensor(v) if not isinstance(v, Tensor) else v)
+            return d._data if isinstance(d, Tensor) else d
+
+        dp = dist(a, p)
+        dn = dist(a, n)
+        if swap:
+            dn = jnp.minimum(dn, dist(p, n))
+        val = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(val, reduction)
+
+    return dispatch("triplet_margin_with_distance_loss", impl,
+                    (input, positive, negative), {})
+
+
+@op("hsigmoid_loss")
+def hsigmoid_loss(input, label, num_classes, weight, bias=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hsigmoid_loss): the path code of class c uses internal
+    nodes (c + num_classes) / 2^k; cost is the summed binary CE along
+    the path."""
+    n = input.shape[0]
+    code_len = int(np.ceil(np.log2(max(num_classes, 2)))) + 1
+    lab = label.astype(jnp.int32).reshape(-1)
+    losses = jnp.zeros((n,), jnp.float32)
+    node = lab + num_classes
+    for _ in range(code_len):
+        parent = node // 2
+        active = node > 1                        # has a parent edge
+        is_right = (node % 2).astype(jnp.float32)
+        idx = jnp.clip(parent - 1, 0, num_classes - 2)
+        w_row = weight[idx]                      # [N, feature]
+        logit = jnp.sum(w_row * input, axis=-1)
+        if bias is not None:
+            logit = logit + bias[idx]
+        ce = jnp.maximum(logit, 0) - logit * is_right + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        losses = losses + jnp.where(active, ce, 0.0)
+        node = parent
+    return losses[:, None]

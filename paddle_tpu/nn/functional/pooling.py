@@ -141,3 +141,116 @@ adaptive_max_pool2d = op("adaptive_max_pool2d")(
 adaptive_max_pool3d = op("adaptive_max_pool3d")(
     lambda x, output_size, data_format="NCDHW":
     _adaptive_pool(x, output_size, 3, data_format, "max"))
+
+
+# ---- round-2: index-returning max pool + unpool ------------------------
+# reference: max_pool2d_with_index / unpool kernels (phi
+# max_pool*_with_index; python/paddle/nn/functional/pooling.py
+# return_mask + max_unpool1d/2d/3d). Mask = flat index into each (N, C)
+# spatial plane, matching the reference's unpool contract.
+
+def _max_pool_with_index(x, ksize, stride, padding, nsp):
+    k = _tuple(ksize, nsp)
+    s = _tuple(stride if stride is not None else ksize, nsp)
+    p = _tuple(padding, nsp)
+    neg = jnp.asarray(-jnp.inf, x.dtype) \
+        if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    pad_cfg = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    xp = jnp.pad(x, pad_cfg, constant_values=neg)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s,
+        padding=[(0, 0)] * nsp)
+    n, _, *out_sp = patches.shape
+    c = x.shape[1]
+    kn = int(np.prod(k))
+    # patches channel order: (C, *kernel) flattened, C slowest
+    pr = patches.reshape((n, c, kn) + tuple(out_sp))
+    arg = jnp.argmax(pr, axis=2)          # within-window offset
+    out = jnp.max(pr, axis=2)
+    # offset -> padded coords -> unpadded flat index
+    in_sp = x.shape[2:]
+    offs = jnp.unravel_index(arg, k)      # tuple of [N, C, *out_sp]
+    grids = jnp.meshgrid(*[jnp.arange(o) for o in out_sp],
+                         indexing="ij")
+    flat = None
+    for d in range(nsp):
+        coord = grids[d] * s[d] - p[d] + offs[d]
+        coord = jnp.clip(coord, 0, in_sp[d] - 1)
+        flat = coord if flat is None else flat * in_sp[d] + coord
+    return out, flat.astype(jnp.int32)
+
+
+def _max_unpool(x, indices, nsp, kernel_size, stride=None, padding=0,
+                output_size=None, data_format=None):
+    k = _tuple(kernel_size, nsp)
+    s = _tuple(stride if stride is not None else kernel_size, nsp)
+    p = _tuple(padding, nsp)
+    xr = x.data if hasattr(x, "data") else jnp.asarray(x)
+    idx = indices.data if hasattr(indices, "data") \
+        else jnp.asarray(indices)
+    n, c, *in_sp = xr.shape
+    if output_size is None:
+        out_sp = [(in_sp[d] - 1) * s[d] - 2 * p[d] + k[d]
+                  for d in range(nsp)]
+    else:
+        out_sp = [int(v) for v in output_size[-nsp:]]
+    total = int(np.prod(out_sp))
+
+    from ...core.tensor import dispatch
+
+    def impl(vals, ind):
+        flat = jnp.zeros((n, c, total), vals.dtype)
+        vf = vals.reshape(n, c, -1)
+        inf = ind.reshape(n, c, -1).astype(jnp.int32)
+        bi = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        flat = flat.at[bi, ci, inf].set(vf)
+        return flat.reshape((n, c) + tuple(out_sp))
+
+    return dispatch("max_unpool", impl, (x, indices), {})
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size)
+
+
+def _pool_with_mask(name, nsp):
+    from ...core.tensor import dispatch
+
+    def fn(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+           data_format=None, return_mask=True):
+        if ceil_mode:
+            raise NotImplementedError(
+                f"{name}: ceil_mode=True is unsupported with "
+                "return_mask (pad the input instead)")
+        if data_format is not None and str(data_format).endswith("C"):
+            raise NotImplementedError(
+                f"{name}: channel-last data_format is unsupported "
+                "with return_mask; transpose to NC... first")
+        return dispatch(
+            name,
+            lambda arr: _max_pool_with_index(arr, kernel_size, stride,
+                                             padding, nsp),
+            (x,), {})
+
+    return fn
+
+
+max_pool1d_with_index = _pool_with_mask("max_pool1d_with_index", 1)
+max_pool2d_with_index = _pool_with_mask("max_pool2d_with_index", 2)
+max_pool3d_with_index = _pool_with_mask("max_pool3d_with_index", 3)

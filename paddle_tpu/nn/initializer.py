@@ -166,3 +166,31 @@ class ParamAttr:
         self.trainable = trainable
         self.regularizer = regularizer
         self.need_clip = need_clip
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv initializer (reference
+    python/paddle/nn/initializer/dirac.py): a delta at each kernel
+    center so conv layers start as (grouped) identity maps."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = int(groups)
+
+    def __call__(self, shape, dtype=None):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) < 3:
+            raise ValueError(
+                f"Dirac needs a conv weight of rank 3/4/5, got {shape}")
+        out_c, in_c = shape[0], shape[1]
+        if out_c % self.groups != 0:
+            raise ValueError(
+                f"out_channels {out_c} not divisible by groups "
+                f"{self.groups}")
+        w = np.zeros(shape, np.float32)
+        center = tuple(k // 2 for k in shape[2:])
+        per = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, in_c)):
+                w[(g * per + i, i) + center] = 1.0
+        return jnp.asarray(
+            w, dtype_mod.convert_dtype(dtype or "float32"))

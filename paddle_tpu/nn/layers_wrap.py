@@ -1,0 +1,447 @@
+"""Thin Layer wrappers over existing functional ops — the remainder of
+the reference's paddle.nn class surface.
+
+Reference: python/paddle/nn/layer/{activation,pooling,loss,norm,
+common,conv,rnn}.py — each class below delegates to the corresponding
+`nn.functional` op exactly like the reference classes delegate to
+their functional forms.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import functional as F
+from .layer import Layer
+
+__all__ = [
+    "CELU", "Hardshrink", "Hardtanh", "LogSigmoid", "Maxout", "RReLU",
+    "SELU", "Softplus", "Softshrink", "Softsign", "Tanhshrink",
+    "ThresholdedReLU", "Softmax2D", "AlphaDropout", "Dropout3D",
+    "AvgPool1D", "AvgPool3D", "MaxPool1D", "MaxPool3D",
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "Conv1DTranspose", "Conv3DTranspose", "InstanceNorm1D",
+    "InstanceNorm3D", "LocalResponseNorm", "ChannelShuffle",
+    "PixelShuffle", "PixelUnshuffle", "SpectralNorm", "CTCLoss",
+    "CosineEmbeddingLoss", "HingeEmbeddingLoss", "MarginRankingLoss",
+    "MultiLabelSoftMarginLoss", "MultiMarginLoss", "SoftMarginLoss",
+    "TripletMarginLoss", "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+]
+
+
+def _act(name, fn_name, params=()):
+    """Build an activation Layer class delegating to F.<fn_name>."""
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kw = {}
+            for i, pname in enumerate(params):
+                if i < len(args):
+                    self._kw[pname] = args[i]
+                elif pname in kwargs:
+                    self._kw[pname] = kwargs[pname]
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kw)
+
+        def extra_repr(self):
+            return ", ".join(f"{k}={v}" for k, v in self._kw.items())
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+CELU = _act("CELU", "celu", ("alpha",))
+Hardshrink = _act("Hardshrink", "hardshrink", ("threshold",))
+Hardtanh = _act("Hardtanh", "hardtanh", ("min", "max"))
+LogSigmoid = _act("LogSigmoid", "log_sigmoid")
+SELU = _act("SELU", "selu", ("scale", "alpha"))
+Softplus = _act("Softplus", "softplus", ("beta", "threshold"))
+Softshrink = _act("Softshrink", "softshrink", ("threshold",))
+Softsign = _act("Softsign", "softsign")
+Tanhshrink = _act("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _act("ThresholdedReLU", "thresholded_relu",
+                       ("threshold",))
+
+
+class Maxout(Layer):
+    def __init__(self, groups: int, axis: int = 1):
+        super().__init__()
+        self._groups, self._axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self._groups, self._axis)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (reference nn/layer/activation.py RReLU):
+    random slope in [lower, upper] while training, mean slope in eval."""
+
+    def __init__(self, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0):
+        super().__init__()
+        self._lower, self._upper = float(lower), float(upper)
+
+    def forward(self, x):
+        from ..core import random as random_mod
+        from ..core.tensor import dispatch
+        if self.training:
+            import jax
+            key = random_mod.next_key()
+
+            def impl(arr):
+                slope = jax.random.uniform(
+                    key, arr.shape, jnp.float32,
+                    self._lower, self._upper).astype(arr.dtype)
+                return jnp.where(arr >= 0, arr, slope * arr)
+
+            return dispatch("rrelu", impl, (x,), {})
+        mid = (self._lower + self._upper) / 2.0
+        return F.leaky_relu(x, negative_slope=mid)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference
+    activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3-d/4-d input, got {x.ndim}-d")
+        return F.softmax(x, axis=-3)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self._p, training=self.training)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCDHW"):
+        super().__init__()
+        self._p, self._fmt = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self._p, training=self.training,
+                           data_format=self._fmt)
+
+
+def _pool(name, fn_name, has_exclusive=False):
+    class _Pool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0,
+                     exclusive=True, ceil_mode=False, return_mask=False,
+                     data_format=None, name=None):
+            super().__init__()
+            self._args = (kernel_size, stride, padding)
+            self._ceil = ceil_mode
+            self._exclusive = exclusive
+            self._return_mask = return_mask
+
+        def forward(self, x):
+            k, s, p = self._args
+            if self._return_mask:
+                from .functional.pooling import (max_pool1d_with_index,
+                                                 max_pool2d_with_index,
+                                                 max_pool3d_with_index)
+                nsp = {"MaxPool1D": max_pool1d_with_index,
+                       "MaxPool2D": max_pool2d_with_index,
+                       "MaxPool3D": max_pool3d_with_index}[name]
+                return nsp(x, k, s, p)
+            kw = {"ceil_mode": self._ceil}
+            if has_exclusive:
+                kw["exclusive"] = self._exclusive
+            return getattr(F, fn_name)(x, k, s, p, **kw)
+
+    _Pool.__name__ = name
+    _Pool.__qualname__ = name
+    return _Pool
+
+
+AvgPool1D = _pool("AvgPool1D", "avg_pool1d", has_exclusive=True)
+AvgPool3D = _pool("AvgPool3D", "avg_pool3d", has_exclusive=True)
+MaxPool1D = _pool("MaxPool1D", "max_pool1d")
+MaxPool3D = _pool("MaxPool3D", "max_pool3d")
+
+
+def _adaptive(name, fn_name, with_mask=False):
+    class _Ad(Layer):
+        def __init__(self, output_size, return_mask=False, name=None):
+            super().__init__()
+            self._out = output_size
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, self._out)
+
+    _Ad.__name__ = name
+    _Ad.__qualname__ = name
+    return _Ad
+
+
+AdaptiveAvgPool1D = _adaptive("AdaptiveAvgPool1D", "adaptive_avg_pool1d")
+AdaptiveAvgPool3D = _adaptive("AdaptiveAvgPool3D", "adaptive_avg_pool3d")
+AdaptiveMaxPool1D = _adaptive("AdaptiveMaxPool1D", "adaptive_max_pool1d")
+AdaptiveMaxPool3D = _adaptive("AdaptiveMaxPool3D", "adaptive_max_pool3d")
+
+
+def _unpool(name, fn_name):
+    class _Un(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0,
+                     data_format=None, output_size=None, name=None):
+            super().__init__()
+            self._args = (kernel_size, stride, padding)
+            self._out = output_size
+
+        def forward(self, x, indices):
+            k, s, p = self._args
+            from .functional import pooling
+            return getattr(pooling, fn_name)(
+                x, indices, k, s, p, output_size=self._out)
+
+    _Un.__name__ = name
+    _Un.__qualname__ = name
+    return _Un
+
+
+MaxUnPool1D = _unpool("MaxUnPool1D", "max_unpool1d")
+MaxUnPool2D = _unpool("MaxUnPool2D", "max_unpool2d")
+MaxUnPool3D = _unpool("MaxUnPool3D", "max_unpool3d")
+
+
+class ChannelShuffle(Layer):
+    """Shuffle channels between groups (reference common.py
+    ChannelShuffle / phi channel_shuffle kernel)."""
+
+    def __init__(self, groups: int, data_format: str = "NCHW"):
+        super().__init__()
+        self._g, self._fmt = groups, data_format
+
+    def forward(self, x):
+        from ..core.tensor import dispatch
+        g = self._g
+        chan_last = self._fmt.endswith("C")
+
+        def impl(arr):
+            a = jnp.moveaxis(arr, -1, 1) if chan_last else arr
+            n, c = a.shape[0], a.shape[1]
+            rest = a.shape[2:]
+            a = a.reshape((n, g, c // g) + rest)
+            a = jnp.swapaxes(a, 1, 2).reshape((n, c) + rest)
+            return jnp.moveaxis(a, 1, -1) if chan_last else a
+
+        return dispatch("channel_shuffle", impl, (x,), {})
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self._r, self._fmt = upscale_factor, data_format
+
+    def forward(self, x):
+        from .functional.common import pixel_shuffle
+        return pixel_shuffle(x, self._r, self._fmt)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self._r, self._fmt = downscale_factor, data_format
+
+    def forward(self, x):
+        from .functional.common import pixel_unshuffle
+        return pixel_unshuffle(x, self._r, self._fmt)
+
+
+class SpectralNorm(Layer):
+    """Normalize an input WEIGHT tensor by its spectral norm (reference
+    nn/layer/norm.py SpectralNorm — the layer form that takes the
+    weight as input, unlike utils.spectral_norm which wraps a layer)."""
+
+    def __init__(self, weight_shape: Sequence[int], dim: int = 0,
+                 power_iters: int = 1, eps: float = 1e-12, name=None):
+        super().__init__()
+        self._dim, self._iters, self._eps = dim, power_iters, eps
+        rng = np.random.RandomState(0)
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        u = rng.randn(h).astype(np.float32)
+        v = rng.randn(w).astype(np.float32)
+        self.register_buffer("weight_u",
+                             Tensor(u / (np.linalg.norm(u) + eps)))
+        self.register_buffer("weight_v",
+                             Tensor(v / (np.linalg.norm(v) + eps)))
+
+    def forward(self, weight):
+        from ..core.tensor import dispatch
+        dim, iters, eps = self._dim, self._iters, self._eps
+
+        def impl(w, u, v):
+            m = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v2 = m.T @ u
+                v2 = v2 / jnp.maximum(jnp.linalg.norm(v2), eps)
+                u2 = m @ v2
+                u = u2 / jnp.maximum(jnp.linalg.norm(u2), eps)
+                v = v2
+            sigma = u @ m @ v
+            return w / jnp.maximum(sigma, eps)
+
+        return dispatch("spectral_norm", impl,
+                        (weight, self.weight_u, self.weight_v), {})
+
+
+def _norm_nd(name, rank):
+    class _IN(Layer):
+        def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                     weight_attr=None, bias_attr=None, data_format=None,
+                     name=None):
+            super().__init__()
+            from ..core.tensor import Parameter
+            self._eps = epsilon
+            if weight_attr is not False:
+                self.scale = Parameter(np.ones(num_features, np.float32))
+            else:
+                self.scale = None
+            if bias_attr is not False:
+                self.bias = Parameter(np.zeros(num_features, np.float32))
+            else:
+                self.bias = None
+
+        def forward(self, x):
+            if x.ndim != rank:
+                raise ValueError(
+                    f"{name} expects {rank}-d input, got {x.ndim}-d")
+            return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                                   epsilon=self._eps)
+
+    _IN.__name__ = name
+    _IN.__qualname__ = name
+    return _IN
+
+
+InstanceNorm1D = _norm_nd("InstanceNorm1D", 3)
+InstanceNorm3D = _norm_nd("InstanceNorm3D", 5)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (size, alpha, beta, k)
+
+    def forward(self, x):
+        size, alpha, beta, k = self._args
+        return F.local_response_norm(x, size, alpha=alpha, beta=beta,
+                                     k=k)
+
+
+def _convT(name, fn_name):
+    class _CT(Layer):
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, output_padding=0, groups=1,
+                     dilation=1, weight_attr=None, bias_attr=None,
+                     data_format=None):
+            super().__init__()
+            from ..core.tensor import Parameter
+            from .initializer import XavierNormal
+            nsp = 1 if "1d" in fn_name else 3
+            ks = (kernel_size,) * nsp if isinstance(kernel_size, int) \
+                else tuple(kernel_size)
+            self.weight = Parameter(XavierNormal()(
+                (in_channels, out_channels // groups) + ks))
+            self.bias = None if bias_attr is False else Parameter(
+                np.zeros(out_channels, np.float32))
+            self._cfg = (stride, padding, output_padding, groups,
+                         dilation)
+
+        def forward(self, x):
+            stride, padding, out_pad, groups, dilation = self._cfg
+            return getattr(F, fn_name)(
+                x, self.weight, self.bias, stride=stride,
+                padding=padding, output_padding=out_pad, groups=groups,
+                dilation=dilation)
+
+    _CT.__name__ = name
+    _CT.__qualname__ = name
+    return _CT
+
+
+Conv1DTranspose = _convT("Conv1DTranspose", "conv1d_transpose")
+Conv3DTranspose = _convT("Conv3DTranspose", "conv3d_transpose")
+
+
+def _loss(name, fn_name, params=()):
+    class _Loss(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kw = {}
+            for i, pname in enumerate(params):
+                if i < len(args):
+                    self._kw[pname] = args[i]
+                elif pname in kwargs:
+                    self._kw[pname] = kwargs[pname]
+
+        def forward(self, *inputs):
+            return getattr(F, fn_name)(*inputs, **self._kw)
+
+    _Loss.__name__ = name
+    _Loss.__qualname__ = name
+    return _Loss
+
+
+CTCLoss = _loss("CTCLoss", "ctc_loss", ("blank", "reduction"))
+CosineEmbeddingLoss = _loss("CosineEmbeddingLoss",
+                            "cosine_embedding_loss",
+                            ("margin", "reduction"))
+HingeEmbeddingLoss = _loss("HingeEmbeddingLoss", "hinge_embedding_loss",
+                           ("margin", "reduction"))
+MarginRankingLoss = _loss("MarginRankingLoss", "margin_ranking_loss",
+                          ("margin", "reduction"))
+TripletMarginLoss = _loss("TripletMarginLoss", "triplet_margin_loss",
+                          ("margin", "p", "epsilon", "swap",
+                           "reduction"))
+MultiLabelSoftMarginLoss = _loss("MultiLabelSoftMarginLoss",
+                                 "multi_label_soft_margin_loss",
+                                 ("weight", "reduction"))
+MultiMarginLoss = _loss("MultiMarginLoss", "multi_margin_loss",
+                        ("p", "margin", "weight", "reduction"))
+SoftMarginLoss = _loss("SoftMarginLoss", "soft_margin_loss",
+                       ("reduction",))
+TripletMarginWithDistanceLoss = _loss(
+    "TripletMarginWithDistanceLoss",
+    "triplet_margin_with_distance_loss",
+    ("distance_function", "margin", "swap", "reduction"))
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss over a complete binary tree (reference
+    nn/layer/loss.py HSigmoidLoss / phi hsigmoid_loss kernel, default
+    tree)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom or is_sparse:
+            raise NotImplementedError(
+                "custom-tree / sparse hsigmoid is unsupported; use the "
+                "default complete-binary-tree form")
+        from ..core.tensor import Parameter
+        from .initializer import XavierNormal
+        self._num_classes = num_classes
+        self.weight = Parameter(XavierNormal()(
+            (num_classes - 1, feature_size)))
+        self.bias = None if bias_attr is False else Parameter(
+            np.zeros((num_classes - 1,), np.float32))
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias)

@@ -310,3 +310,27 @@ class LSTM(_RNNBase):
 
 class GRU(_RNNBase):
     _cell_cls = GRUCell
+
+
+class BiRNN(Layer):
+    """Bidirectional cell pair over a sequence (reference
+    python/paddle/nn/layer/rnn.py BiRNN): forward and backward cells
+    scan independently; outputs concatenate on the feature dim."""
+
+    def __init__(self, cell_fw, cell_bw, time_major: bool = False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self._fw(inputs, st_fw)
+        out_bw, fin_bw = self._bw(inputs, st_bw)
+        from .. import ops
+        out = ops.manipulation.concat([out_fw, out_bw], axis=-1)
+        return out, (fin_fw, fin_bw)

@@ -1,0 +1,144 @@
+"""paddle.nn.utils analog: weight_norm / spectral_norm / vector-param
+helpers.
+
+Reference: python/paddle/nn/utils/{weight_norm_hook,spectral_norm_hook,
+transform_parameters}.py. TPU-native: both reparameterizations are
+implemented as forward-pre-hooks that recompute the effective weight
+from the decomposed parameters each call, so the whole thing stays
+inside the traced program (no mutable-state kernels like the
+reference's norm ops).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+from .layer import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparameterize `name` as g * v/||v|| (reference
+    weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    g0 = _norm_except(w.data, dim)
+    v = Parameter(w.data)
+    g = Parameter(g0)
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+    # the original param becomes derived state, not a trainable param
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ..core.tensor import dispatch
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        # dispatched so the eager tape records d(eff)/d(v, g) — raw jnp
+        # here would orphan the reparameterized params from backward
+        eff = dispatch(
+            "weight_norm_eff",
+            lambda v, g: g * v / jnp.maximum(_norm_except(v, dim),
+                                             1e-12),
+            (vv, gg), {})
+        setattr(lyr, name, eff)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = (handle, name, dim)
+    hook(layer, ())  # materialize immediately
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    handle, _, dim = layer._weight_norm_handle
+    handle.remove() if hasattr(handle, "remove") else handle()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    norm = _norm_except(v.data, dim)
+    w = Parameter(g.data * v.data / jnp.maximum(norm, 1e-12))
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = None):
+    """Spectral normalization (reference spectral_norm_hook.py): divide
+    the weight by its largest singular value, estimated by power
+    iteration on persistent u/v buffers."""
+    w = getattr(layer, name)
+    if dim is None:
+        from .layers_common import Conv2DTranspose, Linear
+        dim = 1 if isinstance(layer, Linear) else 0
+    mat = jnp.moveaxis(w.data, dim, 0).reshape(w.data.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(mat.shape[0]).astype(np.float32)
+    v0 = rng.randn(mat.shape[1]).astype(np.float32)
+    layer.register_buffer(name + "_u",
+                          Tensor(u0 / (np.linalg.norm(u0) + eps)))
+    layer.register_buffer(name + "_v",
+                          Tensor(v0 / (np.linalg.norm(v0) + eps)))
+    orig = Parameter(w.data)
+    layer.add_parameter(name + "_orig", orig)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        import jax as _jax
+        from ..core.tensor import dispatch
+        wo = getattr(lyr, name + "_orig")
+        ub = getattr(lyr, name + "_u")
+        vb = getattr(lyr, name + "_v")
+
+        def impl(w, u, v):
+            m = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(n_power_iterations):
+                v = _jax.lax.stop_gradient(m).T @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = _jax.lax.stop_gradient(m) @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            sigma = u @ m @ v
+            return w / jnp.maximum(sigma, eps), u, v
+
+        eff, u_new, v_new = dispatch("spectral_norm_eff", impl,
+                                     (wo, ub, vb), {})
+        # persist the power-iteration state only when concrete (a
+        # traced value must not leak into the buffers)
+        if not isinstance(u_new._data, _jax.core.Tracer):
+            ub._data = u_new._data
+            vb._data = v_new._data
+        setattr(lyr, name, eff)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer
+
+
+def parameters_to_vector(parameters):
+    """Flatten parameters into one vector (reference
+    transform_parameters.py)."""
+    return Tensor(jnp.concatenate(
+        [jnp.ravel(p.data) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters):
+    arr = vec.data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(arr[off:off + n].reshape(p.data.shape))
+        off += n

@@ -39,11 +39,53 @@ _METHOD_SOURCES = {
     creation: ["tril", "triu", "zeros_like", "ones_like", "full_like"],
 }
 
+# round-2 completion: install the REST of the reference's
+# tensor_method_func surface (python/paddle/tensor/__init__.py) —
+# everything already implemented as a function becomes a method
+_METHOD_SOURCES[math] += [
+    "acos", "acosh", "asin", "asinh", "atan", "atanh", "sinh", "cosh",
+    "atan2", "add_n", "addmm", "amax", "amin", "angle", "conj", "real",
+    "imag", "deg2rad", "rad2deg", "digamma", "lgamma", "erfinv",
+    "expm1", "fmax", "fmin", "frac", "frexp", "gcd", "lcm", "heaviside",
+    "increment", "inner", "outer", "isclose", "kron", "kthvalue",
+    "logit", "logcumsumexp", "logical_xor", "mod", "mode", "multiplex",
+    "nanmean", "nanmedian", "nanquantile", "nansum", "neg", "quantile",
+    "sgn", "stanh", "trunc", "diagonal", "cummax", "cummin", "hypot",
+    "vander", "renorm",
+]
+_METHOD_SOURCES[manipulation] += [
+    "as_complex", "as_real", "broadcast_shape", "broadcast_tensors",
+    "bucketize", "concat", "diff", "index_add", "index_sample",
+    "index_fill", "reverse", "rot90", "scatter_nd", "shard_index",
+    "slice", "stack", "strided_slice", "take", "unique_consecutive",
+    "unstack", "vsplit", "swapaxes", "searchsorted", "where", "one_hot",
+    # module-level inplace variants double as methods (single
+    # implementation: manipulation.py's _adopt-based functions)
+    "reshape_", "squeeze_", "unsqueeze_", "scatter_", "index_add_",
+    "tanh_",
+]
+_METHOD_SOURCES[linalg] += [
+    "bincount", "histogram", "cond", "corrcoef", "cov", "eig",
+    "eigvals", "eigvalsh", "cholesky_solve", "triangular_solve",
+    "lstsq", "lu", "lu_unpack", "multi_dot", "tensordot",
+]
+_METHOD_SOURCES[math] += [
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+]
+
 for module, names in _METHOD_SOURCES.items():
     for name in names:
         fn = getattr(module, name, None)
         if fn is not None and not hasattr(Tensor, name):
             setattr(Tensor, name, fn)
+
+# framework predicates as methods (reference registers them too)
+from .. import framework as _framework  # noqa: E402
+
+for _n in ("is_complex", "is_empty", "is_floating_point", "is_integer",
+           "is_tensor", "rank"):
+    if not hasattr(Tensor, _n):
+        setattr(Tensor, _n, getattr(_framework, _n))
 
 
 def _astype(self, dtype):
@@ -52,3 +94,52 @@ def _astype(self, dtype):
 
 Tensor.astype = _astype
 Tensor.cast = _astype
+
+
+# ---- trailing-underscore inplace variants -------------------------------
+# reference: inplace-version APIs (python/paddle/tensor/*_ with
+# monkey_patch); here: run the out-of-place op, adopt value+grad record
+# via Tensor._adopt (snapshot-safe)
+
+def _make_inplace(base_name):
+    def inplace(self, *args, **kwargs):
+        out = getattr(self, base_name)(*args, **kwargs)
+        self._adopt(out)
+        return self
+
+    inplace.__name__ = base_name + "_"
+    return inplace
+
+
+# generated only where no module-level _ function exists (those are
+# installed as methods directly above)
+_INPLACE_BASES = [
+    "add", "subtract", "ceil", "clip", "exp", "floor", "erfinv",
+    "lerp", "reciprocal", "remainder", "round", "rsqrt", "scale",
+    "sqrt", "flatten", "put_along_axis",
+]
+for _b in _INPLACE_BASES:
+    if hasattr(Tensor, _b) and not hasattr(Tensor, _b + "_"):
+        setattr(Tensor, _b + "_", _make_inplace(_b))
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0):
+    """In-place uniform refill (reference Tensor.uniform_)."""
+    new = creation.uniform(self.shape, dtype=str(self.dtype),
+                           min=min, max=max, seed=seed)
+    self._adopt(new.detach())
+    return self
+
+
+def _exponential_(self, lam=1.0):
+    """In-place exponential refill: -log(U)/lam."""
+    import jax.numpy as jnp
+    u = creation.uniform(self.shape, dtype=str(self.dtype),
+                         min=1e-7, max=1.0)
+    self._adopt(Tensor(-jnp.log(u._data) / lam))
+    return self
+
+
+Tensor.uniform_ = _uniform_
+Tensor.exponential_ = _exponential_
+Tensor.floor_mod = Tensor.remainder  # reference alias

@@ -174,3 +174,34 @@ def tensordot(x, y, axes=2):
         if len(axes) == 1:
             axes = (axes[0], axes[0])
     return jnp.tensordot(x, y, axes=axes)
+
+
+eigvals = op("eigvals", differentiable=False)(
+    lambda x: jnp.linalg.eigvals(x))
+cond = op("cond", differentiable=False)(
+    lambda x, p=None: jnp.linalg.cond(x, p=p))
+
+
+@op("lu_unpack", differentiable=False)
+def lu_unpack(lu_mat, pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack paddle.linalg.lu results -> (P, L, U); pivots are 1-based
+    (reference lu_unpack kernel semantics)."""
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+    U = jnp.triu(lu_mat[..., :k, :])
+    # pivots -> permutation matrix: row swaps applied in order (2-d
+    # case; batched matrices go through vmap in the linalg namespace)
+    piv = pivots.astype(jnp.int32) - 1
+    perm = jnp.eye(m, dtype=lu_mat.dtype)
+
+    def swap(i, pm):
+        j = piv[i]
+        ri = pm[i]
+        rj = pm[j]
+        pm = pm.at[i].set(rj)
+        pm = pm.at[j].set(ri)
+        return pm
+
+    perm = jax.lax.fori_loop(0, piv.shape[-1], swap, perm)
+    return perm.T, L, U

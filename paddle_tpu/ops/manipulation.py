@@ -645,3 +645,106 @@ def index_add_(x, index, axis, value):
 def tanh_(x):
     from .math import tanh as _tanh
     return _adopt(x, _tanh(x))
+
+
+# ---- round-2 wave 2: remaining tensor-op families ----------------------
+# reference: phi api yaml diag_embed / fill_diagonal(_tensor) /
+# temporal_shift / gather_tree kernels
+
+@op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal embed (paddle.diag_embed): place the last dim of
+    x on the (dim1, dim2) diagonal of a new square trailing matrix."""
+    n = x.shape[-1] + abs(int(offset))
+    out_ndim = x.ndim + 1
+    d1 = dim1 % out_ndim
+    d2 = dim2 % out_ndim
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    rng = jnp.arange(x.shape[-1])
+    rows = rng + max(-offset, 0)
+    cols = rng + max(offset, 0)
+    base = base.at[..., rows, cols].set(x)
+    # move the two trailing matrix dims to (dim1, dim2)
+    order = list(range(x.ndim - 1))
+    mat_axes = [x.ndim - 1, x.ndim]
+    pos = sorted([d1, d2])
+    if (d1, d2) != (out_ndim - 2, out_ndim - 1):
+        perm = []
+        src = iter(order)
+        mat = iter(mat_axes if d1 < d2 else mat_axes[::-1])
+        for i in range(out_ndim):
+            if i in pos:
+                perm.append(next(mat))
+            else:
+                perm.append(next(src))
+        base = jnp.transpose(base, perm)
+    elif d1 > d2:
+        base = jnp.swapaxes(base, -1, -2)
+    return base
+
+
+@op("fill_diagonal")
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """Return x with its main diagonal set to `value`
+    (paddle.Tensor.fill_diagonal_ semantics, functional form)."""
+    n = min(x.shape[-2], x.shape[-1])
+    rng = jnp.arange(n - abs(int(offset)) if offset else n)
+    rows = rng + max(-offset, 0)
+    cols = rng + max(offset, 0)
+    return x.at[..., rows, cols].set(jnp.asarray(value, x.dtype))
+
+
+@op("fill_diagonal_tensor")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Fill the (dim1, dim2) diagonal of x with tensor y."""
+    nd = x.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    xm = jnp.moveaxis(x, (d1, d2), (-2, -1))
+    n = min(xm.shape[-2], xm.shape[-1]) - abs(int(offset))
+    rng = jnp.arange(n)
+    rows = rng + max(-offset, 0)
+    cols = rng + max(offset, 0)
+    ym = jnp.moveaxis(y, -1, y.ndim - 1) if y.ndim else y
+    xm = xm.at[..., rows, cols].set(ym.astype(x.dtype))
+    return jnp.moveaxis(xm, (-2, -1), (d1, d2))
+
+
+@op("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM temporal shift (reference temporal_shift op): shift a
+    fraction of channels one step along the segment (time) axis."""
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.pad(xr[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                    (0, 0)))
+    fwd = jnp.pad(xr[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                      (0, 0)))
+    keep = xr[:, :, c2:]
+    out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+@op("gather_tree", differentiable=False)
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree op): ids/parents are
+    [max_time, batch, beam]; walk parents from the last step backward to
+    assemble full sequences."""
+    T = ids.shape[0]
+
+    def step(carry, t):
+        beams = carry  # [batch, beam] current beam index per slot
+        idx = T - 1 - t
+        tok = jnp.take_along_axis(ids[idx], beams, axis=-1)
+        beams = jnp.take_along_axis(parents[idx], beams, axis=-1)
+        return beams, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, toks = jax.lax.scan(step, init, jnp.arange(T))
+    return jnp.flip(toks, axis=0)

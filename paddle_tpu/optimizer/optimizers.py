@@ -15,7 +15,18 @@ class SGD(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision=multi_precision)
 
+    def _init_state(self, shape, dtype):
+        if self.multi_precision and jnp.dtype(dtype) in (
+                jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+            return {"master": None}  # filled lazily from the param
+        return {}
+
     def _update(self, p, g, state, lr, step):
+        if "master" in state:
+            master = state["master"] if state["master"] is not None \
+                else p.astype(jnp.float32)
+            master = master - lr * g.astype(jnp.float32)
+            return master.astype(p.dtype), {"master": master}
         return p - lr * g, state
 
 

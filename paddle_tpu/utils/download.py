@@ -37,16 +37,21 @@ def _decompress(fname: str) -> str:
     d = osp.dirname(fname)
     if tarfile.is_tarfile(fname):
         with tarfile.open(fname) as tf:
-            tf.extractall(d)
             names = tf.getnames()
-    elif zipfile.is_zipfile(fname):
+            root = names[0].split("/")[0] if names else ""
+            out = osp.join(d, root)
+            if not (root and osp.exists(out)):  # cache hit: no re-IO
+                tf.extractall(d)
+        return out if root else fname
+    if zipfile.is_zipfile(fname):
         with zipfile.ZipFile(fname) as zf:
-            zf.extractall(d)
             names = zf.namelist()
-    else:
-        return fname
-    root = names[0].split("/")[0] if names else ""
-    return osp.join(d, root)
+            root = names[0].split("/")[0] if names else ""
+            out = osp.join(d, root)
+            if not (root and osp.exists(out)):
+                zf.extractall(d)
+        return out if root else fname
+    return fname
 
 
 def get_path_from_url(url: str, root_dir: str = DATA_HOME,

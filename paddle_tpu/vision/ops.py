@@ -1,0 +1,378 @@
+"""paddle.vision.ops analog — detection/vision operators.
+
+Reference: python/paddle/vision/ops.py (yolo_box:287, prior_box:485,
+box_coder:657, roi_pool:1685, roi_align:1826, psroi_pool:1553,
+nms:2072, DeformConv2D:1096) over the phi detection kernels. TPU-native
+notes: box transforms and pooling lower to XLA gather/segment math;
+NMS's data-dependent output count is host-side in eager mode (same
+dynamic-shape boundary the reference draws for its -1 shaped outputs).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "psroi_pool", "yolo_box",
+           "box_coder", "prior_box", "RoIAlign", "RoIPool", "PSRoIPool",
+           "ConvNormActivation"]
+
+
+def _raw(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _iou_matrix(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    xx1 = jnp.maximum(x1[:, None], x1[None, :])
+    yy1 = jnp.maximum(y1[:, None], y1[None, :])
+    xx2 = jnp.minimum(x2[:, None], x2[None, :])
+    yy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """Hard NMS (reference vision/ops.py:2072). Returns kept indices
+    sorted by score. With category_idxs, suppression is per-category
+    (boxes of different categories never suppress each other)."""
+    b = _raw(boxes)
+    n = b.shape[0]
+    s = jnp.arange(n, 0, -1, dtype=jnp.float32) if scores is None \
+        else _raw(scores)
+    order = jnp.argsort(-s)
+    iou = _iou_matrix(b)
+    if category_idxs is not None:
+        cats = _raw(category_idxs)
+        same = cats[:, None] == cats[None, :]
+        iou = jnp.where(same, iou, 0.0)
+    iou_np = np.asarray(iou)
+    order_np = np.asarray(order)
+    suppressed = np.zeros(n, bool)
+    keep: List[int] = []
+    for i in order_np:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        suppressed[iou_np[i] > iou_threshold] = True
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep, np.int64)))
+
+
+def _roi_grid(x, box, out_h, out_w, samples_h, samples_w):
+    """Bilinear-sample a dense grid covering `box` on feature map x
+    [C, H, W] -> [C, out_h*samples_h, out_w*samples_w]."""
+    c, h, w = x.shape
+    x1, y1, x2, y2 = box
+    bh = jnp.maximum(y2 - y1, 1e-4)
+    bw = jnp.maximum(x2 - x1, 1e-4)
+    gy = out_h * samples_h
+    gx = out_w * samples_w
+    ys = y1 + (jnp.arange(gy) + 0.5) * bh / gy - 0.5
+    xs = x1 + (jnp.arange(gx) + 0.5) * bw / gx - 0.5
+    y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+    y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+    x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+    y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+    wy = jnp.clip(ys - y0, 0.0, 1.0)
+    wx = jnp.clip(xs - x0, 0.0, 1.0)
+    f00 = x[:, y0i][:, :, x0i]
+    f01 = x[:, y0i][:, :, x1i]
+    f10 = x[:, y1i][:, :, x0i]
+    f11 = x[:, y1i][:, :, x1i]
+    top = f00 * (1 - wx)[None, None, :] + f01 * wx[None, None, :]
+    bot = f10 * (1 - wx)[None, None, :] + f11 * wx[None, None, :]
+    return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference vision/ops.py:1826): bilinear grid sampling
+    averaged per output bin. boxes [R, 4] xyxy in input coords;
+    boxes_num [B] rois per image."""
+    xr = _raw(x)
+    br = _raw(boxes).astype(jnp.float32)
+    bn = np.asarray(_raw(boxes_num)).astype(np.int64)
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    samples = sampling_ratio if sampling_ratio > 0 else 2
+    off = 0.5 if aligned else 0.0
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+
+    def one(roi, img_idx):
+        box = roi * spatial_scale - jnp.asarray(
+            [off, off, off, off], jnp.float32)
+        grid = _roi_grid(xr[img_idx], box, out_h, out_w,
+                         samples, samples)
+        c = grid.shape[0]
+        g = grid.reshape(c, out_h, samples, out_w, samples)
+        return g.mean(axis=(2, 4))
+
+    outs = [one(br[i], int(img_of_roi[i])) for i in range(br.shape[0])]
+    return Tensor(jnp.stack(outs) if outs else
+                  jnp.zeros((0, xr.shape[1], out_h, out_w), xr.dtype))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """RoIPool (reference vision/ops.py:1685): max over quantized bins."""
+    xr = _raw(x)
+    br = _raw(boxes).astype(jnp.float32)
+    bn = np.asarray(_raw(boxes_num)).astype(np.int64)
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    img_of_roi = np.repeat(np.arange(len(bn)), bn)
+    h, w = xr.shape[2], xr.shape[3]
+
+    def one(roi, img_idx):
+        x1, y1, x2, y2 = np.asarray(roi * spatial_scale)
+        x1, y1 = int(np.round(x1)), int(np.round(y1))
+        x2, y2 = max(int(np.round(x2)), x1 + 1), \
+            max(int(np.round(y2)), y1 + 1)
+        x1, y1 = min(x1, w - 1), min(y1, h - 1)
+        x2, y2 = min(x2, w), min(y2, h)
+        fm = xr[img_idx][:, y1:y2, x1:x2]
+        c, rh, rw = fm.shape
+        ys = np.linspace(0, rh, out_h + 1).astype(int)
+        xs = np.linspace(0, rw, out_w + 1).astype(int)
+        rows = []
+        for i in range(out_h):
+            cols = []
+            for j in range(out_w):
+                cell = fm[:, ys[i]:max(ys[i + 1], ys[i] + 1),
+                          xs[j]:max(xs[j + 1], xs[j] + 1)]
+                cols.append(cell.max(axis=(1, 2)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    outs = [one(br[i], int(img_of_roi[i])) for i in range(br.shape[0])]
+    return Tensor(jnp.stack(outs) if outs else
+                  jnp.zeros((0, xr.shape[1], out_h, out_w), xr.dtype))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (reference vision/ops.py:1553):
+    channel k of output bin (i, j) averages input channel
+    k*out_h*out_w + i*out_w + j over that bin."""
+    xr = _raw(x)
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    c = xr.shape[1]
+    if c % (out_h * out_w):
+        raise ValueError(
+            f"psroi_pool needs channels {c} divisible by "
+            f"{out_h}*{out_w}")
+    out_c = c // (out_h * out_w)
+    pooled = roi_align(x, boxes, boxes_num, (out_h, out_w),
+                       spatial_scale, sampling_ratio=2, aligned=False)
+    pr = pooled.data  # [R, C, out_h, out_w]
+    r = pr.shape[0]
+    ps = pr.reshape(r, out_c, out_h, out_w, out_h, out_w)
+    # pick the position-specific channel group per bin
+    iy = jnp.arange(out_h)
+    ix = jnp.arange(out_w)
+    out = ps[:, :, iy[:, None], ix[None, :], iy[:, None], ix[None, :]]
+    return Tensor(out)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference
+    vision/ops.py:287). x: [N, A*(5+C), H, W]."""
+    xr = _raw(x).astype(jnp.float32)
+    n, _, h, w = xr.shape
+    a = len(anchors) // 2
+    anc = jnp.asarray(anchors, jnp.float32).reshape(a, 2)
+    feats = xr.reshape(n, a, 5 + class_num, h, w)
+    gx, gy = jnp.meshgrid(jnp.arange(w), jnp.arange(h), indexing="xy")
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(feats[:, :, 0]) * alpha + beta + gx) / w
+    by = (jax.nn.sigmoid(feats[:, :, 1]) * alpha + beta + gy) / h
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+    bw = jnp.exp(feats[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+    bh = jnp.exp(feats[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+    obj = jax.nn.sigmoid(feats[:, :, 4])
+    cls = jax.nn.sigmoid(feats[:, :, 5:])
+    scores = obj[:, :, None] * cls
+    img_size = _raw(img_size).astype(jnp.float32)
+    ih = img_size[:, 0][:, None, None, None]
+    iw = img_size[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = jnp.moveaxis(scores, 2, -1).reshape(n, -1, class_num)
+    keep = (obj > conf_thresh).reshape(n, -1)
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    return Tensor(boxes), Tensor(scores)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Encode/decode boxes against priors (reference vision/ops.py:657,
+    the SSD/R-CNN delta transform)."""
+    pb = _raw(prior_box).astype(jnp.float32)
+    tb = _raw(target_box).astype(jnp.float32)
+    var = None if prior_box_var is None \
+        else _raw(prior_box_var).astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if var is not None:
+            out = out / var[None, :, :]
+        return Tensor(out)
+    # decode_center_size: target deltas [N, M, 4] (or [N, 4] broadcast)
+    d = tb if tb.ndim == 3 else tb[:, None, :]
+    if var is not None:
+        v = var[None, :, :] if var.ndim == 2 else var
+        d = d * v
+    if axis == 1:
+        pcx, pcy, pw, ph = (a[None, :] for a in (pcx, pcy, pw, ph))
+    else:
+        pcx, pcy, pw, ph = (a[:, None] for a in (pcx, pcy, pw, ph))
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph + pcy
+    w = jnp.exp(d[..., 2]) * pw
+    h = jnp.exp(d[..., 3]) * ph
+    return Tensor(jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2 - norm, cy + h / 2 - norm],
+        axis=-1))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes over the feature grid (reference
+    vision/ops.py:485)."""
+    fr = _raw(input)
+    ir = _raw(image)
+    fh, fw = fr.shape[2], fr.shape[3]
+    ih, iw = ir.shape[2], ir.shape[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = [(ms, ms, a) for a in ars]
+        if max_sizes:
+            mx = max_sizes[ms_i]
+            sizes.append((float(np.sqrt(ms * mx)),
+                          float(np.sqrt(ms * mx)), 1.0))
+        for bw_, bh_, a in sizes:
+            sq = np.sqrt(a)
+            boxes.append((bw_ * sq, bh_ / sq))
+    cy, cx = np.meshgrid(np.arange(fh), np.arange(fw), indexing="ij")
+    ccx = (cx + offset) * step_w
+    ccy = (cy + offset) * step_h
+    out = np.zeros((fh, fw, len(boxes), 4), np.float32)
+    for k, (bw_, bh_) in enumerate(boxes):
+        out[..., k, 0] = (ccx - bw_ / 2) / iw
+        out[..., k, 1] = (ccy - bh_ / 2) / ih
+        out[..., k, 2] = (ccx + bw_ / 2) / iw
+        out[..., k, 3] = (ccy + bh_ / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+# ---- Layer wrappers ----------------------------------------------------
+from ..nn.layer import Layer  # noqa: E402
+from ..nn.container import Sequential  # noqa: E402
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._out, self._scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._out, self._scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._out, self._scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._out, self._scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._out, self._scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._out, self._scale)
+
+
+class ConvNormActivation(Sequential):
+    """Conv2D + Norm + Activation block (reference vision/ops.py:2015)."""
+
+    _UNSET = object()
+
+    def __init__(self, in_channels, out_channels, kernel_size=3,
+                 stride=1, padding=None, groups=1, norm_layer=_UNSET,
+                 activation_layer=_UNSET, dilation=1, bias=None):
+        from ..nn import BatchNorm2D, Conv2D, ReLU
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        # reference semantics: omitting the arg means BatchNorm2D/ReLU;
+        # passing None explicitly means NO norm / NO activation
+        if norm_layer is ConvNormActivation._UNSET:
+            norm_layer = BatchNorm2D
+        if activation_layer is ConvNormActivation._UNSET:
+            activation_layer = ReLU
+        if bias is None:
+            bias = norm_layer is None
+        layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation=dilation, groups=groups,
+                         bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

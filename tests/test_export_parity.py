@@ -197,3 +197,38 @@ def test_setitem_inplace_no_tape_self_loop():
     t[0] = 5.0
     t.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_tensor_method_parity():
+    """Every reference Tensor method (tensor_method_func in
+    python/paddle/tensor/__init__.py, frozen list) resolves on our
+    Tensor."""
+    ref = set(open(os.path.join(
+        _HERE, "data_ref_tensor_methods.txt")).read().split())
+    t = paddle.ones([2, 2])
+    missing = sorted(n for n in ref if not hasattr(t, n))
+    assert not missing, f"missing Tensor methods: {missing}"
+
+
+def test_inplace_method_variants():
+    x = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+    x.sqrt_()
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0], rtol=1e-6)
+    y = paddle.to_tensor(np.ones(3, np.float32))
+    y.stop_gradient = False
+    z = y * 3.0
+    z.add_(paddle.full([3], 1.0))
+    z.sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0, 3.0, 3.0])
+    r = paddle.zeros([500])
+    r.uniform_(0.0, 1.0)
+    assert 0.3 < float(r.mean()) < 0.7
+
+
+def test_lu_unpack_roundtrip():
+    from paddle_tpu.ops.linalg import lu, lu_unpack
+    a = paddle.to_tensor(np.array([[4.0, 3.0], [6.0, 3.0]], np.float32))
+    lum, piv = lu(a)
+    P, L, U = lu_unpack(lum, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(),
+                               a.numpy(), atol=1e-5)

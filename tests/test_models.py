@@ -151,3 +151,61 @@ def test_ernie_state_dict_roundtrip(tmp_path):
     b, _ = model2(ids)
     np.testing.assert_allclose(np.asarray(a.numpy()),
                                np.asarray(b.numpy()), rtol=1e-6)
+
+
+def test_fused_lm_loss_matches_plain():
+    """Chunked fused LM-head+CE == plain logits+CE (the HBM fix for
+    long-seq configs; BASELINE.md r2). Also trains through TrainStep."""
+    from paddle_tpu.models.gpt import gpt
+    paddle.seed(0)
+    plain = gpt("test-tiny")
+    plain.eval()
+    paddle.seed(0)
+    fused = gpt("test-tiny", fused_lm_loss=True, lm_loss_chunk=7)
+    fused.eval()
+    ids = np.random.RandomState(0).randint(0, 512, (2, 19)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(ids.astype(np.int64))
+    l_plain = float(plain.loss(plain(x), y))
+    l_fused = float(fused.loss(fused(x), y))
+    assert abs(l_plain - l_fused) < 2e-3, (l_plain, l_fused)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=fused.parameters())
+    step = paddle.jit.TrainStep(fused, opt,
+                                lambda out, lab: fused.loss(out, lab))
+    l0 = float(step(x, y))
+    for _ in range(3):
+        ln = float(step(x, y))
+    assert ln < l0
+
+
+def test_fused_lm_loss_head_gradient_matches_plain():
+    """Regression: the fused path must propagate the LM-head/wte weight
+    gradient (it was captured as a constant and silently dropped)."""
+    from paddle_tpu.models.gpt import gpt
+    ids = np.random.RandomState(0).randint(0, 512, (2, 16)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(ids.astype(np.int64))
+
+    def wte_grad(fused):
+        paddle.seed(0)
+        m = gpt("test-tiny", fused_lm_loss=fused, lm_loss_chunk=8)
+        m.eval()
+        loss = m.loss(m(x), y)
+        loss.backward()
+        return np.asarray(m.gpt.embed.wte.weight.grad.numpy())
+
+    g_plain = wte_grad(False)
+    g_fused = wte_grad(True)
+    np.testing.assert_allclose(g_fused, g_plain, rtol=1e-3, atol=1e-5)
+
+
+def test_fused_lm_loss_pipeline_loss_fn_still_works():
+    # gpt_pipe builds loss_fn with self=None; the fused branch must not
+    # dereference cfg on None
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    logits = paddle.randn([2, 8, 16])
+    labels = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 16, (2, 8)).astype(np.int64))
+    val = GPTForCausalLM.loss(None, logits, labels)
+    assert np.isfinite(float(val))

@@ -179,3 +179,57 @@ def test_unfold_fold_match_torch():
     back = pnn.Fold(output_sizes=(6, 6), kernel_sizes=2,
                     strides=2)(uf)
     np.testing.assert_allclose(back.numpy(), img.numpy(), rtol=1e-6)
+
+
+def test_weight_norm_and_remove():
+    """nn.utils.weight_norm: w = g * v/||v||; output preserved at init
+    and after removal (reference weight_norm_hook.py)."""
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    paddle.seed(0)
+    lin = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    ref = lin(x).numpy()
+    weight_norm(lin, "weight", dim=0)
+    assert "weight_g" in dict(lin.named_parameters())
+    np.testing.assert_allclose(lin(x).numpy(), ref, rtol=1e-5)
+    remove_weight_norm(lin, "weight")
+    np.testing.assert_allclose(lin(x).numpy(), ref, rtol=1e-5)
+
+
+def test_spectral_norm_bounds_sigma():
+    from paddle_tpu.nn.utils import spectral_norm
+    paddle.seed(0)
+    lin = nn.Linear(8, 8)
+    lin.weight.set_value(5.0 * np.eye(8, dtype=np.float32))
+    spectral_norm(lin, "weight", n_power_iterations=5)
+    lin(paddle.randn([1, 8]))  # hook runs
+    w = lin.weight.numpy()
+    s = np.linalg.svd(np.asarray(w), compute_uv=False)
+    assert abs(s[0] - 1.0) < 0.05  # sigma normalized to ~1
+
+
+def test_parameters_vector_roundtrip():
+    from paddle_tpu.nn.utils import (parameters_to_vector,
+                                     vector_to_parameters)
+    m = nn.Linear(3, 2)
+    vec = parameters_to_vector(m.parameters())
+    assert vec.shape == [3 * 2 + 2]
+    vector_to_parameters(paddle.zeros_like(vec), m.parameters())
+    assert float(paddle.abs(m.weight).sum()) == 0.0
+
+
+def test_affine_grid_matches_identity():
+    import paddle_tpu.nn.functional as F
+    theta = paddle.to_tensor(
+        np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 4, 4], align_corners=True)
+    assert grid.shape == [1, 4, 4, 2]
+    np.testing.assert_allclose(grid.numpy()[0, 0, 0], [-1.0, -1.0],
+                               atol=1e-6)
+    np.testing.assert_allclose(grid.numpy()[0, -1, -1], [1.0, 1.0],
+                               atol=1e-6)
+    # identity grid sampling returns the input
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 2, 4, 4).astype(np.float32))
+    y = F.grid_sample(x, grid, align_corners=True)
+    np.testing.assert_allclose(y.numpy(), x.numpy(), atol=1e-5)

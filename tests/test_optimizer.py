@@ -129,3 +129,35 @@ def test_scheduler_with_optimizer():
         opt.step()
         opt.clear_grad()
     assert opt.get_lr() != lr0  # per-iter scheduler advanced
+
+
+def test_lookahead_optimizer():
+    """incubate.optimizer.LookAhead: slow weights pull toward fast
+    weights every k steps (reference lookahead.py semantics)."""
+    from paddle_tpu.incubate.optimizer import LookAhead
+    paddle.seed(0)
+    w = paddle.Parameter(np.ones(2, np.float32))
+    inner = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    la = LookAhead(inner, alpha=0.5, k=2)
+    for _ in range(4):
+        (w * w).sum().backward()
+        la.step()
+        la.clear_grad()
+    # fast-only SGD after 4 steps would differ; lookahead interpolates
+    assert 0.0 < float(w.numpy()[0]) < 1.0
+    sd = la.state_dict()
+    assert "_k_count" in sd and sd["_k_count"] == 4
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+    w = paddle.Parameter(np.zeros(2, np.float32))
+    ma = ModelAverage(0.15, parameters=[w], min_average_window=2,
+                      max_average_window=10)
+    for v in (1.0, 2.0, 3.0):
+        w.set_value(np.full(2, v, np.float32))
+        ma.step()
+    live = w.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(w.numpy(), 2.0)  # mean(1,2,3)
+    np.testing.assert_allclose(w.numpy(), live)  # restored

@@ -1,0 +1,119 @@
+"""paddle.vision.ops detection operators (reference
+python/paddle/vision/ops.py: nms/roi_align/roi_pool/psroi_pool/
+yolo_box/box_coder/prior_box), golden-checked against hand math."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+from paddle_tpu.ops import manipulation as manip
+
+
+def test_nms_basic():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                    scores=paddle.to_tensor(scores))
+    np.testing.assert_array_equal(keep.numpy(), [0, 2])
+
+
+def test_nms_categories():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1], np.int64)  # different classes: both kept
+    keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                    scores=paddle.to_tensor(scores),
+                    category_idxs=paddle.to_tensor(cats),
+                    categories=[0, 1])
+    assert sorted(keep.numpy().tolist()) == [0, 1]
+
+
+def test_roi_align_uniform_map():
+    # constant feature map -> every pooled value equals the constant
+    x = paddle.to_tensor(np.full((1, 3, 16, 16), 2.5, np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = vops.roi_align(x, boxes, num, output_size=4)
+    assert out.shape == [1, 3, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 2.5, rtol=1e-5)
+
+
+def test_roi_pool_max():
+    fm = np.zeros((1, 1, 8, 8), np.float32)
+    fm[0, 0, 2, 2] = 7.0
+    x = paddle.to_tensor(fm)
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = vops.roi_pool(x, boxes, num, output_size=2)
+    assert float(out.numpy().max()) == 7.0
+
+
+def test_psroi_pool_shapes():
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 8, 8, 8).astype(np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 6, 6]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = vops.psroi_pool(x, boxes, num, output_size=2)
+    assert out.shape == [1, 2, 2, 2]
+
+
+def test_yolo_box_decode():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3 * 7, 4, 4).astype(np.float32))
+    img = paddle.to_tensor(np.array([[64, 64], [32, 32]], np.int32))
+    boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                  class_num=2, conf_thresh=0.0,
+                                  downsample_ratio=16)
+    assert boxes.shape == [2, 48, 4] and scores.shape == [2, 48, 2]
+    b = boxes.numpy()
+    assert (b[0, :, 2] <= 63.0 + 1e-3).all()  # clipped to image
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [10, 10, 30, 30]], np.float32)
+    targets = np.array([[1, 1, 11, 11], [12, 8, 28, 32]], np.float32)
+    enc = vops.box_coder(paddle.to_tensor(priors), None,
+                         paddle.to_tensor(targets),
+                         code_type="encode_center_size")
+    dec = vops.box_coder(paddle.to_tensor(priors), None,
+                         paddle.to_tensor(np.asarray(enc.numpy())),
+                         code_type="decode_center_size")
+    d = dec.numpy()
+    # decoded box i against prior i must reproduce target i
+    np.testing.assert_allclose(
+        np.stack([d[0, 0], d[1, 1]]), targets, rtol=1e-4, atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    boxes, var = vops.prior_box(feat, img, min_sizes=[16.0],
+                                aspect_ratios=[1.0, 2.0], clip=True)
+    assert boxes.shape == var.shape
+    assert boxes.shape[0] == 4 and boxes.shape[1] == 4
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_new_tensor_ops():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    d = paddle.diag_embed(x)
+    np.testing.assert_allclose(d.numpy(), np.diag([1.0, 2.0, 3.0]))
+    m = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    f = paddle.fill_diagonal(m, 5.0)
+    np.testing.assert_allclose(np.diag(f.numpy()), 5.0)
+    ft = paddle.fill_diagonal_tensor(m, x)
+    np.testing.assert_allclose(np.diag(ft.numpy()), [1.0, 2.0, 3.0])
+    # temporal shift keeps shape and moves channel slices in time
+    v = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8, 2, 2).astype(np.float32))
+    ts = manip.temporal_shift(v, seg_num=2)
+    assert ts.shape == [4, 8, 2, 2]
+    # gather_tree reconstructs beams
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2]], [[6, 1]]], np.int64))       # [T=2, B=1, beam=2]
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 0]]], np.int64))
+    out = manip.gather_tree(ids, parents)
+    assert out.shape == [2, 1, 2]
