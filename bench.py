@@ -131,8 +131,15 @@ def _mlm_bench(dev, on_tpu, cfg_name, batch, seq, iters=20):
     from paddle_tpu import optimizer
     from paddle_tpu.models.ernie import ernie
 
+    import os
+    fused = os.environ.get("BENCH_FUSED", "1") == "1"
     paddle.seed(0)
-    model = ernie(cfg_name if on_tpu else "test-tiny")
+    # fused MLM loss: only the (<= max_predictions) masked positions
+    # run the vocab projection — the dense [B, S, vocab] logits never
+    # materialize (BENCH_FUSED=0 opts out)
+    model = ernie(cfg_name if on_tpu else "test-tiny",
+                  fused_mlm_loss=fused,
+                  max_predictions=max(int(seq * 0.19), 8))
     model.bfloat16() if on_tpu else None
     opt = optimizer.AdamW(learning_rate=1e-4,
                           parameters=model.parameters(),

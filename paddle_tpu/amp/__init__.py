@@ -1,3 +1,4 @@
 from .auto_cast import (amp_guard, auto_cast, is_autocast_enabled,  # noqa: F401
                         get_autocast_dtype)
 from .grad_scaler import GradScaler  # noqa: F401
+from .auto_cast import decorate  # noqa: F401

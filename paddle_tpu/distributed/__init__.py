@@ -4,6 +4,15 @@ from . import fleet  # noqa: F401
 from . import topology  # noqa: F401
 from .auto_parallel import (Engine, ProcessMesh, shard_layer,  # noqa: F401
                             shard_op, shard_tensor)
+from . import stream  # noqa: F401
+from .fleet_dataset import (CountFilterEntry, InMemoryDataset,  # noqa: F401
+                            ProbabilityEntry, QueueDataset,
+                            ShowClickEntry)
+from .comm_extra import (Group, ParallelMode, all_gather_object,  # noqa: F401
+                         destroy_process_group, get_group,
+                         gloo_barrier, gloo_init_parallel_env,
+                         gloo_release, irecv, isend, new_group, recv,
+                         reduce, send, split, wait)
 from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
                          all_to_all, alltoall_single, broadcast,
                          reduce_scatter, scatter)

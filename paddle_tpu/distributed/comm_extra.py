@@ -1,0 +1,272 @@
+"""Remaining paddle.distributed surface: groups, p2p, object
+collectives, misc shims.
+
+Reference: python/paddle/distributed/collective.py (new_group:340,
+send/recv/isend/irecv, reduce, split, wait, all_gather_object),
+parallel.py (ParallelMode, gloo_* helpers). TPU-native notes: a
+"process group" here is a VIEW over mesh axes (XLA emits the
+collectives), so groups are lightweight descriptors; eager host-side
+p2p rides the rendezvous TCPStore (control plane only — bulk tensors
+belong in compiled collectives), matching how the reference uses
+send/recv for control flow rather than throughput.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import topology
+
+__all__ = ["Group", "ParallelMode", "new_group", "get_group",
+           "destroy_process_group", "wait", "all_gather_object",
+           "send", "recv", "isend", "irecv", "reduce", "split",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release"]
+
+
+class ParallelMode:
+    """Training-mode enum (reference parallel.py ParallelMode)."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class Group:
+    """A mesh-axis view standing in for ProcessGroup (reference
+    collective.py Group): `axis` names the mesh dimension whose
+    collectives this group runs over."""
+
+    def __init__(self, gid: int, axis: Optional[str], ranks: List[int]):
+        self.id = gid
+        self.axis = axis
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis}, " \
+               f"ranks={self.ranks})"
+
+
+_GROUPS = {}
+_NEXT_GID = [1]
+
+
+def new_group(ranks=None, backend=None, axis: Optional[str] = None,
+              timeout=None):
+    """Create a group over `axis` (or explicit ranks — recorded for
+    bookkeeping; XLA partitions by axis name, reference new_group
+    collective.py:340)."""
+    hcg = topology.get_hybrid_communicate_group()
+    if ranks is None:
+        n = hcg.nranks if hcg is not None else 1
+        ranks = list(range(n))
+    gid = _NEXT_GID[0]
+    _NEXT_GID[0] += 1
+    g = Group(gid, axis, ranks)
+    _GROUPS[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if gid == 0:
+        hcg = topology.get_hybrid_communicate_group()
+        n = hcg.nranks if hcg is not None else 1
+        return Group(0, None, list(range(n)))
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    """Tear down groups (reference destroy_process_group); the global
+    mesh itself is owned by fleet/topology."""
+    if group is None:
+        _GROUPS.clear()
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    """Block until `tensor` is materialized (the stream-sync analog —
+    XLA has no user-visible streams, so readiness is block_until_ready,
+    ≈ c_sync_comm_stream)."""
+    arr = tensor.data if isinstance(tensor, Tensor) else tensor
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return tensor
+
+
+# --- store-backed object/p2p plane --------------------------------------
+
+_STORE = [None]
+
+
+def _store():
+    """Shared TCPStore for the object/p2p plane (reference: the
+    rendezvous TCPStore created by init_parallel_env). Lazily connects
+    using the launcher env (PADDLE_MASTER port + 2, clear of the jax
+    coordinator and the rpc store)."""
+    if _STORE[0] is None:
+        import os
+        from .store import TCPStore
+        base = os.environ.get("PADDLE_MASTER")
+        if base is None:
+            raise RuntimeError(
+                "no PADDLE_MASTER in the environment — launch via "
+                "paddle.distributed.launch for store-backed "
+                "collectives")
+        host, port = base.rsplit(":", 1)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        _STORE[0] = TCPStore(host, int(port) + 2,
+                             is_master=(rank == 0))
+    return _STORE[0]
+
+
+def _world():
+    import os
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1")), \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def all_gather_object(object_list: list, obj, group=None):
+    """Gather arbitrary picklable objects from every rank (reference
+    all_gather_object): store-backed exchange; single-process returns
+    [obj]."""
+    world, rank = _world()
+    if world == 1:
+        object_list.clear()
+        object_list.append(obj)
+        return
+    store = _store()
+    key = f"__ago/{_NEXT_GID[0]}"
+    store.set(f"{key}/{rank}", pickle.dumps(obj))
+    store.barrier(f"{key}/b", world)
+    object_list.clear()
+    for r in range(world):
+        object_list.append(pickle.loads(store.get(f"{key}/{r}")))
+    _NEXT_GID[0] += 1
+
+
+_P2P_SEQ: dict = {}
+
+
+def send(tensor, dst: int = 0, group=None, sync_op: bool = True):
+    """Host-plane p2p send (reference collective send; control-plane
+    semantics — bulk tensors belong in compiled collectives). Each
+    (src, dst) channel carries a sequence number so back-to-back sends
+    never overwrite an unconsumed message."""
+    world, rank = _world()
+    if world == 1:
+        raise RuntimeError("send needs a multi-process launch")
+    arr = np.asarray(tensor.data if isinstance(tensor, Tensor)
+                     else tensor)
+    store = _store()
+    chan = ("s", rank, dst)
+    seq = _P2P_SEQ.get(chan, 0)
+    _P2P_SEQ[chan] = seq + 1
+    store.set(f"__p2p/{rank}->{dst}/{seq}", pickle.dumps(arr))
+
+
+def recv(tensor, src: int = 0, group=None, sync_op: bool = True):
+    world, rank = _world()
+    if world == 1:
+        raise RuntimeError("recv needs a multi-process launch")
+    store = _store()
+    chan = ("r", src, rank)
+    seq = _P2P_SEQ.get(chan, 0)
+    _P2P_SEQ[chan] = seq + 1
+    key = f"__p2p/{src}->{rank}/{seq}"
+    data = pickle.loads(store.get(key))
+    store.delete(key)  # consume
+    if isinstance(tensor, Tensor):
+        tensor.set_value(jnp.asarray(data))
+        return tensor
+    return Tensor(jnp.asarray(data))
+
+
+class _DoneTask:
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        return self._value
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst: int = 0, group=None):
+    send(tensor, dst, group)
+    return _DoneTask()
+
+
+def irecv(tensor, src: int = 0, group=None):
+    out = recv(tensor, src, group)
+    return _DoneTask(out)
+
+
+def reduce(tensor, dst: int = 0, op=None, group=None,
+           axis: Optional[str] = None, sync_op: bool = True):
+    """Reduce-to-one (reference c_reduce): on the SPMD mesh a reduce is
+    an all_reduce whose non-dst shards are simply unused — XLA's
+    partitioner drops dead outputs, so this is not wasteful."""
+    from .collective import all_reduce
+    return all_reduce(tensor, op=op or "sum", group=group, axis=axis)
+
+
+def split(x, size, operation: str = "linear", axis: Optional[str] = "mp",
+          num_partitions: Optional[int] = None, gather_out: bool = True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split (reference collective.py split): build
+    a row/column-parallel linear or parallel embedding over the mp
+    axis. Delegates to the mpu layers — on TPU the partitioning is a
+    sharding annotation."""
+    from .parallel.mp_layers import (ColumnParallelLinear,
+                                     RowParallelLinear,
+                                     VocabParallelEmbedding)
+    in_sz, out_sz = size
+    if operation == "embedding":
+        return VocabParallelEmbedding(in_sz, out_sz)
+    if operation == "linear":
+        # reference picks row/column by the axis= argument (0=row)
+        if num_partitions is not None and gather_out:
+            return RowParallelLinear(in_sz, out_sz)
+        return ColumnParallelLinear(in_sz, out_sz,
+                                    gather_output=gather_out)
+    raise ValueError(f"unknown split operation {operation!r}")
+
+
+# --- gloo shims (CPU barrier plane) -------------------------------------
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str):
+    """CPU rendezvous (reference gloo_init_parallel_env) over the
+    TCPStore instead of a gloo ring."""
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank_id == 0))
+    _GROUPS["__gloo__"] = (store, rank_id, rank_num)
+
+
+def gloo_barrier():
+    entry = _GROUPS.get("__gloo__")
+    if entry is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    store, rank, num = entry
+    store.barrier(f"gloo/{_NEXT_GID[0]}", num)
+    _NEXT_GID[0] += 1
+
+
+def gloo_release():
+    entry = _GROUPS.pop("__gloo__", None)
+    if entry is not None:
+        entry[0].close()

@@ -1,0 +1,112 @@
+"""Fleet dataset shims: InMemoryDataset / QueueDataset + feature
+entries.
+
+Reference: python/paddle/distributed/fleet/dataset/ (C++ DataFeed-based
+readers for the parameter-server pipeline, SURVEY §2.2 Dataset/
+DataFeed). The PS training path is a declared non-goal on TPU
+(SURVEY §2.6 item 10); these classes keep the configuration API
+usable and feed standard python pipelines instead of the brpc one.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+__all__ = ["InMemoryDataset", "QueueDataset", "CountFilterEntry",
+           "ProbabilityEntry", "ShowClickEntry"]
+
+
+class _Entry:
+    def __init__(self, **kw):
+        self._cfg = kw
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._cfg})"
+
+
+class CountFilterEntry(_Entry):
+    """Sparse-feature frequency filter config (reference
+    entry_attr CountFilterEntry)."""
+
+    def __init__(self, count_filter: int = 0):
+        super().__init__(count_filter=count_filter)
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability: float = 1.0):
+        super().__init__(probability=probability)
+
+
+class ShowClickEntry(_Entry):
+    def __init__(self, show_name: str = "", click_name: str = ""):
+        super().__init__(show_name=show_name, click_name=click_name)
+
+
+class _FileDataset:
+    def __init__(self):
+        self._files: List[str] = []
+        self._parse_fn: Optional[Callable] = None
+        self._batch_size = 1
+        self._thread = 1
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             **kwargs):
+        self._batch_size = batch_size
+        self._thread = thread_num
+
+    def set_filelist(self, filelist: List[str]):
+        self._files = list(filelist)
+
+    def set_parse_func(self, fn: Callable):
+        """Line -> sample parser (stands in for pipe_command)."""
+        self._parse_fn = fn
+
+    def _iter_lines(self):
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    yield self._parse_fn(line) if self._parse_fn \
+                        else line
+
+
+class InMemoryDataset(_FileDataset):
+    """Load text samples fully into memory, then iterate/shuffle
+    (reference fleet InMemoryDataset minus the brpc PS plumbing)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: List = []
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_lines())
+
+    def local_shuffle(self, seed: int = 0):
+        import random
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        return iter(self._samples)
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, i):
+        return self._samples[i]
+
+
+class QueueDataset(_FileDataset):
+    """Streaming file dataset (reference QueueDataset): iterate without
+    materializing."""
+
+    def __iter__(self):
+        return self._iter_lines()

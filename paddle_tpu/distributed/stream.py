@@ -1,0 +1,18 @@
+"""paddle.distributed.stream namespace.
+
+Reference: python/paddle/distributed/communication/stream/ — the
+stream-variant collectives taking sync_op/use_calc_stream. XLA owns
+stream scheduling (latency-hiding scheduler), so these are the same
+compiled collectives; sync_op=False returns a completed task handle
+for API parity.
+"""
+from __future__ import annotations
+
+from .collective import (ReduceOp, all_gather, all_reduce,  # noqa: F401
+                         all_to_all, alltoall_single, broadcast,
+                         reduce_scatter, scatter)
+from .comm_extra import recv, reduce, send  # noqa: F401
+
+__all__ = ["all_gather", "all_reduce", "all_to_all", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "scatter", "send",
+           "recv"]

@@ -7,7 +7,7 @@ registry). jax-native: log_prob/entropy are traced math, sample() draws
 eagerly from the global RNG bridge (core/random.py), rsample is the
 reparameterized path where it exists.
 """
-from .distributions import (Bernoulli, Beta, Categorical,  # noqa: F401
+from .distributions import (Bernoulli, Beta, Categorical, Independent,  # noqa: F401
                             Dirichlet, Distribution, ExponentialFamily,
                             Exponential, Gamma, Geometric, Gumbel,
                             Laplace, LogNormal, Multinomial, Normal,

@@ -568,3 +568,50 @@ class StudentT(Distribution):
         return _wrap(jsp.gammaln((d + 1) / 2) - jsp.gammaln(d / 2)
                      - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
                      - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (reference
+    distribution/independent.py): log_prob sums the reinterpreted
+    dimensions."""
+
+    def __init__(self, base, reinterpreted_batch_rank: int):
+        r = int(reinterpreted_batch_rank)
+        if r <= 0 or r > len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank must be in "
+                f"[1, {len(base.batch_shape)}], got {r}")
+        self._base = base
+        self._rank = r
+        super().__init__(
+            batch_shape=base.batch_shape[:-r],
+            event_shape=base.batch_shape[-r:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self._base.log_prob(value)
+        arr = lp.data if isinstance(lp, Tensor) else jnp.asarray(lp)
+        return Tensor(jnp.sum(
+            arr, axis=tuple(range(-self._rank, 0))))
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return Tensor(jnp.exp(lp.data))
+
+    def entropy(self):
+        ent = self._base.entropy()
+        arr = ent.data if isinstance(ent, Tensor) else jnp.asarray(ent)
+        return Tensor(jnp.sum(arr, axis=tuple(range(-self._rank, 0))))

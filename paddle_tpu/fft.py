@@ -6,7 +6,8 @@ import jax.numpy as jnp
 
 from .ops.op_registry import op
 
-__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "hfft2",
+           "hfftn", "ihfft2", "ihfftn", "fft2",
            "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn",
            "irfftn", "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
 
@@ -61,3 +62,39 @@ def rfftfreq(n, d=1.0, dtype=None):
     from .core.tensor import Tensor
     out = jnp.fft.rfftfreq(int(n), d=float(d))
     return Tensor(out.astype(dtype) if dtype else out)
+
+
+# hermitian 2-d/n-d transforms (reference python/paddle/fft.py hfft2/
+# hfftn/ihfft2/ihfftn): hermitian-symmetric input -> real output, built
+# from the axis-wise hfft/ihfft pair like numpy does
+hfft2 = op("hfft2")(
+    lambda x, s=None, axes=(-2, -1), norm="backward":
+    _hfftn_impl(x, s=s, axes=tuple(axes), norm=norm))
+def _hfftn_impl(x, s=None, axes=None, norm="backward"):
+    # leading axes take a FORWARD fft (the hermitian reduction applies
+    # only to the last axis); verified by the ihfftn round-trip
+    ax = tuple(axes) if axes is not None else \
+        tuple(range(-x.ndim, 0))
+    for i, a in enumerate(ax[:-1]):
+        x = jnp.fft.fft(x, n=None if s is None else s[i], axis=a,
+                        norm=_norm(norm))
+    return jnp.fft.hfft(x, n=None if s is None else s[-1],
+                        axis=ax[-1], norm=_norm(norm))
+
+
+hfftn = op("hfftn")(_hfftn_impl)
+ihfft2 = op("ihfft2")(
+    lambda x, s=None, axes=(-2, -1), norm="backward":
+    _ihfftn_impl(x, s=s, axes=tuple(axes), norm=norm))
+def _ihfftn_impl(x, s=None, axes=None, norm="backward"):
+    ax = tuple(axes) if axes is not None else \
+        tuple(range(-x.ndim, 0))
+    out = jnp.fft.ihfft(x, n=None if s is None else s[-1],
+                        axis=ax[-1], norm=_norm(norm))
+    for i, a in enumerate(ax[:-1]):
+        out = jnp.fft.ifft(out, n=None if s is None else s[i], axis=a,
+                           norm=_norm(norm))
+    return out
+
+
+ihfftn = op("ihfftn")(_ihfftn_impl)

@@ -13,3 +13,66 @@ from . import nn  # noqa: F401,E402
 
 __all__.append("nn")
 from . import optimizer  # noqa: F401
+
+# top-level incubate surface (reference python/paddle/incubate/__init__.py)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from ..geometric import (segment_max, segment_mean,  # noqa: F401
+                         segment_min, segment_sum)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss without changing it (reference
+    incubate identity_loss; IPU-era marker — reductions apply)."""
+    import jax.numpy as jnp
+    from ..core.tensor import dispatch
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    return dispatch(
+        "identity_loss",
+        lambda a: (jnp.sum(a) if red == "sum"
+                   else jnp.mean(a) if red == "mean" else a),
+        (x,), {})
+
+
+def softmax_mask_fuse(x, mask):
+    """Fused masked softmax (reference incubate softmax_mask_fuse CUDA
+    kernel): on TPU XLA fuses the add+softmax — one dispatched op."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import dispatch
+    return dispatch(
+        "softmax_mask_fuse",
+        lambda a, m: jax.nn.softmax(a + m, axis=-1), (x, mask), {})
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference fused upper-triangle variant)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import dispatch
+
+    def impl(a):
+        s = a.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, a.shape, a.ndim - 2)
+        cols = jax.lax.broadcasted_iota(jnp.int32, a.shape, a.ndim - 1)
+        masked = jnp.where(rows >= cols, a, jnp.asarray(-1e9, a.dtype))
+        return jax.nn.softmax(masked, axis=-1)
+
+    return dispatch("softmax_mask_fuse_upper_triangle", impl, (x,), {})
+
+
+def _graph_gate(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"{name} produces data-dependent-shaped neighbor sets "
+            "(dynamic sampling) — host-side graph sampling; use "
+            "paddle.geometric segment/send_u_recv ops for on-device "
+            "message passing and sample neighbors in the DataLoader")
+
+    fn.__name__ = name
+    return fn
+
+
+graph_khop_sampler = _graph_gate("graph_khop_sampler")
+graph_reindex = _graph_gate("graph_reindex")
+graph_sample_neighbors = _graph_gate("graph_sample_neighbors")

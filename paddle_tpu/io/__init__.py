@@ -1,4 +1,4 @@
-from .dataloader import DataLoader  # noqa: F401
+from .dataloader import DataLoader, WorkerInfo, get_worker_info  # noqa: F401
 from .token_loader import TokenLoader  # noqa: F401
 from .dataset import (ChainDataset, ComposeDataset, Dataset,  # noqa: F401
                       IterableDataset, Subset, TensorDataset, random_split)

@@ -59,6 +59,13 @@ def _worker_init(dataset, collate_fn, user_init_fn, id_counter,
         # ids must stay in [0, num_workers)
         worker_id = id_counter.value % num_workers
         id_counter.value += 1
+    global _WORKER_INFO
+    # deterministic per-worker seed (reference contract: base_seed +
+    # worker_id, reproducible augmentation across runs)
+    from ..core import flags as _flags
+    base_seed = int(_flags.get_flag("seed") or 0)
+    _WORKER_INFO = WorkerInfo(worker_id, num_workers,
+                              base_seed + worker_id, dataset)
     if user_init_fn is not None:
         user_init_fn(worker_id)
 
@@ -231,3 +238,23 @@ class DataLoader:
         if self.batch_sampler is None:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
+
+
+class WorkerInfo:
+    """Info about the current DataLoader worker (reference
+    fluid/dataloader/worker.py WorkerInfo)."""
+
+    def __init__(self, id: int, num_workers: int, seed: int, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_WORKER_INFO = None
+
+
+def get_worker_info():
+    """In a worker process: that worker's WorkerInfo; None in the main
+    process (reference io.get_worker_info)."""
+    return _WORKER_INFO

@@ -1,3 +1,6 @@
-from .api import (TrainStep, functional_call, grad, jit, to_static,  # noqa: F401
+from .api import (ProgramTranslator, TracedLayer, TrainStep,  # noqa: F401
+                  TranslatedLayer, functional_call, grad, jit,
+                  not_to_static, set_code_level, set_verbosity,
+                  to_static,
                   value_and_grad)
 from .save_load import load, save  # noqa: F401

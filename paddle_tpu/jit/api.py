@@ -61,6 +61,8 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
     def deco(fn):
         is_layer = isinstance(fn, Layer)
         target = fn.forward if is_layer else fn
+        if getattr(target, "__jit_not_to_static__", False):
+            return fn  # @not_to_static: stay eager
         # dy2static pass: tensor-dependent if/while become
         # lax.cond/while_loop before jax.jit traces the function
         if not is_layer:
@@ -83,6 +85,12 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
 
         @functools.wraps(target)
         def wrapper(*args, **kwargs):
+            if not ProgramTranslator.enable_to_static:
+                # global kill-switch: run the ORIGINAL eagerly so
+                # breakpoints/prints work (reference
+                # ProgramTranslator.enable(False) semantics)
+                return fn(*args, **kwargs) if is_layer else \
+                    (fn(*args, **kwargs))
             if is_layer:
                 state = fn.state_dict()
                 jitted._state_names = list(state.keys())
@@ -308,3 +316,76 @@ class TrainStep:
             np.float32(self.optimizer.get_lr()),
             np.int32(self.optimizer._step_count + 1), *raw_batch)
         return lowered.compile().cost_analysis()
+
+
+def not_to_static(fn=None):
+    """Mark a function to stay un-converted under @to_static (reference
+    jit/api.py not_to_static)."""
+    def deco(f):
+        f.__jit_not_to_static__ = True
+        return f
+
+    return deco(fn) if fn is not None else deco
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    """dy2static transformed-code logging (reference
+    dygraph_to_static/logging_utils.set_code_level)."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """dy2static verbosity (reference logging_utils.set_verbosity)."""
+    set_code_level(level, also_to_stdout)
+
+
+class ProgramTranslator:
+    """Singleton toggling dy2static conversion globally (reference
+    dygraph_to_static/program_translator.py ProgramTranslator). Here
+    conversion happens in to_static itself; the toggle makes
+    @to_static fall back to eager when disabled."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        type(self).enable_to_static = bool(enable_to_static)
+
+
+class TracedLayer:
+    """Trace a dygraph Layer into a compiled callable + saved artifact
+    (reference fluid/dygraph/jit.py TracedLayer over the legacy
+    tracer; here: to_static capture + jit save)."""
+
+    def __init__(self, layer: Layer, inputs):
+        self._layer = layer
+        self._compiled = to_static(layer)
+        self._example = inputs
+
+    @staticmethod
+    def trace(layer: Layer, inputs):
+        traced = TracedLayer(layer, inputs)
+        return traced(*inputs), traced
+
+    def __call__(self, *inputs):
+        return self._compiled(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from .save_load import save as jit_save
+        jit_save(self._layer, path, input_spec=list(self._example))
+
+
+def TranslatedLayer(path):
+    """Load a saved program as a callable layer-like object (reference
+    jit/translated_layer.py TranslatedLayer; here the jit.load result
+    plays that role directly)."""
+    from .save_load import load as jit_load
+    return jit_load(path)

@@ -637,6 +637,8 @@ def convert_to_static(fn: Callable) -> Callable:
     """Source-rewrite `fn` so tensor-dependent if/while trace into
     lax.cond/while_loop (the ProgramTranslator.get_func analog).
     Falls back to the original function when source is unavailable."""
+    if getattr(fn, "__jit_not_to_static__", False):
+        return fn  # @not_to_static opt-out
     try:
         src = textwrap.dedent(inspect.getsource(fn))
     except (OSError, TypeError):

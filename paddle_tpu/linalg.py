@@ -1,10 +1,11 @@
 """paddle.linalg namespace (≈ python/paddle/linalg.py re-exporting
 tensor/linalg.py) — decompositions and solvers lower to XLA's native
 linalg (QR/SVD/Cholesky run on the MXU where shapes allow)."""
-from .ops.linalg import (cholesky, cholesky_solve, cov,  # noqa: F401
-                         corrcoef, cross, det, eig, eigh, eigvalsh,
-                         inv, lstsq, lu, matrix_power, matrix_rank,
-                         multi_dot, norm, pinv, qr, slogdet, solve,
-                         svd, triangular_solve)
+from .ops.linalg import (cholesky, cholesky_solve, cond, cov,  # noqa: F401
+                         corrcoef, cross, det, eig, eigh, eigvals,
+                         eigvalsh, inv, lstsq, lu, lu_unpack,
+                         matrix_power, matrix_rank, multi_dot, norm,
+                         pinv, qr, slogdet, solve, svd,
+                         triangular_solve)
 
 inverse = inv

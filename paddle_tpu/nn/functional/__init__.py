@@ -5,3 +5,4 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention  # noqa: F401
+from .extra import *  # noqa: F401,F403

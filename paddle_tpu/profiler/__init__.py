@@ -28,6 +28,7 @@ Usage (reference API shape):
     p.summary()
 """
 from .profiler import (Profiler, ProfilerResult, ProfilerState,  # noqa: F401
-                       ProfilerTarget, RecordEvent,
-                       export_chrome_tracing, make_scheduler)
+                       ProfilerTarget, RecordEvent, SummaryView,
+                       export_chrome_tracing, export_protobuf,
+                       load_profiler_result, make_scheduler)
 from .statistic import SortedKeys, summary_table  # noqa: F401

@@ -355,3 +355,36 @@ class Profiler:
             print("No profiler data recorded.")
             return
         print(result.summary(sorted_by=sorted_by, time_unit=time_unit))
+
+
+class SummaryView(enum.Enum):
+    """Which table summary() prints (reference profiler/profiler.py
+    SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(result: "ProfilerResult", path: str):
+    """Persist a ProfilerResult (reference export_protobuf writes the
+    profiler protobuf dump; here a self-contained pickle of the host
+    spans + device-trace pointer — load_profiler_result reads it)."""
+    import pickle
+    with open(path, "wb") as f:
+        pickle.dump({"events": result.events,
+                     "device_trace_dir": result.device_trace_dir}, f)
+
+
+def load_profiler_result(path: str) -> "ProfilerResult":
+    """Reload a dump written by export_protobuf (reference
+    load_profiler_result)."""
+    import pickle
+    with open(path, "rb") as f:
+        d = pickle.load(f)
+    return ProfilerResult(d["events"], d.get("device_trace_dir"))

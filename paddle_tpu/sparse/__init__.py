@@ -10,10 +10,11 @@ sparse here is a memory/IO format with correct semantics, not a FLOP
 saver — same trade the reference makes on non-cuSPARSE backends.
 """
 from . import nn  # noqa: F401
-from .binary import (add, divide, masked_matmul, matmul,  # noqa: F401
+from .binary import (add, addmm, divide, masked_matmul, matmul, mv,  # noqa: F401
                      multiply, subtract)
 from .creation import (SparseCooTensor, SparseCsrTensor,  # noqa: F401
                        sparse_coo_tensor, sparse_csr_tensor)
-from .unary import (abs, cast, coalesce, deg2rad, expm1,  # noqa: F401
+from .unary import (abs, asin, asinh, atan, atanh, log1p, reshape, transpose,  # noqa: F401
+                    cast, coalesce, deg2rad, expm1,
                     is_same_shape, neg, pow, rad2deg, relu, sin, sinh,
                     sqrt, square, tan, tanh)

@@ -78,3 +78,19 @@ def masked_matmul(x, y, mask: _SparseBase):
     vals = (xd[rows, :] * yd[:, cols].T).sum(-1)
     out = jsparse.BCOO((vals, coo.indices), shape=coo.shape)
     return _rewrap(mask, out)
+
+
+def mv(x, vec):
+    """Sparse matrix x dense vector (reference sparse mv kernel)."""
+    from ..core.tensor import Tensor
+    v = vec.data if isinstance(vec, Tensor) else vec
+    return Tensor(x._mat @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta * input + alpha * (x @ y), x sparse (reference sparse
+    addmm)."""
+    from ..core.tensor import Tensor
+    inp = input.data if isinstance(input, Tensor) else input
+    yv = y.data if isinstance(y, Tensor) else y
+    return Tensor(beta * inp + alpha * (x._mat @ yv))

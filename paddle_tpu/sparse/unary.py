@@ -7,9 +7,10 @@ import jax.numpy as jnp
 
 from .creation import SparseCooTensor, SparseCsrTensor, _SparseBase
 
-__all__ = ["abs", "cast", "coalesce", "deg2rad", "expm1",
-           "is_same_shape", "neg", "pow", "rad2deg", "relu", "sin",
-           "sinh", "sqrt", "square", "tan", "tanh"]
+__all__ = ["abs", "asin", "asinh", "atan", "atanh", "cast",
+           "coalesce", "deg2rad", "expm1", "is_same_shape", "log1p",
+           "neg", "pow", "rad2deg", "relu", "reshape", "sin", "sinh",
+           "sqrt", "square", "tan", "tanh", "transpose"]
 
 
 def _map_values(x: _SparseBase, fn) -> _SparseBase:
@@ -97,3 +98,42 @@ def coalesce(x: SparseCooTensor) -> SparseCooTensor:
 
 def is_same_shape(x, y) -> bool:
     return list(x.shape) == list(y.shape)
+
+
+# round-2: remaining elementwise surface (reference python/paddle/
+# sparse/unary.py) — value-map ops preserve the sparsity pattern
+def asin(x):
+    return _map_values(x, jnp.arcsin)
+
+
+def asinh(x):
+    return _map_values(x, jnp.arcsinh)
+
+
+def atan(x):
+    return _map_values(x, jnp.arctan)
+
+
+def atanh(x):
+    return _map_values(x, jnp.arctanh)
+
+
+def log1p(x):
+    return _map_values(x, jnp.log1p)
+
+
+def reshape(x, shape):
+    """Sparse reshape via densify/re-sparsify (the reference's sparse
+    reshape kernel reindexes; COO on XLA round-trips through dense,
+    acceptable for the API surface)."""
+    import jax.experimental.sparse as jsparse
+    from .creation import SparseCooTensor
+    dense = x._mat.todense().reshape(tuple(int(s) for s in shape))
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+
+
+def transpose(x, perm):
+    import jax.experimental.sparse as jsparse
+    from .creation import SparseCooTensor
+    dense = jnp.transpose(x._mat.todense(), tuple(perm))
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense))

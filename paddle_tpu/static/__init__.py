@@ -16,6 +16,7 @@ from .program import (Program, Variable, OpDesc, VarDesc, program_guard,
                       data, default_main_program, default_startup_program,
                       append_backward, name_scope, in_static_build)
 from .executor import Executor, Scope, global_scope, CompiledProgram
+from .extras import *  # noqa: F401,F403
 from .io import (save_inference_model, load_inference_model,
                  LoadedInferenceProgram)
 
@@ -124,7 +125,14 @@ class _StaticNN:
         return dispatch("while_loop", impl, tuple(loop_vars), {})
 
 
-nn = _StaticNN()
+# static.nn is the helper MODULE (fc/conv2d/...; static/nn.py) with
+# the control-flow ops attached — one namespace serving both the
+# layer-helper and cond/while_loop surfaces like the reference
+from . import nn as _nn_mod  # noqa: E402
+
+_nn_mod.cond = _StaticNN.cond
+_nn_mod.while_loop = _StaticNN.while_loop
+nn = _nn_mod
 
 __all__ = [
     "Program", "Variable", "OpDesc", "VarDesc", "program_guard", "data",

@@ -1,0 +1,417 @@
+"""Remaining paddle.static surface.
+
+Reference: python/paddle/static/__init__.py re-exports over
+fluid/framework.py (scope/device guards, program state), fluid/io.py
+(save/load + serialization), incubate ExponentialMovingAverage. TPU
+notes inline: places map onto jax devices; program state is the
+Program's var table; serialization reuses the StableHLO-based
+inference-model artifacts.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "scope_guard", "device_guard", "cpu_places", "cuda_places",
+    "npu_places", "mlu_places", "xpu_places", "create_global_var",
+    "create_parameter", "gradients", "py_func", "Print", "accuracy",
+    "auc", "exponential_decay", "ExponentialMovingAverage",
+    "WeightNormParamAttr", "BuildStrategy", "ExecutionStrategy",
+    "ParallelExecutor", "save", "load", "save_to_file",
+    "load_from_file", "serialize_program", "deserialize_program",
+    "serialize_persistables", "deserialize_persistables",
+    "normalize_program", "load_program_state", "set_program_state",
+    "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard",
+    "set_ipu_shard", "ctr_metric_bundle",
+]
+
+
+# ------------------------------------------------------------- guards
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Switch the active global Scope (reference static.scope_guard)."""
+    from . import executor as _ex
+    prev = _ex._GLOBAL_SCOPE
+    _ex._GLOBAL_SCOPE = scope
+    try:
+        yield
+    finally:
+        _ex._GLOBAL_SCOPE = prev
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """Pin ops built inside to a device (reference device_guard). On
+    TPU placement is XLA's job; the guard records intent and routes
+    'cpu' placements via jax default-device for eager creation ops."""
+    if device is None or device.startswith("tpu") or \
+            device.startswith("gpu"):
+        yield
+        return
+    plat = device.split(":")[0]
+    try:
+        dev = jax.devices(plat)[0]
+    except RuntimeError:
+        yield
+        return
+    with jax.default_device(dev):
+        yield
+
+
+def _places(platform: str, count: Optional[int] = None):
+    from ..framework import CUDAPlace
+    from ..core.device import Place
+    try:
+        devs = jax.devices(platform)
+    except RuntimeError:
+        devs = jax.devices()
+    if count is not None:
+        devs = devs[:count]
+    return [Place(d) for d in devs]
+
+
+def cpu_places(device_count: Optional[int] = None):
+    return _places("cpu", device_count)
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (reference cuda_places; TPU chips here)."""
+    devs = jax.devices()
+    from ..core.device import Place
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return [Place(d) for d in devs]
+
+
+npu_places = cuda_places
+mlu_places = cuda_places
+xpu_places = cuda_places
+
+
+# ----------------------------------------------------- vars / autodiff
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A persistable filled variable (reference
+    static.create_global_var)."""
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        jnp.dtype(dtype) if not isinstance(dtype, str)
+                        else dtype), name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..ops.creation import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Static-graph gradient API (reference fluid/backward.py
+    gradients) — same engine as paddle.grad."""
+    from ..autograd.backward_engine import tensor_grad
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return tensor_grad(outs, ins, grad_outputs=target_gradients,
+                       no_grad_vars=no_grad_set, allow_unused=True)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference py_func over PyFuncRegistry): eager
+    here — runs `func` on host numpy and wraps the result."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrs = [np.asarray(v.data if isinstance(v, Tensor) else v)
+            for v in xs]
+    res = func(*arrs)
+    res_list = res if isinstance(res, (list, tuple)) else [res]
+    outs = [Tensor(jnp.asarray(r)) for r in res_list]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug-print op (reference Print): host print + passthrough; in
+    traced code use jax.debug.print semantics via callback."""
+    arr = input.data if isinstance(input, Tensor) else input
+    if isinstance(arr, jax.core.Tracer):
+        jax.debug.print((message or "") + " {x}", x=arr)
+        return input
+    head = message or ""
+    if print_tensor_name and getattr(input, "name", None):
+        head += f" name={input.name}"
+    flat = np.asarray(arr).ravel()[:summarize]
+    print(f"{head} shape={list(np.shape(arr))} values={flat}")
+    return input
+
+
+# --------------------------------------------------------------- metric
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095,
+        topk=1, slide_steps=1):
+    """Batch AUC (reference static auc): returns (auc, *state) — here
+    the scalar AUC over this batch via the streaming metric."""
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(np.asarray(input.data if isinstance(input, Tensor)
+                        else input),
+             np.asarray(label.data if isinstance(label, Tensor)
+                        else label))
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+
+
+def ctr_metric_bundle(input, label):
+    """PS CTR metric bundle — parameter-server metrics are a declared
+    non-goal on TPU (SURVEY §2.6 item 10)."""
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server path "
+        "(non-goal on TPU); use paddle.metric.Auc/Accuracy")
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    from ..optimizer.lr import ExponentialDecay
+    # reference static helper returns a schedule variable; the modern
+    # LRScheduler carries the same curve
+    return ExponentialDecay(gamma=decay_rate,
+                            learning_rate=learning_rate)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values (reference
+    static/ExponentialMovingAverage): update() folds current params
+    into shadows; apply() swaps them in (context manager), restore()
+    swaps back."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None,
+                 name: Optional[str] = None,
+                 parameter_list: Optional[List[Parameter]] = None):
+        self._decay = float(decay)
+        # reference semantics: the (1+t)/(10+t) warm-up ramp applies
+        # ONLY when thres_steps is given; otherwise decay is fixed
+        self._thres_steps = thres_steps
+        self._params = parameter_list
+        self._shadow: Dict[int, jnp.ndarray] = {}
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._step = 0
+
+    def _plist(self):
+        if self._params is not None:
+            return [p for p in self._params if isinstance(p, Parameter)]
+        raise RuntimeError(
+            "pass parameter_list= (the static global-block sweep does "
+            "not exist in the TPU build)")
+
+    def update(self):
+        from ..optimizer.optimizer import opt_key
+        self._step += 1
+        d = self._decay if self._thres_steps is None else \
+            min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._plist():
+            k = opt_key(p)
+            cur = self._shadow.get(k)
+            self._shadow[k] = p.data if cur is None else \
+                d * cur + (1 - d) * p.data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        from ..optimizer.optimizer import opt_key
+        for p in self._plist():
+            k = opt_key(p)
+            if k in self._shadow:
+                self._backup[k] = p.data
+                p._replace_data(self._shadow[k])
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        from ..optimizer.optimizer import opt_key
+        for p in self._plist():
+            k = opt_key(p)
+            if k in self._backup:
+                p._replace_data(self._backup.pop(k))
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight-normalized parameterization
+    (reference WeightNormParamAttr): Layers consume it by calling
+    nn.utils.weight_norm after construction."""
+
+    def __init__(self, dim: Optional[int] = None, name=None,
+                 initializer=None, learning_rate: float = 1.0,
+                 regularizer=None, trainable: bool = True,
+                 do_model_average: bool = False, need_clip: bool = True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+# ------------------------------------------------------- compat shims
+class BuildStrategy:
+    """Graph-build knobs (reference BuildStrategy over the SSA-graph
+    executor). XLA's pass pipeline replaces every fusion toggle, so the
+    attributes are recorded no-ops kept for config compatibility."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["_opts"][k]
+        except KeyError:
+            raise AttributeError(k)
+
+
+class ExecutionStrategy(BuildStrategy):
+    """Executor threading knobs (reference ExecutionStrategy); XLA owns
+    scheduling."""
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor (reference parallel_executor.cc).
+    On TPU the SPMD partitioner subsumes it: wrap a CompiledProgram."""
+
+    def __init__(self, use_cuda=False, loss_name=None,
+                 main_program=None, build_strategy=None,
+                 exec_strategy=None, **kw):
+        from .executor import CompiledProgram
+        from .program import default_main_program
+        self._compiled = CompiledProgram(
+            main_program or default_main_program())
+
+    def run(self, fetch_list=None, feed=None, **kw):
+        from .executor import Executor
+        return Executor().run(self._compiled._program, feed=feed,
+                              fetch_list=fetch_list)
+
+
+# --------------------------------------------------------- persistence
+def _program_state(program) -> Dict[str, np.ndarray]:
+    """Persistable values of a recorded Program: the executor's global
+    Scope value when the program has run, else the captured startup
+    value (program._param_inits)."""
+    from .executor import global_scope
+    scope = global_scope()
+    out = {}
+    for name, init in getattr(program, "_param_inits", {}).items():
+        live = scope.find_var(name)
+        out[name] = np.asarray(live if live is not None else init)
+    return out
+
+
+def save(program, model_path: str, protocol: int = 4):
+    """Persist a Program's persistable vars (reference static.save ->
+    .pdparams): name -> ndarray pickle."""
+    payload = _program_state(program)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        payload = pickle.load(f)
+    set_program_state(program, payload)
+
+
+def load_program_state(model_path: str, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict: Dict[str, np.ndarray]):
+    from .executor import global_scope
+    scope = global_scope()
+    inits = getattr(program, "_param_inits", {})
+    for k, v in state_dict.items():
+        arr = jnp.asarray(v)
+        if k in inits:
+            inits[k] = arr
+        scope.vars[k] = arr
+
+
+def serialize_program(feed_vars, fetch_vars, program=None) -> bytes:
+    """Program structure -> bytes (reference serialize_program emits
+    the ProgramDesc proto; here the recorded op list pickles)."""
+    from .program import default_main_program
+    prog = program or default_main_program()
+    return pickle.dumps(prog)
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None) -> bytes:
+    from .program import default_main_program
+    prog = program or default_main_program()
+    return pickle.dumps(_program_state(prog))
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Prune to the feed->fetch subgraph (reference normalize_program);
+    the recorded Program replays only reachable ops at run time, so
+    normalization is identity here."""
+    return program
+
+
+# ---------------------------------------------------------- IPU shims
+class IpuStrategy:
+    """Graphcore IPU config (reference IpuStrategy) — different
+    accelerator family; not applicable to the TPU build."""
+
+    def __init__(self):
+        raise NotImplementedError(
+            "IPU support is not applicable on the TPU backend")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU support is not applicable on the TPU backend")
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError(
+        "IPU support is not applicable on the TPU backend")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError(
+        "IPU support is not applicable on the TPU backend")

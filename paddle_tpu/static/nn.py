@@ -1,0 +1,419 @@
+"""paddle.static.nn — static-graph layer helpers.
+
+Reference: python/paddle/static/nn/__init__.py (fluid layers built via
+LayerHelper.append_op). Here each helper builds the same computation
+with the dynamic layers/ops inside the recording program_guard — the
+static hook records them into the Program exactly like append_op.
+
+LoD-sequence ops (sequence_*) are a documented divergence: LoD tensors
+do not exist on TPU (ragged batches break XLA's static shapes — same
+boundary as SelectedRows/strings, SURVEY §2.1); use dense padding +
+paddle.nn.functional.sequence_mask instead. The parameter-server-only
+helpers (sparse_embedding, multi_box_head's PS path, nce's distributed
+sampler) follow SURVEY §2.6's non-goal list.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "data_norm", "prelu", "spectral_norm",
+    "bilinear_tensor_product", "row_conv", "crf_decoding", "py_func",
+    "nce", "case", "switch_case", "StaticRNN", "deform_conv2d",
+    "multi_box_head", "sparse_embedding", "sequence_concat",
+    "sequence_conv", "sequence_enumerate", "sequence_expand",
+    "sequence_expand_as", "sequence_first_step", "sequence_last_step",
+    "sequence_pad", "sequence_pool", "sequence_reshape",
+    "sequence_reverse", "sequence_scatter", "sequence_slice",
+    "sequence_softmax", "sequence_unpad",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# ------------------------------------------------------------- layers
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully-connected helper (reference static/nn/common.py fc)."""
+    from .. import nn
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    for xi in xs:
+        xi = _t(xi)
+        flat_dim = int(np.prod(xi.shape[num_flatten_dims:]))
+        flat = xi.reshape(list(xi.shape[:num_flatten_dims]) + [flat_dim])
+        lin = nn.Linear(flat_dim, size,
+                        bias_attr=bias_attr if bias_attr is not None
+                        else None)
+        outs.append(lin(flat))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if activation:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from .. import nn
+    emb = nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+    return emb(_t(input))
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None):
+    from .. import nn
+    in_c = input.shape[1 if data_format.startswith("NC") else -1]
+    conv = nn.Conv2D(in_c, num_filters, filter_size, stride, padding,
+                     dilation, groups, data_format=data_format)
+    out = conv(_t(input))
+    if act:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format="NCHW", name=None):
+    from .. import nn
+    in_c = input.shape[1 if data_format.startswith("NC") else -1]
+    conv = nn.Conv2DTranspose(in_c, num_filters, filter_size, stride,
+                              padding, groups=groups, dilation=dilation)
+    return conv(_t(input))
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", name=None):
+    from .. import nn
+    in_c = input.shape[1 if data_format.startswith("NC") else -1]
+    conv = nn.Conv3D(in_c, num_filters, filter_size, stride, padding,
+                     dilation, groups)
+    return conv(_t(input))
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format="NCDHW", name=None):
+    from .. import nn
+    in_c = input.shape[1 if data_format.startswith("NC") else -1]
+    conv = nn.Conv3DTranspose(in_c, num_filters, filter_size, stride,
+                              padding, groups=groups, dilation=dilation)
+    return conv(_t(input))
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", in_place=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from .. import nn
+    c = input.shape[1 if data_layout.startswith("NC") else -1]
+    bn = nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon,
+                        data_format=data_layout) if input.ndim == 4 \
+        else nn.BatchNorm1D(c, momentum=momentum, epsilon=epsilon)
+    if is_test or use_global_stats:
+        bn.eval()
+    return bn(_t(input))
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    import paddle_tpu.nn.functional as F
+    shape = list(input.shape[begin_norm_axis:])
+    from .. import nn
+    ln = nn.LayerNorm(shape, epsilon=epsilon)
+    return ln(_t(input))
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    c = input.shape[1 if data_layout.startswith("NC") else -1]
+    gn = nn.GroupNorm(groups, c, epsilon=epsilon)
+    return gn(_t(input))
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    import paddle_tpu.nn.functional as F
+    return F.instance_norm(_t(input), epsilon=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, **kwargs):
+    """Per-feature standardization without batch coupling (reference
+    data_norm — the PS-era BN variant); stateless dense form."""
+    x = _t(input)
+    import paddle_tpu.nn.functional as F
+    mean = x.mean(axis=0, keepdim=True)
+    var = ((x - mean) ** 2).mean(axis=0, keepdim=True)
+    return (x - mean) / (var + epsilon).sqrt()
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW",
+          name=None):
+    from .. import nn
+    n = 1 if mode == "all" else \
+        x.shape[1 if data_format.startswith("NC") else -1]
+    layer = nn.PReLU(num_parameters=n)
+    return layer(_t(x))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.layers_wrap import SpectralNorm
+    layer = SpectralNorm(list(weight.shape), dim=dim,
+                         power_iters=power_iters, eps=eps)
+    return layer(_t(weight))
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .. import nn
+    layer = nn.Bilinear(x.shape[-1], y.shape[-1], size)
+    return layer(_t(x), _t(y))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference row_conv op, DeepSpeech2):
+    out[t] = sum_{i=0..k} x[t+i] * w[i], per feature channel."""
+    x = _t(input)
+    k = int(future_context_size) + 1
+    d = x.shape[-1]
+    w = Parameter(np.full((k, d), 1.0 / k, np.float32))
+
+    from ..core.tensor import dispatch
+
+    def impl(arr, wv):
+        pad = jnp.pad(arr, ((0, 0), (0, k - 1), (0, 0)))
+        out = jnp.zeros_like(arr)
+        for i in range(k):
+            out = out + pad[:, i:i + arr.shape[1], :] * wv[i]
+        return out
+
+    return dispatch("row_conv", impl, (x, w), {})
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi decode over emission scores (reference crf_decoding op);
+    rides the text.viterbi_decode kernel."""
+    from ..text import viterbi_decode
+    x = _t(input)
+    if transition is None:
+        raise ValueError(
+            "pass transition= (the learned [T+2, T] CRF transition "
+            "matrix; the fluid helper read it from the linear_chain_crf "
+            "param scope)")
+    lens = length if length is not None else \
+        Tensor(jnp.full((x.shape[0],), x.shape[1], jnp.int64))
+    scores, path = viterbi_decode(x, _t(transition), lens)
+    return path
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .extras import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce op): uniform
+    negative sampling + logistic loss over (1 + k) candidates."""
+    from ..core import random as random_mod
+    x = _t(input)
+    lab = _t(label)
+    d = x.shape[-1]
+    k = int(num_neg_samples or 5)
+    w = Parameter(np.random.RandomState(seed or 0)
+                  .randn(num_total_classes, d).astype(np.float32) * 0.01)
+    b = Parameter(np.zeros((num_total_classes,), np.float32))
+    key = random_mod.next_key()
+
+    from ..core.tensor import dispatch
+
+    def impl(xv, lv, wv, bv):
+        n = xv.shape[0]
+        neg = jax.random.randint(key, (n, k), 0, num_total_classes)
+        cand = jnp.concatenate([lv.reshape(n, 1), neg], axis=1)
+        cw = wv[cand]                       # [N, 1+k, D]
+        cb = bv[cand]
+        logits = jnp.einsum("nd,nkd->nk", xv, cw) + cb
+        tgt = jnp.zeros_like(logits).at[:, 0].set(1.0)
+        z = jnp.maximum(logits, 0) - logits * tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(z, axis=1, keepdims=True)
+
+    return dispatch("nce", impl, (x, lab, w, b), {})
+
+
+# ---------------------------------------------------- control flow
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true branch selection (reference static/nn/control_flow
+    case): python preds run eagerly; traced preds chain lax.cond via
+    the dy2static convert helper."""
+    from ..jit.dy2static import convert_ifelse
+
+    def build(pairs):
+        if not pairs:
+            if default is None:
+                raise ValueError("case: no branch matched and no "
+                                 "default given")
+            return default()
+        pred, fn = pairs[0]
+        return convert_ifelse(pred, lambda: fn(),
+                              lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Indexed branch selection (reference switch_case) — lax.switch
+    when the index is traced."""
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    idx = branch_index
+    arr = idx.data if isinstance(idx, Tensor) else idx
+    keys = sorted(fns)
+    if not isinstance(arr, jax.core.Tracer):
+        i = int(np.asarray(arr))
+        if i in fns:
+            return fns[i]()
+        if default is not None:
+            return default()
+        return fns[keys[-1]]()
+    branches = [fns[k] for k in keys]
+    if default is not None:
+        branches.append(default)
+    # map arbitrary keys onto dense positions; unmatched index falls
+    # through to default when given, else the LARGEST key (same
+    # fallthrough the eager path and the reference use)
+    pos = sum(jnp.where(arr == k, j + 1, 0)
+              for j, k in enumerate(keys)) - 1
+    fallthrough = len(keys) if default is not None else len(keys) - 1
+    pos = jnp.where(pos < 0, fallthrough, pos)
+    return jax.lax.switch(jnp.clip(pos, 0, len(branches) - 1),
+                          [lambda fn=f: fn() for f in branches])
+
+
+class StaticRNN:
+    """Step-wise RNN builder (reference StaticRNN): collect per-step
+    ops then scan. Dense form: the user supplies the step via
+    step_input/memory handles; internally a lax.scan over time."""
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._mems = []
+        self._step_fn: Optional[Callable] = None
+        self._outputs = []
+
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield self
+
+        return ctx()
+
+    def step_input(self, x):
+        self._inputs.append(_t(x))
+        return self._inputs[-1][:, 0]
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0):
+        if init is None:
+            b = (batch_ref.shape[0] if batch_ref is not None
+                 else self._inputs[0].shape[0])
+            init = Tensor(jnp.full((b,) + tuple(shape or ()),
+                                   init_value, jnp.float32))
+        self._mems.append(_t(init))
+        return self._mems[-1]
+
+    def update_memory(self, mem, new):
+        self._update = (mem, new)
+
+    def step_output(self, out):
+        self._outputs.append(out)
+
+    def output(self, *outs):
+        for o in outs:
+            self.step_output(o)
+
+    def __call__(self):
+        raise NotImplementedError(
+            "StaticRNN's imperative step-recording is a fluid-era API; "
+            "build scans with paddle.nn.RNN / jax.lax.scan instead "
+            "(same capability, compiled as ONE fused loop)")
+
+
+# ------------------------------------------- gated (documented) ops
+def _lod_gate(name: str):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"{name} operates on LoD (ragged) tensors, which do not "
+            "exist on TPU (static XLA shapes; same boundary as "
+            "SelectedRows — SURVEY §2.1). Use dense padding + "
+            "paddle.nn.functional.sequence_mask, or lax.scan over "
+            "(data, lengths).")
+
+    fn.__name__ = name
+    return fn
+
+
+sequence_concat = _lod_gate("sequence_concat")
+sequence_conv = _lod_gate("sequence_conv")
+sequence_enumerate = _lod_gate("sequence_enumerate")
+sequence_expand = _lod_gate("sequence_expand")
+sequence_expand_as = _lod_gate("sequence_expand_as")
+sequence_first_step = _lod_gate("sequence_first_step")
+sequence_last_step = _lod_gate("sequence_last_step")
+sequence_pad = _lod_gate("sequence_pad")
+sequence_pool = _lod_gate("sequence_pool")
+sequence_reshape = _lod_gate("sequence_reshape")
+sequence_reverse = _lod_gate("sequence_reverse")
+sequence_scatter = _lod_gate("sequence_scatter")
+sequence_slice = _lod_gate("sequence_slice")
+sequence_softmax = _lod_gate("sequence_softmax")
+sequence_unpad = _lod_gate("sequence_unpad")
+
+
+def sparse_embedding(*a, **k):
+    raise NotImplementedError(
+        "sparse_embedding feeds the brpc parameter server — a declared "
+        "non-goal on TPU (SURVEY §2.6 item 10); use nn.Embedding with "
+        "VocabParallelEmbedding for large vocabularies")
+
+
+def deform_conv2d(*a, **k):
+    raise NotImplementedError(
+        "deformable conv's gather-heavy sampling kernel is not "
+        "implemented yet; paddle.vision.ops.roi_align/grid_sample "
+        "cover the sampling primitives")
+
+
+def multi_box_head(*a, **k):
+    raise NotImplementedError(
+        "multi_box_head (SSD assembly helper) is not implemented; "
+        "compose paddle.vision.ops.prior_box + box_coder directly")

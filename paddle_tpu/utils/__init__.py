@@ -1,3 +1,73 @@
 """paddle.utils analog: custom op registration + C++ extensions."""
 from . import cpp_extension  # noqa: F401
 from .custom_op import register_custom_op  # noqa: F401
+
+
+# ---- paddle.utils top-level helpers (reference python/paddle/utils/) ---
+
+def try_import(module_name: str, err_msg: str = None):
+    """Import a soft dependency with an actionable error (reference
+    utils/lazy_import.py try_import)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; "
+            f"this environment cannot pip install — gate the feature")
+
+
+def require_version(min_version: str, max_version: str = None):
+    """Check the installed framework version (reference
+    utils/install_check.py require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Decorator marking an API deprecated (reference
+    utils/deprecated.py): warns on call, raises at level 2."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API '{fn.__name__}' is deprecated since {since}; "
+                   f"{('use ' + update_to) if update_to else ''} "
+                   f"{reason}")
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """Smoke-check the installation on the current device (reference
+    utils/install_check.py run_check): one tiny matmul + grad."""
+    import numpy as np
+    from .. import nn, optimizer, randn, to_tensor
+    from ..core.device import get_device
+    m = nn.Linear(4, 2)
+    x = randn([2, 4])
+    out = m(x)
+    loss = (out * out).mean()
+    loss.backward()
+    assert m.weight.grad is not None
+    print(f"paddle_tpu is installed successfully! device={get_device()}")
