@@ -406,11 +406,20 @@ def sparse_embedding(*a, **k):
         "VocabParallelEmbedding for large vocabularies")
 
 
-def deform_conv2d(*a, **k):
-    raise NotImplementedError(
-        "deformable conv's gather-heavy sampling kernel is not "
-        "implemented yet; paddle.vision.ops.roi_align/grid_sample "
-        "cover the sampling primitives")
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """Static-graph deformable conv (reference static/nn/common.py:171):
+    creates the filter/bias parameters and delegates to the r3
+    vision.ops.deform_conv2d sampling kernel (mask=None => v1)."""
+    from ..vision.ops import DeformConv2D
+    in_channels = int(x.shape[1])
+    layer = DeformConv2D(in_channels, num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+    return layer(x, offset, mask)
 
 
 def multi_box_head(*a, **k):

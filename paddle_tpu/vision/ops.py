@@ -19,7 +19,9 @@ from ..core.tensor import Tensor
 
 __all__ = ["nms", "roi_align", "roi_pool", "psroi_pool", "yolo_box",
            "box_coder", "prior_box", "RoIAlign", "RoIPool", "PSRoIPool",
-           "ConvNormActivation"]
+           "ConvNormActivation", "yolo_loss", "deform_conv2d",
+           "DeformConv2D", "matrix_nms", "distribute_fpn_proposals",
+           "generate_proposals", "read_file", "decode_jpeg"]
 
 
 def _raw(x):
@@ -376,3 +378,571 @@ class ConvNormActivation(Sequential):
         if activation_layer is not None:
             layers.append(activation_layer())
         super().__init__(*layers)
+
+
+# ---- Detection training/postprocess ops (round-3 additions) ------------
+from ..ops.op_registry import op as _op  # noqa: E402
+
+
+def _bce_logits(x, label):
+    """Numerically-stable sigmoid cross entropy, elementwise — the
+    shared nn.functional impl with reduction='none'."""
+    from ..nn.functional.loss import binary_cross_entropy_with_logits
+    return binary_cross_entropy_with_logits.raw(x, label, reduction="none")
+
+
+def _cxcywh_iou(b1, b2):
+    """IoU of boxes given as (cx, cy, w, h), broadcasting
+    (reference yolov3_loss_kernel.cc:83 CalcBoxIoU)."""
+    w = jnp.minimum(b1[..., 0] + b1[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2) \
+        - jnp.maximum(b1[..., 0] - b1[..., 2] / 2, b2[..., 0] - b2[..., 2] / 2)
+    h = jnp.minimum(b1[..., 1] + b1[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2) \
+        - jnp.maximum(b1[..., 1] - b1[..., 3] / 2, b2[..., 1] - b2[..., 3] / 2)
+    inter = jnp.where((w < 0) | (h < 0), 0.0, w * h)
+    union = b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter
+    return inter / union
+
+
+def _yolo_loss_impl(x, gt_box, gt_label, gt_score, *, anchors, anchor_mask,
+                    class_num, ignore_thresh, downsample_ratio,
+                    use_label_smooth, scale_x_y):
+    """YOLOv3 loss, vectorized (reference semantics:
+    phi/kernels/cpu/yolov3_loss_kernel.cc:181 Yolov3LossKernel).
+
+    Matching/masks are computed under stop_gradient, mirroring the
+    reference grad kernel which treats the objectness/match masks as
+    constants; gradients flow only through the predicted entries."""
+    x = x.astype(jnp.float32)
+    n, _, h, w = x.shape
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    b = gt_box.shape[1]
+    input_size = downsample_ratio * h
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+    anc = jnp.asarray(anchors, jnp.float32).reshape(an_num, 2)
+    amask = jnp.asarray(anchor_mask, jnp.int32)
+
+    xr = x.reshape(n, mask_num, 5 + class_num, h, w)
+    gt_box = gt_box.astype(jnp.float32)
+    gt_score = (jnp.ones((n, b), jnp.float32) if gt_score is None
+                else gt_score.astype(jnp.float32))
+    valid = (gt_box[..., 2] >= 1e-6) & (gt_box[..., 3] >= 1e-6)  # [N, B]
+
+    # --- ignore mask: best IoU of each predicted box vs any valid gt
+    gx, gy = jnp.meshgrid(jnp.arange(w), jnp.arange(h), indexing="xy")
+    stop = jax.lax.stop_gradient
+    px = (gx + jax.nn.sigmoid(stop(xr[:, :, 0])) * scale + bias) / h
+    py = (gy + jax.nn.sigmoid(stop(xr[:, :, 1])) * scale + bias) / h
+    pw = jnp.exp(stop(xr[:, :, 2])) * anc[amask, 0][None, :, None, None] \
+        / input_size
+    ph = jnp.exp(stop(xr[:, :, 3])) * anc[amask, 1][None, :, None, None] \
+        / input_size
+    pred = jnp.stack([px, py, pw, ph], axis=-1)      # [N, A, H, W, 4]
+    iou = _cxcywh_iou(pred[:, :, :, :, None, :],
+                      gt_box[:, None, None, None, :, :])  # [N,A,H,W,B]
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1) if b else jnp.zeros_like(px)
+    ignore = best_iou > ignore_thresh                # [N, A, H, W]
+
+    # --- per-gt best anchor (width/height IoU at origin, all anchors)
+    aw = anc[:, 0] / input_size
+    ah = anc[:, 1] / input_size
+    inter = jnp.minimum(gt_box[..., 2:3], aw) * \
+        jnp.minimum(gt_box[..., 3:4], ah)            # [N, B, an_num]
+    union = gt_box[..., 2:3] * gt_box[..., 3:4] + aw * ah - inter
+    wh_iou = inter / union
+    best_n = jnp.argmax(wh_iou, axis=-1)             # [N, B] first-max
+    in_mask = best_n[..., None] == amask[None, None, :]
+    mask_idx = jnp.where(in_mask.any(-1),
+                         jnp.argmax(in_mask, -1), -1)  # [N, B]
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    pos = valid & (mask_idx >= 0)                    # [N, B]
+    safe_mask = jnp.maximum(mask_idx, 0)
+    safe_n = jnp.where(pos, best_n, 0)               # global anchor idx
+
+    nn_idx = jnp.arange(n)[:, None]
+
+    def gather_entry(c):
+        # xr[n, mask_idx, c, gj, gi] -> [N, B]
+        return xr[nn_idx, safe_mask, c, gj, gi]
+
+    tx_t = gt_box[..., 0] * w - gi
+    ty_t = gt_box[..., 1] * h - gj
+    tw_t = jnp.log(jnp.where(pos, gt_box[..., 2], 1.0)
+                   * input_size / anc[safe_n, 0])
+    th_t = jnp.log(jnp.where(pos, gt_box[..., 3], 1.0)
+                   * input_size / anc[safe_n, 1])
+    box_scale = (2.0 - gt_box[..., 2] * gt_box[..., 3]) * gt_score
+    loc = (_bce_logits(gather_entry(0), tx_t)
+           + _bce_logits(gather_entry(1), ty_t)
+           + jnp.abs(tw_t - gather_entry(2))
+           + jnp.abs(th_t - gather_entry(3))) * box_scale
+    loc = jnp.where(pos, loc, 0.0).sum(axis=1)       # [N]
+
+    if use_label_smooth:
+        smooth = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - smooth, smooth
+    else:
+        label_pos, label_neg = 1.0, 0.0
+    cls_pred = xr[nn_idx[..., None], safe_mask[..., None],
+                  5 + jnp.arange(class_num)[None, None, :],
+                  gj[..., None], gi[..., None]]      # [N, B, class_num]
+    cls_t = jnp.where(
+        jnp.arange(class_num)[None, None, :] == gt_label[..., None],
+        label_pos, label_neg)
+    cls = (_bce_logits(cls_pred, cls_t).sum(-1)) * gt_score
+    cls = jnp.where(pos, cls, 0.0).sum(axis=1)       # [N]
+
+    # --- objectness mask: 0 / -1 (ignored) / score (positive, last
+    # write per gt wins — sequential over B to match reference order)
+    obj_mask = jnp.where(ignore, -1.0, 0.0)          # [N, A, H, W]
+    obj_mask = stop(obj_mask)
+
+    def write_t(t, m):
+        mi, j_, i_ = safe_mask[:, t], gj[:, t], gi[:, t]
+        cur = m[nn_idx[:, 0], mi, j_, i_]
+        val = jnp.where(pos[:, t], gt_score[:, t], cur)
+        return m.at[nn_idx[:, 0], mi, j_, i_].set(val)
+
+    obj_mask = jax.lax.fori_loop(0, b, lambda t, m: write_t(t, m),
+                                 obj_mask) if b else obj_mask
+    obj_pred = xr[:, :, 4]
+    obj_loss = jnp.where(
+        obj_mask > 1e-5, _bce_logits(obj_pred, 1.0) * obj_mask,
+        jnp.where(obj_mask > -0.5, _bce_logits(obj_pred, 0.0), 0.0))
+    obj = obj_loss.sum(axis=(1, 2, 3))               # [N]
+    return loc + cls + obj
+
+
+@_op("yolo_loss")
+def _yolo_loss_op(x, gt_box, gt_label, gt_score=None, *, anchors,
+                  anchor_mask, class_num, ignore_thresh, downsample_ratio,
+                  use_label_smooth=True, scale_x_y=1.0):
+    return _yolo_loss_impl(
+        x, gt_box, gt_label, gt_score, anchors=tuple(anchors),
+        anchor_mask=tuple(anchor_mask), class_num=class_num,
+        ignore_thresh=float(ignore_thresh),
+        downsample_ratio=int(downsample_ratio),
+        use_label_smooth=bool(use_label_smooth),
+        scale_x_y=float(scale_x_y))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference vision/ops.py:52 yolo_loss over
+    phi/kernels/cpu/yolov3_loss_kernel.cc). Returns per-image loss [N]."""
+    gt_label = _raw(gt_label).astype(jnp.int32)
+    args = [x, _raw(gt_box), Tensor(gt_label)]
+    if gt_score is not None:
+        args.append(gt_score)
+    return _yolo_loss_op(
+        *args, anchors=anchors, anchor_mask=anchor_mask,
+        class_num=class_num, ignore_thresh=ignore_thresh,
+        downsample_ratio=downsample_ratio,
+        use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)
+
+
+def _deform_conv2d_impl(x, offset, weight, bias, mask, *, stride, padding,
+                        dilation, deformable_groups, groups):
+    """Deformable conv v1/v2 via bilinear gather + einsum (reference
+    vision/ops.py:858 deform_conv2d over phi deform_conv kernels).
+    Offset channels are (dy, dx) pairs per kernel point, matching the
+    reference's modulated_deformable_im2col layout."""
+    xf = x.astype(jnp.float32)
+    n, cin, h, w = xf.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    hout = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    wout = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    dg = deformable_groups
+    k = kh * kw
+
+    off = offset.astype(jnp.float32).reshape(n, dg, k, 2, hout, wout)
+    dy, dx = off[:, :, :, 0], off[:, :, :, 1]        # [N, dg, K, Ho, Wo]
+    base_y = (jnp.arange(hout) * sh - ph)[:, None] \
+        + (jnp.arange(kh) * dh)[None, :]             # [Ho, kh]
+    base_x = (jnp.arange(wout) * sw - pw)[:, None] \
+        + (jnp.arange(kw) * dw)[None, :]             # [Wo, kw]
+    ky = jnp.repeat(jnp.arange(kh), kw)
+    kx = jnp.tile(jnp.arange(kw), kh)
+    yy = base_y[:, ky].T[None, None, :, :, None] + dy  # [N,dg,K,Ho,Wo]
+    xx = base_x[:, kx].T[None, None, :, None, :] + dx  # [N,dg,K,Ho,Wo]
+
+    def bil(xg, ys, xs):
+        """xg [N, dg, Cg, H, W]; ys/xs [N, dg, K, Ho, Wo] -> samples
+        [N, dg, Cg, K, Ho, Wo] with zero padding outside."""
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+        out = 0.0
+        for (yi, wyi) in ((y0, 1.0 - wy), (y0 + 1, wy)):
+            for (xi, wxi) in ((x0, 1.0 - wx), (x0 + 1, wx)):
+                inb = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                flat = xg.reshape(n, dg, xg.shape[2], h * w)
+                idx = (yc * w + xc).reshape(n, dg, -1)
+                g = jnp.take_along_axis(
+                    flat, idx[:, :, None, :], axis=3).reshape(
+                    n, dg, xg.shape[2], *ys.shape[2:])
+                out = out + g * (wyi * wxi * inb)[:, :, None]
+        return out
+
+    xg = xf.reshape(n, dg, cin // dg, h, w)
+    col = bil(xg, yy, xx)                            # [N,dg,Cg,K,Ho,Wo]
+    if mask is not None:
+        m = mask.astype(jnp.float32).reshape(n, dg, 1, k, hout, wout)
+        col = col * m
+    col = col.reshape(n, cin, k, hout, wout)
+    # grouped conv over the sampled columns
+    colg = col.reshape(n, groups, cin // groups, k, hout, wout)
+    wg = weight.astype(jnp.float32).reshape(
+        groups, cout // groups, cin_g, k)
+    out = jnp.einsum("ngckhw,gock->ngohw", colg, wg,
+                     precision=jax.lax.Precision.HIGHEST)
+    out = out.reshape(n, cout, hout, wout)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32).reshape(1, cout, 1, 1)
+    return out.astype(x.dtype)
+
+
+@_op("deform_conv2d")
+def _deform_conv2d_op(x, offset, weight, bias=None, mask=None, *,
+                      stride, padding, dilation, deformable_groups, groups):
+    return _deform_conv2d_impl(
+        x, offset, weight, bias, mask, stride=stride, padding=padding,
+        dilation=dilation, deformable_groups=deformable_groups,
+        groups=groups)
+
+
+from ..nn.layers_extra import _pair as _nn_pair  # noqa: E402
+
+
+def _pair(v):
+    return tuple(int(i) for i in _nn_pair(v))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (mask=None) / v2 (reference
+    vision/ops.py:858). x [N,Cin,H,W], offset
+    [N, 2*deformable_groups*kH*kW, Hout, Wout]."""
+    args = dict(stride=_pair(stride), padding=_pair(padding),
+                dilation=_pair(dilation),
+                deformable_groups=int(deformable_groups),
+                groups=int(groups))
+    # dispatch tree-flattens args, so None bias/mask pass through fine
+    return _deform_conv2d_op(x, offset, weight, bias, mask, **args)
+
+
+class DeformConv2D(Layer):
+    """Deformable conv layer (reference vision/ops.py:1096)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        kh, kw = _pair(kernel_size)
+        self._stride = _pair(stride)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = in_channels * kh * kw // groups
+        bound = 1.0 / fan_in ** 0.5
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, self._stride,
+            self._padding, self._dilation, self._deformable_groups,
+            self._groups, mask)
+
+
+def _xyxy_area(box, normalized):
+    """BBoxArea (reference phi/kernels/cpu/matrix_nms_kernel.cc:23)."""
+    w, h = box[2] - box[0], box[3] - box[1]
+    if w < 0 or h < 0:
+        return 0.0
+    return w * h if normalized else (w + 1) * (h + 1)
+
+
+def _xyxy_iou(b1, b2, normalized):
+    """JaccardOverlap (reference matrix_nms_kernel.cc:41)."""
+    if b2[0] > b1[2] or b2[2] < b1[0] or b2[1] > b1[3] or b2[3] < b1[1]:
+        return 0.0
+    norm = 0.0 if normalized else 1.0
+    iw = min(b1[2], b2[2]) - max(b1[0], b2[0]) + norm
+    ih = min(b1[3], b2[3]) - max(b1[1], b2[1]) + norm
+    inter = iw * ih
+    union = _xyxy_area(b1, normalized) + _xyxy_area(b2, normalized) - inter
+    return inter / union
+
+
+def _xyxy_iou_mat(a, b, normalized):
+    """Vectorized JaccardOverlap: [Na, 4] x [Nb, 4] -> [Na, Nb] numpy
+    (same semantics as _xyxy_iou, incl. the strict-disjoint zero and
+    the +1 un-normalized offset)."""
+    norm = 0.0 if normalized else 1.0
+
+    def area(x):
+        w, h = x[:, 2] - x[:, 0], x[:, 3] - x[:, 1]
+        return np.where((w < 0) | (h < 0), 0.0, (w + norm) * (h + norm))
+
+    iw = np.minimum(a[:, None, 2], b[None, :, 2]) \
+        - np.maximum(a[:, None, 0], b[None, :, 0]) + norm
+    ih = np.minimum(a[:, None, 3], b[None, :, 3]) \
+        - np.maximum(a[:, None, 1], b[None, :, 1]) + norm
+    inter = iw * ih
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    disjoint = (b[None, :, 0] > a[:, None, 2]) \
+        | (b[None, :, 2] < a[:, None, 0]) \
+        | (b[None, :, 1] > a[:, None, 3]) \
+        | (b[None, :, 3] < a[:, None, 1])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        iou = inter / union
+    return np.where(disjoint, 0.0, iou)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS — decay-based soft suppression (reference
+    vision/ops.py:2430 over phi/kernels/cpu/matrix_nms_kernel.cc:244).
+    Host-side: the output count is data-dependent, the same dynamic-
+    shape boundary the reference's -1-shaped outputs draw.
+
+    bboxes [N, M, 4], scores [N, C, M]. Returns (Out [No, 6],
+    Index [No, 1]?, RoisNum [N]?) per the return_* flags."""
+    bb = np.asarray(_raw(bboxes), np.float64)
+    sc = np.asarray(_raw(scores), np.float64)
+    n, c, m = sc.shape
+    out_rows, out_index, rois_num = [], [], []
+    for i in range(n):
+        all_idx, all_scores, all_classes = [], [], []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[i, cls]
+            cand = np.flatnonzero(s > score_threshold)
+            if cand.size == 0:
+                continue
+            cand = cand[np.argsort(-s[cand], kind="stable")]
+            if 0 <= nms_top_k < cand.size:
+                cand = cand[:nms_top_k]
+            num = cand.size
+            cboxes = bb[i, cand]
+            ious = np.tril(_xyxy_iou_mat(cboxes, cboxes, normalized), -1)
+            tri = np.tril(np.ones((num, num), bool), -1)
+            iou_max = np.where(tri, ious, -np.inf).max(axis=1,
+                                                       initial=0.0)
+            iou_max = np.maximum(iou_max, 0.0)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                if use_gaussian:
+                    decay = np.exp((iou_max[None, :] ** 2 - ious ** 2)
+                                   * gaussian_sigma)
+                else:
+                    decay = (1.0 - ious) / (1.0 - iou_max[None, :])
+            # exact duplicates (iou = max_iou = 1) decay to zero; the
+            # reference C++ hits 0/0 there — documented tie-break
+            decay = np.nan_to_num(decay, nan=0.0, posinf=np.inf)
+            min_decay = np.where(tri, decay, np.inf).min(axis=1,
+                                                         initial=1.0)
+            min_decay = np.minimum(min_decay, 1.0)
+            min_decay[0] = 1.0
+            ds_all = min_decay * s[cand]
+            for a in np.flatnonzero(ds_all > post_threshold):
+                all_idx.append(cand[a])
+                all_scores.append(ds_all[a])
+                all_classes.append(cls)
+        num_det = len(all_idx)
+        if keep_top_k > -1:
+            num_det = min(num_det, keep_top_k)
+        order = np.argsort(-np.asarray(all_scores), kind="stable")[:num_det]
+        rois_num.append(len(order))
+        for p in order:
+            out_rows.append([all_classes[p], all_scores[p], *bb[i, all_idx[p]]])
+            out_index.append(i * m + all_idx[p])
+    dt = np.asarray(_raw(bboxes)).dtype
+    out = Tensor(jnp.asarray(np.asarray(out_rows, np.float64).reshape(-1, 6),
+                             dt))
+    ret = [out]
+    if return_index:
+        ret.append(Tensor(jnp.asarray(
+            np.asarray(out_index, np.int32).reshape(-1, 1))))
+    if return_rois_num:
+        ret.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return ret[0] if len(ret) == 1 else tuple(ret)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Split RoIs across FPN levels by scale (reference vision/ops.py:1296
+    over phi distribute_fpn_proposals: tgt_lvl =
+    floor(log2(sqrt(area)/refer_scale + 1e-6) + refer_level), clipped)."""
+    rois = np.asarray(_raw(fpn_rois), np.float64)
+    num_level = max_level - min_level + 1
+    if rois_num is not None:
+        per_img = np.asarray(_raw(rois_num), np.int64)
+    else:
+        per_img = np.asarray([rois.shape[0]], np.int64)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    area = np.where((w < 0) | (h < 0), 0.0, w * h)
+    scale = np.sqrt(area)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    img_of_roi = np.repeat(np.arange(len(per_img)), per_img)
+    multi_rois, level_nums, restore_src = [], [], []
+    for L in range(min_level, max_level + 1):
+        sel = np.flatnonzero(lvl == L)  # stable: image-major order kept
+        multi_rois.append(Tensor(jnp.asarray(
+            rois[sel], np.asarray(_raw(fpn_rois)).dtype).reshape(-1, 4)))
+        level_nums.append(Tensor(jnp.asarray(np.bincount(
+            img_of_roi[sel], minlength=len(per_img)).astype(np.int32))))
+        restore_src.extend(sel.tolist())
+    restore = np.empty(rois.shape[0], np.int32)
+    restore[np.asarray(restore_src, np.int64)] = \
+        np.arange(rois.shape[0], dtype=np.int32)
+    restore_ind = Tensor(jnp.asarray(restore.reshape(-1, 1)))
+    if rois_num is not None:
+        return multi_rois, restore_ind, level_nums
+    return multi_rois, restore_ind
+
+
+_BBOX_CLIP = float(np.log(1000.0 / 16.0))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference vision/ops.py:2241 over
+    phi/kernels/cpu/generate_proposals_v2_kernel.cc): decode deltas
+    against anchors with variances, clip to image, filter small boxes,
+    greedy NMS. Host-side eager op (dynamic output count)."""
+    sc = np.asarray(_raw(scores), np.float64)          # [N, A, H, W]
+    bd = np.asarray(_raw(bbox_deltas), np.float64)     # [N, 4A, H, W]
+    im = np.asarray(_raw(img_size), np.float64)        # [N, 2] (h, w)
+    an = np.asarray(_raw(anchors), np.float64).reshape(-1, 4)
+    var = np.asarray(_raw(variances), np.float64).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, rois_nums = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)       # HWA order
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")
+        if 0 < pre_nms_top_n < order.size:
+            order = order[:pre_nms_top_n]
+        s_sel, d_sel = s[order], d[order]
+        an_sel, var_sel = an[order], var[order]
+        # BoxCoder (generate_proposals_v2_kernel.cc:114)
+        aw = an_sel[:, 2] - an_sel[:, 0] + off
+        ah = an_sel[:, 3] - an_sel[:, 1] + off
+        acx = an_sel[:, 0] + 0.5 * aw
+        acy = an_sel[:, 1] + 0.5 * ah
+        cx = var_sel[:, 0] * d_sel[:, 0] * aw + acx
+        cy = var_sel[:, 1] * d_sel[:, 1] * ah + acy
+        bw = np.exp(np.minimum(var_sel[:, 2] * d_sel[:, 2], _BBOX_CLIP)) * aw
+        bh = np.exp(np.minimum(var_sel[:, 3] * d_sel[:, 3], _BBOX_CLIP)) * ah
+        props = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+        # clip to image (is_scale=False in the v2 kernel)
+        im_h, im_w = im[i, 0], im[i, 1]
+        props[:, 0] = np.clip(props[:, 0], 0, im_w - off)
+        props[:, 1] = np.clip(props[:, 1], 0, im_h - off)
+        props[:, 2] = np.clip(props[:, 2], 0, im_w - off)
+        props[:, 3] = np.clip(props[:, 3], 0, im_h - off)
+        # FilterBoxes (v2: is_scale=False)
+        ms = max(min_size, 1.0)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            keep &= (props[:, 0] + ws / 2 <= im_w) & \
+                    (props[:, 1] + hs / 2 <= im_h)
+        keep = np.flatnonzero(keep)
+        props, s_keep = props[keep], s_sel[keep]
+        # greedy NMS with eta-adaptive threshold; candidate-vs-kept
+        # IoU is one vectorized row per candidate
+        sel, thr = [], nms_thresh
+        kept_boxes = np.zeros((0, 4))
+        for j in range(props.shape[0]):
+            if kept_boxes.shape[0] and _xyxy_iou_mat(
+                    props[j:j + 1], kept_boxes,
+                    normalized=not pixel_offset).max() > thr:
+                continue
+            sel.append(j)
+            kept_boxes = props[np.asarray(sel, np.int64)]
+            if len(sel) >= post_nms_top_n > 0:
+                break
+            if thr > 0.5:
+                thr *= eta
+        sel = np.asarray(sel, np.int64)
+        all_rois.append(props[sel])
+        all_probs.append(s_keep[sel])
+        rois_nums.append(len(sel))
+    dt = np.asarray(_raw(scores)).dtype
+    rois = Tensor(jnp.asarray(
+        np.concatenate(all_rois, 0) if all_rois else
+        np.zeros((0, 4)), dt).reshape(-1, 4))
+    probs = Tensor(jnp.asarray(
+        np.concatenate(all_probs, 0) if all_probs else
+        np.zeros((0,)), dt).reshape(-1, 1))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(
+            np.asarray(rois_nums, np.int32)))
+    return rois, probs
+
+
+def read_file(filename, name=None):
+    """Read raw file bytes into a uint8 1-D tensor (reference
+    vision/ops.py:1456)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (reference
+    vision/ops.py:1501; the reference uses nvjpeg — here PIL on host,
+    the honest decode path for a TPU-side framework where image IO is
+    host work)."""
+    import io as _io
+
+    from PIL import Image
+    raw = np.asarray(_raw(x)).astype(np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(raw))
+    if mode != "unchanged":
+        conv = {"gray": "L", "grey": "L", "rgb": "RGB"}.get(
+            str(mode).lower())
+        if conv is None:
+            raise ValueError(f"decode_jpeg: unsupported mode {mode!r}")
+        img = img.convert(conv)
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

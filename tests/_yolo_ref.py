@@ -1,0 +1,107 @@
+"""Plain-numpy port of the reference YOLOv3 loss CPU kernel
+(phi/kernels/cpu/yolov3_loss_kernel.cc) — golden oracle for tests only."""
+import numpy as np
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _bce(x, label):
+    return max(x, 0.0) - x * label + np.log1p(np.exp(-abs(x)))
+
+
+def _iou(b1, b2):
+    def overlap(c1, w1, c2, w2):
+        left = max(c1 - w1 / 2, c2 - w2 / 2)
+        right = min(c1 + w1 / 2, c2 + w2 / 2)
+        return right - left
+    w = overlap(b1[0], b1[2], b2[0], b2[2])
+    h = overlap(b1[1], b1[3], b2[1], b2[3])
+    inter = 0.0 if (w < 0 or h < 0) else w * h
+    union = b1[2] * b1[3] + b2[2] * b2[3] - inter
+    return inter / union
+
+
+def yolo_loss_ref(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                  ignore_thresh, downsample_ratio, gt_score=None,
+                  use_label_smooth=True, scale_x_y=1.0):
+    n, _, h, w = x.shape
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    b = gt_box.shape[1]
+    input_size = downsample_ratio * h
+    scale = scale_x_y
+    bias = -0.5 * (scale - 1.0)
+    if gt_score is None:
+        gt_score = np.ones((n, b), np.float64)
+    if use_label_smooth:
+        smooth = min(1.0 / class_num, 1.0 / 40)
+        pos, neg = 1.0 - smooth, smooth
+    else:
+        pos, neg = 1.0, 0.0
+
+    xr = x.reshape(n, mask_num, 5 + class_num, h, w).astype(np.float64)
+    loss = np.zeros(n, np.float64)
+    obj_mask = np.zeros((n, mask_num, h, w), np.float64)
+    valid = (gt_box[..., 2] >= 1e-6) & (gt_box[..., 3] >= 1e-6)
+
+    for i in range(n):
+        for j in range(mask_num):
+            for k in range(h):
+                for l in range(w):
+                    px = (l + _sigmoid(xr[i, j, 0, k, l]) * scale + bias) / h
+                    py = (k + _sigmoid(xr[i, j, 1, k, l]) * scale + bias) / h
+                    pw = np.exp(xr[i, j, 2, k, l]) \
+                        * anchors[2 * anchor_mask[j]] / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) \
+                        * anchors[2 * anchor_mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(b):
+                        if not valid[i, t]:
+                            continue
+                        best = max(best, _iou((px, py, pw, ph), gt_box[i, t]))
+                    if best > ignore_thresh:
+                        obj_mask[i, j, k, l] = -1.0
+        for t in range(b):
+            if not valid[i, t]:
+                continue
+            gt = gt_box[i, t]
+            gi, gj = int(gt[0] * w), int(gt[1] * h)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                an = (0.0, 0.0, anchors[2 * a] / input_size,
+                      anchors[2 * a + 1] / input_size)
+                iou = _iou(an, (0.0, 0.0, gt[2], gt[3]))
+                if iou > best_iou:
+                    best_iou, best_n = iou, a
+            mask_idx = anchor_mask.index(best_n) \
+                if best_n in anchor_mask else -1
+            if mask_idx < 0:
+                continue
+            score = gt_score[i, t]
+            tx = gt[0] * w - gi
+            ty = gt[1] * h - gj
+            tw = np.log(gt[2] * input_size / anchors[2 * best_n])
+            th = np.log(gt[3] * input_size / anchors[2 * best_n + 1])
+            sc = (2.0 - gt[2] * gt[3]) * score
+            loss[i] += _bce(xr[i, mask_idx, 0, gj, gi], tx) * sc
+            loss[i] += _bce(xr[i, mask_idx, 1, gj, gi], ty) * sc
+            loss[i] += abs(tw - xr[i, mask_idx, 2, gj, gi]) * sc
+            loss[i] += abs(th - xr[i, mask_idx, 3, gj, gi]) * sc
+            obj_mask[i, mask_idx, gj, gi] = score
+            label = int(gt_label[i, t])
+            for c in range(class_num):
+                loss[i] += _bce(xr[i, mask_idx, 5 + c, gj, gi],
+                                pos if c == label else neg) * score
+    for i in range(n):
+        for j in range(mask_num):
+            for k in range(h):
+                for l in range(w):
+                    o = obj_mask[i, j, k, l]
+                    p = xr[i, j, 4, k, l]
+                    if o > 1e-5:
+                        loss[i] += _bce(p, 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += _bce(p, 0.0)
+    return loss

@@ -1,0 +1,371 @@
+"""Golden tests for the round-3 detection op set (VERDICT r2 Next #3):
+yolo_loss, deform_conv2d, matrix_nms, distribute_fpn_proposals,
+generate_proposals, read_file/decode_jpeg."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision.ops import (DeformConv2D, decode_jpeg,
+                                   deform_conv2d, distribute_fpn_proposals,
+                                   generate_proposals, matrix_nms,
+                                   read_file, yolo_loss)
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _yolo_ref import yolo_loss_ref  # noqa: E402
+
+
+ANCHORS9 = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+            59, 119, 116, 90, 156, 198, 373, 326]
+
+
+class TestYoloLoss:
+    def _data(self, seed, n=2, b=5, h=8, w=8, cls=4, mask_num=3):
+        rng = np.random.RandomState(seed)
+        x = rng.randn(n, mask_num * (5 + cls), h, w).astype(np.float32) * 0.5
+        gt = rng.rand(n, b, 4).astype(np.float32)
+        gt[..., 2:] = gt[..., 2:] * 0.5 + 0.05
+        gt[..., :2] = gt[..., :2] * 0.8 + 0.1
+        gt[0, -1] = 0.0  # invalid box
+        lab = rng.randint(0, cls, (n, b)).astype(np.int32)
+        return x, gt, lab
+
+    @pytest.mark.parametrize("mask", [[0, 1, 2], [6, 7, 8], [3, 4, 5]])
+    def test_matches_reference_kernel(self, mask):
+        x, gt, lab = self._data(0)
+        ref = yolo_loss_ref(x.astype(np.float64), gt.astype(np.float64),
+                            lab, ANCHORS9, mask, 4, 0.7, 32)
+        got = np.asarray(yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gt),
+            paddle.to_tensor(lab), ANCHORS9, mask, 4, 0.7, 32).data)
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_gt_score_and_no_smooth(self):
+        x, gt, lab = self._data(1)
+        rng = np.random.RandomState(9)
+        score = rng.rand(2, 5).astype(np.float32)
+        ref = yolo_loss_ref(x.astype(np.float64), gt.astype(np.float64),
+                            lab, ANCHORS9, [0, 1, 2], 4, 0.5, 32,
+                            gt_score=score.astype(np.float64),
+                            use_label_smooth=False)
+        got = np.asarray(yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gt),
+            paddle.to_tensor(lab), ANCHORS9, [0, 1, 2], 4, 0.5, 32,
+            gt_score=paddle.to_tensor(score),
+            use_label_smooth=False).data)
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_scale_x_y(self):
+        x, gt, lab = self._data(2)
+        ref = yolo_loss_ref(x.astype(np.float64), gt.astype(np.float64),
+                            lab, ANCHORS9, [1, 2, 3], 4, 0.7, 32,
+                            scale_x_y=1.05)
+        got = np.asarray(yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gt),
+            paddle.to_tensor(lab), ANCHORS9, [1, 2, 3], 4, 0.7, 32,
+            scale_x_y=1.05).data)
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_gradients_finite_difference(self):
+        x, gt, lab = self._data(3, n=1, b=3, h=4, w=4, cls=3)
+        anchors = ANCHORS9[:6]
+        mask = [0, 1, 2]
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        loss = yolo_loss(xt, paddle.to_tensor(gt), paddle.to_tensor(lab),
+                         anchors, mask, 3, 0.7, 32).sum()
+        loss.backward()
+        g = np.asarray(xt.grad.data)
+        rng = np.random.RandomState(0)
+        eps = 1e-3
+        checked = 0
+        for _ in range(12):
+            idx = tuple(rng.randint(0, s) for s in x.shape)
+            xp, xm = x.copy(), x.copy()
+            xp[idx] += eps
+            xm[idx] -= eps
+            fp = yolo_loss_ref(xp.astype(np.float64),
+                               gt.astype(np.float64), lab, anchors, mask,
+                               3, 0.7, 32).sum()
+            fm = yolo_loss_ref(xm.astype(np.float64),
+                               gt.astype(np.float64), lab, anchors, mask,
+                               3, 0.7, 32).sum()
+            fd = (fp - fm) / (2 * eps)
+            assert abs(fd - g[idx]) < 2e-2 + 0.02 * abs(fd), (idx, fd, g[idx])
+            checked += abs(fd) > 1e-6
+        assert checked >= 3  # at least some non-zero-grad entries hit
+
+    def test_trains_down(self):
+        paddle.seed(0)
+        head = nn.Conv2D(8, 3 * 9, 1)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=head.parameters())
+        rng = np.random.RandomState(2)
+        feat = paddle.to_tensor(rng.randn(2, 8, 8, 8).astype(np.float32))
+        gtb = paddle.to_tensor(np.asarray(
+            [[[0.4, 0.4, 0.3, 0.35]], [[0.6, 0.5, 0.2, 0.2]]], np.float32))
+        gtl = paddle.to_tensor(np.zeros((2, 1), np.int32))
+        first = last = None
+        for _ in range(12):
+            loss = yolo_loss(head(feat), gtb, gtl, ANCHORS9[:6], [0, 1, 2],
+                             4, 0.7, 32).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first * 0.8
+
+
+class TestDeformConv2D:
+    def _oracle(self, x, off, wt, msk, stride, pad, dil, groups, dg):
+        n, cin, h, w = x.shape
+        cout, _, kh, kw = wt.shape
+        hout = (h + 2 * pad[0] - (dil[0] * (kh - 1) + 1)) // stride[0] + 1
+        wout = (w + 2 * pad[1] - (dil[1] * (kw - 1) + 1)) // stride[1] + 1
+        out = np.zeros((n, cout, hout, wout))
+        cg, cpg = cin // groups, cin // dg
+        for b in range(n):
+            for co in range(cout):
+                g = co // (cout // groups)
+                for ho in range(hout):
+                    for wo in range(wout):
+                        acc = 0.0
+                        for ci in range(cg):
+                            cif = g * cg + ci
+                            d = cif // cpg
+                            for i in range(kh):
+                                for j in range(kw):
+                                    p = i * kw + j
+                                    py = ho * stride[0] - pad[0] \
+                                        + i * dil[0] \
+                                        + off[b, d * 2 * kh * kw + 2 * p,
+                                              ho, wo]
+                                    px = wo * stride[1] - pad[1] \
+                                        + j * dil[1] \
+                                        + off[b, d * 2 * kh * kw + 2 * p + 1,
+                                              ho, wo]
+                                    y0 = int(np.floor(py))
+                                    x0 = int(np.floor(px))
+                                    v = 0.0
+                                    for yi, wy in ((y0, 1 - (py - y0)),
+                                                   (y0 + 1, py - y0)):
+                                        for xi, wx in ((x0, 1 - (px - x0)),
+                                                       (x0 + 1, px - x0)):
+                                            if 0 <= yi < h and 0 <= xi < w:
+                                                v += x[b, cif, yi, xi] \
+                                                    * wy * wx
+                                    if msk is not None:
+                                        v *= msk[b, d * kh * kw + p, ho, wo]
+                                    acc += v * wt[co, ci, i, j]
+                        out[b, co, ho, wo] = acc
+        return out
+
+    @pytest.mark.parametrize("groups,dg,use_mask", [
+        (1, 1, False), (1, 1, True), (2, 1, False), (1, 2, True),
+        (2, 2, True)])
+    def test_matches_naive_oracle(self, groups, dg, use_mask):
+        rng = np.random.RandomState(groups * 7 + dg)
+        n, cin, h, w = 2, 4, 7, 6
+        cout, kh, kw = 6, 3, 3
+        stride, pad, dil = (2, 1), (1, 2), (1, 1)
+        hout = (h + 2 * pad[0] - (dil[0] * (kh - 1) + 1)) // stride[0] + 1
+        wout = (w + 2 * pad[1] - (dil[1] * (kw - 1) + 1)) // stride[1] + 1
+        x = rng.randn(n, cin, h, w).astype(np.float32)
+        off = (rng.randn(n, 2 * dg * kh * kw, hout, wout) * 1.5) \
+            .astype(np.float32)
+        msk = rng.rand(n, dg * kh * kw, hout, wout).astype(np.float32) \
+            if use_mask else None
+        wt = rng.randn(cout, cin // groups, kh, kw).astype(np.float32)
+        ref = self._oracle(x.astype(np.float64), off.astype(np.float64),
+                           wt.astype(np.float64),
+                           None if msk is None else msk.astype(np.float64),
+                           stride, pad, dil, groups, dg)
+        got = np.asarray(deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off),
+            paddle.to_tensor(wt), stride=stride, padding=pad, dilation=dil,
+            deformable_groups=dg, groups=groups,
+            mask=None if msk is None else paddle.to_tensor(msk)).data)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_zero_offset_equals_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3, 8, 8).astype(np.float32)
+        wt = rng.randn(5, 3, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 8, 8), np.float32)
+        got = np.asarray(deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off),
+            paddle.to_tensor(wt), padding=1).data)
+        conv = nn.Conv2D(3, 5, 3, padding=1, bias_attr=False)
+        conv.weight.set_value(paddle.to_tensor(wt))
+        ref = np.asarray(conv(paddle.to_tensor(x)).data)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_layer_trains(self):
+        paddle.seed(0)
+        dc = DeformConv2D(4, 6, 3, padding=1)
+        offp = nn.Conv2D(4, 18, 3, padding=1)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.01,
+            parameters=dc.parameters() + offp.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(2, 4, 10, 10).astype(np.float32))
+        first = last = None
+        for _ in range(8):
+            loss = ((dc(x, offp(x)) - 1.0) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first
+
+    def test_static_nn_wrapper(self):
+        from paddle_tpu.static import nn as snn
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4, 6, 6).astype(np.float32))
+        off = paddle.zeros([2, 18, 6, 6])
+        out = snn.deform_conv2d(x, off, None, 8, 3, padding=1)
+        assert tuple(out.shape) == (2, 8, 6, 6)
+
+
+class TestMatrixNMS:
+    def test_single_survivor(self):
+        # two heavily-overlapping boxes, one distinct: decay kills none
+        # outright but scales scores; check the hand-computed decays
+        boxes = np.asarray([[[0, 0, 10, 10], [0, 0, 10, 9],
+                             [50, 50, 60, 60]]], np.float32)
+        scores = np.asarray([[[0.9, 0.8, 0.7]]], np.float32)  # 1 class
+        out, idx, num = matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=-1,
+            keep_top_k=-1, background_label=-1, return_index=True)
+        o = np.asarray(out.data)
+        assert o.shape == (3, 6)
+        assert int(np.asarray(num.data)[0]) == 3
+        # rows sorted by decayed score: 0.9, then the distinct box
+        # (undecayed 0.7), then box1 decayed by (1 - iou)
+        iou = (10 * 9) / (100 + 90 - 90)
+        np.testing.assert_allclose(
+            o[:, 1], [0.9, 0.7, 0.8 * (1 - iou)], rtol=1e-5)
+        # index points back into the flattened [N*M] box array
+        np.testing.assert_array_equal(
+            np.asarray(idx.data).ravel(), [0, 2, 1])
+
+    def test_post_threshold_and_background(self):
+        boxes = np.asarray([[[0, 0, 10, 10], [0, 0, 10, 10]]], np.float32)
+        scores = np.asarray([[[0.9, 0.85], [0.5, 0.4]]], np.float32)
+        out, num = matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.3, post_threshold=0.3, nms_top_k=-1,
+            keep_top_k=-1, background_label=0)
+        o = np.asarray(out.data)
+        # class 0 is background; class 1: second box decays to 0 (iou=1)
+        assert o.shape[0] == 1
+        assert o[0, 0] == 1.0 and abs(o[0, 1] - 0.5) < 1e-6
+
+    def test_gaussian_decay(self):
+        boxes = np.asarray([[[0, 0, 10, 10], [0, 0, 10, 9]]], np.float32)
+        scores = np.asarray([[[0.9, 0.8]]], np.float32)
+        out = matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                         0.1, 0.0, -1, -1, use_gaussian=True,
+                         gaussian_sigma=2.0, background_label=-1,
+                         return_rois_num=False)
+        o = np.asarray(out.data)
+        iou = 90 / 100
+        np.testing.assert_allclose(
+            o[1, 1], 0.8 * np.exp(-(iou ** 2) * 2.0), rtol=1e-5)
+
+
+class TestDistributeFpnProposals:
+    def test_level_assignment_and_restore(self):
+        rois = np.asarray([
+            [0, 0, 16, 16],      # sqrt(256)=16 -> low level
+            [0, 0, 224, 224],    # refer_scale -> refer_level
+            [0, 0, 448, 448],    # 2x refer -> refer_level+1
+            [0, 0, 896, 896],    # clipped at max_level
+            [0, 0, 60, 60],
+        ], np.float32)
+        rois_num = np.asarray([3, 2], np.int32)
+        multi, restore, per_level = distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224,
+            rois_num=paddle.to_tensor(rois_num))
+        assert len(multi) == 4 and len(per_level) == 4
+        sizes = [np.asarray(m.data).shape[0] for m in multi]
+        assert sum(sizes) == 5
+        # level of roi 1 (area 224^2) = floor(log2(1+eps)+4) = 4
+        lv = {}
+        for li, m in enumerate(multi):
+            for r in np.asarray(m.data):
+                lv[int(r[2])] = li + 2
+        assert lv[224] == 4 and lv[448] == 5 and lv[896] == 5 \
+            and lv[16] == 2
+        # restore index is a permutation that undoes the shuffle
+        rest = np.asarray(restore.data).ravel()
+        shuffled = np.concatenate([np.asarray(m.data) for m in multi])
+        np.testing.assert_allclose(shuffled[rest], rois)
+        # per-level counts sum per image
+        counts = np.stack([np.asarray(p.data) for p in per_level])
+        assert counts.sum() == 5
+        np.testing.assert_array_equal(counts.sum(axis=0), rois_num)
+
+
+class TestGenerateProposals:
+    def test_decode_clip_filter_nms(self):
+        rng = np.random.RandomState(0)
+        n, a, h, w = 2, 3, 4, 4
+        scores = rng.rand(n, a, h, w).astype(np.float32)
+        deltas = (rng.randn(n, 4 * a, h, w) * 0.1).astype(np.float32)
+        img = np.asarray([[64.0, 64.0], [64.0, 64.0]], np.float32)
+        base = np.stack(np.meshgrid(np.arange(w) * 16, np.arange(h) * 16,
+                                    indexing="xy"), -1).astype(np.float32)
+        anchors = np.zeros((h, w, a, 4), np.float32)
+        for k, sz in enumerate([16, 32, 48]):
+            anchors[..., k, 0] = base[..., 0]
+            anchors[..., k, 1] = base[..., 1]
+            anchors[..., k, 2] = base[..., 0] + sz
+            anchors[..., k, 3] = base[..., 1] + sz
+        var = np.ones((h, w, a, 4), np.float32)
+        rois, probs, num = generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(img), paddle.to_tensor(anchors),
+            paddle.to_tensor(var), pre_nms_top_n=30, post_nms_top_n=10,
+            nms_thresh=0.5, min_size=4.0, return_rois_num=True)
+        r = np.asarray(rois.data)
+        p = np.asarray(probs.data)
+        nm = np.asarray(num.data)
+        assert r.shape[1] == 4 and p.shape[1] == 1
+        assert nm.sum() == r.shape[0] and len(nm) == n
+        assert (nm <= 10).all()
+        # all inside image, min size respected
+        assert (r >= 0).all() and (r[:, 2] <= 64).all() \
+            and (r[:, 3] <= 64).all()
+        assert ((r[:, 2] - r[:, 0]) >= 4 - 1e-4).all()
+        # probs sorted descending within each image
+        o = 0
+        for c in nm:
+            seg = p[o:o + c, 0]
+            assert (np.diff(seg) <= 1e-6).all()
+            o += c
+
+
+class TestReadDecode:
+    def test_read_file_and_decode_jpeg(self, tmp_path):
+        from PIL import Image
+        arr = (np.random.RandomState(0).rand(12, 16, 3) * 255) \
+            .astype(np.uint8)
+        fp = str(tmp_path / "t.jpg")
+        Image.fromarray(arr).save(fp, quality=95)
+        raw = read_file(fp)
+        assert raw.dtype == paddle.uint8 and raw.ndim == 1
+        img = decode_jpeg(raw)
+        got = np.asarray(img.data)
+        assert got.shape == (3, 12, 16)
+        # exact match vs PIL's own decode of the same bytes
+        ref = np.asarray(Image.open(fp)).transpose(2, 0, 1)
+        np.testing.assert_array_equal(got, ref)
+        gray = decode_jpeg(raw, mode="gray")
+        assert np.asarray(gray.data).shape == (1, 12, 16)

@@ -237,6 +237,7 @@ def test_lu_unpack_roundtrip():
 NAMESPACE_LISTS = {
     "functional": "paddle_tpu.nn.functional",
     "distributed": "paddle_tpu.distributed",
+    "vision_ops": "paddle_tpu.vision.ops",
     "static": "paddle_tpu.static",
     "static_nn": "paddle_tpu.static.nn",
     "linalg": "paddle_tpu.linalg",
