@@ -227,12 +227,16 @@ def bench_gpt67_layer(dev, on_tpu):
 
 
 def bench_resnet50(dev, on_tpu):
+    import os
     import paddle_tpu as paddle
     from paddle_tpu import nn, optimizer
     from paddle_tpu.models.resnet import resnet50
 
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC")
+    s2d = os.environ.get("BENCH_S2D", "1") == "1"
     paddle.seed(0)
-    model = resnet50(num_classes=1000)
+    model = resnet50(num_classes=1000, data_format=layout,
+                     stem_space_to_depth=s2d)
     model.bfloat16() if on_tpu else None
     opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                              parameters=model.parameters(),
@@ -255,6 +259,7 @@ def bench_resnet50(dev, on_tpu):
     mfu = (xla_flops * iters / dt) / peak_flops(dev)
     return {
         "metric": f"resnet50 train images/sec/chip (b{b} {hw}x{hw}, "
+                  f"{layout}{', s2d-stem' if s2d else ''}, "
                   f"MFU={mfu:.3f}, loss={loss:.3f}, "
                   f"device={dev.device_kind})",
         "value": round(imgs_per_sec, 1),

@@ -1,7 +1,9 @@
 """ResNet family (≈ python/paddle/vision/models/resnet.py — the reference
 ships resnet18/34/50/101/152 with BasicBlock/BottleneckBlock). NCHW API
-for parity; XLA:TPU's layout assignment converts to its preferred layout
-internally."""
+for parity; `data_format="NHWC"` runs the whole trunk channels-last
+(input transposed once at entry), the layout the reference plumbs per
+conv (nn/functional/conv.py data_format) and the one TPU convs prefer
+— see BASELINE.md for the measured NCHW-vs-NHWC comparison."""
 from __future__ import annotations
 
 from ..nn import functional as F
@@ -14,13 +16,16 @@ from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
 class BasicBlock(Layer):
     expansion = 1
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
+        df = dict(data_format=data_format)
         self.conv1 = Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn1 = BatchNorm2D(planes)
-        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = BatchNorm2D(planes)
+                            bias_attr=False, **df)
+        self.bn1 = BatchNorm2D(planes, **df)
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                            **df)
+        self.bn2 = BatchNorm2D(planes, **df)
         self.downsample = downsample
         self.relu = ReLU()
 
@@ -37,16 +42,17 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64):
+                 groups=1, base_width=64, data_format="NCHW"):
         super().__init__()
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(width)
+        df = dict(data_format=data_format)
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = BatchNorm2D(width, **df)
         self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
-                            groups=groups, bias_attr=False)
-        self.bn2 = BatchNorm2D(width)
-        self.conv3 = Conv2D(width, planes * 4, 1, bias_attr=False)
-        self.bn3 = BatchNorm2D(planes * 4)
+                            groups=groups, bias_attr=False, **df)
+        self.bn2 = BatchNorm2D(width, **df)
+        self.conv3 = Conv2D(width, planes * 4, 1, bias_attr=False, **df)
+        self.bn3 = BatchNorm2D(planes * 4, **df)
         self.downsample = downsample
         self.relu = ReLU()
 
@@ -62,49 +68,104 @@ class BottleneckBlock(Layer):
 
 class ResNet(Layer):
     def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
-                 groups=1, width_per_group=64):
+                 groups=1, width_per_group=64, data_format="NCHW",
+                 stem_space_to_depth=False):
         super().__init__()
         if not issubclass(block, BottleneckBlock) and \
                 (groups != 1 or width_per_group != 64):
             raise ValueError(
                 "groups/width_per_group require BottleneckBlock "
                 "(resnet50+); BasicBlock variants do not support them")
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, "
+                             f"got {data_format!r}")
         self.inplanes = 64
         self.groups = groups
         self.base_width = width_per_group
-        self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
-        self.bn1 = BatchNorm2D(64)
+        self.data_format = data_format
+        self.stem_space_to_depth = stem_space_to_depth
+        df = dict(data_format=data_format)
+        self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False,
+                            **df)
+        self.bn1 = BatchNorm2D(64, **df)
         self.relu = ReLU()
-        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, depth_cfg[0])
         self.layer2 = self._make_layer(block, 128, depth_cfg[1], stride=2)
         self.layer3 = self._make_layer(block, 256, depth_cfg[2], stride=2)
         self.layer4 = self._make_layer(block, 512, depth_cfg[3], stride=2)
         self.with_pool = with_pool
         if with_pool:
-            self.avgpool = AdaptiveAvgPool2D(1)
+            self.avgpool = AdaptiveAvgPool2D(1, **df)
         if num_classes > 0:
             self.fc = Linear(512 * block.expansion, num_classes)
         self.num_classes = num_classes
 
     def _make_layer(self, block, planes, blocks, stride=1):
         downsample = None
+        df = dict(data_format=self.data_format)
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = Sequential(
                 Conv2D(self.inplanes, planes * block.expansion, 1,
-                       stride=stride, bias_attr=False),
-                BatchNorm2D(planes * block.expansion))
-        kw = {}
+                       stride=stride, bias_attr=False, **df),
+                BatchNorm2D(planes * block.expansion, **df))
+        kw = dict(df)
         if issubclass(block, BottleneckBlock):
-            kw = dict(groups=self.groups, base_width=self.base_width)
+            kw.update(groups=self.groups, base_width=self.base_width)
         layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, **kw))
         return Sequential(*layers)
 
+    def _stem_s2d(self, x):
+        """Space-to-depth stem: the 7x7/s2 conv on 3 input channels
+        uses ~3/128 of the MXU contraction depth. Repack 2x2 pixel
+        blocks into channels (C 3->12) and run the numerically-equal
+        4x4/s1 conv built from the same 7x7 weight (zero-padded to 8x8
+        at the front). The MLPerf-TPU trick; weights stay in the
+        reference 7x7 layout so checkpoints are unaffected."""
+        w = self.conv1.weight                               # [O, 3, 7, 7]
+        o = w.shape[0]
+        wp = F.pad(w, [1, 0, 1, 0], data_format="NCHW")     # [O, 3, 8, 8]
+        wp = wp.reshape([o, 3, 4, 2, 4, 2])                 # O I mh rh mw rw
+        wp = wp.transpose([0, 3, 5, 1, 2, 4]).reshape([o, 12, 4, 4])
+        if self.data_format == "NHWC":
+            n, h, wd, c = x.shape
+        else:
+            n, c, h, wd = x.shape
+        if h % 2 or wd % 2:
+            raise ValueError(
+                f"stem_space_to_depth requires even input H/W, got "
+                f"{h}x{wd}; use the default stem for odd sizes")
+        if self.data_format == "NHWC":
+            xp = x.reshape([n, h // 2, 2, wd // 2, 2, c])
+            xp = xp.transpose([0, 1, 3, 2, 4, 5]).reshape(
+                [n, h // 2, wd // 2, 4 * c])
+        else:
+            xp = x.reshape([n, c, h // 2, 2, wd // 2, 2])
+            xp = xp.transpose([0, 3, 5, 1, 2, 4]).reshape(
+                [n, 4 * c, h // 2, wd // 2])
+        # bias present after fuse_conv_bn folding; None otherwise
+        return F.conv2d(xp, wp, bias=getattr(self.conv1, "bias", None),
+                        stride=1, padding=[2, 1, 2, 1],
+                        data_format=self.data_format)
+
     def forward(self, x):
+        if self.data_format == "NHWC" and x.shape[-1] != 3:
+            # accept NCHW input for API compat; one transpose at entry
+            if x.shape[1] != 3:
+                raise ValueError(
+                    f"NHWC ResNet expects input [N,H,W,3] or NCHW "
+                    f"[N,3,H,W]; got shape {list(x.shape)}")
+            x = x.transpose([0, 2, 3, 1])
+        if self.stem_space_to_depth:
+            x = self.maxpool(self.relu(self.bn1(self._stem_s2d(x))))
+            return self._trunk(x)
         x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        return self._trunk(x)
+
+    def _trunk(self, x):
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)

@@ -16,7 +16,8 @@ import numpy as np
 from ..core.tensor import Parameter, Tensor
 from .layer import Layer
 
-__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+__all__ = ["fuse_conv_bn",
+           "weight_norm", "remove_weight_norm", "spectral_norm",
            "parameters_to_vector", "vector_to_parameters"]
 
 
@@ -142,3 +143,73 @@ def vector_to_parameters(vec, parameters):
         n = int(np.prod(p.shape)) if p.shape else 1
         p.set_value(arr[off:off + n].reshape(p.data.shape))
         off += n
+
+
+def fuse_conv_bn(model: Layer):
+    """Fold BatchNorm into the preceding Conv for inference: conv
+    weights scale by gamma/sqrt(var+eps) per out-channel and BN becomes
+    the identity (weight=1, bias=0, mean=0, var=1 absorbed into the
+    conv bias). Walks Sequential containers and known (convN, bnN)
+    attribute pairs; call on an .eval() model. Reference analog:
+    the conv_bn_fuse inference pass
+    (paddle/fluid/framework/ir/conv_bn_fuse_pass.cc); on TPU XLA
+    already fuses the scale multiply into the conv read, so this is a
+    parameter-count/latency cleanup for the AOT predictor path."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from .container import Sequential
+    from .layers_common import _BatchNormBase, Conv2D
+
+    def fold(conv, bn):
+        eps = bn.epsilon
+        mean = bn._mean.data
+        var = bn._variance.data
+        gamma = bn.weight.data if bn.weight is not None else \
+            jnp.ones_like(mean)
+        beta = bn.bias.data if bn.bias is not None else \
+            jnp.zeros_like(mean)
+        scale = gamma / jnp.sqrt(var + eps)
+        w = conv.weight.data
+        conv.weight._replace_data(
+            (w.astype(jnp.float32)
+             * scale.reshape((-1,) + (1,) * (w.ndim - 1))).astype(w.dtype))
+        old_bias = conv.bias.data if getattr(conv, "bias", None) is not None \
+            else jnp.zeros_like(mean)
+        new_bias = (old_bias.astype(jnp.float32) - mean) * scale + beta
+        if getattr(conv, "bias", None) is not None:
+            conv.bias._replace_data(new_bias.astype(old_bias.dtype))
+        else:
+            # register as a real parameter so state_dict()/parameters()
+            # round-trip the folded bias
+            bias = conv.create_parameter([int(mean.shape[0])],
+                                         is_bias=True)
+            bias._replace_data(new_bias.astype(w.dtype))
+            bias.stop_gradient = True
+            conv.bias = bias
+        # neutralize the BN
+        if bn.weight is not None:
+            bn.weight._replace_data(jnp.ones_like(mean))
+        if bn.bias is not None:
+            bn.bias._replace_data(jnp.zeros_like(mean))
+        bn._mean._replace_data(jnp.zeros_like(mean))
+        bn._variance._replace_data(jnp.ones_like(var))
+        bn.use_global_stats = True
+
+    def walk(layer):
+        subs = list(layer.named_children())
+        # fold adjacent (Conv2D, BatchNorm) pairs inside Sequentials
+        if isinstance(layer, Sequential):
+            for (_, a), (_, b) in zip(subs, subs[1:]):
+                if isinstance(a, Conv2D) and isinstance(b, _BatchNormBase):
+                    fold(a, b)
+        # fold convN/bnN attribute naming convention (resnet-style)
+        for name, sub in subs:
+            if isinstance(sub, Conv2D) and name.startswith("conv"):
+                bn = getattr(layer, "bn" + name[4:], None)
+                if isinstance(bn, _BatchNormBase):
+                    fold(sub, bn)
+            walk(sub)
+
+    walk(model)
+    return model

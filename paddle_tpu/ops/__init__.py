@@ -89,7 +89,7 @@ for _n in ("is_complex", "is_empty", "is_floating_point", "is_integer",
 
 
 def _astype(self, dtype):
-    return math.cast(self, dtype)
+    return math.cast(self, dtype)  # guarded: int/bool targets detach
 
 
 Tensor.astype = _astype

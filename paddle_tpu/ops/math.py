@@ -121,8 +121,26 @@ multiplex = op("multiplex", differentiable=False)(
     lambda inputs, index: jnp.stack(inputs, 0)[index[:, 0],
                                                jnp.arange(index.shape[0])])
 
-cast = op("cast", differentiable=False)(
+# differentiable for float->float (AMP patterns like
+# `logits.astype("float32")` must keep the tape; jax's
+# convert_element_type transpose casts the cotangent back to the source
+# dtype). Non-float targets detach (no gradient exists).
+_cast_op = op("cast")(
     lambda x, dtype: x.astype(dtype_mod.convert_dtype(dtype)))
+
+
+def cast(x, dtype):
+    import jax.numpy as _jnp
+    if not _jnp.issubdtype(_jnp.dtype(dtype_mod.convert_dtype(dtype)),
+                           _jnp.inexact):
+        from ..core.tensor import no_grad
+        with no_grad():
+            return _cast_op(x, dtype)
+    return _cast_op(x, dtype)
+
+
+cast.op_name = "cast"
+cast.raw = _cast_op.raw
 
 # ------------------------------------------------------------- cumulative
 

@@ -248,3 +248,92 @@ def test_ernie_fused_mlm_loss_matches_plain():
     for _ in range(3):
         ln = float(step(x, y))
     assert ln < l0
+
+
+def test_resnet_nhwc_and_s2d_parity():
+    """data_format=NHWC and the space-to-depth stem are numerically
+    equal to the NCHW reference path (same state_dict)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.resnet import resnet18
+    paddle.seed(0)
+    m1 = resnet18(num_classes=6)
+    m2 = resnet18(num_classes=6, data_format="NHWC",
+                  stem_space_to_depth=True)
+    m2.set_state_dict(m1.state_dict())
+    m1.eval()
+    m2.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+    np.testing.assert_allclose(np.asarray(m1(x).data),
+                               np.asarray(m2(x).data),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_fuse_conv_bn_eval_parity():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.resnet import resnet18
+    from paddle_tpu.nn.utils import fuse_conv_bn
+    paddle.seed(0)
+    m = resnet18(num_classes=5)
+    m.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 3, 32, 32).astype("float32"))
+    for _ in range(2):
+        m(x)  # populate running stats
+    m.eval()
+    ref = np.asarray(m(x).data)
+    fuse_conv_bn(m)
+    got = np.asarray(m(x).data)
+    # tolerance covers the CPU backend's relaxed conv precision; at
+    # jax_default_matmul_precision=highest the max diff is 2.4e-6
+    np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_same_dtype_astype_keeps_tape():
+    """float->float astype must stay on the autograd tape (the AMP
+    `logits.astype("float32")` pattern); int casts detach."""
+    import numpy as np
+    import paddle_tpu as paddle
+    w = paddle.Parameter(np.ones((2,), np.float32))
+    z = (w * 2.0).astype("float32")
+    z.sum().backward()
+    np.testing.assert_allclose(np.asarray(w.grad.data), [2.0, 2.0])
+    w2 = paddle.Parameter(np.ones((2,), np.float32))
+    zb = w2.astype("bfloat16").astype("float32") * 3
+    zb.sum().backward()
+    np.testing.assert_allclose(np.asarray(w2.grad.data), [3.0, 3.0])
+    assert paddle.cast(w, "int32").stop_gradient
+    assert w.astype("bool").stop_gradient
+
+
+def test_fuse_conv_bn_s2d_and_state_dict_roundtrip():
+    """Folding must stay correct through the space-to-depth stem (the
+    folded bias rides the repacked conv) and round-trip state_dict."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.resnet import resnet18
+    from paddle_tpu.nn.utils import fuse_conv_bn
+    paddle.seed(0)
+    m = resnet18(num_classes=5, data_format="NHWC",
+                 stem_space_to_depth=True)
+    m.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 3, 32, 32).astype("float32"))
+    for _ in range(2):
+        m(x)
+    m.eval()
+    ref = np.asarray(m(x).data)
+    fuse_conv_bn(m)
+    got = np.asarray(m(x).data)
+    np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
+    sd = m.state_dict()
+    assert "conv1.bias" in sd  # folded bias is a registered parameter
+    m2 = resnet18(num_classes=5, data_format="NHWC",
+                  stem_space_to_depth=True)
+    fuse_conv_bn(m2)  # create the bias slots, then load
+    m2.set_state_dict(sd)
+    m2.eval()
+    np.testing.assert_allclose(np.asarray(m2(x).data), got,
+                               rtol=1e-5, atol=1e-5)
