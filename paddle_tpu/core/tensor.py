@@ -351,6 +351,27 @@ class Tensor:
     def __mod__(self, o):
         return self._binop(o, "remainder")
 
+    # bitwise magic methods (reference tensor/__init__.py
+    # magic_method_func: __and__/__or__/__xor__/__invert__)
+    def __and__(self, o):
+        return self._binop(o, "bitwise_and")
+
+    __rand__ = __and__
+
+    def __or__(self, o):
+        return self._binop(o, "bitwise_or")
+
+    __ror__ = __or__
+
+    def __xor__(self, o):
+        return self._binop(o, "bitwise_xor")
+
+    __rxor__ = __xor__
+
+    def __invert__(self):
+        from .. import ops
+        return ops.math.bitwise_not(self)
+
     def __pow__(self, o):
         return self._binop(o, "pow")
 

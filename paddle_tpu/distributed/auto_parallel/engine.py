@@ -79,6 +79,16 @@ class Engine:
         self._build_train_step()
         return self
 
+    def tune(self, *example_batch, max_candidates: int = 8,
+             verbose: bool = False, **tuner_kwargs):
+        """strategy='auto' entry: search mesh degrees for this model on
+        the visible devices (reference parallel_tuner.py analog; see
+        tuner.py for the compiled-program cost model). Returns the
+        winning Candidate and leaves the engine on its mesh."""
+        return _engine_tune(self, example_batch,
+                            max_candidates=max_candidates,
+                            verbose=verbose, **tuner_kwargs)
+
     def _build_train_step(self):
         if self._train_step is not None:
             return
@@ -292,3 +302,75 @@ def _batches(data, batch_size: Optional[int]):
         for batch in data:
             yield tuple(batch) if isinstance(batch, (tuple, list)) \
                 else (batch,)
+
+
+class _LowerAdapter:
+    """Minimal .lower(*batch) wrapper so ParallelTuner can score an
+    Engine-style GSPMD step the same way it scores a
+    fleet.DistributedTrainStep."""
+
+    def __init__(self, jit_step, params, opt_state, lr, batch_shardings):
+        self._jit = jit_step
+        self._params = params
+        self._opt_state = opt_state
+        self._lr = lr
+        self._bshard = batch_shardings
+
+    def lower(self, *batch):
+        raw = [jax.device_put(np.asarray(b), s)
+               for b, s in zip(batch, self._bshard)]
+        return self._jit.lower(self._params, self._opt_state,
+                               np.float32(self._lr), np.int32(1), *raw)
+
+
+def _engine_tune(engine: "Engine", example_batch, max_candidates=8,
+                 verbose=False, **tuner_kwargs):
+    """strategy='auto': pick the (data x model) mesh for this Engine by
+    compiling candidates and ranking them (tuner.py cost model).
+    Model-parallel axis names come from the model's shard_tensor
+    annotations; with no annotations only the data axis is searched."""
+    from .tuner import ParallelTuner
+
+    names, params = engine._names_and_params()
+    model_axes = []
+    for p in params:
+        attr = get_dist_attr(p)
+        if attr:
+            for ax in attr["shard_spec"]:
+                if ax is not None and ax not in model_axes:
+                    model_axes.append(ax)
+    if len(model_axes) > 1:
+        raise ValueError(
+            f"Engine strategy='auto' tunes one model axis; model "
+            f"annotations use {model_axes} — pass an explicit "
+            f"process_mesh for >2-D meshes")
+    model_axis = model_axes[0] if model_axes else None
+    n = len(jax.devices())
+    data_axis = engine._data_axis or "dp"
+
+    def step_builder(cfg):
+        dp, mp = cfg["dp_degree"], cfg["mp_degree"]
+        shape = (dp, mp) if model_axis else (dp,)
+        axis_names = [data_axis] + ([model_axis] if model_axis else [])
+        pm = ProcessMesh(
+            np.arange(n).reshape(shape), dim_names=axis_names)
+        engine.mesh = pm
+        engine._train_step = None  # rebuild on the candidate mesh
+        engine._build_train_step()
+        mesh = pm.jax_mesh
+        pvals = [p._data for p in engine.model.parameters()]
+        opt_state = [engine.optimizer.init_state_for(v) for v in pvals]
+        bshard = [engine._batch_sharding(np.asarray(b).ndim, mesh)
+                  for b in example_batch]
+        adapter = _LowerAdapter(engine._jit_step, pvals, opt_state,
+                                engine.optimizer.get_lr(), bshard)
+        return adapter, tuple(np.asarray(b) for b in example_batch)
+
+    tuner = ParallelTuner(
+        n, step_builder, axes=("dp", "mp") if model_axis else ("dp",),
+        max_candidates=max_candidates, **tuner_kwargs)
+    best = tuner.tune(verbose=verbose)
+    # leave the engine on the winning mesh
+    step_builder(best.hybrid_configs)
+    engine._tuned = best
+    return best

@@ -1,0 +1,269 @@
+"""Parallel-strategy tuner: choose hybrid mesh degrees for a model and
+chip count by compiling candidates and ranking with a measured cost
+model.
+
+Reference analog: auto_parallel/tuner/parallel_tuner.py (candidate
+dist-attr search with pruning) + auto_parallel/cost/ (comm/comp cost
+model over measured op latencies, static_op_benchmark.json).
+
+TPU-native: instead of a hand-maintained latency table, every candidate
+is actually COMPILED through XLA SPMD on the virtual device mesh and
+scored from the compiled program itself —
+  t  =  max(flops / peak, hbm_bytes / hbm_bw)          (roofline)
+      + ici_bytes / ici_bw + n_ici * ici_latency       (collectives)
+      + dcn_bytes / dcn_bw + n_dcn * dcn_latency
+where collective bytes are read out of the compiled HLO (all-reduce /
+all-gather / reduce-scatter / collective-permute result shapes) and a
+collective is billed to DCN when its replica groups span slice
+boundaries (devices_per_slice) — the same crossing rule
+create_hybrid_device_mesh (topology.py:41) uses to lay the mesh out.
+Candidates that cannot hold their parameter + optimizer shard in HBM
+are pruned before compiling (the reference tuner's memory check).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Candidate", "ParallelTuner", "tune_parallel"]
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s64": 8, "u64": 8,
+    "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+    "pred": 1, "c64": 8, "c128": 16,
+}
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?:\(([^)]*)\)|(\w+)\[([\d,]*)\][^ ]*)\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute)"
+    r"(?:-start)?\(", )
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*\})\}")
+_TUPLE_ELEM_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def collective_bytes(hlo_text: str, devices_per_slice: Optional[int]
+                     ) -> Tuple[float, float]:
+    """Parse compiled HLO, return (ici_bytes, dcn_bytes, n_ici, n_dcn)
+    for collectives. A collective crosses DCN when any replica group
+    holds device ids from more than one slice."""
+    ici = dcn = 0.0
+    n_ici = n_dcn = 0
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        tuple_body, dtype, dims, kind = m.groups()
+        if tuple_body is not None:
+            size = sum(_shape_bytes(d, s)
+                       for d, s in _TUPLE_ELEM_RE.findall(tuple_body))
+        else:
+            size = _shape_bytes(dtype, dims)
+        crosses = False
+        gm = _GROUPS_RE.search(line)
+        if gm and devices_per_slice:
+            for grp in re.findall(r"\{([\d,]+)\}", gm.group(1)):
+                slices = {int(i) // devices_per_slice
+                          for i in grp.split(",")}
+                if len(slices) > 1:
+                    crosses = True
+                    break
+        # ring cost factor (k-1)/k folded into bw constants; bytes are
+        # the payload itself
+        if crosses:
+            dcn += size
+            n_dcn += 1
+        else:
+            ici += size
+            n_ici += 1
+    return ici, dcn, n_ici, n_dcn
+
+
+@dataclass
+class Candidate:
+    dp: int = 1
+    sharding: int = 1
+    pp: int = 1
+    mp: int = 1
+    interleave: int = 1
+    cost_s: float = float("inf")
+    detail: Dict[str, float] = field(default_factory=dict)
+    feasible: bool = True
+    reason: str = ""
+
+    @property
+    def hybrid_configs(self) -> Dict[str, int]:
+        return {"dp_degree": self.dp, "sharding_degree": self.sharding,
+                "pp_degree": self.pp, "mp_degree": self.mp}
+
+    def __repr__(self):
+        tag = (f"dp{self.dp}xshard{self.sharding}xpp{self.pp}"
+               f"xmp{self.mp}")
+        if not self.feasible:
+            return f"Candidate({tag}, pruned: {self.reason})"
+        return f"Candidate({tag}, est {self.cost_s * 1e3:.3f} ms)"
+
+
+def _factorizations(n: int) -> List[Tuple[int, int, int, int]]:
+    out = []
+    for dp in _divisors(n):
+        for sharding in _divisors(n // dp):
+            rem = n // dp // sharding
+            for pp in _divisors(rem):
+                mp = rem // pp
+                out.append((dp, sharding, pp, mp))
+    return out
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class ParallelTuner:
+    """Rank hybrid-parallel configs for `n_devices`.
+
+    step_builder(hybrid_configs: dict) -> (step, batch_tuple) must
+    build a fleet.DistributedTrainStep (or any object with
+    .lower(*batch) returning a jax Lowered) on the CURRENT virtual
+    mesh for the given degrees. The tuner compiles each surviving
+    candidate and scores it from the compiled program.
+    """
+
+    def __init__(self, n_devices: int,
+                 step_builder: Callable[[Dict[str, int]], Any],
+                 *,
+                 num_layers: Optional[int] = None,
+                 num_heads: Optional[int] = None,
+                 param_bytes: Optional[float] = None,
+                 hbm_capacity: float = 16e9,       # v5e chip
+                 peak_flops: float = 197e12,       # bf16 v5e
+                 hbm_bw: float = 819e9,
+                 ici_bw: float = 180e9,            # ~4 links x 45GB/s
+                 dcn_bw: float = 12.5e9,
+                 ici_latency: float = 1e-6,        # per-collective floor
+                 dcn_latency: float = 25e-6,
+                 devices_per_slice: Optional[int] = None,
+                 max_mp: int = 8,
+                 max_candidates: int = 8,
+                 axes: Sequence[str] = ("dp", "sharding", "pp", "mp")):
+        self.n = n_devices
+        self.step_builder = step_builder
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.param_bytes = param_bytes
+        self.hbm_capacity = hbm_capacity
+        self.peak_flops = peak_flops
+        self.hbm_bw = hbm_bw
+        self.ici_bw = ici_bw
+        self.dcn_bw = dcn_bw
+        self.ici_latency = ici_latency
+        self.dcn_latency = dcn_latency
+        self.devices_per_slice = devices_per_slice
+        self.max_mp = max_mp
+        self.max_candidates = max_candidates
+        self.axes = set(axes)
+        self.candidates: List[Candidate] = []
+
+    # ------------------------------------------------------------ pruning
+    def _enumerate(self) -> List[Candidate]:
+        cands = []
+        for dp, sharding, pp, mp in _factorizations(self.n):
+            degrees = {"dp": dp, "sharding": sharding, "pp": pp,
+                       "mp": mp}
+            if any(v > 1 for k, v in degrees.items()
+                   if k not in self.axes):
+                continue  # axis not being searched stays at degree 1
+            c = Candidate(dp, sharding, pp, mp)
+            if mp > self.max_mp:
+                c.feasible, c.reason = False, f"mp {mp} > {self.max_mp}"
+            elif self.num_heads and self.num_heads % mp:
+                c.feasible, c.reason = False, \
+                    f"mp {mp} does not divide num_heads {self.num_heads}"
+            elif self.num_layers and pp > 1 and self.num_layers % pp:
+                c.feasible, c.reason = False, \
+                    f"pp {pp} does not divide num_layers {self.num_layers}"
+            elif self.devices_per_slice and \
+                    self.n > self.devices_per_slice and \
+                    dp < self.n // self.devices_per_slice:
+                # DCN rule: only the outermost (dp) axis may cross
+                # slices (create_hybrid_device_mesh layout); dp must
+                # cover the slice count
+                c.feasible, c.reason = False, \
+                    "non-dp axis would cross DCN slices"
+            elif self.param_bytes is not None:
+                # fp32 master + 2 AdamW moments + bf16 weight ~ 14B per
+                # param when param_bytes counts 4B/param
+                state = self.param_bytes * 3.5
+                shard = state / (sharding * mp * pp)
+                if shard > self.hbm_capacity * 0.85:
+                    c.feasible = False
+                    c.reason = (f"param+opt shard {shard / 1e9:.1f} GB "
+                                f"> 85% of {self.hbm_capacity / 1e9:.0f}"
+                                f" GB HBM")
+            cands.append(c)
+        return cands
+
+    def _rank_heuristic(self, c: Candidate) -> Tuple:
+        # compile-order heuristic: try likely winners first so the
+        # candidate budget is spent well (prefer some sharding for
+        # memory, mild mp, low pp)
+        return (c.pp, c.mp, -c.sharding)
+
+    # ------------------------------------------------------------ scoring
+    def _score(self, cand: Candidate) -> Candidate:
+        step, batch = self.step_builder(cand.hybrid_configs)
+        lowered = step.lower(*batch)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = float(ca.get("flops", 0.0))
+        hbm = float(ca.get("bytes accessed", 0.0))
+        ici_b, dcn_b, n_ici, n_dcn = collective_bytes(
+            compiled.as_text(), self.devices_per_slice)
+        comp = max(flops / self.peak_flops, hbm / self.hbm_bw)
+        cost = comp + ici_b / self.ici_bw + dcn_b / self.dcn_bw \
+            + n_ici * self.ici_latency + n_dcn * self.dcn_latency
+        cand.cost_s = cost
+        cand.detail = {"flops": flops, "hbm_bytes": hbm,
+                       "ici_bytes": ici_b, "dcn_bytes": dcn_b,
+                       "n_ici": n_ici, "n_dcn": n_dcn, "comp_s": comp}
+        return cand
+
+    # ------------------------------------------------------------- search
+    def tune(self, verbose: bool = False) -> Candidate:
+        cands = self._enumerate()
+        feasible = sorted([c for c in cands if c.feasible],
+                          key=self._rank_heuristic)
+        self.candidates = cands
+        budget = feasible[:self.max_candidates]
+        if not budget:
+            raise ValueError(
+                "no feasible parallel config: " +
+                "; ".join(f"{c!r}" for c in cands[:6]))
+        for c in budget:
+            try:
+                self._score(c)
+            except Exception as e:  # candidate failed to build/compile
+                c.feasible = False
+                c.reason = f"compile failed: {type(e).__name__}: {e}"
+            if verbose:
+                print(c)
+        scored = [c for c in budget if c.feasible]
+        if not scored:
+            raise RuntimeError(
+                "every candidate failed to compile; first error: "
+                + budget[0].reason)
+        return min(scored, key=lambda c: c.cost_s)
+
+
+def tune_parallel(n_devices: int, step_builder, **kwargs) -> Candidate:
+    """One-call form: rank configs and return the winner."""
+    return ParallelTuner(n_devices, step_builder, **kwargs).tune()

@@ -156,28 +156,53 @@ class DistributedTrainStep:
             parts[lead] = DATA_AXES
         return jax.device_put(arr, NamedSharding(self.mesh, P(*parts)))
 
-    def __call__(self, *batch):
-        params = self._params
-        if self._opt_state_tree is None:
-            m, s = self.mesh, self.strategy
-            self._opt_state_tree = []
-            for p in params:
-                # seed from restored optimizer state when present
-                st = self.optimizer._state.get(_opt_key(p)) \
-                    or self.optimizer.init_state_for(p)
-                st = {k: (jax.device_put(
-                    v, NamedSharding(m, s.opt_state_spec(
-                        tuple(jnp.shape(v)), m, _param_base_spec(p))))
-                    if v is not None else None)
-                    for k, v in st.items()}
-                self._opt_state_tree.append(st)
+    def _ensure_opt_state(self):
+        """Seed (or re-load from a restored optimizer) the sharded
+        optimizer-state tree."""
+        if self._opt_state_tree is not None:
+            return
+        m, s = self.mesh, self.strategy
+        self._opt_state_tree = []
+        for p in self._params:
+            st = self.optimizer._state.get(_opt_key(p)) \
+                or self.optimizer.init_state_for(p)
+            st = {k: (jax.device_put(
+                v, NamedSharding(m, s.opt_state_spec(
+                    tuple(jnp.shape(v)), m, _param_base_spec(p))))
+                if v is not None else None)
+                for k, v in st.items()}
+            self._opt_state_tree.append(st)
+
+    def _prepare(self, batch):
+        """Shared by __call__ and lower(): opt state + jit + sharded
+        raw batch."""
+        self._ensure_opt_state()
         if self._jitted is None:
             self._build(tuple(getattr(b, "ndim", 0) for b in batch))
-        raw_batch = tuple(
+        return tuple(
             jax.tree_util.tree_map(
                 lambda t: self._shard_batch(_unwrap(t)), b,
                 is_leaf=lambda t: isinstance(t, Tensor))
             for b in batch)
+
+    def lower(self, *batch):
+        """jax Lowered for the step on these example inputs — the
+        auto-parallel tuner compiles it per candidate mesh and scores
+        the resulting program (tuner.py); also usable for AOT caching."""
+        raw_batch = self._prepare(batch)
+        return self._jitted.lower(
+            [p._data for p in self._params], self._opt_state_tree,
+            np.float32(self.optimizer.get_lr()),
+            np.int32(self.optimizer._step_count + 1), *raw_batch)
+
+    def cost_analysis(self, *batch):
+        """XLA cost analysis of the compiled distributed step."""
+        ca = self.lower(*batch).compile().cost_analysis()
+        return ca[0] if isinstance(ca, (list, tuple)) else ca
+
+    def __call__(self, *batch):
+        params = self._params
+        raw_batch = self._prepare(batch)
         lr = self.optimizer.get_lr()
         self.optimizer._step_count += 1
         loss, new_vals, self._opt_state_tree = self._jitted(

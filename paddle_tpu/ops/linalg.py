@@ -129,8 +129,13 @@ def eigh(x, UPLO="L"):
 
 eigvalsh = op("eigvalsh")(lambda x, UPLO="L": jnp.linalg.eigvalsh(x, UPLO=UPLO))
 
-cross = op("cross")(
-    lambda x, y, axis=9: jnp.cross(x, y, axis=-1 if axis == 9 else axis))
+def _cross_impl(x, y, axis=9):
+    if axis == 9:  # paddle sentinel: first dimension of size 3
+        axis = next((i for i, d in enumerate(jnp.shape(x)) if d == 3), -1)
+    return jnp.cross(x, y, axis=axis)
+
+
+cross = op("cross")(_cross_impl)
 
 cov = op("cov")(
     lambda x, rowvar=True, ddof=True, fweights=None, aweights=None:

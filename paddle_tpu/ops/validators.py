@@ -331,3 +331,390 @@ def _put_along_axis(arr, indices, values, axis, *a, **k):
     enforce(_ndim(indices) == _ndim(arr), "put_along_axis",
             f"indices rank {_ndim(indices)} must equal array rank "
             f"{_ndim(arr)}")
+
+
+# ---------------- round-3 breadth: the next failure-magnet families
+# (VERDICT r2 Next #7 — slice/pad/gather_nd/scatter/pool/conv-transpose/
+# norm; reference paddle/phi/infermeta/unary.cc, binary.cc)
+
+def _is_int(v):
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+@infer_check("slice")
+def _slice(x, axes, starts, ends, *a, **k):
+    nd = _ndim(x)
+    enforce(len(axes) == len(starts) == len(ends), "slice",
+            f"axes/starts/ends must have equal length, got "
+            f"{len(axes)}/{len(starts)}/{len(ends)}")
+    for ax in axes:
+        _check_axis("slice", ax, nd)
+    enforce(len(set(a % nd for a in axes)) == len(axes), "slice",
+            f"repeated axis in {list(axes)}")
+
+
+@infer_check("strided_slice")
+def _strided_slice(x, axes, starts, ends, strides, *a, **k):
+    nd = _ndim(x)
+    enforce(len(axes) == len(starts) == len(ends) == len(strides),
+            "strided_slice",
+            f"axes/starts/ends/strides lengths differ: "
+            f"{len(axes)}/{len(starts)}/{len(ends)}/{len(strides)}")
+    for ax in axes:
+        _check_axis("strided_slice", ax, nd)
+    for st in strides:
+        enforce(st != 0, "strided_slice", "stride must be non-zero")
+
+
+@infer_check("pad")
+def _pad(x, pad, mode="constant", value=0.0, data_format="NCHW", *a, **k):
+    nd = _ndim(x)
+    if isinstance(pad, (list, tuple)):
+        enforce(len(pad) % 2 == 0, "pad",
+                f"pad list must have an even length, got {len(pad)}")
+        enforce(len(pad) <= 2 * nd, "pad",
+                f"pad list of length {len(pad)} exceeds 2*ndim "
+                f"({2 * nd}) for a {nd}-d input")
+    enforce(mode in ("constant", "reflect", "replicate", "circular"),
+            "pad", f"mode must be one of constant/reflect/replicate/"
+            f"circular, got {mode!r}")
+
+
+@infer_check("gather_nd")
+def _gather_nd(x, index, *a, **k):
+    sx, si = _shape(x), _shape(index)
+    enforce(len(si) >= 1, "gather_nd",
+            f"index needs ndim >= 1, got {list(si)}")
+    enforce(si[-1] <= len(sx), "gather_nd",
+            f"index.shape[-1] ({si[-1]}) must be <= x.ndim "
+            f"({len(sx)}) — each index row addresses a prefix of x's "
+            f"dims")
+
+
+@infer_check("scatter")
+def _scatter(x, index, updates, *a, **k):
+    sx, si, su = _shape(x), _shape(index), _shape(updates)
+    enforce(len(si) in (0, 1), "scatter",
+            f"index must be 0-d or 1-d, got {list(si)}")
+    if not si:  # 0-d index: updates replace one row of x
+        enforce(su == sx[1:], "scatter",
+                f"with a 0-d index, updates shape {list(su)} must "
+                f"match one x row {list(sx[1:])}")
+        return
+    enforce(len(su) >= 1 and su[0] == si[0], "scatter",
+            f"updates.shape[0] ({su[0] if su else '()'}) must equal "
+            f"index length ({si[0]})")
+    enforce(su[1:] == sx[1:], "scatter",
+            f"updates trailing dims {list(su[1:])} must match x "
+            f"trailing dims {list(sx[1:])}")
+
+
+@infer_check("scatter_nd_add")
+def _scatter_nd_add(x, index, updates, *a, **k):
+    sx, si, su = _shape(x), _shape(index), _shape(updates)
+    enforce(len(si) >= 1, "scatter_nd_add",
+            f"index needs ndim >= 1, got {list(si)}")
+    enforce(si[-1] <= len(sx), "scatter_nd_add",
+            f"index.shape[-1] ({si[-1]}) must be <= x.ndim ({len(sx)})")
+    expect = si[:-1] + sx[si[-1]:]
+    enforce(su == expect, "scatter_nd_add",
+            f"updates shape {list(su)} must be "
+            f"index.shape[:-1] + x.shape[index.shape[-1]:] "
+            f"= {list(expect)}")
+
+
+def _conv_check(op, nsp):
+    @infer_check(op)
+    def check(x, weight, bias=None, stride=1, padding=0, dilation=1,
+              groups=1, data_format=None, *a, **k):
+        sx, sw = _shape(x), _shape(weight)
+        enforce(len(sx) == nsp + 2, op,
+                f"input must be {nsp + 2}-d, got {list(sx)}")
+        enforce(len(sw) == nsp + 2, op,
+                f"weight must be {nsp + 2}-d, got {list(sw)}")
+        cf = bool(data_format) and str(data_format).endswith("C")
+        cin = sx[-1] if cf else sx[1]
+        enforce(cin == sw[1] * groups, op,
+                f"input channels ({cin}) must equal "
+                f"weight.shape[1] * groups ({sw[1]} * {groups})")
+        enforce(sw[0] % groups == 0, op,
+                f"out channels ({sw[0]}) must divide by groups "
+                f"({groups})")
+    return check
+
+
+for _n, _d in (("conv1d", 1), ("conv3d", 3)):
+    _conv_check(_n, _d)
+
+
+def _conv_transpose_check(op, nsp):
+    @infer_check(op)
+    def check(x, weight, bias=None, stride=1, padding=0,
+              output_padding=0, groups=1, dilation=1, data_format=None,
+              *a, **k):
+        sx, sw = _shape(x), _shape(weight)
+        enforce(len(sx) == nsp + 2, op,
+                f"input must be {nsp + 2}-d, got {list(sx)}")
+        enforce(len(sw) == nsp + 2, op,
+                f"weight must be {nsp + 2}-d "
+                f"[in, out//groups, *k], got {list(sw)}")
+        cf = bool(data_format) and str(data_format).endswith("C")
+        cin = sx[-1] if cf else sx[1]
+        enforce(cin == sw[0], op,
+                f"input channels ({cin}) must equal weight.shape[0] "
+                f"({sw[0]}) — transpose weights are [in, out//groups, "
+                f"*k]")
+    return check
+
+
+for _n, _d in (("conv1d_transpose", 1), ("conv2d_transpose", 2),
+               ("conv3d_transpose", 3)):
+    _conv_transpose_check(_n, _d)
+
+
+def _pool_check(op, nsp):
+    @infer_check(op)
+    def check(x, kernel_size=None, *a, **k):
+        sx = _shape(x)
+        enforce(len(sx) == nsp + 2, op,
+                f"input must be {nsp + 2}-d "
+                f"(N, C + {nsp} spatial dims), got {list(sx)}")
+        if isinstance(kernel_size, (list, tuple)):
+            enforce(len(kernel_size) == nsp, op,
+                    f"kernel_size needs {nsp} entries, got "
+                    f"{list(kernel_size)}")
+    return check
+
+
+for _n, _d in (("max_pool1d", 1), ("max_pool2d", 2), ("max_pool3d", 3),
+               ("avg_pool1d", 1), ("avg_pool2d", 2), ("avg_pool3d", 3),
+               ("adaptive_avg_pool1d", 1), ("adaptive_avg_pool2d", 2),
+               ("adaptive_avg_pool3d", 3), ("adaptive_max_pool1d", 1),
+               ("adaptive_max_pool2d", 2), ("adaptive_max_pool3d", 3)):
+    _pool_check(_n, _d)
+
+
+@infer_check("batch_norm_train")
+def _bn_train(x, weight=None, bias=None, epsilon=1e-5,
+              data_format="NCHW", **kw):
+    _bn_shapes("batch_norm_train", x, weight, bias, data_format)
+
+
+@infer_check("batch_norm_infer")
+def _bn_infer(x, running_mean=None, running_var=None, weight=None,
+              bias=None, epsilon=1e-5, data_format="NCHW", **kw):
+    _bn_shapes("batch_norm_infer", x, weight, bias, data_format)
+
+
+@infer_check("instance_norm")
+def _in_check(x, weight=None, bias=None, epsilon=1e-5, **kw):
+    _bn_shapes("instance_norm", x, weight, bias, "NCHW")
+
+
+def _bn_shapes(op, x, weight, bias, data_format):
+    sx = _shape(x)
+    enforce(len(sx) >= 2, op,
+            f"input needs ndim >= 2 (N, C, ...), got {list(sx)}")
+    c = sx[-1] if str(data_format).endswith("C") else sx[1]
+    for nm, p in (("weight", weight), ("bias", bias)):
+        if p is not None:
+            enforce(_shape(p) == (c,), op,
+                    f"{nm} must have shape [{c}] (the channel "
+                    f"count), got {list(_shape(p))}")
+
+
+@infer_check("group_norm")
+def _group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+                data_format="NCHW", *a, **k):
+    sx = _shape(x)
+    enforce(len(sx) >= 2, "group_norm",
+            f"input needs ndim >= 2, got {list(sx)}")
+    c = sx[-1] if str(data_format).endswith("C") else sx[1]
+    enforce(c % num_groups == 0, "group_norm",
+            f"channels ({c}) must divide by num_groups ({num_groups})")
+
+
+@infer_check("rms_norm")
+def _rms_norm(x, weight=None, *a, **k):
+    if weight is not None:
+        sx, sw = _shape(x), _shape(weight)
+        enforce(sx[-len(sw):] == sw, "rms_norm",
+                f"weight shape {list(sw)} must match trailing input "
+                f"dims of {list(sx)}")
+
+
+@infer_check("local_response_norm")
+def _lrn(x, size, *a, **k):
+    enforce(_ndim(x) in (3, 4, 5), "local_response_norm",
+            f"input must be 3/4/5-d, got {_ndim(x)}-d")
+    enforce(size > 0, "local_response_norm",
+            f"size must be positive, got {size}")
+
+
+@infer_check("interpolate")
+def _interpolate(x, size=None, scale_factor=None, mode="nearest",
+                 *a, **k):
+    enforce(size is not None or scale_factor is not None, "interpolate",
+            "one of size= or scale_factor= is required")
+    enforce(size is None or scale_factor is None, "interpolate",
+            "size= and scale_factor= are mutually exclusive")
+    enforce(_ndim(x) in (3, 4, 5), "interpolate",
+            f"input must be 3/4/5-d, got {_ndim(x)}-d")
+
+
+@infer_check("grid_sample")
+def _grid_sample(x, grid, *a, **k):
+    sx, sg = _shape(x), _shape(grid)
+    enforce(len(sx) == 4 and len(sg) == 4, "grid_sample",
+            f"x and grid must be 4-d, got x{list(sx)} grid{list(sg)}")
+    enforce(sx[0] == sg[0], "grid_sample",
+            f"batch sizes differ: x {sx[0]} vs grid {sg[0]}")
+    enforce(sg[-1] == 2, "grid_sample",
+            f"grid last dim must be 2 (x, y), got {sg[-1]}")
+
+
+def _pixel_check(op):
+    @infer_check(op)
+    def check(x, factor, data_format="NCHW", *a, **k):
+        sx = _shape(x)
+        enforce(len(sx) == 4, op,
+                f"input must be 4-d, got {list(sx)}")
+        c = sx[-1] if str(data_format).endswith("C") else sx[1]
+        if op == "pixel_shuffle":
+            enforce(c % (factor * factor) == 0, op,
+                    f"channels ({c}) must divide by upscale_factor^2 "
+                    f"({factor}^2)")
+        else:
+            h = sx[1] if str(data_format).endswith("C") else sx[2]
+            w = sx[2] if str(data_format).endswith("C") else sx[3]
+            enforce(h % factor == 0 and w % factor == 0, op,
+                    f"spatial dims ({h}x{w}) must divide by "
+                    f"downscale_factor ({factor})")
+    return check
+
+
+for _n in ("pixel_shuffle", "pixel_unshuffle"):
+    _pixel_check(_n)
+
+
+@infer_check("unfold")
+def _unfold(x, kernel_sizes, *a, **k):
+    enforce(_ndim(x) == 4, "unfold",
+            f"input must be 4-d [N, C, H, W], got {_ndim(x)}-d")
+
+
+@infer_check("roll")
+def _roll(x, shifts, axis=None, *a, **k):
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        for ax in axes:
+            _check_axis("roll", ax, _ndim(x))
+        ns = len(shifts) if isinstance(shifts, (list, tuple)) else 1
+        enforce(ns == len(axes), "roll",
+                f"shifts ({ns}) and axis ({len(axes)}) counts differ")
+
+
+@infer_check("cross")
+def _cross(x, y, axis=9, *a, **k):
+    sx, sy = _shape(x), _shape(y)
+    enforce(sx == sy, "cross",
+            f"operands must have identical shapes, got x{list(sx)} "
+            f"y{list(sy)}")
+    if axis == 9:  # paddle sentinel: first dim of size 3
+        enforce(3 in sx, "cross",
+                f"no dimension of size 3 in shape {list(sx)}")
+    else:
+        _check_axis("cross", axis, len(sx))
+        enforce(sx[axis] == 3, "cross",
+                f"dim {axis} must have size 3, got {sx[axis]}")
+
+
+@infer_check("dot")
+def _dot(x, y, *a, **k):
+    sx, sy = _shape(x), _shape(y)
+    enforce(len(sx) in (1, 2) and sx == sy, "dot",
+            f"dot needs two equal-shape 1-d or 2-d operands, got "
+            f"x{list(sx)} y{list(sy)}")
+
+
+@infer_check("index_sample")
+def _index_sample(x, index, *a, **k):
+    sx, si = _shape(x), _shape(index)
+    enforce(len(sx) == 2 and len(si) == 2, "index_sample",
+            f"x and index must be 2-d, got x{list(sx)} index{list(si)}")
+    enforce(sx[0] == si[0], "index_sample",
+            f"batch dims differ: x {sx[0]} vs index {si[0]}")
+
+
+@infer_check("repeat_interleave")
+def _repeat_interleave(x, repeats, axis=None, *a, **k):
+    if axis is not None:
+        _check_axis("repeat_interleave", axis, _ndim(x))
+    if not _is_int(repeats):
+        sr = _shape(repeats)
+        enforce(len(sr) == 1, "repeat_interleave",
+                f"repeats tensor must be 1-d, got {list(sr)}")
+
+
+@infer_check("kthvalue")
+def _kthvalue(x, k=None, axis=-1, keepdim=False, **kw):
+    _check_axis("kthvalue", axis, _ndim(x))
+    n = _shape(x)[axis]
+    if k is not None:
+        enforce(1 <= k <= n, "kthvalue",
+                f"k must be in [1, {n}] for axis of size {n}, got {k}")
+
+
+@infer_check("renorm")
+def _renorm(x, p, axis, max_norm, *a, **k):
+    _check_axis("renorm", axis, _ndim(x))
+    enforce(p > 0, "renorm", f"p must be positive, got {p}")
+
+
+@infer_check("searchsorted")
+def _searchsorted(sorted_sequence, values, *a, **k):
+    ss, sv = _shape(sorted_sequence), _shape(values)
+    if len(ss) > 1:
+        enforce(ss[:-1] == sv[:len(ss) - 1], "searchsorted",
+                f"leading dims of sorted_sequence {list(ss)} must "
+                f"match values {list(sv)}")
+
+
+@infer_check("diagonal")
+def _diagonal(x, offset=0, axis1=0, axis2=1, *a, **k):
+    nd = _ndim(x)
+    enforce(nd >= 2, "diagonal", f"input needs ndim >= 2, got {nd}")
+    _check_axis("diagonal", axis1, nd)
+    _check_axis("diagonal", axis2, nd)
+    enforce(axis1 % nd != axis2 % nd, "diagonal",
+            f"axis1 and axis2 must differ, both resolve to "
+            f"{axis1 % nd}")
+
+
+@infer_check("diag_embed")
+def _diag_embed(x, offset=0, dim1=-2, dim2=-1, *a, **k):
+    nd = _ndim(x) + 1
+    enforce((dim1 % nd) != (dim2 % nd), "diag_embed",
+            f"dim1 and dim2 must differ, both resolve to {dim1 % nd}")
+
+
+@infer_check("temporal_shift")
+def _temporal_shift(x, seg_num, *a, **k):
+    sx = _shape(x)
+    enforce(len(sx) == 4, "temporal_shift",
+            f"input must be 4-d, got {list(sx)}")
+    enforce(sx[0] % seg_num == 0, "temporal_shift",
+            f"batch ({sx[0]}) must divide by seg_num ({seg_num})")
+
+
+@infer_check("multi_dot")
+def _multi_dot(xs, *a, **k):
+    enforce(isinstance(xs, (list, tuple)) and len(xs) >= 2, "multi_dot",
+            "multi_dot needs a list of >= 2 matrices")
+    for i in range(len(xs) - 1):
+        a_, b_ = _shape(xs[i]), _shape(xs[i + 1])
+        ka = a_[-1]
+        kb = b_[0] if len(b_) >= 1 else None
+        enforce(ka == kb, "multi_dot",
+                f"matrices {i} and {i + 1} have incompatible inner "
+                f"dims: {list(a_)} @ {list(b_)}")

@@ -225,7 +225,22 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
             "param scope)")
     lens = length if length is not None else \
         Tensor(jnp.full((x.shape[0],), x.shape[1], jnp.int64))
-    scores, path = viterbi_decode(x, _t(transition), lens)
+    tr = _t(transition)
+    num_tags = x.shape[-1]
+    if tr.shape[0] == num_tags + 2:
+        # fluid layout: rows 0/1 are start/stop weights, rest is the
+        # square tag-transition matrix; fold start/stop into the first
+        # and last-valid emissions and decode with the square part
+        raw = tr.data
+        xr = x.data
+        lv = (lens.data if isinstance(lens, Tensor)
+              else jnp.asarray(lens)).astype(jnp.int32)
+        xr = xr.at[:, 0, :].add(raw[0])
+        xr = xr.at[jnp.arange(xr.shape[0]), lv - 1, :].add(raw[1])
+        scores, path = viterbi_decode(Tensor(xr), Tensor(raw[2:]), lens,
+                                      include_bos_eos_tag=False)
+    else:
+        scores, path = viterbi_decode(x, tr, lens)
     return path
 
 
@@ -318,54 +333,100 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 
 class StaticRNN:
-    """Step-wise RNN builder (reference StaticRNN): collect per-step
-    ops then scan. Dense form: the user supplies the step via
-    step_input/memory handles; internally a lax.scan over time."""
+    """Step-wise RNN builder (reference fluid/layers/control_flow.py
+    StaticRNN). Dense TPU form: ops inside `with rnn.step():` are
+    recorded into a private static Program (the same recorder
+    program_guard uses); `rnn()` replays that program as ONE fused
+    jax.lax.scan over time. Inputs are batch-major [B, T, ...]; outputs
+    stack per-step values to [B, T, ...]."""
 
     def __init__(self, name=None):
-        self._inputs = []
-        self._mems = []
-        self._step_fn: Optional[Callable] = None
-        self._outputs = []
+        self._prog = None
+        self._guard = None
+        self._inputs = []    # (var_name, full input Tensor [B, T, ...])
+        self._mems = []      # (var_name, init value)
+        self._updates = {}   # mem var name -> new var name
+        self._outputs = []   # output var names
 
     def step(self):
         import contextlib
 
+        from .program import Program, program_guard
+        self._prog = Program()
+        guard = program_guard(self._prog)
+
         @contextlib.contextmanager
         def ctx():
-            yield self
+            with guard:
+                yield self
 
         return ctx()
 
+    def _make_var(self, value, hint):
+        from .program import Variable
+        name = self._prog.add_tmp_var(value, hint=hint)
+        var = Variable(value, self._prog, name)
+        self._prog._build_vals[name] = var._data
+        return name, var
+
     def step_input(self, x):
-        self._inputs.append(_t(x))
-        return self._inputs[-1][:, 0]
+        x = _t(x)
+        name, var = self._make_var(x.data[:, 0], "rnn_in")
+        self._inputs.append((name, x))
+        return var
 
     def memory(self, init=None, shape=None, batch_ref=None,
-               init_value=0.0):
+               init_value=0.0, **kwargs):
         if init is None:
             b = (batch_ref.shape[0] if batch_ref is not None
-                 else self._inputs[0].shape[0])
-            init = Tensor(jnp.full((b,) + tuple(shape or ()),
+                 else self._inputs[0][1].shape[0])
+            init = Tensor(jnp.full((b,) + tuple(s for s in (shape or ())
+                                                if s not in (-1, None)),
                                    init_value, jnp.float32))
-        self._mems.append(_t(init))
-        return self._mems[-1]
+        init = _t(init)
+        name, var = self._make_var(init.data, "rnn_mem")
+        self._mems.append((name, init.data))
+        return var
 
     def update_memory(self, mem, new):
-        self._update = (mem, new)
+        self._updates[mem._static_name] = new._static_name
 
     def step_output(self, out):
-        self._outputs.append(out)
+        self._outputs.append(out._static_name)
 
     def output(self, *outs):
         for o in outs:
             self.step_output(o)
 
     def __call__(self):
-        raise NotImplementedError(
-            "StaticRNN's imperative step-recording is a fluid-era API; "
-            "build scans with paddle.nn.RNN / jax.lax.scan instead "
-            "(same capability, compiled as ONE fused loop)")
+        from .program import replay
+        if self._prog is None or not self._inputs:
+            raise ValueError("StaticRNN: record a step first "
+                             "(with rnn.step(): ...)")
+        in_names = [n for n, _ in self._inputs]
+        mem_names = [n for n, _ in self._mems]
+        xs = tuple(jnp.swapaxes(t.data, 0, 1)      # [T, B, ...]
+                   for _, t in self._inputs)
+        init = tuple(v for _, v in self._mems)
+        prog, updates, out_names = self._prog, self._updates, self._outputs
+        # read CURRENT parameter values (optimizer steps between record
+        # and replay must be visible), falling back to build-time inits
+        param_env = dict(prog._param_inits)
+        param_env.update({n: t._data
+                          for n, t in prog._param_refs.items()})
+
+        def step_fn(carry, xt):
+            env = dict(param_env)
+            env.update(zip(mem_names, carry))
+            env.update(zip(in_names, xt))
+            env = replay(prog, env)
+            new_carry = tuple(env[updates.get(m, m)] for m in mem_names)
+            outs = tuple(env[n] for n in out_names)
+            return new_carry, outs
+
+        _, stacked = jax.lax.scan(step_fn, init, xs)
+        outs = [Tensor(jnp.swapaxes(o, 0, 1)) for o in stacked]
+        return outs[0] if len(outs) == 1 else outs
 
 
 # ------------------------------------------- gated (documented) ops
@@ -385,18 +446,189 @@ def _lod_gate(name: str):
 sequence_concat = _lod_gate("sequence_concat")
 sequence_conv = _lod_gate("sequence_conv")
 sequence_enumerate = _lod_gate("sequence_enumerate")
-sequence_expand = _lod_gate("sequence_expand")
-sequence_expand_as = _lod_gate("sequence_expand_as")
-sequence_first_step = _lod_gate("sequence_first_step")
-sequence_last_step = _lod_gate("sequence_last_step")
-sequence_pad = _lod_gate("sequence_pad")
-sequence_pool = _lod_gate("sequence_pool")
 sequence_reshape = _lod_gate("sequence_reshape")
-sequence_reverse = _lod_gate("sequence_reverse")
 sequence_scatter = _lod_gate("sequence_scatter")
 sequence_slice = _lod_gate("sequence_slice")
-sequence_softmax = _lod_gate("sequence_softmax")
-sequence_unpad = _lod_gate("sequence_unpad")
+
+
+# ---------------- dense sequence ops on (data, lengths) pairs ----------
+# The reference's sequence_* layers consume LoD (ragged) tensors
+# (fluid/layers/sequence_lod.py). LoD does not exist on TPU; the dense
+# contract here is the same packed data plus an explicit int lengths
+# vector — exactly the information LoD level 1 carries. Ops whose math
+# is expressible on that pair are implemented below (VERDICT r2 #6);
+# the ragged-only ops above stay gated.
+
+def _seq_parts(length):
+    import numpy as _np
+    ln = _np.asarray(length.numpy() if hasattr(length, "numpy")
+                     else length).astype(_np.int64)
+    off = _np.concatenate([[0], _np.cumsum(ln)])
+    return ln, off
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Dense analog of sequence_pad (reference
+    fluid/layers/sequence_lod.py:934): packed x [T, ...] + `length` [N]
+    -> (padded [N, maxlen, ...], length). `length` is required — it is
+    the dense replacement for the input LoD."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    if length is None:
+        raise ValueError("dense sequence_pad requires length= (the "
+                         "explicit replacement for the input LoD)")
+    xr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    ln, off = _seq_parts(length)
+    n = len(ln)
+    m = int(maxlen) if maxlen is not None else int(ln.max()) if n else 0
+    idx = off[:-1, None] + _np.arange(m)[None, :]          # [N, maxlen]
+    mask = _np.arange(m)[None, :] < ln[:, None]
+    gathered = xr[jnp.asarray(_np.clip(idx, 0, max(xr.shape[0] - 1, 0)))]
+    pv = (pad_value.data if isinstance(pad_value, Tensor)
+          else jnp.asarray(pad_value)).astype(xr.dtype)
+    shape = (n, m) + (1,) * (xr.ndim - 1)
+    out = jnp.where(jnp.asarray(mask).reshape(shape), gathered, pv)
+    return Tensor(out), Tensor(jnp.asarray(ln))
+
+
+def sequence_unpad(x, length, name=None):
+    """Dense analog of sequence_unpad (sequence_lod.py:1036): padded
+    [N, maxlen, ...] + length [N] -> packed [T, ...]."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    xr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    ln, off = _seq_parts(length)
+    rows = _np.repeat(_np.arange(len(ln)), ln)
+    cols = _np.concatenate([_np.arange(l) for l in ln]) if len(ln) else         _np.zeros(0, _np.int64)
+    return Tensor(xr[jnp.asarray(rows), jnp.asarray(cols)])
+
+
+def sequence_reverse(x, length, name=None):
+    """Dense analog of sequence_reverse (sequence_lod.py:1434): reverse
+    each sequence of the packed x [T, ...] in place."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    xr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    ln, off = _seq_parts(length)
+    src = _np.concatenate([_np.arange(o + l - 1, o - 1, -1)
+                           for o, l in zip(off[:-1], ln)])         if len(ln) else _np.zeros(0, _np.int64)
+    return Tensor(xr[jnp.asarray(src)])
+
+
+def sequence_first_step(input, length=None, name=None):
+    """Dense analog of sequence_first_step (sequence_lod.py:435)."""
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None, name=None):
+    """Dense analog of sequence_last_step (sequence_lod.py:522)."""
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_pool(input, pool_type="average", length=None,
+                  pad_value=0.0, is_test=False, name=None):
+    """Dense analog of sequence_pool (sequence_lod.py:271): pool each
+    packed sequence to one row. pool_type: average/sum/sqrt/max/min/
+    first/last; empty sequences produce pad_value. `length` is required
+    — the dense replacement for the input LoD (argument order matches
+    the reference sequence_pool(input, pool_type, ...))."""
+    if length is None or isinstance(length, str):
+        raise ValueError(
+            "dense sequence_pool requires length= (the explicit "
+            "replacement for the input LoD)")
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    xr = input.data if isinstance(input, Tensor) else jnp.asarray(input)
+    ln, off = _seq_parts(length)
+    n = len(ln)
+    seg = jnp.asarray(_np.repeat(_np.arange(n), ln))
+    pt = pool_type.lower()
+    if pt in ("average", "mean", "sum", "sqrt"):
+        s = jax.ops.segment_sum(xr, seg, num_segments=n)
+        denom = jnp.asarray(_np.maximum(ln, 1)).astype(s.dtype)
+        denom = denom.reshape((n,) + (1,) * (xr.ndim - 1))
+        if pt in ("average", "mean"):
+            s = s / denom
+        elif pt == "sqrt":
+            s = s / jnp.sqrt(denom)
+        out = s
+    elif pt == "max":
+        out = jax.ops.segment_max(xr, seg, num_segments=n)
+    elif pt == "min":
+        out = jax.ops.segment_min(xr, seg, num_segments=n)
+    elif pt == "first":
+        out = xr[jnp.asarray(_np.minimum(off[:-1], max(xr.shape[0] - 1, 0)))]
+    elif pt == "last":
+        out = xr[jnp.asarray(_np.maximum(off[1:] - 1, 0))]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    empty = jnp.asarray((ln == 0).reshape((n,) + (1,) * (xr.ndim - 1)))
+    return Tensor(jnp.where(empty, jnp.asarray(pad_value, out.dtype), out))
+
+
+def sequence_softmax(input, length, name=None):
+    """Dense analog of sequence_softmax (sequence_lod.py:1151):
+    softmax within each packed sequence."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    xr = input.data if isinstance(input, Tensor) else jnp.asarray(input)
+    flat = xr.reshape(xr.shape[0])
+    ln, off = _seq_parts(length)
+    n = len(ln)
+    seg = jnp.asarray(_np.repeat(_np.arange(n), ln))
+    mx = jax.ops.segment_max(flat, seg, num_segments=n)
+    e = jnp.exp(flat - mx[seg])
+    z = jax.ops.segment_sum(e, seg, num_segments=n)
+    return Tensor((e / z[seg]).reshape(xr.shape))
+
+
+def sequence_expand(x, y, ref_level=-1, x_length=None, y_length=None,
+                    name=None):
+    """Dense analog of sequence_expand (sequence_lod.py:622): repeat
+    each sequence i of packed x `y_length[i]` times. x_length [N] plays
+    x's LoD (pass None for one-row sequences); y_length [N] plays
+    y's ref_level LoD repeat counts (y itself is unused in the dense
+    contract and may be None)."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    if y_length is None:
+        raise ValueError("dense sequence_expand requires y_length= "
+                         "(the repeat counts y's LoD would carry)")
+    xr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    rep = _np.asarray(y_length.numpy() if hasattr(y_length, "numpy")
+                      else y_length).astype(_np.int64)
+    if x_length is None:
+        ln = _np.ones(len(rep), _np.int64)
+        off = _np.arange(len(rep) + 1)
+    else:
+        ln, off = _seq_parts(x_length)
+    src = _np.concatenate(
+        [_np.tile(_np.arange(off[i], off[i] + ln[i]), max(int(r), 0))
+         for i, r in enumerate(rep)]) if len(rep) else         _np.zeros(0, _np.int64)
+    return Tensor(xr[jnp.asarray(src)])
+
+
+def sequence_expand_as(x, y, y_length=None, name=None):
+    """Dense analog of sequence_expand_as (sequence_lod.py:774): row i
+    of x becomes a sequence of y_length[i] copies."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from ..core.tensor import Tensor
+    if y_length is None:
+        raise ValueError("dense sequence_expand_as requires y_length=")
+    xr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    rep = _np.asarray(y_length.numpy() if hasattr(y_length, "numpy")
+                      else y_length).astype(_np.int64)
+    src = _np.repeat(_np.arange(len(rep)), rep)
+    return Tensor(xr[jnp.asarray(src)])
 
 
 def sparse_embedding(*a, **k):

@@ -125,6 +125,9 @@ class Program:
         self._param_inits: Dict[str, jax.Array] = {}
         # id(Tensor) -> var name for params captured during build
         self._param_ids: Dict[int, str] = {}
+        # var name -> live Tensor, so replayers can read CURRENT values
+        # (the Executor reads the scope; StaticRNN reads these)
+        self._param_refs: Dict[str, Any] = {}
         # (lr_var_name, optimizer) pairs; Executor refreshes @LR per run
         self._lr_hooks: List[Tuple[str, Any]] = []
         self._tmp_counter = 0
@@ -163,6 +166,7 @@ class Program:
                                    stop_gradient=t.stop_gradient)
         self._param_inits[name] = t._data
         self._param_ids[key] = name
+        self._param_refs[name] = t
         return name
 
     def add_tmp_var(self, value, hint="tmp") -> str:
@@ -199,6 +203,7 @@ class Program:
         p._build_vals = dict(self._build_vals)
         p._param_inits = dict(self._param_inits)
         p._param_ids = dict(self._param_ids)
+        p._param_refs = dict(self._param_refs)
         p._lr_hooks = [] if for_test else list(self._lr_hooks)
         p._tmp_counter = self._tmp_counter
         p.random_seed = self.random_seed

@@ -112,3 +112,114 @@ def test_valid_calls_unaffected():
                          axis=0).shape == [4, 2]
     assert paddle.reshape(paddle.ones([2, 3]), [-1]).shape == [6]
     assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+
+
+# ---- round-3 breadth (VERDICT r2 Next #7): the next failure magnets
+
+
+def test_slice_validators():
+    with pytest.raises(EnforceError, match=r"slice.*equal length"):
+        paddle.slice(paddle.ones([4, 4]), axes=[0, 1], starts=[0],
+                     ends=[2, 2])
+    with pytest.raises(EnforceError, match=r"strided_slice.*non-zero"):
+        paddle.strided_slice(paddle.ones([4]), axes=[0], starts=[0],
+                             ends=[4], strides=[0])
+
+
+def test_pad_validators():
+    import paddle_tpu.nn.functional as F
+    with pytest.raises(EnforceError, match=r"pad.*even length"):
+        F.pad(paddle.ones([2, 2]), [1, 0, 1])
+    with pytest.raises(EnforceError, match=r"pad.*mode"):
+        F.pad(paddle.ones([2, 2]), [1, 1], mode="bogus")
+
+
+def test_gather_scatter_validators():
+    with pytest.raises(EnforceError, match=r"gather_nd.*<= x.ndim"):
+        paddle.gather_nd(paddle.ones([2, 2]),
+                         paddle.to_tensor(np.zeros((1, 3), np.int64)))
+    with pytest.raises(EnforceError, match=r"scatter.*trailing dims"):
+        paddle.scatter(paddle.ones([4, 3]),
+                       paddle.to_tensor(np.array([0], np.int64)),
+                       paddle.ones([1, 5]))
+    with pytest.raises(EnforceError, match=r"scatter_nd_add.*updates"):
+        paddle.scatter_nd_add(
+            paddle.ones([4, 3]),
+            paddle.to_tensor(np.zeros((2, 1), np.int64)),
+            paddle.ones([2, 7]))
+
+
+def test_pool_validators():
+    import paddle_tpu.nn.functional as F
+    with pytest.raises(EnforceError, match=r"max_pool2d.*4-d"):
+        F.max_pool2d(paddle.ones([2, 3, 8]), 2)
+    with pytest.raises(EnforceError, match=r"avg_pool1d.*3-d"):
+        F.avg_pool1d(paddle.ones([2, 3, 8, 8]), 2)
+    with pytest.raises(EnforceError, match=r"kernel_size needs 2"):
+        F.max_pool2d(paddle.ones([2, 3, 8, 8]), [2, 2, 2])
+
+
+def test_conv_transpose_validators():
+    import paddle_tpu.nn.functional as F
+    # transpose weights are [in, out//groups, kh, kw]
+    with pytest.raises(EnforceError,
+                       match=r"conv2d_transpose.*weight.shape\[0\]"):
+        F.conv2d_transpose(paddle.ones([1, 3, 8, 8]),
+                           paddle.ones([5, 4, 3, 3]))
+    with pytest.raises(EnforceError, match=r"conv3d.*5-d"):
+        F.conv3d(paddle.ones([1, 3, 8, 8]), paddle.ones([4, 3, 3, 3, 3]))
+
+
+def test_norm_validators():
+    import paddle_tpu.nn.functional as F
+    with pytest.raises(EnforceError, match=r"group_norm.*divide"):
+        F.group_norm(paddle.ones([2, 6, 4, 4]), num_groups=4)
+    with pytest.raises(EnforceError,
+                       match=r"instance_norm.*channel count"):
+        F.instance_norm(paddle.ones([2, 3, 4, 4]),
+                        weight=paddle.ones([5]))
+
+
+def test_interpolate_grid_sample_validators():
+    import paddle_tpu.nn.functional as F
+    with pytest.raises(EnforceError, match=r"interpolate.*required"):
+        F.interpolate(paddle.ones([1, 3, 8, 8]))
+    with pytest.raises(EnforceError, match=r"mutually exclusive"):
+        F.interpolate(paddle.ones([1, 3, 8, 8]), size=[4, 4],
+                      scale_factor=2)
+    with pytest.raises(EnforceError, match=r"grid_sample.*last dim"):
+        F.grid_sample(paddle.ones([1, 3, 8, 8]),
+                      paddle.ones([1, 4, 4, 3]))
+
+
+def test_misc_r3_validators():
+    with pytest.raises(EnforceError, match=r"kthvalue.*k must be"):
+        paddle.kthvalue(paddle.ones([4]), k=9)
+    with pytest.raises(EnforceError, match=r"cross.*size 3"):
+        paddle.cross(paddle.ones([2, 4]), paddle.ones([2, 4]), axis=1)
+    with pytest.raises(EnforceError, match=r"dot.*equal-shape"):
+        paddle.dot(paddle.ones([3]), paddle.ones([4]))
+    with pytest.raises(EnforceError, match=r"diagonal.*must differ"):
+        paddle.diagonal(paddle.ones([3, 3]), axis1=0, axis2=0)
+    with pytest.raises(EnforceError, match=r"temporal_shift.*divide"):
+        import paddle_tpu.nn.functional as F
+        F.temporal_shift(paddle.ones([3, 4, 2, 2]), seg_num=2)
+    with pytest.raises(EnforceError, match=r"pixel_shuffle.*divide"):
+        import paddle_tpu.nn.functional as F
+        F.pixel_shuffle(paddle.ones([1, 6, 2, 2]), 2)
+
+
+def test_r3_valid_calls_unaffected():
+    import paddle_tpu.nn.functional as F
+    assert F.max_pool2d(paddle.ones([1, 3, 8, 8]), 2).shape \
+        == [1, 3, 4, 4]
+    assert paddle.gather_nd(
+        paddle.ones([2, 3]),
+        paddle.to_tensor(np.array([[0, 1]], np.int64))).shape == [1]
+    assert F.conv2d_transpose(paddle.ones([1, 3, 4, 4]),
+                              paddle.ones([3, 5, 3, 3])).shape \
+        == [1, 5, 6, 6]
+    assert paddle.slice(paddle.ones([4, 4]), [0], [1], [3]).shape \
+        == [2, 4]
+    out, idx = paddle.kthvalue(paddle.ones([4]), k=2)
+    assert float(out.numpy()) == 1.0
