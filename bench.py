@@ -307,8 +307,60 @@ def bench_vit_l(dev, on_tpu):
     }
 
 
+def bench_moe_block(dev, on_tpu):
+    """Single-chip MoE transformer block (EP correctness lives in the
+    dryrun/tests; this is the expert-compute perf leg — BASELINE.md
+    r3 MoE row). 8 local experts, gshard gate."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models.gpt import CONFIGS, GPTBlock, GPTConfig
+    import dataclasses
+
+    base = CONFIGS["gpt2-small" if on_tpu else "test-tiny"]
+    cfg = dataclasses.replace(base, moe_num_experts=8,
+                              moe_capacity_factor=1.25)
+    paddle.seed(0)
+
+    class OneBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.block = GPTBlock(cfg)
+
+        def forward(self, x):
+            return self.block(x)
+
+    model = OneBlock()
+    model.bfloat16() if on_tpu else None
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=on_tpu)
+    from paddle_tpu.distributed.parallel.moe import aux_loss
+    loss_fn = lambda out, labels: \
+        (out.astype("float32") ** 2).mean() + aux_loss(model)
+    step = paddle.jit.TrainStep(model, opt, loss_fn)
+    b, s = (16, 1024) if on_tpu else (2, 32)
+    rng = np.random.RandomState(0)
+    h = rng.randn(b, s, cfg.hidden_size).astype(np.float32)
+    x = paddle.to_tensor(h).astype("bfloat16" if on_tpu else "float32")
+    y = paddle.zeros([1])
+    xla_flops = float(step.cost_analysis(x, y).get("flops", 0.0))
+    iters = 30 if on_tpu else 2
+    dt, loss = _time_steps(step, x, y, iters)
+    tokens_per_sec = b * s * iters / dt
+    mfu = (xla_flops * iters / dt) / peak_flops(dev)
+    return {
+        "metric": f"moe block (8 experts, gshard, h={cfg.hidden_size}) "
+                  f"train tokens/sec/chip (b{b} s{s}, MFU={mfu:.3f}, "
+                  f"device={dev.device_kind})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
+    "moe-block": bench_moe_block,
     "resnet50": bench_resnet50,
     "ernie-base": bench_ernie_base,
     "bert-large": bench_bert_large,

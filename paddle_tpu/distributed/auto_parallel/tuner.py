@@ -39,6 +39,8 @@ _COLLECTIVE_RE = re.compile(
     r"(all-reduce|all-gather|reduce-scatter|collective-permute)"
     r"(?:-start)?\(", )
 _GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*\})\}")
+_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
 _TUPLE_ELEM_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 
@@ -48,6 +50,37 @@ def _shape_bytes(dtype: str, dims: str) -> int:
         if d:
             n *= int(d)
     return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _crosses_slices(line: str, devices_per_slice: int) -> bool:
+    """True when any replica group mixes devices from different slices.
+    Handles both HLO forms: explicit brace lists {{0,1},{2,3}} and the
+    iota form [rows,cols]<=[dims]T(perm) XLA prints for regular
+    meshes."""
+    gm = _GROUPS_RE.search(line)
+    if gm:
+        for grp in re.findall(r"\{([\d,]+)\}", gm.group(1)):
+            slices = {int(i) // devices_per_slice
+                      for i in grp.split(",")}
+            if len(slices) > 1:
+                return True
+        return False
+    im = _IOTA_RE.search(line)
+    if im:
+        import numpy as _np
+        rows, cols = int(im.group(1)), int(im.group(2))
+        dims = [int(d) for d in im.group(3).split(",")]
+        ids = _np.arange(rows * cols).reshape(dims)
+        if im.group(4):
+            perm = [int(p) for p in im.group(4).split(",")]
+            ids = ids.transpose(perm)
+        for grp in ids.reshape(rows, cols):
+            if len({int(i) // devices_per_slice for i in grp}) > 1:
+                return True
+        return False
+    # unparseable groups: bill conservatively as DCN-crossing so the
+    # tuner never under-costs a slice-spanning collective
+    return "replica_groups" in line
 
 
 def collective_bytes(hlo_text: str, devices_per_slice: Optional[int]
@@ -68,14 +101,8 @@ def collective_bytes(hlo_text: str, devices_per_slice: Optional[int]
         else:
             size = _shape_bytes(dtype, dims)
         crosses = False
-        gm = _GROUPS_RE.search(line)
-        if gm and devices_per_slice:
-            for grp in re.findall(r"\{([\d,]+)\}", gm.group(1)):
-                slices = {int(i) // devices_per_slice
-                          for i in grp.split(",")}
-                if len(slices) > 1:
-                    crosses = True
-                    break
+        if devices_per_slice:
+            crosses = _crosses_slices(line, devices_per_slice)
         # ring cost factor (k-1)/k folded into bw constants; bytes are
         # the payload itself
         if crosses:

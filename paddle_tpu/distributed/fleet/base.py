@@ -59,8 +59,12 @@ _FLEET_STRATEGY: Optional[DistributedStrategy] = None
 
 
 def init(role_maker=None, is_collective: bool = True,
-         strategy: Optional[DistributedStrategy] = None):
-    """≈ fleet.init: rendezvous + build the mesh."""
+         strategy: Optional[DistributedStrategy] = None,
+         slices=None):
+    """≈ fleet.init: rendezvous + build the mesh. `slices` (list of
+    device groups) builds a DCN-aware hierarchical mesh where only the
+    dp axis crosses slice boundaries (topology.create_hybrid_device_mesh
+    — the ProcessGroupHeter analog)."""
     global _FLEET_STRATEGY
     init_parallel_env()
     strategy = strategy or DistributedStrategy()
@@ -69,7 +73,7 @@ def init(role_maker=None, is_collective: bool = True,
     hcg = topology.HybridCommunicateGroup(
         dp_degree=hc.dp_degree, mp_degree=hc.mp_degree,
         pp_degree=hc.pp_degree, sharding_degree=hc.sharding_degree,
-        sp_degree=hc.sp_degree, ep_degree=hc.ep_degree)
+        sp_degree=hc.sp_degree, ep_degree=hc.ep_degree, slices=slices)
     topology.set_hybrid_communicate_group(hcg)
     return hcg
 

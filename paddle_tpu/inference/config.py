@@ -32,6 +32,7 @@ class Config:
         self.device: str = "tpu"
         self._memory_optim = True
         self._ir_optim = True
+        self._int8_compute = False
         self._compile_cache_dir: Optional[str] = None
         self._math_threads = 1
         if prog_file is not None:
@@ -65,6 +66,17 @@ class Config:
 
     def disable_gpu(self):
         self.device = "cpu"
+        return self
+
+    def enable_int8_compute(self, flag: bool = True):
+        """With precision Int8, run Linear matmuls as int8 x int8 ->
+        int32 on the MXU (2x bf16 peak; measured 1.5-1.8x on v5e MLP
+        blocks — BASELINE.md r3) instead of weight-only dequant.
+        Activations quantize with PTQ-calibrated scales when the
+        served layer came from PTQ.convert(), dynamically otherwise.
+        ≈ the reference PTQ deployment's int8 kernels
+        (slim/quantization/post_training_quantization.py)."""
+        self._int8_compute = flag
         return self
 
     def enable_memory_optim(self, flag: bool = True):

@@ -142,7 +142,21 @@ class Predictor:
                     if jnp.issubdtype(v.dtype, jnp.floating) else v
                     for v in vals]
         scales: Dict[str, jax.Array] = {}
-        if prec == PrecisionType.Int8:
+        if prec == PrecisionType.Int8 and \
+                getattr(self.config, "_int8_compute", False):
+            # int8 COMPUTE: swap Linears for int8 x int8 -> int32
+            # modules before tracing (quantization/int8_compute.py);
+            # remaining float params serve bf16
+            from ..quantization.int8_compute import \
+                convert_to_int8_compute
+            layer = convert_to_int8_compute(layer, inplace=False)
+            state = layer.state_dict()
+            names = list(state.keys())
+            vals = [t._data for t in state.values()]
+            vals = [v.astype(jnp.bfloat16)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for v in vals]
+        elif prec == PrecisionType.Int8:
             # int8 serving (the reference's PTQ deployment,
             # slim/quantization/post_training_quantization.py):
             # Linear/Conv weights live in HBM as int8 + per-channel

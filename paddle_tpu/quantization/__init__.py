@@ -16,3 +16,5 @@ from .observers import (AbsmaxObserver, AVGObserver,  # noqa: F401
                         ChannelWiseAbsmaxObserver)
 from .ptq import PTQ  # noqa: F401
 from .qat import QAT, QuantedConv2D, QuantedLinear  # noqa: F401
+from .int8_compute import (Int8ComputeLinear,  # noqa: F401
+                           convert_to_int8_compute)

@@ -1,0 +1,119 @@
+"""Int8 COMPUTE path: int8 x int8 -> int32 matmuls on the MXU.
+
+Reference analog: the deployed form of PTQ
+(slim/quantization/post_training_quantization.py) — quantized models
+run int8 kernels, not dequantized float. The TPU MXU natively executes
+int8 x int8 -> int32 at 2x the bf16 rate (v5e: 394 vs 197 TOPS), which
+is the actual payoff of PTQ; the r2 serving path only dequantized
+weights to bf16 (memory relief). Here `Int8ComputeLinear` keeps the
+weight in int8 and quantizes the activation (calibrated PTQ scale when
+available, dynamic absmax otherwise), so the dot itself runs
+int8 x int8 with `preferred_element_type=int32`, then rescales once.
+
+convert_to_int8_compute() walks a model (plain, or PTQ.convert()
+output) and swaps Linear layers in place. Conv stays weight-only: XLA
+TPU lowers int8 convolutions through an upcast today, so there is no
+compute win to claim (documented limitation).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn.layers_common import Linear
+from .fake_quant import quantize_int8
+
+__all__ = ["Int8ComputeLinear", "convert_to_int8_compute"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Int8ComputeLinear(Layer):
+    """Linear whose matmul executes int8 x int8 -> int32 on the MXU.
+
+    weight is stored int8 [in, out] with a per-out-channel float scale
+    (w ~ q_w * w_scale / 127). Activations quantize per tensor: with a
+    calibrated `act_scale` (PTQ) the scale is constant; without one,
+    dynamic quantization computes absmax per call (one extra reduction,
+    fused by XLA)."""
+
+    def __init__(self, weight_int8, w_scale, bias=None,
+                 act_scale: Optional[float] = None):
+        super().__init__()
+        # registered buffers: state_dict round-trips the quantized
+        # weights, and jitted serving passes them as program INPUTS
+        # (not giant embedded constants)
+        self.register_buffer(
+            "weight_int8", Tensor(jnp.asarray(_raw(weight_int8),
+                                              jnp.int8)))
+        self.register_buffer(
+            "weight_scale",
+            Tensor(jnp.asarray(_raw(w_scale), jnp.float32) / 127.0))
+        if bias is not None:
+            self.register_buffer("bias", Tensor(_raw(bias)))
+        else:
+            self.bias = None
+        self._act_scale = None if act_scale is None else float(act_scale)
+
+    @classmethod
+    def from_linear(cls, lin: Linear, act_scale=None):
+        q, s = quantize_int8(lin.weight._data, axis=1)
+        return cls(q, s, None if lin.bias is None else lin.bias._data,
+                   act_scale)
+
+    def forward(self, x):
+        xr = _raw(x).astype(jnp.float32)
+        qw = _raw(self.weight_int8)
+        sw = _raw(self.weight_scale).astype(jnp.float32)
+        if self._act_scale is not None:
+            sx = jnp.float32(self._act_scale) / 127.0
+        else:
+            sx = jnp.max(jnp.abs(xr)) / 127.0
+            sx = jnp.where(sx == 0, 1.0, sx)
+        qx = jnp.clip(jnp.round(xr / sx), -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            qx, qw, (((xr.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (sx * sw)
+        if self.bias is not None:
+            out = out + _raw(self.bias).astype(jnp.float32)
+        return Tensor(out.astype(_raw(x).dtype)
+                      if jnp.issubdtype(_raw(x).dtype, jnp.floating)
+                      else out)
+
+
+def convert_to_int8_compute(model: Layer,
+                            act_scales: Optional[Dict[str, float]] = None,
+                            inplace: bool = True) -> Layer:
+    """Swap Linear sublayers for Int8ComputeLinear. `act_scales` maps
+    layer paths to calibrated activation scales (PTQ.quant_info's
+    act_scale entries); layers without one use dynamic quantization."""
+    if not inplace:
+        import copy
+        model = copy.deepcopy(model)
+    act_scales = act_scales or {}
+
+    def walk(layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            full = f"{prefix}{name}"
+            from .ptq import _FrozenQuantLinear
+            if isinstance(sub, _FrozenQuantLinear):
+                layer._sub_layers[name] = Int8ComputeLinear.from_linear(
+                    sub.inner, act_scale=sub.act_scale)
+            elif isinstance(sub, Linear):
+                layer._sub_layers[name] = Int8ComputeLinear.from_linear(
+                    sub, act_scale=act_scales.get(full))
+            else:
+                walk(sub, full + ".")
+
+    walk(model, "")
+    return model

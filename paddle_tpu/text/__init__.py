@@ -1,4 +1,6 @@
 """paddle.text analog (python/paddle/text/) — NLP datasets +
 viterbi_decode/ViterbiDecoder."""
 from . import datasets  # noqa: F401
+from .datasets import (Conll05st, Imdb, Imikolov,  # noqa: F401
+                       Movielens, UCIHousing, WMT14, WMT16)
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401

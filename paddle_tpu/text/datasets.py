@@ -12,7 +12,8 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["Imdb", "UCIHousing", "FakeTextClassification"]
+__all__ = ["Imdb", "UCIHousing", "FakeTextClassification",
+           "Imikolov", "Conll05st", "Movielens", "WMT14", "WMT16"]
 
 
 def _no_download(name: str):
@@ -131,3 +132,427 @@ class FakeTextClassification(Dataset):
         ids = rng.randint(0, self.vocab_size,
                           self.seq_len).astype(np.int64)
         return ids, int(rng.randint(self.num_classes))
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset from the standard simple-examples
+    tgz (reference text/datasets/imikolov.py). data_type 'NGRAM'
+    (sliding windows of window_size) or 'SEQ' (src/trg shifted pairs);
+    vocab built from train+valid with min_word_freq, sorted by
+    (-freq, word), '<unk>' last."""
+
+    _TRAIN = "./simple-examples/data/ptb.train.txt"
+    _VALID = "./simple-examples/data/ptb.valid.txt"
+
+    def __init__(self, data_file: Optional[str] = None,
+                 data_type: str = "NGRAM", window_size: int = -1,
+                 mode: str = "train", min_word_freq: int = 50,
+                 download: bool = False):
+        data_type = data_type.upper()
+        if data_type not in ("NGRAM", "SEQ"):
+            raise AssertionError(
+                f"data type should be 'NGRAM', 'SEQ', but got {data_type}")
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise AssertionError(
+                f"mode should be 'train', 'test', but got {mode}")
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data_type, self.mode = data_type, mode
+        self.window_size = window_size
+
+        import collections
+        freq: dict = collections.defaultdict(int)
+        with tarfile.open(data_file) as tf:
+            for member in (self._TRAIN, self._VALID):
+                for line in tf.extractfile(member):
+                    for w in line.strip().split():
+                        freq[w.decode()] += 1
+                    freq["<s>"] += 1
+                    freq["<e>"] += 1
+            freq.pop("<unk>", None)
+            kept = sorted([kv for kv in freq.items()
+                           if kv[1] > min_word_freq],
+                          key=lambda kv: (-kv[1], kv[0]))
+            words = [w for w, _ in kept]
+            self.word_idx = {w: i for i, w in enumerate(words)}
+            self.word_idx["<unk>"] = len(words)
+
+            src = self._TRAIN if mode == "train" else \
+                "./simple-examples/data/ptb.test.txt"
+            self.data: List = []
+            unk = self.word_idx["<unk>"]
+            for line in tf.extractfile(src):
+                toks = ["<s>"] + line.strip().decode().split() + ["<e>"]
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                if data_type == "NGRAM":
+                    if window_size <= 0:
+                        raise AssertionError(
+                            "window_size must be set for NGRAM data")
+                    if len(ids) >= window_size:
+                        for i in range(window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - window_size:i]))
+                else:
+                    self.data.append((ids[:-1], ids[1:]))
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return tuple(np.asarray(x) for x in row)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL dataset (reference text/datasets/conll05.py):
+    reads the standard conll05st-release tarball (test.wsj words/props
+    gz members) plus word/verb/label dicts; items are the 9-tuple
+    (word, ctx_n2..ctx_p2, pred, mark, label) index arrays."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 word_dict_file: Optional[str] = None,
+                 verb_dict_file: Optional[str] = None,
+                 target_dict_file: Optional[str] = None,
+                 emb_file: Optional[str] = None, download: bool = False):
+        if None in (data_file, word_dict_file, verb_dict_file,
+                    target_dict_file):
+            _no_download(type(self).__name__)
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self._emb_file = emb_file
+        self._load_anno(data_file)
+
+    @staticmethod
+    def _load_dict(path):
+        d = {}
+        with open(path) as f:
+            for i, line in enumerate(f):
+                d[line.strip()] = i
+        return d
+
+    @staticmethod
+    def _load_label_dict(path):
+        """Expand the bracketed tag list into B-/I- variants + O
+        (reference conll05.py:167)."""
+        d = {}
+        tag_dict = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("B-"):
+                    tag_dict.add(line[2:])
+                elif line.startswith("I-"):
+                    tag_dict.add(line[2:])
+        index = 0
+        for tag in sorted(tag_dict):
+            for prefix in ("B-", "I-"):
+                d[prefix + tag] = index
+                index += 1
+        d["O"] = index
+        return d
+
+    def _load_anno(self, data_file):
+        import gzip
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentences, labels, one_seg = [], [], []
+                for word, label in zip(words_file, props_file):
+                    word = word.strip().decode()
+                    label = label.strip().decode().split()
+                    if len(label) == 0:  # end of sentence
+                        for i in range(len(one_seg[0]) if one_seg
+                                       else 0):
+                            labels.append([x[i] for x in one_seg])
+                        if len(labels) >= 1:
+                            verb_list = [x for x in labels[0]
+                                         if x != "-"]
+                            for i, lbl in enumerate(labels[1:]):
+                                self.sentences.append(sentences)
+                                self.predicates.append(verb_list[i])
+                                self.labels.append(
+                                    self._spans_to_bio(lbl))
+                        sentences, labels, one_seg = [], [], []
+                    else:
+                        sentences.append(word)
+                        one_seg.append(label)
+
+    @staticmethod
+    def _spans_to_bio(lbl):
+        out, cur, inside = [], "O", False
+        for l in lbl:
+            if l == "*" and not inside:
+                out.append("O")
+            elif l == "*" and inside:
+                out.append("I-" + cur)
+            elif l == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in l and ")" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = False
+            elif "(" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"Unexpected label: {l}")
+        return out
+
+    def __getitem__(self, idx):
+        UNK_IDX = 0
+        sentence, labels = self.sentences[idx], self.labels[idx]
+        predicate = self.predicates[idx]
+        sen_len = len(sentence)
+        verb_index = labels.index("B-V")
+        mark = [0] * len(labels)
+        ctx = {}
+        for off, name, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                               (0, "0", None), (1, "p1", "eos"),
+                               (2, "p2", "eos")):
+            j = verb_index + off
+            if 0 <= j < len(labels):
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = pad
+        word_idx = [self.word_dict.get(w, UNK_IDX) for w in sentence]
+        rows = [np.array(word_idx)]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            rows.append(np.array(
+                [self.word_dict.get(ctx[name], UNK_IDX)] * sen_len))
+        rows.append(np.array(
+            [self.predicate_dict.get(predicate)] * sen_len))
+        rows.append(np.array(mark))
+        rows.append(np.array([self.label_dict.get(w) for w in labels]))
+        return tuple(rows)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+
+class Movielens(Dataset):
+    """MovieLens ml-1m from the standard zip (reference
+    text/datasets/movielens.py): items are user fields + movie fields
+    + [[rating*2-5]] as arrays; train/test split by test_ratio with
+    the global numpy RNG, matching the reference."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 mode: str = "train", test_ratio: float = 0.1,
+                 rand_seed: int = 0, download: bool = False):
+        import zipfile
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.mode = mode.lower()
+        np.random.seed(rand_seed)
+        pat = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin").strip() \
+                        .split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = pat.match(title).group(1).strip()
+                    self.movie_info[int(mid)] = (int(mid), cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.movie_title_dict = {w: i for i, w in
+                                     enumerate(sorted(title_words))}
+            self.categories_dict = {c: i for i, c in
+                                    enumerate(sorted(categories))}
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job = line.decode("latin") \
+                        .strip().split("::")[:4]
+                    self.user_info[int(uid)] = (
+                        int(uid), 0 if gender == "M" else 1,
+                        int(age), int(job))
+            self.data = []
+            is_test = self.mode == "test"
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (np.random.random() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode("latin") \
+                        .strip().split("::")
+                    uid, mid = int(uid), int(mid)
+                    rating = float(rating) * 2 - 5.0
+                    u = self.user_info[uid]
+                    m = self.movie_info[mid]
+                    self.data.append(
+                        [[u[0]], [u[1]], [u[2]], [u[3]], [m[0]],
+                         [self.categories_dict[c] for c in m[1]],
+                         [self.movie_title_dict[w.lower()]
+                          for w in m[2].split()],
+                         [rating]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(Dataset):
+    """WMT14 en->fr from the standard tarball layout (reference
+    text/datasets/wmt14.py): *src.dict / *trg.dict members plus
+    '{mode}/{mode}' tab-separated pair files; items are
+    (src_ids, trg_ids, trg_ids_next)."""
+
+    _START, _END, _UNK_IDX = "<s>", "<e>", 2
+
+    def __init__(self, data_file: Optional[str] = None,
+                 mode: str = "train", dict_size: int = -1,
+                 download: bool = False):
+        if data_file is None:
+            _no_download(type(self).__name__)
+        if mode.lower() not in ("train", "test", "gen"):
+            raise AssertionError(
+                f"mode should be 'train', 'test' or 'gen', got {mode}")
+        if dict_size <= 0:
+            raise AssertionError(
+                "dict_size should be set as positive number")
+        self.mode = mode.lower()
+        self.dict_size = dict_size
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.strip().decode()] = i
+            return out
+
+        with tarfile.open(data_file) as f:
+            names = [m.name for m in f if m.name.endswith("src.dict")]
+            self.src_dict = to_dict(f.extractfile(names[0]), dict_size)
+            names = [m.name for m in f if m.name.endswith("trg.dict")]
+            self.trg_dict = to_dict(f.extractfile(names[0]), dict_size)
+            fname = f"{self.mode}/{self.mode}"
+            for name in [m.name for m in f if m.name.endswith(fname)]:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, self._UNK_IDX)
+                           for w in [self._START] + parts[0].split()
+                           + [self._END]]
+                    trg = [self.trg_dict.get(w, self._UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.trg_ids_next.append(
+                        trg + [self.trg_dict[self._END]])
+                    self.trg_ids.append(
+                        [self.trg_dict[self._START]] + trg)
+                    self.src_ids.append(src)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(Dataset):
+    """WMT16 en<->de from the standard tarball (reference
+    text/datasets/wmt16.py): 'wmt16/{train,val,test}' tab-separated
+    files; vocab built from the train split per language with
+    <pad>/<s>/<e>/<unk> specials; items are (src_ids, trg_ids,
+    trg_ids_next)."""
+
+    _SPECIALS = ["<pad>", "<s>", "<e>", "<unk>"]
+
+    def __init__(self, data_file: Optional[str] = None,
+                 mode: str = "train", src_dict_size: int = -1,
+                 trg_dict_size: int = -1, lang: str = "en",
+                 download: bool = False):
+        if data_file is None:
+            _no_download(type(self).__name__)
+        if mode.lower() not in ("train", "test", "val"):
+            raise AssertionError(
+                f"mode should be 'train', 'test' or 'val', got {mode}")
+        self.mode = mode.lower()
+        self.lang = lang
+        # single pass over wmt16/train counts both language columns
+        import collections
+        freqs = [collections.defaultdict(int),
+                 collections.defaultdict(int)]
+        with tarfile.open(data_file) as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for col in (0, 1):
+                    for w in parts[col].split():
+                        freqs[col][w] += 1
+        src_col = 0 if lang == "en" else 1
+        self.src_dict = self._freq_to_dict(freqs[src_col],
+                                           src_dict_size)
+        self.trg_dict = self._freq_to_dict(freqs[1 - src_col],
+                                           trg_dict_size)
+        self._load(data_file)
+
+    def _freq_to_dict(self, freq, dict_size):
+        words = [w for w, _ in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))]
+        if dict_size > 0:
+            words = words[:max(dict_size - len(self._SPECIALS), 0)]
+        vocab = self._SPECIALS + words
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load(self, data_file):
+        bos, eos = self.src_dict["<s>"], self.src_dict["<e>"]
+        unk_s, unk_t = self.src_dict["<unk>"], self.trg_dict["<unk>"]
+        src_col = 0 if self.lang == "en" else 1
+        member = {"train": "wmt16/train", "test": "wmt16/test",
+                  "val": "wmt16/val"}[self.mode]
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(data_file) as f:
+            for line in f.extractfile(member):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [bos] + [self.src_dict.get(w, unk_s)
+                               for w in parts[src_col].split()] + [eos]
+                trg_words = [self.trg_dict.get(w, unk_t)
+                             for w in parts[1 - src_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids.append(
+                    [self.trg_dict["<s>"]] + trg_words)
+                self.trg_ids_next.append(
+                    trg_words + [self.trg_dict["<e>"]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d

@@ -1,0 +1,104 @@
+"""Int8 compute path (VERDICT r2 Next #5): int8 x int8 -> int32 dots on
+the MXU, accuracy-bounded vs the float model, wired into the predictor.
+Measured on one v5e chip: 1.49x (b256) / 1.79x (b2048) over bf16 on a
+3-layer 4096^2 MLP block — see BASELINE.md r3."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import PTQ, convert_to_int8_compute
+from paddle_tpu.quantization.int8_compute import Int8ComputeLinear
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                         nn.Linear(64, 8))
+
+
+def test_dynamic_int8_accuracy_bounded():
+    model = _mlp()
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(16, 32).astype(np.float32))
+    ref = np.asarray(model(x).data)
+    m = convert_to_int8_compute(model, inplace=False)
+    assert isinstance(m[0], Int8ComputeLinear)
+    got = np.asarray(m(x).data)
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def test_ptq_calibrated_int8_accuracy_bounded():
+    model = _mlp()
+    model.eval()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 32).astype(np.float32))
+    ref = np.asarray(model(x).data)
+    ptq = PTQ()
+    q = ptq.quantize(model, inplace=False)
+    for _ in range(4):
+        q(paddle.to_tensor(rng.randn(16, 32).astype(np.float32)))
+    conv = ptq.convert(q)
+    m = convert_to_int8_compute(conv)
+    # calibrated scales flow from the PTQ wrapper
+    assert m[0]._act_scale is not None
+    got = np.asarray(m(x).data)
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.08, rel
+
+
+def test_int8_dot_in_program():
+    """The compiled program must contain a true i8 x i8 -> i32 dot —
+    the whole point vs the weight-only dequant path."""
+    import jax
+    model = _mlp()
+    model.eval()
+    m = convert_to_int8_compute(model, inplace=False)
+    x = np.random.RandomState(0).randn(16, 32).astype(np.float32)
+    sd = {k: v._data for k, v in m.state_dict().items()}
+    from paddle_tpu.jit.api import functional_call
+
+    def f(state, a):
+        return functional_call(m, state, paddle.Tensor(a)).data
+
+    txt = jax.jit(f).lower(sd, x).as_text()
+    assert "xi8>" in txt and "xi32>" in txt
+    assert "i8>, tensor<32x64xi8>) -> tensor<16x64xi32>" in txt
+
+
+def test_state_dict_roundtrip():
+    model = _mlp()
+    model.eval()
+    m = convert_to_int8_compute(model, inplace=False)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 32).astype(np.float32))
+    ref = np.asarray(m(x).data)
+    sd = m.state_dict()
+    assert any("weight_int8" in k for k in sd)
+    m2 = convert_to_int8_compute(_mlp(), inplace=False)
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(m2(x).data), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_int8_compute_path():
+    from paddle_tpu.inference import Config, PrecisionType, \
+        create_predictor
+    model = _mlp()
+    model.eval()
+    x = np.random.RandomState(0).randn(8, 32).astype(np.float32)
+    ref = np.asarray(model(paddle.to_tensor(x)).data)
+    cfg = Config().from_layer(
+        model, [paddle.to_tensor(np.zeros((8, 32), np.float32))])
+    cfg.enable_tpu(PrecisionType.Int8)
+    cfg.enable_int8_compute()
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    rel = np.abs(out - ref).max() / np.abs(ref).max()
+    assert rel < 0.06, rel

@@ -1,0 +1,110 @@
+"""Real ONNX emission (VERDICT r2 Next #9): hand-encoded protobuf for
+the Linear/Conv/Norm subset, validated structurally with the in-tree
+wire parser and (when available) `protoc --decode_raw`."""
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.onnx_proto import DT_FLOAT, export_onnx, parse_wire
+
+
+def _model():
+    paddle.seed(0)
+    return nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+        nn.MaxPool2D(2), nn.AdaptiveAvgPool2D(1), nn.Flatten(),
+        nn.Linear(8, 4), nn.Softmax())
+
+
+def _graph_fields(path):
+    model_fields = parse_wire(open(path, "rb").read())
+    by = {}
+    for f, w, v in model_fields:
+        by.setdefault(f, []).append(v)
+    assert by[1] == [8]          # ir_version
+    graph = parse_wire(by[7][0])
+    return by, graph
+
+
+def test_structure_and_ops(tmp_path):
+    m = _model()
+    m.eval()
+    p = export_onnx(m, str(tmp_path / "m"), [1, 3, 16, 16])
+    assert p.endswith(".onnx")
+    _, graph = _graph_fields(p)
+    nodes = [parse_wire(v) for f, w, v in graph if f == 1]
+    op_types = [next(v for ff, ww, v in n if ff == 4).decode()
+                for n in nodes]
+    assert op_types == ["Conv", "BatchNormalization", "Relu",
+                        "MaxPool", "GlobalAveragePool", "Flatten",
+                        "Gemm", "Softmax"]
+    # graph inputs/outputs present
+    assert any(f == 11 for f, w, v in graph)
+    assert any(f == 12 for f, w, v in graph)
+
+
+def test_initializers_round_trip(tmp_path):
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(4, 3))
+    m.eval()
+    p = export_onnx(m, str(tmp_path / "lin"), [2, 4])
+    _, graph = _graph_fields(p)
+    inits = [parse_wire(v) for f, w, v in graph if f == 5]
+    tensors = {}
+    for t in inits:
+        fields = {f: v for f, w, v in t}
+        dims = [v for f, w, v in t if f == 1]
+        assert fields[2] == DT_FLOAT
+        tensors[fields[8].decode()] = np.frombuffer(
+            fields[9], np.float32).reshape(dims)
+    w_name = [n for n in tensors if n.startswith("W")][0]
+    b_name = [n for n in tensors if n.startswith("B")][0]
+    np.testing.assert_allclose(tensors[w_name],
+                               np.asarray(m[0].weight.numpy()))
+    # poor-man's runtime: Gemm(input, W) + B == model output
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    ref = np.asarray(m(paddle.to_tensor(x)).data)
+    np.testing.assert_allclose(x @ tensors[w_name] + tensors[b_name],
+                               ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None,
+                    reason="protoc unavailable")
+def test_protoc_decodes(tmp_path):
+    m = _model()
+    m.eval()
+    p = export_onnx(m, str(tmp_path / "m"), [1, 3, 16, 16])
+    r = subprocess.run(["protoc", "--decode_raw"],
+                       stdin=open(p, "rb"), capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+    for op in ("Conv", "Gemm", "BatchNormalization", "Softmax"):
+        assert op in r.stdout
+
+
+def test_export_entrypoint_and_fallback(tmp_path):
+    m = _model()
+    m.eval()
+    out = paddle.onnx.export(
+        m, str(tmp_path / "art"),
+        input_spec=[paddle.to_tensor(
+            np.zeros((1, 3, 16, 16), np.float32))],
+        format="onnx")
+    assert out.endswith(".onnx")
+    # outside-subset models raise with a pointer to StableHLO
+    class Odd(nn.Layer):
+        def forward(self, x):
+            return x * 2
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        export_onnx(Odd(), str(tmp_path / "odd"), [1, 4])
+    # layernorm bumps the opset to 17
+    m2 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    m2.eval()
+    p2 = export_onnx(m2, str(tmp_path / "ln"), [2, 4])
+    by, _ = _graph_fields(p2)
+    opset = parse_wire(by[8][0])
+    assert {f: v for f, w, v in opset}[2] == 17

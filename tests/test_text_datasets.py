@@ -1,0 +1,159 @@
+"""Text dataset readers (VERDICT r2 Next #9): Imikolov/Conll05st/
+Movielens/WMT14/WMT16 read the STANDARD archive layouts (egress-gated
+environment: tests build synthetic archives in those layouts)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (Conll05st, Imikolov, Movielens, WMT14,
+                             WMT16)
+
+
+def _tar_add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def ptb_tar(tmp_path):
+    p = str(tmp_path / "simple-examples.tgz")
+    train = b"the cat sat\nthe dog sat on the cat\n"
+    valid = b"the cat ran\n"
+    test = b"a dog sat\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "./simple-examples/data/ptb.train.txt", train)
+        _tar_add(tf, "./simple-examples/data/ptb.valid.txt", valid)
+        _tar_add(tf, "./simple-examples/data/ptb.test.txt", test)
+    return p
+
+
+def test_imikolov_ngram_and_seq(ptb_tar):
+    ds = Imikolov(data_file=ptb_tar, data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=1)
+    # vocab: words with freq > 1 over train+valid, (-freq, word) order,
+    # <s>/<e> counted per line, <unk> last
+    wi = ds.word_idx
+    assert wi["<unk>"] == len(wi) - 1
+    assert "the" in wi and "cat" in wi
+    assert len(ds) > 0
+    first = ds[0]
+    assert len(first) == 2 and all(x.shape == () for x in first)
+
+    seq = Imikolov(data_file=ptb_tar, data_type="SEQ", mode="test",
+                   min_word_freq=1)
+    src, trg = seq[0]
+    assert len(src) == len(trg)
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+def test_conll05st(tmp_path):
+    words = b"The\ncat\nsat\n\nDogs\nbark\n\n"
+    # props: first column = verb sense ('-' for none), then per-verb
+    # span columns
+    props = (b"-\t*\n-\t*\nsit\t(V*)\n\n"
+             b"-\t(A0*)\nbark\t(V*)\n\n")
+    p = str(tmp_path / "conll05st-tests.tar.gz")
+    with tarfile.open(p, "w:gz") as tf:
+        wbuf = io.BytesIO()
+        with gzip.GzipFile(fileobj=wbuf, mode="w") as g:
+            g.write(words)
+        _tar_add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 wbuf.getvalue())
+        pbuf = io.BytesIO()
+        with gzip.GzipFile(fileobj=pbuf, mode="w") as g:
+            g.write(props)
+        _tar_add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 pbuf.getvalue())
+    wd = str(tmp_path / "words.dict")
+    open(wd, "w").write("The\ncat\nsat\nDogs\nbark\n")
+    vd = str(tmp_path / "verbs.dict")
+    open(vd, "w").write("sit\nbark\n")
+    td = str(tmp_path / "targets.dict")
+    open(td, "w").write("B-V\nI-V\nB-A0\nI-A0\n")
+    ds = Conll05st(data_file=p, word_dict_file=wd, verb_dict_file=vd,
+                   target_dict_file=td)
+    assert len(ds) == 2
+    row = ds[0]
+    assert len(row) == 9
+    word_idx, *_ctx, pred, mark, label = row
+    assert word_idx.shape == (3,)
+    assert mark.tolist().count(1) >= 1
+    assert label.shape == (3,)
+
+
+def test_movielens(tmp_path):
+    p = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::4::12345\n2::F::35::7::67890\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n2::2::3::978302109\n"
+                   "1::2::4::978301968\n")
+    ds = Movielens(data_file=p, mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    row = ds[0]
+    # uid, gender, age, job, mov_id, categories, title words, rating
+    assert len(row) == 8
+    assert row[-1].shape == (1,)
+    assert float(row[-1][0]) in (5.0, 1.0, 3.0)  # rating*2-5
+    test = Movielens(data_file=p, mode="test", test_ratio=0.0)
+    assert len(test) == 0
+
+
+@pytest.fixture
+def wmt14_tar(tmp_path):
+    p = str(tmp_path / "wmt14.tgz")
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    train = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "wmt14/src.dict", src_dict)
+        _tar_add(tf, "wmt14/trg.dict", trg_dict)
+        _tar_add(tf, "wmt14/train/train", train)
+        _tar_add(tf, "wmt14/test/test", b"world\tmonde\n")
+    return p
+
+
+def test_wmt14(wmt14_tar):
+    ds = WMT14(data_file=wmt14_tar, mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    # <s> hello world <e>
+    assert src.tolist() == [0, 3, 4, 1]
+    assert trg.tolist() == [0, 3, 4]       # <s> bonjour monde
+    assert trg_next.tolist() == [3, 4, 1]  # bonjour monde <e>
+    sd, td = ds.get_dict()
+    assert sd["hello"] == 3 and td["monde"] == 4
+    test = WMT14(data_file=wmt14_tar, mode="test", dict_size=5)
+    assert len(test) == 1
+
+
+def test_wmt16(tmp_path):
+    p = str(tmp_path / "wmt16.tar.gz")
+    train = b"hello world\thallo welt\nhello\thallo\n"
+    val = b"world\twelt\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _tar_add(tf, "wmt16/train", train)
+        _tar_add(tf, "wmt16/val", val)
+        _tar_add(tf, "wmt16/test", val)
+    ds = WMT16(data_file=p, mode="train", src_dict_size=10,
+               trg_dict_size=10, lang="en")
+    src, trg, trg_next = ds[0]
+    # <s>=1 <e>=2; "hello" most frequent -> id 4
+    assert src[0] == 1 and src[-1] == 2
+    assert trg[0] == 1 and trg_next[-1] == 2
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+    # de source direction swaps columns
+    de = WMT16(data_file=p, mode="val", src_dict_size=10,
+               trg_dict_size=10, lang="de")
+    s2, t2, _ = de[0]
+    assert len(s2) == 3 and len(t2) == 2
