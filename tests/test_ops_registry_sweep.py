@@ -457,7 +457,15 @@ MANUAL_SPECS = {
 # below (BF16_SKIP / GRAD_SKIP) are the analog of the reference's
 # white_list/op_accuracy_white_list.py: the op still runs fp32+jit,
 # only the named check is excused, each with a reason class.
-EXCEPTIONS: dict = {}
+EXCEPTIONS: dict = {
+    # dedicated golden suite with numpy oracles + finite-difference
+    # grads (tests/test_detection_ops.py); registered lazily on
+    # paddle_tpu.vision.ops import
+    "yolo_loss": "tests/test_detection_ops.py::TestYoloLoss "
+                 "(reference-kernel oracle incl. FD grads)",
+    "deform_conv2d": "tests/test_detection_ops.py::TestDeformConv2D "
+                     "(naive-loop oracle, grouped/masked variants)",
+}
 
 
 def _spec_for(name):
@@ -501,13 +509,25 @@ JIT_SKIP = {
 def test_registry_fully_covered():
     """Coverage gate: a newly registered op must get a spec here or an
     enumerated exception."""
-    missing = sorted(n for n in OPS
-                     if n not in MANUAL_SPECS and n not in AUTO_TAGS
+    def framework_op(n):
+        if "::" in n:  # utils.custom_op user namespace
+            return False
+        # exclude only ops registered BY TEST MODULES; jnp-implemented
+        # framework ops (impl module jax.numpy etc.) stay governed
+        mod = getattr(OPS[n].impl, "__module__", "") or ""
+        return not mod.split(".")[0].startswith(("test", "conftest"))
+
+    # user/custom ops registered by tests (utils.custom_op) are outside
+    # the framework registry contract
+    missing = sorted(n for n in OPS if framework_op(n)
+                     and n not in MANUAL_SPECS and n not in AUTO_TAGS
                      and n not in EXCEPTIONS)
     assert not missing, (
         f"{len(missing)} registered ops lack a sweep spec or "
         f"exception: {missing}")
     assert len(EXCEPTIONS) < 30
+    # import lazily-registered surfaces so the stale check sees them
+    import paddle_tpu.vision.ops  # noqa: F401
     stale = sorted(n for n in EXCEPTIONS if n not in OPS)
     assert not stale, f"stale exception entries: {stale}"
     # check-level whitelists stay bounded and name real ops

@@ -279,9 +279,13 @@ def test_onnx_export_stablehlo(tmp_path):
     loaded = paddle.jit.load(str(tmp_path / "m"))
     np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
                                rtol=1e-5)
-    with pytest.raises(RuntimeError, match="ONNX emission"):
-        ponnx.export(model, str(tmp_path / "m2"), input_spec=[x],
-                     format="onnx")
+    # r3: format="onnx" emits REAL ONNX protobuf (onnx_proto.py)
+    p2 = ponnx.export(model, str(tmp_path / "m2"), input_spec=[x],
+                      format="onnx")
+    assert p2.endswith(".onnx")
+    from paddle_tpu.onnx_proto import parse_wire
+    fields = {f: v for f, w, v in parse_wire(open(p2, "rb").read())}
+    assert fields[1] == 8  # ir_version
 
 
 # ------------------------------------------------------------------- audio
