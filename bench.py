@@ -87,7 +87,11 @@ def bench_gpt2(dev, on_tpu):
     # the [B, S, vocab] logits and wins ~10% MFU at s1024, ~16% at
     # s2048 (see BASELINE.md sweeps). BENCH_FUSED=0 opts out.
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
-    chunk = int(os.environ.get("BENCH_CHUNK", "256"))
+    # fused-loss chunk: measured optimum is ~8192 logit rows per chunk
+    # (b16: chunk 512 -> MFU 0.497 vs 0.491 at 256; b32: chunk 256
+    # beats 512 — the [batch*chunk, vocab] buffer is what matters)
+    chunk = int(os.environ.get("BENCH_CHUNK", 0)) or \
+        max(8192 // batch, 128)
 
     paddle.seed(0)
     model = gpt(name, max_position_embeddings=seq,
