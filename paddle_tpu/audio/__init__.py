@@ -6,6 +6,7 @@ mel_to_hz, compute_fbank_matrix, create_dct, power_to_db). Built on
 paddle_tpu.signal.stft; note the tunneled axon backend lacks complex
 FFT — run feature extraction on the CPU backend or real TPU.
 """
-from . import functional  # noqa: F401
+from . import backends, datasets, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 from .features import (LogMelSpectrogram, MFCC,  # noqa: F401
                        MelSpectrogram, Spectrogram)

@@ -1,0 +1,115 @@
+"""paddle.audio.backends analog (reference
+python/paddle/audio/backends/wave_backend.py): WAV load/info/save over
+the stdlib `wave` module — the reference's default backend does exactly
+this (PCM_S 16-bit)."""
+from __future__ import annotations
+
+import wave
+from collections import namedtuple
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend",
+           "set_backend"]
+
+AudioInfo = namedtuple("AudioInfo", ["sample_rate", "num_frames",
+                                     "num_channels", "bits_per_sample",
+                                     "encoding"])
+
+
+def info(filepath) -> AudioInfo:
+    """Signal info of a WAV file (wave_backend.py:36)."""
+    f = filepath if hasattr(filepath, "read") else open(filepath, "rb")
+    try:
+        w = wave.open(f)
+    except wave.Error:
+        f.close()
+        raise NotImplementedError(
+            "only WAV (PCM_S) files are supported by the wave backend")
+    out = AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                    w.getsampwidth() * 8, "PCM_S")
+    f.close()
+    return out
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True,
+         channels_first: bool = True) -> Tuple[Tensor, int]:
+    """Read a WAV file (wave_backend.py:88). normalize=True returns
+    float32 in (-1, 1); False returns raw int16. channels_first=True
+    gives [channels, time]."""
+    f = filepath if hasattr(filepath, "read") else open(filepath, "rb")
+    try:
+        w = wave.open(f)
+    except wave.Error:
+        f.close()
+        raise NotImplementedError(
+            "only WAV (PCM_S) files are supported by the wave backend")
+    try:
+        sr, nch = w.getframerate(), w.getnchannels()
+        width = w.getsampwidth()
+        if width != 2:
+            raise NotImplementedError(
+                f"only 16-bit PCM WAV is supported, got {width * 8}-bit")
+        if not 0 <= frame_offset <= w.getnframes():
+            raise ValueError(
+                f"frame_offset {frame_offset} out of range for a "
+                f"{w.getnframes()}-frame file")
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 \
+            else num_frames
+        raw = w.readframes(n)
+    finally:
+        f.close()
+    data = np.frombuffer(raw, np.int16).reshape(-1, nch)
+    if normalize:
+        data = (data.astype(np.float32) / (1 << 15))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_S",
+         bits_per_sample: int = 16) -> None:
+    """Write [channels, time] (or [time, channels]) to 16-bit PCM WAV
+    (wave_backend.py:167)."""
+    if bits_per_sample != 16 or encoding != "PCM_S":
+        raise NotImplementedError(
+            "the wave backend writes 16-bit PCM_S only")
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if not channels_first:
+        arr = arr.T
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.clip(arr, -1.0, 1.0 - 1.0 / (1 << 15))
+        arr = (arr * (1 << 15)).astype(np.int16)
+    elif arr.dtype != np.int16:
+        raise TypeError(
+            f"save() accepts float (-1, 1) or int16 samples, got "
+            f"{arr.dtype} — convert explicitly to avoid wraparound")
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[0])
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(arr.T.astype("<i2").tobytes())
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the stdlib wave backend is available (the reference's "
+            "soundfile backend needs the optional paddleaudio package)")

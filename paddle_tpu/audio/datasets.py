@@ -1,0 +1,164 @@
+"""paddle.audio.datasets analog (reference
+python/paddle/audio/datasets/{dataset,esc50,tess}.py): audio
+classification datasets over local extracted archives (zero-egress —
+download=True raises with instructions), items are (feature, label)
+with feat_type raw/spectrogram/melspectrogram/logmelspectrogram/mfcc
+riding the in-tree feature extractors."""
+from __future__ import annotations
+
+import collections
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.dataset import Dataset
+from .backends import load as _load_wav
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+
+def _feat_funcs():
+    from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,
+                           Spectrogram)
+    return {"raw": None, "melspectrogram": MelSpectrogram,
+            "mfcc": MFCC, "logmelspectrogram": LogMelSpectrogram,
+            "spectrogram": Spectrogram}
+
+
+class AudioClassificationDataset(Dataset):
+    """Base class (reference audio/datasets/dataset.py:32): files +
+    int labels; feat_type selects the transform applied per item."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = None,
+                 **kwargs):
+        super().__init__()
+        funcs = _feat_funcs()
+        if feat_type not in funcs:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(funcs)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._extractor = None  # built lazily ONCE (filterbanks/DCT)
+
+    def _get_extractor(self, sr: int):
+        if self._extractor is None:
+            import inspect
+            func_cls = _feat_funcs()[self.feat_type]
+            kwargs = dict(self.feat_config)
+            if "sr" in inspect.signature(func_cls.__init__).parameters:
+                kwargs.setdefault("sr", self.sample_rate or sr)
+            self._extractor = func_cls(**kwargs)
+        return self._extractor
+
+    def _convert_to_record(self, idx: int):
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sr = _load_wav(file)
+        w = waveform.data[0]                      # mono channel
+        if _feat_funcs()[self.feat_type] is None:
+            feat = np.asarray(w, np.float32)
+        else:
+            extractor = self._get_extractor(sr)
+            feat = np.asarray(
+                extractor(Tensor(w[None, :])).data[0], np.float32)
+        return feat, np.array(label, np.int64)
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+from ..io.dataset import no_download_gate as _no_download  # noqa: E402
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference esc50.py:26): 5-fold
+    layout from the ESC-50-master directory (meta/esc50.csv + audio/),
+    mode 'train' excludes the split fold, 'dev' keeps it."""
+
+    meta_info = collections.namedtuple(
+        "META_INFO", ("filename", "fold", "target", "category",
+                      "esc10", "src_file", "take"))
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw",
+                 data_dir: Optional[str] = None, **kwargs):
+        if data_dir is None:
+            _no_download(type(self).__name__)
+        root = os.path.join(data_dir, "ESC-50-master")
+        if not os.path.isdir(root):
+            root = data_dir
+        files, labels = self._get_data(root, mode, split)
+        super().__init__(files=files, labels=labels,
+                         feat_type=feat_type, **kwargs)
+
+    def _get_data(self, root, mode, split) -> Tuple[List[str],
+                                                    List[int]]:
+        meta = os.path.join(root, "meta", "esc50.csv")
+        files, labels = [], []
+        with open(meta) as f:
+            rows = list(csv.reader(f))[1:]
+        for row in rows:
+            info = self.meta_info(*row[:7])
+            keep = int(info.fold) != split if mode == "train" \
+                else int(info.fold) == split
+            if keep:
+                files.append(os.path.join(root, "audio", info.filename))
+                labels.append(int(info.target))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference tess.py): wav files named
+    <speaker>_<word>_<emotion>.wav under the standard extracted dir;
+    n_folds cross-validation split as in the reference."""
+
+    archive_dir = "TESS_Toronto_emotional_speech_set"
+    emotions = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 data_dir: Optional[str] = None, **kwargs):
+        assert split <= n_folds, (
+            f"The selected split should not be larger than n_fold, "
+            f"but got {split} > {n_folds}")
+        if data_dir is None:
+            _no_download(type(self).__name__)
+        root = os.path.join(data_dir, self.archive_dir)
+        if not os.path.isdir(root):
+            root = data_dir
+        files, labels = self._get_data(root, mode, n_folds, split)
+        super().__init__(files=files, labels=labels,
+                         feat_type=feat_type, **kwargs)
+
+    def _get_data(self, root, mode, n_folds, split):
+        wav_files = []
+        for r, _, fs in os.walk(root):
+            for f in sorted(fs):
+                if f.endswith(".wav"):
+                    wav_files.append(os.path.join(r, f))
+        # filter to known emotions FIRST, then fold over the kept
+        # files; clamp so remainder files land in the last fold rather
+        # than a phantom fold no split ever selects
+        kept = [(p, os.path.basename(p)[:-4].split("_")[-1])
+                for p in wav_files]
+        kept = [(p, e) for p, e in kept if e in self.emotions]
+        files, labels = [], []
+        n_per_fold = max(len(kept) // n_folds, 1)
+        for idx, (path, emotion) in enumerate(kept):
+            fold = min(idx // n_per_fold + 1, n_folds)
+            keep = fold != split if mode == "train" else fold == split
+            if keep:
+                files.append(path)
+                labels.append(self.emotions.index(emotion))
+        return files, labels

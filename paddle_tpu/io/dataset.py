@@ -87,3 +87,12 @@ def random_split(dataset: Dataset, lengths: Sequence[int], generator=None):
         out.append(Subset(dataset, perm[acc:acc + ln].tolist()))
         acc += ln
     return out
+
+
+def no_download_gate(name: str):
+    """Zero-egress environment: datasets cannot download; standard
+    archives must be provided locally (shared by text/audio/vision
+    dataset readers)."""
+    raise RuntimeError(
+        f"{name}: download is unavailable in this environment; place "
+        f"the standard archive/files locally and pass the data path")

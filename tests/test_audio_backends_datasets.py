@@ -1,0 +1,100 @@
+"""Audio backends (WAV load/info/save over the stdlib wave module) and
+classification datasets (ESC50/TESS on the standard extracted
+layouts), completing the paddle.audio surface (reference
+python/paddle/audio/{backends,datasets})."""
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+def _write_wav(path, sr=16000, n=1600, ch=1, freq=440.0):
+    t = np.arange(n) / sr
+    sig = (0.3 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+    data = np.tile(sig[:, None], (1, ch))
+    pcm = (data * (1 << 15)).astype("<i2")
+    with wave.open(path, "wb") as w:
+        w.setnchannels(ch)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(pcm.tobytes())
+    return sig
+
+
+def test_info_load_save_roundtrip(tmp_path):
+    p = str(tmp_path / "t.wav")
+    sig = _write_wav(p, ch=2)
+    meta = audio.info(p)
+    assert (meta.sample_rate, meta.num_channels,
+            meta.bits_per_sample) == (16000, 2, 16)
+    assert meta.num_frames == 1600
+    wav, sr = audio.load(p)
+    assert sr == 16000 and tuple(wav.shape) == (2, 1600)
+    np.testing.assert_allclose(np.asarray(wav.data)[0], sig, atol=1e-4)
+    # raw int16 + frame windows
+    raw, _ = audio.load(p, frame_offset=100, num_frames=50,
+                        normalize=False)
+    assert raw.dtype == paddle.int16 and tuple(raw.shape) == (2, 50)
+    # save round-trip
+    p2 = str(tmp_path / "o.wav")
+    audio.save(p2, wav, 16000)
+    wav2, sr2 = audio.load(p2)
+    np.testing.assert_allclose(np.asarray(wav2.data),
+                               np.asarray(wav.data), atol=1e-4)
+
+
+def _esc50_tree(tmp_path):
+    root = tmp_path / "ESC-50-master"
+    (root / "meta").mkdir(parents=True)
+    (root / "audio").mkdir()
+    rows = ["filename,fold,target,category,esc10,src_file,take"]
+    for i in range(10):
+        fold = i % 5 + 1
+        fn = f"{fold}-{i}-A-{i % 3}.wav"
+        _write_wav(str(root / "audio" / fn), n=800)
+        rows.append(f"{fn},{fold},{i % 3},cat,{i % 2},{i},A")
+    (root / "meta" / "esc50.csv").write_text("\n".join(rows) + "\n")
+    return str(tmp_path)
+
+
+def test_esc50_split_and_features(tmp_path):
+    data_dir = _esc50_tree(tmp_path)
+    train = audio.datasets.ESC50(mode="train", split=1,
+                                 data_dir=data_dir)
+    dev = audio.datasets.ESC50(mode="dev", split=1, data_dir=data_dir)
+    assert len(train) + len(dev) == 10
+    assert len(dev) == 2  # fold 1 entries
+    feat, label = train[0]
+    assert feat.ndim == 1 and label.dtype == np.int64
+    mel = audio.datasets.ESC50(mode="dev", split=1, data_dir=data_dir,
+                               feat_type="melspectrogram", n_fft=256,
+                               n_mels=32)
+    f2, _ = mel[0]
+    assert f2.shape[0] == 32  # mel bins
+
+
+def test_tess_layout(tmp_path):
+    root = tmp_path / "TESS_Toronto_emotional_speech_set"
+    root.mkdir()
+    emotions = ["angry", "happy", "sad", "neutral"]
+    for i in range(8):
+        _write_wav(str(root / f"OAF_word{i}_{emotions[i % 4]}.wav"),
+                   n=400)
+    ds = audio.datasets.TESS(mode="train", n_folds=4, split=1,
+                             data_dir=str(tmp_path))
+    dev = audio.datasets.TESS(mode="dev", n_folds=4, split=1,
+                              data_dir=str(tmp_path))
+    assert len(ds) + len(dev) == 8
+    feat, label = ds[0]
+    assert 0 <= int(label) < len(audio.datasets.TESS.emotions)
+
+
+def test_download_gated():
+    with pytest.raises(RuntimeError, match="download"):
+        audio.datasets.ESC50()
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("soundfile")
