@@ -192,6 +192,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     labelpad = jnp.arange(lmax)[None, :] >= label_lengths[:, None]
     loss = optax.ctc_loss(lp, logitpad.astype(lp.dtype), labels,
                           labelpad.astype(lp.dtype), blank_id=blank)
+    if reduction == "mean":
+        # reference semantics (nn/functional/loss.py ctc_loss, matching
+        # torch): divide each sequence loss by its label length, then
+        # average the quotients
+        denom = jnp.maximum(label_lengths.astype(loss.dtype), 1)
+        return jnp.mean(loss / denom)
     return _reduce(loss, reduction)
 
 

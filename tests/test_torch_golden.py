@@ -1,0 +1,228 @@
+"""Cross-framework golden checks: paddle_tpu ops vs torch CPU — an
+INDEPENDENT oracle (the registry sweep's finite-difference grads verify
+internal consistency; these verify the semantics themselves match the
+ecosystem's reference implementations). Reference analog: the OpTest
+corpus's comparisons against authoritative kernels."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+rng = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _close(ours, theirs, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(
+        np.asarray(ours.data if hasattr(ours, "data") else ours),
+        theirs.detach().numpy(), rtol=rtol, atol=atol)
+
+
+class TestConvPoolVsTorch:
+    def test_conv2d(self):
+        x = rng.randn(2, 3, 9, 9).astype(np.float32)
+        w = rng.randn(5, 3, 3, 3).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        ours = F.conv2d(_t(x), _t(w), _t(b), stride=2, padding=1,
+                        dilation=1)
+        theirs = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b),
+            stride=2, padding=1)
+        _close(ours, theirs)
+
+    def test_conv2d_grouped_dilated(self):
+        x = rng.randn(1, 4, 8, 8).astype(np.float32)
+        w = rng.randn(6, 2, 3, 3).astype(np.float32)
+        ours = F.conv2d(_t(x), _t(w), groups=2, dilation=2, padding=2)
+        theirs = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), groups=2, dilation=2,
+            padding=2)
+        _close(ours, theirs)
+
+    def test_conv2d_transpose(self):
+        x = rng.randn(1, 3, 5, 5).astype(np.float32)
+        w = rng.randn(3, 4, 3, 3).astype(np.float32)
+        ours = F.conv2d_transpose(_t(x), _t(w), stride=2, padding=1,
+                                  output_padding=1)
+        theirs = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+            output_padding=1)
+        _close(ours, theirs)
+
+    def test_pools(self):
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        _close(F.max_pool2d(_t(x), 2),
+               torch.nn.functional.max_pool2d(torch.tensor(x), 2))
+        _close(F.avg_pool2d(_t(x), 2, stride=2, padding=1),
+               torch.nn.functional.avg_pool2d(
+                   torch.tensor(x), 2, stride=2, padding=1,
+                   count_include_pad=False))
+        _close(F.adaptive_avg_pool2d(_t(x), 3),
+               torch.nn.functional.adaptive_avg_pool2d(
+                   torch.tensor(x), 3))
+
+    def test_grid_sample(self):
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        g = (rng.rand(1, 4, 4, 2).astype(np.float32) * 2 - 1)
+        ours = F.grid_sample(_t(x), _t(g), align_corners=True)
+        theirs = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(g), align_corners=True)
+        _close(ours, theirs, rtol=1e-4, atol=1e-4)
+
+
+class TestLossesVsTorch:
+    def test_cross_entropy_and_grad(self):
+        logits = rng.randn(6, 5).astype(np.float32)
+        labels = rng.randint(0, 5, 6).astype(np.int64)
+        lt = _t(logits)
+        lt.stop_gradient = False
+        ours = F.cross_entropy(lt, _t(labels))
+        ours.backward()
+        tt = torch.tensor(logits, requires_grad=True)
+        theirs = torch.nn.functional.cross_entropy(
+            tt, torch.tensor(labels))
+        theirs.backward()
+        _close(ours, theirs)
+        np.testing.assert_allclose(np.asarray(lt.grad.data),
+                                   tt.grad.numpy(), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_nll_kl_bce(self):
+        p = np.log(np.abs(rng.randn(4, 5)) + 0.2).astype(np.float32)
+        lab = rng.randint(0, 5, 4).astype(np.int64)
+        _close(F.nll_loss(_t(p), _t(lab)),
+               torch.nn.functional.nll_loss(torch.tensor(p),
+                                            torch.tensor(lab)))
+        a = np.log(rng.rand(3, 4).astype(np.float32) + 0.1)
+        b = rng.rand(3, 4).astype(np.float32)
+        _close(F.kl_div(_t(a), _t(b), reduction="batchmean"),
+               torch.nn.functional.kl_div(torch.tensor(a),
+                                          torch.tensor(b),
+                                          reduction="batchmean"))
+        x = rng.randn(4, 3).astype(np.float32)
+        y = rng.rand(4, 3).astype(np.float32)
+        _close(F.binary_cross_entropy_with_logits(_t(x), _t(y)),
+               torch.nn.functional.binary_cross_entropy_with_logits(
+                   torch.tensor(x), torch.tensor(y)))
+
+    def test_ctc_loss(self):
+        T, B, C = 6, 2, 5
+        logp = torch.log_softmax(torch.tensor(
+            rng.randn(T, B, C).astype(np.float32)), dim=-1)
+        targets = torch.tensor(
+            rng.randint(1, C, (B, 3)).astype(np.int64))
+        ilen = torch.tensor([T, T])
+        tlen = torch.tensor([3, 2])
+        theirs = torch.nn.functional.ctc_loss(
+            logp, targets, ilen, tlen, blank=0, reduction="mean",
+            zero_infinity=False)
+        ours = F.ctc_loss(_t(logp.numpy()), _t(targets.numpy()),
+                          _t(ilen.numpy()), _t(tlen.numpy()),
+                          blank=0, reduction="mean")
+        _close(ours, theirs, rtol=1e-4)
+
+    def test_margin_and_triplet(self):
+        a = rng.randn(4, 6).astype(np.float32)
+        p = rng.randn(4, 6).astype(np.float32)
+        n = rng.randn(4, 6).astype(np.float32)
+        _close(F.triplet_margin_loss(_t(a), _t(p), _t(n), margin=0.7),
+               torch.nn.functional.triplet_margin_loss(
+                   torch.tensor(a), torch.tensor(p), torch.tensor(n),
+                   margin=0.7))
+        x1 = rng.randn(5).astype(np.float32)
+        x2 = rng.randn(5).astype(np.float32)
+        y = np.sign(rng.randn(5)).astype(np.float32)
+        _close(F.margin_ranking_loss(_t(x1), _t(x2), _t(y),
+                                     margin=0.2),
+               torch.nn.functional.margin_ranking_loss(
+                   torch.tensor(x1), torch.tensor(x2),
+                   torch.tensor(y), margin=0.2))
+
+
+class TestNormActivationsVsTorch:
+    def test_layer_norm_and_grad(self):
+        x = rng.randn(4, 6).astype(np.float32)
+        w = rng.randn(6).astype(np.float32)
+        b = rng.randn(6).astype(np.float32)
+        xt = _t(x)
+        xt.stop_gradient = False
+        ours = F.layer_norm(xt, [6], _t(w), _t(b))
+        ours.sum().backward()
+        tt = torch.tensor(x, requires_grad=True)
+        theirs = torch.nn.functional.layer_norm(
+            tt, [6], torch.tensor(w), torch.tensor(b))
+        theirs.sum().backward()
+        _close(ours, theirs)
+        np.testing.assert_allclose(np.asarray(xt.grad.data),
+                                   tt.grad.numpy(), rtol=1e-3,
+                                   atol=1e-5)
+
+    def test_batch_group_instance_norm(self):
+        x = rng.randn(3, 4, 5, 5).astype(np.float32)
+        _close(F.batch_norm(_t(x), _t(np.zeros(4, np.float32)),
+                            _t(np.ones(4, np.float32)),
+                            training=True),
+               torch.nn.functional.batch_norm(
+                   torch.tensor(x), torch.zeros(4), torch.ones(4),
+                   training=True), rtol=1e-3, atol=1e-4)
+        _close(F.group_norm(_t(x), 2),
+               torch.nn.functional.group_norm(torch.tensor(x), 2),
+               rtol=1e-3, atol=1e-4)
+        _close(F.instance_norm(_t(x)),
+               torch.nn.functional.instance_norm(torch.tensor(x)),
+               rtol=1e-3, atol=1e-4)
+
+    def test_activations(self):
+        x = rng.randn(3, 7).astype(np.float32)
+        pairs = [
+            (F.gelu(_t(x)), torch.nn.functional.gelu(
+                torch.tensor(x))),
+            (F.silu(_t(x)), torch.nn.functional.silu(
+                torch.tensor(x))),
+            (F.mish(_t(x)), torch.nn.functional.mish(
+                torch.tensor(x))),
+            (F.softplus(_t(x)), torch.nn.functional.softplus(
+                torch.tensor(x))),
+            (F.elu(_t(x), alpha=0.7), torch.nn.functional.elu(
+                torch.tensor(x), alpha=0.7)),
+            (F.hardswish(_t(x)), torch.nn.functional.hardswish(
+                torch.tensor(x))),
+            (F.log_softmax(_t(x), axis=-1),
+             torch.nn.functional.log_softmax(torch.tensor(x),
+                                             dim=-1)),
+        ]
+        for ours, theirs in pairs:
+            _close(ours, theirs, rtol=1e-4, atol=1e-5)
+
+
+class TestLinalgVsTorch:
+    def test_solve_cholesky_det(self):
+        m = rng.randn(4, 4).astype(np.float32)
+        spd = (m @ m.T + 4 * np.eye(4)).astype(np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        _close(paddle.linalg.solve(_t(spd), _t(b)),
+               torch.linalg.solve(torch.tensor(spd),
+                                  torch.tensor(b)), rtol=1e-3,
+               atol=1e-4)
+        _close(paddle.linalg.cholesky(_t(spd)),
+               torch.linalg.cholesky(torch.tensor(spd)), rtol=1e-3,
+               atol=1e-4)
+        _close(paddle.linalg.det(_t(spd)),
+               torch.linalg.det(torch.tensor(spd)), rtol=1e-3)
+
+    def test_matrix_ops(self):
+        a = rng.randn(3, 4).astype(np.float32)
+        _close(paddle.linalg.pinv(_t(a)),
+               torch.linalg.pinv(torch.tensor(a)), rtol=1e-3,
+               atol=1e-4)
+        sym = (lambda m: (m + m.T) / 2)(
+            rng.randn(4, 4)).astype(np.float32)
+        ours = paddle.linalg.eigvalsh(_t(sym))
+        theirs = torch.linalg.eigvalsh(torch.tensor(sym))
+        _close(ours, theirs, rtol=1e-3, atol=1e-4)
