@@ -238,9 +238,13 @@ def bench_resnet50(dev, on_tpu):
 
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
     s2d = os.environ.get("BENCH_S2D", "1") == "1"
+    # fused conv+BN training kernels (Pallas 1x1-conv + stats epilogue /
+    # BN-apply prologue — kernels/fused_resnet.py); BENCH_FUSED_BN=0 opts out
+    fused_bn = os.environ.get("BENCH_FUSED_BN", "1") == "1" and \
+        layout == "NHWC"
     paddle.seed(0)
     model = resnet50(num_classes=1000, data_format=layout,
-                     stem_space_to_depth=s2d)
+                     stem_space_to_depth=s2d, fused_bn=fused_bn)
     model.bfloat16() if on_tpu else None
     opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                              parameters=model.parameters(),
@@ -263,7 +267,8 @@ def bench_resnet50(dev, on_tpu):
     mfu = (xla_flops * iters / dt) / peak_flops(dev)
     return {
         "metric": f"resnet50 train images/sec/chip (b{b} {hw}x{hw}, "
-                  f"{layout}{', s2d-stem' if s2d else ''}, "
+                  f"{layout}{', s2d-stem' if s2d else ''}"
+                  f"{', fused-bn' if fused_bn else ''}, "
                   f"MFU={mfu:.3f}, loss={loss:.3f}, "
                   f"device={dev.device_kind})",
         "value": round(imgs_per_sec, 1),

@@ -1,0 +1,869 @@
+"""Fused ResNet training kernels: 1x1 conv (Pallas matmul) with a BN
+statistics epilogue, and a BN-apply + ReLU prologue variant.
+
+TPU-native analog of the reference's fused ResNet training ops
+(paddle/fluid/operators/fused/resnet_unit_op.cu:1,
+fused_bn_add_activation_op.cu:1): on GPU the fusion is hand-written
+cuDNN epilogues; here the 1x1 convs of a bottleneck block are Pallas
+matmuls whose epilogue accumulates the BN channel statistics of their
+OUTPUT (sum / sum-of-squares, fp32) in the same HBM pass, and whose
+prologue applies the previous BN's folded scale/shift + ReLU to their
+INPUT on the fly. That removes the separate stats-reduction read of the
+conv output and the normalized-activation write+read that XLA
+materializes between a conv and its BatchNorm in training mode — the
+bytes the r3 roofline (BASELINE.md) identified as ResNet-50's binding
+cost on v5e (layer1/2 run at the HBM roof).
+
+Numerics: the matmul accumulates in fp32 on the MXU; statistics are
+computed from the bf16-rounded stored output, so they match what the
+unfused two-pass path computes from the materialized conv output.
+Variance uses the one-pass E[y^2] - E[y]^2 form in fp32 (what the
+reference's cuDNN path uses as well).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_DEF_BLOCK_ROWS = 512
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    block = min(preferred, n)
+    while n % block:
+        block //= 2
+    return max(block, 1)
+
+
+def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref):
+    """y = x @ w; epilogue accumulates per-channel sum / sumsq of y."""
+    i = pl.program_id(0)
+    y = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    yr = y.astype(y_ref.dtype)
+    y_ref[:] = yr
+    yf = yr.astype(jnp.float32)
+
+    @pl.when(i == 0)
+    def _init():
+        s_ref[:] = jnp.zeros_like(s_ref)
+        q_ref[:] = jnp.zeros_like(q_ref)
+
+    s_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
+    q_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+
+def _bn_relu_mm_stats_kernel(x_ref, scale_ref, shift_ref, w_ref,
+                             y_ref, s_ref, q_ref):
+    """a = relu(x * scale + shift) (bf16, on the fly); y = a @ w; stats
+    epilogue as above. scale/shift are the folded BN affine of the
+    PREVIOUS conv's statistics."""
+    i = pl.program_id(0)
+    xf = x_ref[:].astype(jnp.float32)
+    a = jnp.maximum(xf * scale_ref[:] + shift_ref[:], 0.0)
+    a = a.astype(x_ref.dtype)
+    y = jnp.dot(a, w_ref[:], preferred_element_type=jnp.float32)
+    yr = y.astype(y_ref.dtype)
+    y_ref[:] = yr
+    yf = yr.astype(jnp.float32)
+
+    @pl.when(i == 0)
+    def _init():
+        s_ref[:] = jnp.zeros_like(s_ref)
+        q_ref[:] = jnp.zeros_like(q_ref)
+
+    s_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
+    q_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+
+def _vmem_bm(k, n, m, es, extra_f32_cols=0):
+    """Pick a row block that keeps the backward kernel's VMEM footprint
+    under ~14 MB: resident (K,N) fp32 dw accumulator + (N,K) weight +
+    double-buffered (bm, K/N) streaming blocks. `es` is the streaming
+    dtype's itemsize (2 for bf16, 4 for fp32 — fp32 halves the budget
+    twice over, which is exactly when the XLA fallback should win)."""
+    resident = 4 * k * n + es * n * k + 8 * (k + n)
+    budget = 14 * 1024 * 1024 - resident
+    if budget <= 0:
+        return 0
+    per_row = es * (2 * k + 2 * n + k + n) + 4 * (n + extra_f32_cols)
+    bm = int(budget // max(per_row, 1))
+    if bm < 64:
+        return 0
+    bm = 1 << (bm.bit_length() - 1)  # power of two so _pick_block divides
+    return _pick_block(m, min(bm, _DEF_BLOCK_ROWS))
+
+
+def _vmem_fwd_bm(k, n, m, es):
+    """Row block for the forward kernels: resident (K,N) weight + fp32
+    stats rows, double-buffered streams + the fp32 accumulator."""
+    resident = es * k * n + 8 * n
+    budget = 14 * 1024 * 1024 - resident
+    if budget <= 0:
+        return 0
+    per_row = 2 * es * (k + n) + 8 * n
+    bm = int(budget // max(per_row, 1))
+    if bm < 8:
+        return 0
+    bm = 1 << (bm.bit_length() - 1)
+    return _pick_block(m, min(bm, _DEF_BLOCK_ROWS))
+
+
+def _itemsize(x):
+    return jnp.dtype(x.dtype).itemsize
+
+
+def _mm_stats_bwd_kernel(dy_ref, y_ref, x_ref, wt_ref, perch_ref, dvar2_ref,
+                         dx_ref, dw_ref):
+    """One-pass dx + dw with the (mean, var) cotangents folded into the
+    effective output gradient: dy_eff = dy + perch + dvar2 * y."""
+    i = pl.program_id(0)
+    dy_eff = (dy_ref[:].astype(jnp.float32) + perch_ref[:]
+              + dvar2_ref[:] * y_ref[:].astype(jnp.float32))
+    dy_bf = dy_eff.astype(dy_ref.dtype)
+    dx_ref[:] = jnp.dot(dy_bf, wt_ref[:],
+                        preferred_element_type=jnp.float32
+                        ).astype(dx_ref.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
+    dw_ref[:] += jax.lax.dot_general(
+        x_ref[:], dy_bf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _bn_relu_mm_stats_bwd_kernel(dy_ref, y_ref, x_ref, scale_ref, shift_ref,
+                                 wt_ref, perch_ref, dvar2_ref,
+                                 dx_ref, dw_ref, dscale_ref, dshift_ref):
+    """One-pass dx/dw/dscale/dshift for the prologue kernel: recomputes
+    a = relu(x*scale+shift) in VMEM (never from HBM)."""
+    i = pl.program_id(0)
+    dy_eff = (dy_ref[:].astype(jnp.float32) + perch_ref[:]
+              + dvar2_ref[:] * y_ref[:].astype(jnp.float32))
+    dy_bf = dy_eff.astype(dy_ref.dtype)
+    xf = x_ref[:].astype(jnp.float32)
+    pre = xf * scale_ref[:] + shift_ref[:]
+    a = jnp.maximum(pre, 0.0).astype(x_ref.dtype)
+    da = jnp.dot(dy_bf, wt_ref[:], preferred_element_type=jnp.float32)
+    gated = jnp.where(pre > 0.0, da, 0.0)
+    dx_ref[:] = (gated * scale_ref[:]).astype(dx_ref.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        dscale_ref[:] = jnp.zeros_like(dscale_ref)
+        dshift_ref[:] = jnp.zeros_like(dshift_ref)
+
+    dw_ref[:] += jax.lax.dot_general(
+        a, dy_bf, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dscale_ref[:] += jnp.sum(gated * xf, axis=0, keepdims=True)
+    dshift_ref[:] += jnp.sum(gated, axis=0, keepdims=True)
+
+
+def _mm_stats_bwd_pallas(dy, y, x2, w2, perch, dvar2):
+    m, k = x2.shape
+    n = w2.shape[1]
+    bm = _vmem_bm(k, n, m, _itemsize(x2))
+    if not bm:
+        return None
+    wt = w2.T
+    dx, dw = pl.pallas_call(
+        _mm_stats_bwd_kernel,
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((n, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, k), x2.dtype),
+            jax.ShapeDtypeStruct((k, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(dy, y, x2, wt, perch.reshape(1, n), dvar2.reshape(1, n))
+    return dx, dw
+
+
+def _bn_relu_mm_stats_bwd_pallas(dy, y, x2, scale, shift, w2, perch, dvar2):
+    m, k = x2.shape
+    n = w2.shape[1]
+    bm = _vmem_bm(k, n, m, _itemsize(x2), extra_f32_cols=2 * k)
+    if not bm:
+        return None
+    wt = w2.T
+    dx, dw, dscale, dshift = pl.pallas_call(
+        _bn_relu_mm_stats_bwd_kernel,
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((n, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, k), x2.dtype),
+            jax.ShapeDtypeStruct((k, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(dy, y, x2, scale.reshape(1, k).astype(jnp.float32),
+      shift.reshape(1, k).astype(jnp.float32), wt,
+      perch.reshape(1, n), dvar2.reshape(1, n))
+    return dx, dw, dscale[0], dshift[0]
+
+
+def _mm_stats_pallas(x2, w2):
+    m, k = x2.shape
+    n = w2.shape[1]
+    bm = _vmem_fwd_bm(k, n, m, _itemsize(x2))
+    if not bm:
+        return None
+    y, s, q = pl.pallas_call(
+        _mm_stats_kernel,
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), x2.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, w2)
+    return y, s[0], q[0]
+
+
+def _bn_relu_mm_stats_pallas(x2, scale, shift, w2):
+    m, k = x2.shape
+    n = w2.shape[1]
+    bm = _vmem_fwd_bm(k, n, m, _itemsize(x2))
+    if not bm:
+        return None
+    y, s, q = pl.pallas_call(
+        _bn_relu_mm_stats_kernel,
+        grid=(m // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i: (i, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), x2.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, scale.reshape(1, k).astype(jnp.float32),
+      shift.reshape(1, k).astype(jnp.float32), w2)
+    return y, s[0], q[0]
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrappers (flattened [M, C] form)
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def matmul_bn_stats(x2, w2):
+    """y = x2 @ w2 plus the BN batch statistics of y in one HBM pass.
+
+    Returns (y [M,N], mean [N] fp32, var [N] fp32)."""
+    out = _mm_stats_pallas(x2, w2)
+    if out is None:  # VMEM-bounded: plain XLA two-pass
+        y = jnp.dot(x2, w2,
+                    preferred_element_type=jnp.float32).astype(x2.dtype)
+        yf = y.astype(jnp.float32)
+        s, q = jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
+    else:
+        y, s, q = out
+    m = x2.shape[0]
+    mean = s / m
+    var = q / m - mean * mean
+    return y, mean, var
+
+
+def _matmul_bn_stats_fwd(x2, w2):
+    y, mean, var = matmul_bn_stats(x2, w2)
+    return (y, mean, var), (x2, w2, y, mean)
+
+
+def _dy_effective(dy, dmean, dvar, y, mean, rows):
+    """Cotangent of y through (y, mean, var) outputs: mean = sum(y)/M,
+    var = sum(y^2)/M - mean^2."""
+    dyf = dy.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    per_ch = (dmean - 2.0 * dvar * mean) / rows
+    return dyf + per_ch[None, :] + (2.0 / rows) * dvar[None, :] * yf
+
+
+def _stats_cotangent_coeffs(dmean, dvar, mean, rows):
+    """Per-channel coefficients of dy_eff = dy + perch + dvar2 * y."""
+    perch = (dmean - 2.0 * dvar * mean) / rows
+    dvar2 = (2.0 / rows) * dvar
+    return perch.astype(jnp.float32), dvar2.astype(jnp.float32)
+
+
+def _matmul_bn_stats_bwd(res, cts):
+    x2, w2, y, mean = res
+    dy, dmean, dvar = cts
+    rows = x2.shape[0]
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
+    out = _mm_stats_bwd_pallas(dy.astype(x2.dtype), y, x2, w2, perch, dvar2)
+    if out is not None:
+        dx, dw = out
+        return dx, dw.astype(w2.dtype)
+    # VMEM-bounded fallback: plain XLA
+    dy_eff = _dy_effective(dy, dmean, dvar, y, mean, rows).astype(x2.dtype)
+    dx = jnp.dot(dy_eff, w2.T, preferred_element_type=jnp.float32)
+    dw = jnp.dot(x2.T, dy_eff, preferred_element_type=jnp.float32)
+    return dx.astype(x2.dtype), dw.astype(w2.dtype)
+
+
+matmul_bn_stats.defvjp(_matmul_bn_stats_fwd, _matmul_bn_stats_bwd)
+
+
+@jax.custom_vjp
+def bn_relu_matmul_bn_stats(x2, scale, shift, w2):
+    """a = relu(x2 * scale + shift); y = a @ w2; plus BN stats of y.
+
+    The scale/shift prologue is the folded affine of the previous BN
+    (gamma * rsqrt(var+eps), beta - mean * that), so the normalized
+    activation `a` is never written to HBM. Returns (y, mean, var)."""
+    out = _bn_relu_mm_stats_pallas(x2, scale, shift, w2)
+    if out is None:  # VMEM-bounded: plain XLA two-pass
+        a = jnp.maximum(x2.astype(jnp.float32) * scale[None, :]
+                        + shift[None, :], 0.0).astype(x2.dtype)
+        y = jnp.dot(a, w2,
+                    preferred_element_type=jnp.float32).astype(x2.dtype)
+        yf = y.astype(jnp.float32)
+        s, q = jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
+    else:
+        y, s, q = out
+    m = x2.shape[0]
+    mean = s / m
+    var = q / m - mean * mean
+    return y, mean, var
+
+
+def _bn_relu_matmul_bn_stats_fwd(x2, scale, shift, w2):
+    y, mean, var = bn_relu_matmul_bn_stats(x2, scale, shift, w2)
+    return (y, mean, var), (x2, scale, shift, w2, y, mean)
+
+
+def _bn_relu_matmul_bn_stats_bwd(res, cts):
+    x2, scale, shift, w2, y, mean = res
+    dy, dmean, dvar = cts
+    rows = x2.shape[0]
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
+    out = _bn_relu_mm_stats_bwd_pallas(dy.astype(x2.dtype), y, x2, scale,
+                                       shift, w2, perch, dvar2)
+    if out is not None:
+        dx, dw, dscale, dshift = out
+        return dx, dscale, dshift, dw.astype(w2.dtype)
+    # VMEM-bounded fallback: plain XLA
+    dy_eff = _dy_effective(dy, dmean, dvar, y, mean, rows).astype(x2.dtype)
+    # recompute a (XLA fuses this into the matmul operand reads)
+    xf = x2.astype(jnp.float32)
+    pre = xf * scale[None, :] + shift[None, :]
+    a = jnp.maximum(pre, 0.0).astype(x2.dtype)
+    da = jnp.dot(dy_eff, w2.T,
+                 preferred_element_type=jnp.float32)      # [M, K] fp32
+    gated = jnp.where(pre > 0.0, da, 0.0)
+    dx = (gated * scale[None, :]).astype(x2.dtype)
+    dscale = jnp.sum(gated * xf, axis=0)
+    dshift = jnp.sum(gated, axis=0)
+    dw = jnp.dot(a.T, dy_eff, preferred_element_type=jnp.float32)
+    return dx, dscale, dshift, dw.astype(w2.dtype)
+
+
+bn_relu_matmul_bn_stats.defvjp(_bn_relu_matmul_bn_stats_fwd,
+                               _bn_relu_matmul_bn_stats_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused 3x3 conv: BN-apply + ReLU prologue, conv, BN-stats epilogue.
+# One image per grid step — chosen so the 3x3 halo degenerates to the
+# image's own zero padding: the (H+2, W+2, C) activation window lives in
+# VMEM scratch (borders zero = conv padding, interior written from the
+# auto-pipelined input block), and the conv is 9 shifted MXU matmuls
+# against that window. No pad/copy ops, no normalized activation in
+# HBM. This is the middle kernel of the bottleneck chain, so with the
+# 1x1 kernels above an entire stride-1 bottleneck block runs without
+# materializing any normalized activation or separate statistics pass.
+# ---------------------------------------------------------------------------
+
+
+def _conv3x3_fwd_kernel(x_ref, scale_ref, shift_ref, w_ref,
+                        y_ref, s_ref, q_ref, awin, *, hh, ww, cc, oo):
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _init():
+        awin[...] = jnp.zeros_like(awin)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        q_ref[:] = jnp.zeros_like(q_ref)
+
+    raw = x_ref[0]
+    sc = scale_ref[:].reshape(1, 1, cc)
+    sh = shift_ref[:].reshape(1, 1, cc)
+    act = jnp.maximum(raw.astype(jnp.float32) * sc + sh, 0.0)
+    awin[pl.ds(1, hh), pl.ds(1, ww), :] = act.astype(awin.dtype)
+
+    acc = jnp.zeros((hh * ww, oo), jnp.float32)
+    for dh in range(3):
+        for dw in range(3):
+            tile = awin[pl.ds(dh, hh), pl.ds(dw, ww), :]
+            wt = w_ref[pl.ds((dh * 3 + dw) * cc, cc), :]
+            acc += jnp.dot(tile.reshape(hh * ww, cc), wt,
+                           preferred_element_type=jnp.float32)
+    y = acc.astype(y_ref.dtype)
+    y_ref[...] = y.reshape(1, hh, ww, oo)
+    yf = y.astype(jnp.float32)
+    s_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
+    q_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+
+def _conv3x3_bwd_kernel(dy_ref, y_ref, x_ref, scale_ref, shift_ref,
+                        wf_ref, perch_ref, dvar2_ref,
+                        dx_ref, dw_ref, ds_ref, dt_ref,
+                        ewin, xwin, *, hh, ww, cc, oo):
+    """One pass per image: dx (with relu gating + scale), dw (9 taps,
+    fp32 accumulated), dscale/dshift — dy_eff (stats cotangents folded)
+    and the recomputed activation window exist only in VMEM."""
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _init():
+        ewin[...] = jnp.zeros_like(ewin)
+        xwin[...] = jnp.zeros_like(xwin)
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        ds_ref[:] = jnp.zeros_like(ds_ref)
+        dt_ref[:] = jnp.zeros_like(dt_ref)
+
+    dyf = dy_ref[0].astype(jnp.float32)
+    yf = y_ref[0].astype(jnp.float32)
+    e = dyf + perch_ref[:].reshape(1, 1, oo) \
+        + dvar2_ref[:].reshape(1, 1, oo) * yf
+    e_bf = e.astype(ewin.dtype)
+    ewin[pl.ds(1, hh), pl.ds(1, ww), :] = e_bf
+
+    sc = scale_ref[:].reshape(1, 1, cc)
+    sh = shift_ref[:].reshape(1, 1, cc)
+    xf = x_ref[0].astype(jnp.float32)
+    pre = xf * sc + sh
+    xwin[pl.ds(1, hh), pl.ds(1, ww), :] = \
+        jnp.maximum(pre, 0.0).astype(xwin.dtype)
+
+    # dx: transposed conv of dy_eff with flipped taps, gated by relu
+    da = jnp.zeros((hh * ww, cc), jnp.float32)
+    for dh in range(3):
+        for dw in range(3):
+            tile = ewin[pl.ds(dh, hh), pl.ds(dw, ww), :]
+            wt = wf_ref[pl.ds((dh * 3 + dw) * oo, oo), :]
+            da += jnp.dot(tile.reshape(hh * ww, oo), wt,
+                          preferred_element_type=jnp.float32)
+    da = da.reshape(hh, ww, cc)
+    gated = jnp.where(pre > 0.0, da, 0.0)
+    dx_ref[...] = (gated * sc).astype(dx_ref.dtype).reshape(1, hh, ww, cc)
+    ds_ref[:] += jnp.sum(gated * xf, axis=(0, 1)).reshape(1, cc)
+    dt_ref[:] += jnp.sum(gated, axis=(0, 1)).reshape(1, cc)
+
+    # dw taps: a-window (halo) against the centered dy_eff
+    e2 = e_bf.reshape(hh * ww, oo)
+    for dh in range(3):
+        for dw in range(3):
+            tile = xwin[pl.ds(dh, hh), pl.ds(dw, ww), :]
+            dw_ref[pl.ds((dh * 3 + dw) * cc, cc), :] += jax.lax.dot_general(
+                tile.reshape(hh * ww, cc), e2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+
+def _conv3x3_flops(n, hh, ww, cc, oo):
+    return 2 * n * hh * ww * cc * oo * 9
+
+
+def conv3x3_vmem_ok(h, w, c, o, itemsize=2, budget=14 * 2 ** 20):
+    """Whether the fused 3x3 kernel pair fits VMEM for one image. The
+    binding footprint is the backward kernel's: two halo windows
+    (ewin [h+2,w+2,o], xwin [h+2,w+2,c] in the streaming dtype), the
+    fp32 dw accumulator [9c,o], fp32 per-image temporaries (dy_eff,
+    da), and the double-buffered streamed blocks (dy/y [h,w,o],
+    x/dx [h,w,c])."""
+    halo = (h + 2) * (w + 2)
+    img = h * w
+    windows = itemsize * halo * (o + c)          # ewin + xwin
+    dw_acc = 4 * 9 * c * o
+    temps = 4 * img * (o + c)                    # dy_eff + da, fp32
+    streams = 2 * itemsize * img * (2 * o + 2 * c)
+    return windows + dw_acc + temps + streams < budget
+
+
+def _conv3x3_fwd_pallas(x, scale, shift, w9, interpret=False):
+    n, h, wd, c = x.shape
+    o = w9.shape[1]
+    y, s, q = pl.pallas_call(
+        functools.partial(_conv3x3_fwd_kernel, hh=h, ww=wd, cc=c, oo=o),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, wd, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((9 * c, o), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, wd, o), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, o), lambda i: (0, 0)),
+            pl.BlockSpec((1, o), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wd, o), x.dtype),
+            jax.ShapeDtypeStruct((1, o), jnp.float32),
+            jax.ShapeDtypeStruct((1, o), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h + 2, wd + 2, c), x.dtype),
+        ],
+        interpret=interpret,
+    )(x, scale.reshape(1, c).astype(jnp.float32),
+      shift.reshape(1, c).astype(jnp.float32), w9)
+    return y, s[0], q[0]
+
+
+def _conv3x3_bwd_pallas(dy, y, x, scale, shift, w9, wf9, perch, dvar2,
+                        interpret=False):
+    n, h, wd, c = x.shape
+    o = w9.shape[1]
+    dx, dw, ds, dt = pl.pallas_call(
+        functools.partial(_conv3x3_bwd_kernel, hh=h, ww=wd, cc=c, oo=o),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, wd, o), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, h, wd, o), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, h, wd, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((9 * o, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, o), lambda i: (0, 0)),
+            pl.BlockSpec((1, o), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, wd, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((9 * c, o), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h, wd, c), x.dtype),
+            jax.ShapeDtypeStruct((9 * c, o), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h + 2, wd + 2, o), dy.dtype),
+            pltpu.VMEM((h + 2, wd + 2, c), x.dtype),
+        ],
+        interpret=interpret,
+    )(dy, y, x, scale.reshape(1, c).astype(jnp.float32),
+      shift.reshape(1, c).astype(jnp.float32), wf9,
+      perch.reshape(1, o), dvar2.reshape(1, o))
+    return dx, dw, ds[0], dt[0]
+
+
+def _conv3x3_ref_fwd(x, scale, shift, w9):
+    """jnp mirror of the fused 3x3 kernel (CPU path + oracle)."""
+    c = x.shape[-1]
+    o = w9.shape[1]
+    a = jnp.maximum(x.astype(jnp.float32) * scale + shift, 0.0
+                    ).astype(x.dtype)
+    y = jax.lax.conv_general_dilated(
+        a, w9.reshape(3, 3, c, o), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    yb = y.astype(x.dtype)
+    yf = yb.astype(jnp.float32)
+    s = jnp.sum(yf, axis=(0, 1, 2))
+    q = jnp.sum(yf * yf, axis=(0, 1, 2))
+    return yb, s, q
+
+
+@jax.custom_vjp
+def conv3x3_bn_act_stats(x, scale, shift, w9):
+    """relu(x*scale + shift) -> 3x3 SAME conv (NHWC, stride 1) -> BN
+    batch stats of the output. w9 is the (9*C_in, C_out) tap-major
+    weight (rows [(dh*3+dw)*C_in : +C_in] = tap (dh, dw)).
+    Returns (y, mean, var)."""
+    rows = x.shape[0] * x.shape[1] * x.shape[2]
+    # off-TPU the same Pallas kernel runs in interpret mode, so the
+    # CPU test suite exercises the real kernel logic (the jnp mirror
+    # _conv3x3_ref_fwd is the oracle in tests/test_fused_resnet.py)
+    y, s, q = _conv3x3_fwd_pallas(x, scale, shift, w9,
+                                  interpret=_interpret())
+    mean = s / rows
+    var = q / rows - mean * mean
+    return y, mean, var
+
+
+def _conv3x3_flip(w9, c, o):
+    """Window-offset-major flipped/transposed taps (9*C_out, C_in):
+    rows [(dh*3+dw)*C_out : +C_out] = w[2-dh, 2-dw].T — the transposed
+    conv kernel the dx computation slides over the dy_eff window."""
+    w = w9.reshape(3, 3, c, o)
+    wf = jnp.flip(w, axis=(0, 1)).transpose(0, 1, 3, 2)
+    return wf.reshape(9 * o, c)
+
+
+def _conv3x3_fwd(x, scale, shift, w9):
+    y, mean, var = conv3x3_bn_act_stats(x, scale, shift, w9)
+    return (y, mean, var), (x, scale, shift, w9, y, mean)
+
+
+def _conv3x3_bwd(res, cts):
+    x, scale, shift, w9, y, mean = res
+    dy, dmean, dvar = cts
+    n, h, wd, c = x.shape
+    o = w9.shape[1]
+    rows = n * h * wd
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
+    wf9 = _conv3x3_flip(w9, c, o)
+    dx, dw, ds, dt = _conv3x3_bwd_pallas(
+        dy.astype(x.dtype), y, x, scale, shift, w9, wf9, perch, dvar2,
+        interpret=_interpret())
+    return dx, ds, dt, dw.astype(w9.dtype)
+
+
+def _conv3x3_ref_bwd(dy, y, x, scale, shift, w9, perch, dvar2):
+    """jnp mirror of the fused 3x3 backward kernel (test oracle)."""
+    c = x.shape[-1]
+    o = w9.shape[1]
+    e = (dy.astype(jnp.float32) + perch + dvar2 * y.astype(jnp.float32)
+         ).astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    pre = xf * scale + shift
+    a = jnp.maximum(pre, 0.0).astype(x.dtype)
+    whwio = w9.reshape(3, 3, c, o)
+    da = jax.lax.conv_general_dilated(
+        e, jnp.flip(whwio, (0, 1)).transpose(0, 1, 3, 2), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    gated = jnp.where(pre > 0.0, da, 0.0)
+    dx = (gated * scale).astype(x.dtype)
+    ds = jnp.sum(gated * xf, axis=(0, 1, 2))
+    dt = jnp.sum(gated, axis=(0, 1, 2))
+    _, vjp = jax.vjp(
+        lambda wv: jax.lax.conv_general_dilated(
+            a, wv, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32), whwio)
+    dw = vjp(e.astype(jnp.float32))[0]
+    return dx, ds, dt, dw.reshape(9 * c, o).astype(w9.dtype)
+
+
+conv3x3_bn_act_stats.defvjp(_conv3x3_fwd, _conv3x3_bwd)
+
+
+def bn_relu_conv3x3_bn_stats(x, scale, shift, weight):
+    """relu(x*scale+shift) -> 3x3/s1 SAME conv (NHWC, paddle weight
+    layout [O, I, 3, 3]) -> BN stats of the output, with the halo
+    handled by an in-kernel DMA window (no pad/copy ops). The fused
+    middle kernel of a stride-1 bottleneck block."""
+    o, i = weight.shape[0], weight.shape[1]
+    w9 = weight.transpose(2, 3, 1, 0).reshape(9 * i, o).astype(x.dtype)
+    return conv3x3_bn_act_stats(x, scale, shift, w9)
+
+
+# ---------------------------------------------------------------------------
+# Residual-lean BN-apply epilogues. Plain autodiff of
+# relu(bf16(y*scale+shift) + identity) saves the fp32 product as a
+# residual for the dscale reduction (a 2x-sized save + a layout copy,
+# measured as the dominant HBM bloat of the naive fused graph); these
+# custom vjps save only the bf16 tensors that already exist (y, out) and
+# recompute the fp32 elementwise math inside the backward fusion.
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def bn_apply_relu_add(y, scale, shift, identity):
+    """relu(bf16(y*scale + shift) + identity) — the bottleneck block's
+    closing apply; identity is the residual branch (bf16)."""
+    pre = (y.astype(jnp.float32) * scale + shift).astype(y.dtype)
+    return jnp.maximum(pre + identity, jnp.zeros((), y.dtype))
+
+
+def _bn_apply_relu_add_fwd(y, scale, shift, identity):
+    out = bn_apply_relu_add(y, scale, shift, identity)
+    return out, (y, scale, out)
+
+
+def _bn_apply_relu_add_bwd(res, dout):
+    y, scale, out = res
+    mask = out > 0
+    g = jnp.where(mask, dout, jnp.zeros((), dout.dtype))
+    gf = g.astype(jnp.float32)
+    dy = (gf * scale).astype(y.dtype)
+    axes = tuple(range(y.ndim - 1))
+    dscale = jnp.sum(gf * y.astype(jnp.float32), axis=axes)
+    dshift = jnp.sum(gf, axis=axes)
+    return dy, dscale, dshift, g.astype(dout.dtype)
+
+
+bn_apply_relu_add.defvjp(_bn_apply_relu_add_fwd, _bn_apply_relu_add_bwd)
+
+
+@jax.custom_vjp
+def bn_apply_relu(y, scale, shift):
+    """relu(bf16(y*scale + shift)) — the between-conv apply."""
+    pre = (y.astype(jnp.float32) * scale + shift).astype(y.dtype)
+    return jnp.maximum(pre, jnp.zeros((), y.dtype))
+
+
+def _bn_apply_relu_fwd(y, scale, shift):
+    out = bn_apply_relu(y, scale, shift)
+    return out, (y, scale, out)
+
+
+def _bn_apply_relu_bwd(res, dout):
+    y, scale, out = res
+    g = jnp.where(out > 0, dout, jnp.zeros((), dout.dtype))
+    gf = g.astype(jnp.float32)
+    dy = (gf * scale).astype(y.dtype)
+    axes = tuple(range(y.ndim - 1))
+    dscale = jnp.sum(gf * y.astype(jnp.float32), axis=axes)
+    dshift = jnp.sum(gf, axis=axes)
+    return dy, dscale, dshift
+
+
+bn_apply_relu.defvjp(_bn_apply_relu_fwd, _bn_apply_relu_bwd)
+
+
+@jax.custom_vjp
+def bn_apply(y, scale, shift):
+    """bf16(y*scale + shift) — the downsample-branch apply (no relu)."""
+    return (y.astype(jnp.float32) * scale + shift).astype(y.dtype)
+
+
+def _bn_apply_fwd(y, scale, shift):
+    return bn_apply(y, scale, shift), (y, scale)
+
+
+def _bn_apply_bwd(res, dout):
+    y, scale = res
+    df = dout.astype(jnp.float32)
+    dy = (df * scale).astype(y.dtype)
+    axes = tuple(range(y.ndim - 1))
+    dscale = jnp.sum(df * y.astype(jnp.float32), axis=axes)
+    dshift = jnp.sum(df, axis=axes)
+    return dy, dscale, dshift
+
+
+bn_apply.defvjp(_bn_apply_fwd, _bn_apply_bwd)
+
+
+@jax.custom_vjp
+def bn_moments(y):
+    """Channel-last batch moments (fp32 mean/var) with a residual-lean
+    vjp: saves only the bf16 input (already materialized as the conv
+    output) instead of fp32 squares."""
+    yf = y.astype(jnp.float32)
+    axes = tuple(range(y.ndim - 1))
+    mean = jnp.mean(yf, axis=axes)
+    var = jnp.mean(yf * yf, axis=axes) - mean * mean
+    return mean, var
+
+
+def _bn_moments_fwd(y):
+    mean, var = bn_moments(y)
+    return (mean, var), (y, mean)
+
+
+def _bn_moments_bwd(res, cts):
+    y, mean = res
+    dmean, dvar = cts
+    rows = math.prod(y.shape[:-1])
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
+    dy = perch + dvar2 * y.astype(jnp.float32)
+    return (dy.astype(y.dtype),)
+
+
+bn_moments.defvjp(_bn_moments_fwd, _bn_moments_bwd)
+
+
+# ---------------------------------------------------------------------------
+# NHWC conv-shaped entry points
+# ---------------------------------------------------------------------------
+
+def _flatten_nhwc(x):
+    return x.reshape(-1, x.shape[-1])
+
+
+def conv1x1_bn_stats(x, weight, stride=1):
+    """1x1 conv (NHWC, paddle weight layout [O, I, 1, 1]) + BN batch
+    stats of the output in the same pass. Returns (y, mean, var)."""
+    o, i = weight.shape[0], weight.shape[1]
+    w2 = weight.reshape(o, i).T.astype(x.dtype)
+    if stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    shp = x.shape
+    y2, mean, var = matmul_bn_stats(_flatten_nhwc(x), w2)
+    return y2.reshape(*shp[:-1], o), mean, var
+
+
+def bn_relu_conv1x1_bn_stats(x, scale, shift, weight):
+    """relu(x * scale + shift) -> 1x1 conv (NHWC) -> BN stats of the
+    output, without materializing the normalized activation."""
+    o, i = weight.shape[0], weight.shape[1]
+    w2 = weight.reshape(o, i).T.astype(x.dtype)
+    shp = x.shape
+    y2, mean, var = bn_relu_matmul_bn_stats(
+        _flatten_nhwc(x), scale, shift, w2)
+    return y2.reshape(*shp[:-1], o), mean, var
+
+
+def bn_fold(gamma, beta, mean, var, epsilon):
+    """Fold BN (gamma, beta, batch mean/var) into per-channel scale/shift
+    (fp32): bn(y) = y * scale + shift."""
+    g = gamma.astype(jnp.float32) if gamma is not None else 1.0
+    b = beta.astype(jnp.float32) if beta is not None else 0.0
+    scale = g * jax.lax.rsqrt(var.astype(jnp.float32) + epsilon)
+    shift = b - mean.astype(jnp.float32) * scale
+    return scale, shift

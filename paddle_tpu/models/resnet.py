@@ -13,6 +13,16 @@ from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
                                 Linear, MaxPool2D, ReLU)
 
 
+# ablation knob (experiments/fused_bn_probe.py): route the 3x3 of fused
+# blocks through the Pallas window kernel (True) or XLA conv (False)
+_PALLAS3X3 = True
+
+
+def _stride0(conv):
+    s = conv.stride
+    return s[0] if isinstance(s, (tuple, list)) else s
+
+
 class BasicBlock(Layer):
     expansion = 1
 
@@ -42,7 +52,8 @@ class BottleneckBlock(Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, data_format="NCHW"):
+                 groups=1, base_width=64, data_format="NCHW",
+                 fused_bn=False):
         super().__init__()
         width = int(planes * (base_width / 64.0)) * groups
         df = dict(data_format=data_format)
@@ -55,8 +66,13 @@ class BottleneckBlock(Layer):
         self.bn3 = BatchNorm2D(planes * 4, **df)
         self.downsample = downsample
         self.relu = ReLU()
+        self.data_format = data_format
+        self.fused_bn = fused_bn
 
     def forward(self, x):
+        if (self.fused_bn and self.training and self.data_format == "NHWC"
+                and not self.bn1.use_global_stats):
+            return self._forward_fused(x)
         identity = x
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.relu(self.bn2(self.conv2(out)))
@@ -65,11 +81,64 @@ class BottleneckBlock(Layer):
             identity = self.downsample(x)
         return self.relu(out + identity)
 
+    def _forward_fused(self, x):
+        """Training-mode fused path (NHWC): the 1x1 convs run as Pallas
+        matmuls that compute their output's BN statistics in the same
+        HBM pass (conv1, conv3, downsample) and apply the previous BN +
+        ReLU on the fly while reading their input (conv3) — the analog
+        of the reference's resnet_unit_op / fused_bn_add_activation
+        fusion (see kernels/fused_resnet.py for the roofline argument).
+        Numerics match the unfused path within bf16 rounding; running
+        stats update identically."""
+        from ..nn.functional.fused_conv import (bn_apply, bn_apply_relu,
+                                                bn_apply_relu_add, bn_fold,
+                                                bn_moments, conv1x1_bn_stats,
+                                                bn_relu_conv1x1_bn_stats,
+                                                bn_relu_conv3x3_bn_stats)
+        y1, m1, v1 = conv1x1_bn_stats(x, self.conv1.weight)
+        self.bn1._update_running(m1, v1)
+        s1, t1 = bn_fold(self.bn1.weight, self.bn1.bias, m1, v1,
+                         self.bn1.epsilon)
+        from ..kernels.fused_resnet import conv3x3_vmem_ok
+        stride2 = _stride0(self.conv2)
+        h, wd, cw = y1.shape[1], y1.shape[2], y1.shape[3]
+        co = self.conv2.weight.shape[0]
+        itemsize = y1.data.dtype.itemsize if hasattr(y1, "data") \
+            else y1.dtype.itemsize
+        pallas3x3 = (_PALLAS3X3 and stride2 == 1 and self.conv2.groups == 1
+                     and conv3x3_vmem_ok(h, wd, cw, co, itemsize))
+        if pallas3x3:
+            # bn1-apply + relu + 3x3 conv + bn2 stats in one kernel: the
+            # normalized activation never exists in HBM
+            y2, m2, v2 = bn_relu_conv3x3_bn_stats(
+                y1, s1, t1, self.conv2.weight)
+        else:
+            a1 = bn_apply_relu(y1, s1, t1)
+            y2 = self.conv2(a1)
+            m2, v2 = bn_moments(y2)
+        self.bn2._update_running(m2, v2)
+        s2, t2 = bn_fold(self.bn2.weight, self.bn2.bias, m2, v2,
+                         self.bn2.epsilon)
+        y3, m3, v3 = bn_relu_conv1x1_bn_stats(y2, s2, t2, self.conv3.weight)
+        self.bn3._update_running(m3, v3)
+        s3, t3 = bn_fold(self.bn3.weight, self.bn3.bias, m3, v3,
+                         self.bn3.epsilon)
+        if self.downsample is not None:
+            dsconv, dsbn = self.downsample[0], self.downsample[1]
+            yd, md, vd = conv1x1_bn_stats(x, dsconv.weight,
+                                          stride=_stride0(dsconv))
+            dsbn._update_running(md, vd)
+            sd, td = bn_fold(dsbn.weight, dsbn.bias, md, vd, dsbn.epsilon)
+            identity = bn_apply(yd, sd, td)
+        else:
+            identity = x
+        return bn_apply_relu_add(y3, s3, t3, identity)
+
 
 class ResNet(Layer):
     def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
                  groups=1, width_per_group=64, data_format="NCHW",
-                 stem_space_to_depth=False):
+                 stem_space_to_depth=False, fused_bn=False):
         super().__init__()
         if not issubclass(block, BottleneckBlock) and \
                 (groups != 1 or width_per_group != 64):
@@ -84,6 +153,7 @@ class ResNet(Layer):
         self.base_width = width_per_group
         self.data_format = data_format
         self.stem_space_to_depth = stem_space_to_depth
+        self.fused_bn = fused_bn and issubclass(block, BottleneckBlock)
         df = dict(data_format=data_format)
         self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False,
                             **df)
@@ -111,7 +181,8 @@ class ResNet(Layer):
                 BatchNorm2D(planes * block.expansion, **df))
         kw = dict(df)
         if issubclass(block, BottleneckBlock):
-            kw.update(groups=self.groups, base_width=self.base_width)
+            kw.update(groups=self.groups, base_width=self.base_width,
+                      fused_bn=self.fused_bn)
         layers = [block(self.inplanes, planes, stride, downsample, **kw)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):

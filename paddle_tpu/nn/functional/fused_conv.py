@@ -1,0 +1,77 @@
+"""Fused conv+BN training functionals (NHWC), backed by the Pallas
+kernels in paddle_tpu.kernels.fused_resnet.
+
+Reference analog: paddle/fluid/operators/fused/resnet_unit_op.cu:1 and
+fused_bn_add_activation_op.cu:1 — the reference ships conv+BN(+add+relu)
+training fusion as first-class ops for ResNet; here the same byte cut is
+a Pallas matmul with BN-stats epilogue / BN-apply prologue (see the
+kernel docstring for the roofline argument).
+"""
+from __future__ import annotations
+
+from ...ops.op_registry import op
+
+
+@op("conv1x1_bn_stats")
+def conv1x1_bn_stats(x, weight, stride=1):
+    """NHWC 1x1 conv + batch statistics of its output in one HBM pass.
+    weight is the paddle-layout [O, I, 1, 1] conv kernel. Returns
+    (y, mean, var) with fp32 stats."""
+    from ...kernels.fused_resnet import conv1x1_bn_stats as _impl
+    return _impl(x, weight, stride=stride)
+
+
+@op("bn_relu_conv1x1_bn_stats")
+def bn_relu_conv1x1_bn_stats(x, scale, shift, weight):
+    """relu(x*scale + shift) -> NHWC 1x1 conv -> batch stats of the
+    output; the normalized activation never reaches HBM. scale/shift
+    are the folded BN affine (see bn_fold). Returns (y, mean, var)."""
+    from ...kernels.fused_resnet import bn_relu_conv1x1_bn_stats as _impl
+    return _impl(x, scale, shift, weight)
+
+
+@op("bn_relu_conv3x3_bn_stats")
+def bn_relu_conv3x3_bn_stats(x, scale, shift, weight):
+    """relu(x*scale+shift) -> 3x3/s1 SAME conv (NHWC) -> batch stats of
+    the output; the halo comes from an in-kernel DMA window, so no
+    pad/copy or normalized activation ever reaches HBM. Returns
+    (y, mean, var)."""
+    from ...kernels.fused_resnet import bn_relu_conv3x3_bn_stats as _impl
+    return _impl(x, scale, shift, weight)
+
+
+@op("bn_apply_relu_add")
+def bn_apply_relu_add(y, scale, shift, identity):
+    """relu(bf16(y*scale+shift) + identity) with a residual-lean vjp
+    (saves only bf16 y/out; the fp32 math recomputes in backward)."""
+    from ...kernels.fused_resnet import bn_apply_relu_add as _impl
+    return _impl(y, scale, shift, identity)
+
+
+@op("bn_apply_relu")
+def bn_apply_relu(y, scale, shift):
+    """relu(bf16(y*scale+shift)) with a residual-lean vjp."""
+    from ...kernels.fused_resnet import bn_apply_relu as _impl
+    return _impl(y, scale, shift)
+
+
+@op("bn_apply")
+def bn_apply(y, scale, shift):
+    """bf16(y*scale+shift) with a residual-lean vjp."""
+    from ...kernels.fused_resnet import bn_apply as _impl
+    return _impl(y, scale, shift)
+
+
+@op("bn_moments")
+def bn_moments(y):
+    """Channel-last batch mean/var (fp32) with a residual-lean vjp."""
+    from ...kernels.fused_resnet import bn_moments as _impl
+    return _impl(y)
+
+
+@op("bn_fold")
+def bn_fold(gamma, beta, mean, var, epsilon=1e-5):
+    """Fold BN parameters + batch stats into per-channel (scale, shift)
+    fp32 vectors: bn(y) = y * scale + shift."""
+    from ...kernels.fused_resnet import bn_fold as _impl
+    return _impl(gamma, beta, mean, var, epsilon)

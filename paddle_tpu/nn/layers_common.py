@@ -257,21 +257,26 @@ class _BatchNormBase(Layer):
         if training:
             out, mean, var = F.batch_norm_train(
                 x, self.weight, self.bias, self.epsilon, self.data_format)
-            # running-stat update: stateful, host side. Under jit tracing the
-            # update is skipped (buffers would bake as constants) — the jit
-            # training path syncs stats via Layer.apply or accepts frozen
-            # stats, matching how XLA frameworks treat BN.
-            m = mean.data if isinstance(mean, Tensor) else mean
-            v = var.data if isinstance(var, Tensor) else var
-            import jax as _jax
-            if not isinstance(m, _jax.core.Tracer):
-                mom = self.momentum
-                self._mean._replace_data(mom * self._mean.data + (1 - mom) * m)
-                self._variance._replace_data(
-                    mom * self._variance.data + (1 - mom) * v)
+            self._update_running(mean, var)
             return out
         return F.batch_norm_infer(x, self._mean, self._variance, self.weight,
                                   self.bias, self.epsilon, self.data_format)
+
+    def _update_running(self, mean, var):
+        """Running-stat update: stateful, host side. Under jit tracing the
+        update is skipped (buffers would bake as constants) — the jit
+        training path syncs stats via Layer.apply or accepts frozen
+        stats, matching how XLA frameworks treat BN. Also the hook the
+        fused conv+BN path (models/resnet.py) feeds its epilogue stats
+        through."""
+        m = mean.data if isinstance(mean, Tensor) else mean
+        v = var.data if isinstance(var, Tensor) else var
+        import jax as _jax
+        if not isinstance(m, _jax.core.Tracer):
+            mom = self.momentum
+            self._mean._replace_data(mom * self._mean.data + (1 - mom) * m)
+            self._variance._replace_data(
+                mom * self._variance.data + (1 - mom) * v)
 
 
 class BatchNorm1D(_BatchNormBase):

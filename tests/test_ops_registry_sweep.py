@@ -21,6 +21,7 @@ import paddle_tpu.geometric  # noqa: F401
 import paddle_tpu.quantization  # noqa: F401
 import paddle_tpu.signal  # noqa: F401
 import paddle_tpu.text  # noqa: F401
+import paddle_tpu.nn.functional.fused_conv  # noqa: F401
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.op_registry import OPS
 
@@ -486,6 +487,35 @@ MANUAL_SPECS = {
                         np.array([5, 4], np.int64), False], {}),
     "fftshift": ([T34], {}),
     "ifftshift": ([T34], {}),
+    # fused conv+BN training ops (kernels/fused_resnet.py; interpret-mode
+    # pallas on CPU). NHWC activations, paddle-layout [O,I,kh,kw] weights.
+    "conv1x1_bn_stats": ([rng.randn(2, 4, 4, 8).astype(np.float32),
+                          rng.randn(16, 8, 1, 1).astype(np.float32)], {}),
+    "bn_relu_conv1x1_bn_stats": (
+        [rng.randn(2, 4, 4, 8).astype(np.float32),
+         (np.abs(rng.randn(8)) + 0.5).astype(np.float32),
+         (rng.randn(8) * 0.1).astype(np.float32),
+         rng.randn(16, 8, 1, 1).astype(np.float32)], {}),
+    "bn_relu_conv3x3_bn_stats": (
+        [rng.randn(2, 4, 4, 8).astype(np.float32),
+         (np.abs(rng.randn(8)) + 0.5).astype(np.float32),
+         (rng.randn(8) * 0.1).astype(np.float32),
+         (rng.randn(16, 8, 3, 3) * 0.2).astype(np.float32)], {}),
+    "bn_apply_relu_add": ([rng.randn(2, 4, 4, 16).astype(np.float32),
+                           (np.abs(rng.randn(16)) + 0.5).astype(np.float32),
+                           (rng.randn(16) * 0.1).astype(np.float32),
+                           rng.randn(2, 4, 4, 16).astype(np.float32)], {}),
+    "bn_apply_relu": ([rng.randn(2, 4, 4, 16).astype(np.float32),
+                       (np.abs(rng.randn(16)) + 0.5).astype(np.float32),
+                       (rng.randn(16) * 0.1).astype(np.float32)], {}),
+    "bn_apply": ([rng.randn(2, 4, 4, 16).astype(np.float32),
+                  (np.abs(rng.randn(16)) + 0.5).astype(np.float32),
+                  (rng.randn(16) * 0.1).astype(np.float32)], {}),
+    "bn_moments": ([rng.randn(2, 4, 4, 16).astype(np.float32)], {}),
+    "bn_fold": ([(np.abs(rng.randn(8)) + 0.5).astype(np.float32),
+                 rng.randn(8).astype(np.float32),
+                 rng.randn(8).astype(np.float32),
+                 (rng.rand(8) + 0.1).astype(np.float32)], {}),
 }
 
 # complex-dtype FFT family: the sweep's fp32/bf16/FD machinery is
