@@ -1,0 +1,73 @@
+"""Ablate the fused conv+BN ResNet path on the real chip.
+
+Configs: (a) unfused r3 baseline, (b) fused with XLA 3x3 (Pallas 1x1
+epilogue/prologue kernels + residual-lean applies only), (c) fused with
+the Pallas 3x3 window kernel. Prints img/s for each.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run(fused_bn, pallas3x3, remat=()):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models import resnet as resnet_mod
+
+    resnet_mod._PALLAS3X3 = pallas3x3
+    paddle.seed(0)
+    model = resnet_mod.resnet50(num_classes=1000, data_format="NHWC",
+                                stem_space_to_depth=True, fused_bn=fused_bn,
+                                recompute_stages=remat)
+    model.bfloat16()
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    ce = nn.CrossEntropyLoss()
+    step = paddle.jit.TrainStep(
+        model, opt, lambda lg, lb: ce(lg.astype("float32"), lb))
+    b = 128
+    rng = np.random.RandomState(0)
+    img = rng.randn(b, 3, 224, 224).astype(np.float32)
+    x = paddle.to_tensor(img).astype("bfloat16")
+    y = paddle.to_tensor(rng.randint(0, 1000, (b,)).astype(np.int64))
+    loss = step(x, y)
+    float(loss)
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return b * iters / dt, dt / iters * 1e3
+
+
+def main():
+    import jax
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    cfgs = [("unfused (r3 baseline)", False, False, ()),
+            ("fused, XLA 3x3", True, False, ()),
+            ("fused, Pallas 3x3", True, True, ()),
+            ("unfused, remat L1", False, False, (1,)),
+            ("unfused, remat L1-2", False, False, (1, 2)),
+            ("unfused, remat L1-3", False, False, (1, 2, 3))]
+    import sys as _sys
+    only = _sys.argv[1] if len(_sys.argv) > 1 else None
+    for name, fused, p3, remat in cfgs:
+        if only and only not in name:
+            continue
+        ips, ms = run(fused, p3, remat)
+        print(f"{name:24s} {ips:7.1f} img/s   {ms:6.2f} ms/step",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -138,7 +138,8 @@ class BottleneckBlock(Layer):
 class ResNet(Layer):
     def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
                  groups=1, width_per_group=64, data_format="NCHW",
-                 stem_space_to_depth=False, fused_bn=False):
+                 stem_space_to_depth=False, fused_bn=False,
+                 recompute_stages=()):
         super().__init__()
         if not issubclass(block, BottleneckBlock) and \
                 (groups != 1 or width_per_group != 64):
@@ -154,6 +155,18 @@ class ResNet(Layer):
         self.data_format = data_format
         self.stem_space_to_depth = stem_space_to_depth
         self.fused_bn = fused_bn and issubclass(block, BottleneckBlock)
+        # per-stage remat (1-4): re-run the stage's blocks in backward
+        # instead of saving their intermediates — trades spare MXU time
+        # for HBM traffic on the bandwidth-bound early stages. Engages
+        # only under jit tracing (TrainStep), where BN running stats
+        # are frozen by design anyway; eager forward runs the normal
+        # path so running stats keep updating.
+        self.recompute_stages = tuple(recompute_stages)
+        bad = [s for s in self.recompute_stages if s not in (1, 2, 3, 4)]
+        if bad:
+            raise ValueError(
+                f"recompute_stages entries must be stage numbers 1-4 "
+                f"(1-indexed: layer1..layer4), got {bad}")
         df = dict(data_format=data_format)
         self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False,
                             **df)
@@ -237,7 +250,20 @@ class ResNet(Layer):
         return self._trunk(x)
 
     def _trunk(self, x):
-        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        import jax as _jax
+        data = x.data if hasattr(x, "data") else x
+        traced = isinstance(data, _jax.core.Tracer)
+        if self.training and self.recompute_stages and traced:
+            from ..distributed.parallel.recompute import recompute
+            stages = (self.layer1, self.layer2, self.layer3, self.layer4)
+            for i, stage in enumerate(stages, 1):
+                if i in self.recompute_stages:
+                    for blk in stage:
+                        x = recompute(blk, x)
+                else:
+                    x = stage(x)
+        else:
+            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:

@@ -294,6 +294,47 @@ class TestFusedBottleneckBlock:
         np.testing.assert_allclose(np.asarray(m_fused(x).data), ref,
                                    rtol=5e-3, atol=5e-3)
 
+    def test_recompute_stages_jit_parity_and_eager_stats(self):
+        # remat must change memory behavior only: identical jitted
+        # training trajectory, and the eager path (where BN running
+        # stats live) must keep updating stats — remat engages only
+        # under jit tracing, where stats are frozen uniformly by design
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.models.resnet import ResNet, BottleneckBlock
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            ResNet(BottleneckBlock, [1, 1, 1, 1], recompute_stages=(0, 1))
+        rng = np.random.RandomState(14)
+        img = rng.randn(2, 3, 32, 32).astype(np.float32)
+        lbl = rng.randint(0, 10, (2,)).astype(np.int64)
+        losses = {}
+        for remat in ((), (1, 2)):
+            paddle.seed(7)
+            m = ResNet(BottleneckBlock, [1, 1, 1, 1], num_classes=10,
+                       data_format="NHWC", recompute_stages=remat)
+            m.train()
+            opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                     parameters=m.parameters())
+            ce = nn.CrossEntropyLoss()
+            step = paddle.jit.TrainStep(
+                m, opt, lambda lg, lb: ce(lg, lb))
+            x, y = paddle.to_tensor(img), paddle.to_tensor(lbl)
+            losses[remat] = [float(np.asarray(step(x, y).data))
+                             for _ in range(2)]
+        np.testing.assert_allclose(losses[(1, 2)], losses[()],
+                                   rtol=1e-5, atol=1e-6)
+        # eager forward with remat configured still updates running stats
+        paddle.seed(7)
+        m = ResNet(BottleneckBlock, [1, 1, 1, 1], num_classes=10,
+                   data_format="NHWC", recompute_stages=(1,))
+        m.train()
+        before = np.asarray(m.layer1[0].bn1._mean.data).copy()
+        m(paddle.to_tensor(img))
+        after = np.asarray(m.layer1[0].bn1._mean.data)
+        assert not np.allclose(before, after), \
+            "remat froze eager BN running stats"
+
     def test_eval_path_unchanged(self):
         import paddle_tpu as paddle
         rng = np.random.RandomState(9)
