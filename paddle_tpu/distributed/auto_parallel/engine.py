@@ -45,6 +45,7 @@ class Engine:
         self._eval_fn = None
         self._pred_fn = None
         self._opt_state = None
+        self._fleet_step = None  # full-space tune installs a fleet step
         self._history: List[Dict[str, float]] = []
 
     # ------------------------------------------------------------- plumbing
@@ -80,11 +81,26 @@ class Engine:
         return self
 
     def tune(self, *example_batch, max_candidates: int = 8,
-             verbose: bool = False, **tuner_kwargs):
+             verbose: bool = False, model_builder: Optional[Callable] = None,
+             **tuner_kwargs):
         """strategy='auto' entry: search mesh degrees for this model on
         the visible devices (reference parallel_tuner.py analog; see
         tuner.py for the compiled-program cost model). Returns the
-        winning Candidate and leaves the engine on its mesh."""
+        winning Candidate and leaves the engine on its mesh.
+
+        Without `model_builder` the search covers dp x (one annotated
+        model axis) over the engine's own GSPMD step. With
+        `model_builder(hybrid_configs) -> (model, optimizer, loss_fn)`
+        the FULL dp x sharding x pp x mp space is searched through the
+        fleet hybrid path (reference parallel_tuner.py:33 searches
+        pipeline stages too): each candidate gets a fresh fleet.init +
+        model (pipeline splitting changes parameter placement), and the
+        winner's DistributedTrainStep is installed on the engine —
+        fit() then trains through it."""
+        if model_builder is not None:
+            return _engine_tune_full(self, model_builder, example_batch,
+                                     max_candidates=max_candidates,
+                                     verbose=verbose, **tuner_kwargs)
         return _engine_tune(self, example_batch,
                             max_candidates=max_candidates,
                             verbose=verbose, **tuner_kwargs)
@@ -132,6 +148,9 @@ class Engine:
             verbose: int = 1):
         """`train_data` yields (inputs..., label) numpy/Tensor tuples —
         an iterable/DataLoader — or is a tuple of arrays to be batched."""
+        if self._fleet_step is not None:
+            return self._fit_fleet(train_data, epochs, batch_size,
+                                   steps_per_epoch, log_freq, verbose)
         self._build_train_step()
         mesh = self.mesh.jax_mesh
         names, params = self._names_and_params()
@@ -192,6 +211,47 @@ class Engine:
                    else None,
                    "steps": n_steps, "time_s": dt}
             self._history.append(rec)
+        return self._history
+
+    def _fit_fleet(self, train_data, epochs, batch_size, steps_per_epoch,
+                   log_freq, verbose):
+        """fit() through the full-space-tuned fleet DistributedTrainStep
+        (pp/sharding/mp candidates train here; the GSPMD jit path above
+        covers the dp x one-model-axis case)."""
+        step = self._fleet_step
+        axis_size = 1
+        for ax in ("dp", "sharding"):
+            if ax in step.mesh.shape:
+                axis_size *= step.mesh.shape[ax]
+        for epoch in range(epochs):
+            t0 = time.perf_counter()
+            n_steps = 0
+            last_loss = None
+            for bi, batch in enumerate(_batches(train_data, batch_size)):
+                if steps_per_epoch is not None and \
+                        n_steps >= steps_per_epoch:
+                    break
+                leaves = jax.tree_util.tree_leaves(
+                    batch, is_leaf=lambda t: isinstance(t, Tensor))
+                lead = _to_array(leaves[0]).shape[0] if leaves else 0
+                if lead % axis_size != 0:
+                    import warnings
+                    warnings.warn(
+                        f"Engine.fit: skipping batch of {lead} samples "
+                        f"not divisible by the data axes "
+                        f"(size {axis_size})")
+                    continue
+                last_loss = step(*batch)
+                n_steps += 1
+                if verbose and bi % log_freq == 0:
+                    print(f"epoch {epoch} step {bi} "
+                          f"loss {float(np.asarray(last_loss.data)):.4f}")
+            self._history.append(
+                {"epoch": epoch,
+                 "loss": float(np.asarray(last_loss.data))
+                 if last_loss is not None else None,
+                 "steps": n_steps,
+                 "time_s": time.perf_counter() - t0})
         return self._history
 
     # ------------------------------------------------------------ evaluate
@@ -372,5 +432,56 @@ def _engine_tune(engine: "Engine", example_batch, max_candidates=8,
     best = tuner.tune(verbose=verbose)
     # leave the engine on the winning mesh
     step_builder(best.hybrid_configs)
+    engine._tuned = best
+    return best
+
+
+def _engine_tune_full(engine: "Engine", model_builder, example_batch, *,
+                      max_candidates=8, verbose=False, **tuner_kwargs):
+    """Full-space strategy search (dp x sharding x pp x mp) through the
+    fleet hybrid path. Per candidate: fleet.init on the candidate
+    degrees, a FRESH model from model_builder (pipeline splitting
+    changes parameter structure, so the same Layer object cannot be
+    re-partitioned in place), then a fleet.DistributedTrainStep is
+    lowered/compiled and scored by the tuner cost model. The winning
+    candidate is rebuilt and installed: engine.fit() trains through
+    its DistributedTrainStep.
+
+    model_builder(hybrid_configs) -> (model, optimizer, loss_fn); it
+    reads the active fleet topology (already initialized on the
+    candidate degrees when called) to pick e.g. gpt() vs
+    gpt_pipe(num_stages=pp). Reference:
+    auto_parallel/tuner/parallel_tuner.py:33 (candidates over process
+    meshes incl. pipeline stages)."""
+    from .tuner import ParallelTuner
+    from .. import fleet
+
+    def step_builder(cfg):
+        strategy = fleet.DistributedStrategy(
+            hybrid_configs=dict(cfg),
+            sharding=cfg.get("sharding_degree", 1) > 1,
+            sharding_configs={"stage": 2})
+        fleet.init(strategy=strategy)
+        model, opt, loss_fn = model_builder(dict(cfg))
+        model = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(opt)
+        step = fleet.DistributedTrainStep(model, opt, loss_fn)
+        return step, tuple(example_batch)
+
+    axes = tuner_kwargs.pop("axes", ("dp", "sharding", "pp", "mp"))
+    tuner = ParallelTuner(len(jax.devices()), step_builder, axes=axes,
+                          max_candidates=max_candidates, **tuner_kwargs)
+    best = tuner.tune(verbose=verbose)
+    step, _ = step_builder(best.hybrid_configs)
+    engine._fleet_step = step
+    engine.model = step.model
+    engine.optimizer = step.optimizer
+    engine.loss_fn = step.loss_fn
+    # expose the winner's hybrid mesh so evaluate()/_require_mesh see
+    # the tuned topology, not a fresh 1-D fallback
+    dev_ids = np.array([d.id for d in step.mesh.devices.flat]).reshape(
+        step.mesh.devices.shape)
+    engine.mesh = ProcessMesh(dev_ids,
+                              dim_names=list(step.mesh.axis_names))
     engine._tuned = best
     return best

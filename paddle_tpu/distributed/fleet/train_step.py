@@ -69,7 +69,12 @@ class DistributedTrainStep:
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  mesh: Optional[Mesh] = None, donate: bool = True,
-                 accumulate_steps: int = 1):
+                 accumulate_steps: int = 1, abstract: bool = False):
+        """abstract=True skips placing parameters on the mesh (and
+        lower_abstract() skips optimizer/batch buffers too): the step
+        can then only be LOWERED, not executed — compile-planning a
+        mesh whose replicated state would not fit host memory (e.g. a
+        256-chip plan on a virtual CPU mesh)."""
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -79,8 +84,10 @@ class DistributedTrainStep:
         self.strategy: ShardingStrategy = getattr(
             optimizer, "_sharding_strategy", ShardingStrategy(stage=0))
         self.accumulate_steps = accumulate_steps
+        self.abstract = abstract
 
-        shard_model(model, self.mesh, self.strategy)
+        if not abstract:
+            shard_model(model, self.mesh, self.strategy)
         self._params = [p for _, p in model.named_parameters()]
         self._param_names = [n for n, _ in model.named_parameters()]
 
@@ -149,12 +156,8 @@ class DistributedTrainStep:
 
     # ------------------------------------------------------------------ call
     def _shard_batch(self, arr):
-        nd = arr.ndim
-        lead = 1 if self.accumulate_steps > 1 else 0
-        parts = [None] * nd
-        if nd > lead:
-            parts[lead] = DATA_AXES
-        return jax.device_put(arr, NamedSharding(self.mesh, P(*parts)))
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, self._batch_leaf_spec(arr.ndim)))
 
     def _ensure_opt_state(self):
         """Seed (or re-load from a restored optimizer) the sharded
@@ -176,6 +179,12 @@ class DistributedTrainStep:
     def _prepare(self, batch):
         """Shared by __call__ and lower(): opt state + jit + sharded
         raw batch."""
+        if self.abstract:
+            raise RuntimeError(
+                "DistributedTrainStep(abstract=True) never placed its "
+                "parameters/optimizer state on the mesh — it can only "
+                "be lower_abstract()'ed, not executed; rebuild with "
+                "abstract=False to run steps")
         self._ensure_opt_state()
         if self._jitted is None:
             self._build(tuple(getattr(b, "ndim", 0) for b in batch))
@@ -194,6 +203,54 @@ class DistributedTrainStep:
             [p._data for p in self._params], self._opt_state_tree,
             np.float32(self.optimizer.get_lr()),
             np.int32(self.optimizer._step_count + 1), *raw_batch)
+
+    def _batch_leaf_spec(self, nd: int) -> P:
+        lead = 1 if self.accumulate_steps > 1 else 0
+        parts = [None] * nd
+        if nd > lead:
+            parts[lead] = DATA_AXES
+        return P(*parts)
+
+    def lower_abstract(self, *batch):
+        """jax Lowered built from abstract (ShapeDtypeStruct) operands:
+        no parameter, optimizer-state, or batch buffer is ever placed
+        on the mesh, so meshes far larger than host memory compile-plan
+        fine. `batch` leaves may be arrays, Tensors, or
+        ShapeDtypeStructs — only shape/dtype are read."""
+        if self._jitted is None:
+            self._build(None)
+        m, s = self.mesh, self.strategy
+
+        p_avals = [jax.ShapeDtypeStruct(tuple(p.data.shape), p.data.dtype,
+                                        sharding=sh)
+                   for p, sh in zip(self._params, self._param_shardings)]
+        opt_avals = []
+        for p in self._params:
+            st = jax.eval_shape(self.optimizer.init_state_for, p._data)
+            opt_avals.append({
+                k: (jax.ShapeDtypeStruct(
+                    tuple(v.shape), v.dtype,
+                    sharding=NamedSharding(m, s.opt_state_spec(
+                        tuple(v.shape), m, _param_base_spec(p))))
+                    if v is not None else None)
+                for k, v in st.items()})
+        repl = NamedSharding(m, P())
+        lr_aval = jax.ShapeDtypeStruct((), np.float32, sharding=repl)
+        no_aval = jax.ShapeDtypeStruct((), np.int32, sharding=repl)
+
+        def leaf_aval(t):
+            x = _unwrap(t)
+            nd = len(x.shape)
+            return jax.ShapeDtypeStruct(
+                tuple(x.shape), x.dtype,
+                sharding=NamedSharding(m, self._batch_leaf_spec(nd)))
+
+        batch_avals = tuple(
+            jax.tree_util.tree_map(
+                leaf_aval, b, is_leaf=lambda t: isinstance(t, Tensor))
+            for b in batch)
+        return self._jitted.lower(p_avals, opt_avals, lr_aval, no_aval,
+                                  *batch_avals)
 
     def cost_analysis(self, *batch):
         """XLA cost analysis of the compiled distributed step."""

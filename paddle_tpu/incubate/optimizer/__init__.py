@@ -166,3 +166,8 @@ class ModelAverage(Optimizer):
             if key in self._backup:
                 p._replace_data(self._backup.pop(key))
         self._applied = False
+
+
+from . import functional  # noqa: F401,E402  (minimize_bfgs/minimize_lbfgs)
+
+__all__.append("functional")

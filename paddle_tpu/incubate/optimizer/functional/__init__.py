@@ -1,0 +1,8 @@
+"""paddle.incubate.optimizer.functional analogs: quasi-Newton
+minimizers (reference python/paddle/incubate/optimizer/functional/
+{bfgs,lbfgs,line_search}.py) as single-program lax.while_loop
+optimizers."""
+from .bfgs import minimize_bfgs
+from .lbfgs import minimize_lbfgs
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]

@@ -1,0 +1,114 @@
+"""minimize_bfgs: full-matrix BFGS with strong-Wolfe line search.
+
+Reference analog: python/paddle/incubate/optimizer/functional/bfgs.py
+(minimize_bfgs, Nocedal & Wright Alg 6.1). TPU-native: the whole
+optimization is one lax.while_loop — inverse-Hessian update, line
+search and convergence checks are all traced ops, so the call jits to
+a single XLA program.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from .line_search import strong_wolfe
+
+__all__ = ["minimize_bfgs"]
+
+
+class _State(NamedTuple):
+    k: jnp.ndarray
+    done: jnp.ndarray
+    converged: jnp.ndarray
+    x: jnp.ndarray
+    f: jnp.ndarray
+    g: jnp.ndarray
+    H: jnp.ndarray
+    nfev: jnp.ndarray
+
+
+def _unwrap_fn(objective_func):
+    def f(x):
+        out = objective_func(Tensor(x) if not isinstance(x, Tensor)
+                             else x)
+        return out._data if isinstance(out, Tensor) else out
+    return f
+
+
+def minimize_bfgs(objective_func: Callable, initial_position,
+                  max_iters: int = 50, tolerance_grad: float = 1e-7,
+                  tolerance_change: float = 1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn: str = "strong_wolfe",
+                  max_line_search_iters: int = 50,
+                  initial_step_length: float = 1.0,
+                  dtype: str = "float32", name=None):
+    """Minimize `objective_func` (1-D Tensor -> scalar) from
+    `initial_position`. Returns (is_converge, num_func_calls, position,
+    objective_value, objective_gradient) — the reference's signature."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"only line_search_fn='strong_wolfe' is supported, got "
+            f"{line_search_fn!r}")
+    raw = _unwrap_fn(objective_func)
+    x0 = initial_position._data if isinstance(initial_position, Tensor) \
+        else jnp.asarray(initial_position)
+    x0 = x0.astype(dtype)
+    n = x0.shape[0]
+    I = jnp.eye(n, dtype=x0.dtype)
+    H0 = I if initial_inverse_hessian_estimate is None else (
+        initial_inverse_hessian_estimate._data
+        if isinstance(initial_inverse_hessian_estimate, Tensor)
+        else jnp.asarray(initial_inverse_hessian_estimate)).astype(dtype)
+    vg = jax.value_and_grad(raw)
+    f0, g0 = vg(x0)
+
+    def body(s: _State) -> _State:
+        p = -(s.H @ s.g)
+        dphi0 = s.g @ p
+
+        def phi(a):
+            fv, gv = vg(s.x + a * p)
+            return fv, gv @ p
+
+        alpha, _, _, ls_nfev, ls_ok = strong_wolfe(
+            phi, s.f, dphi0, alpha0=initial_step_length,
+            max_iters=max_line_search_iters)
+        x1 = s.x + alpha * p
+        f1, g1 = vg(x1)
+        sk = x1 - s.x
+        yk = g1 - s.g
+        sy = sk @ yk
+        # curvature guard: skip the update when sy is not positive
+        # (numerical breakdown); H stays s.H
+        rho = jnp.where(sy > 1e-10, 1.0 / jnp.where(sy == 0, 1.0, sy),
+                        0.0)
+        V = I - rho * jnp.outer(sk, yk)
+        H1 = jnp.where(sy > 1e-10,
+                       V @ s.H @ V.T + rho * jnp.outer(sk, sk), s.H)
+        gnorm = jnp.max(jnp.abs(g1))
+        xchange = jnp.max(jnp.abs(sk))
+        # a failed line search (alpha=0) makes xchange=0 — that is a
+        # breakdown, not convergence
+        ls_failed = (~ls_ok) & (alpha == 0)
+        converged = (gnorm <= tolerance_grad) | \
+                    ((xchange <= tolerance_change) & ~ls_failed)
+        return _State(k=s.k + 1, done=converged | ls_failed,
+                      converged=converged,
+                      x=x1, f=f1, g=g1, H=H1,
+                      nfev=s.nfev + ls_nfev + 1)
+
+    def cond(s: _State):
+        return (~s.done) & (s.k < max_iters)
+
+    init = _State(k=jnp.zeros((), jnp.int32),
+                  done=jnp.max(jnp.abs(g0)) <= tolerance_grad,
+                  converged=jnp.max(jnp.abs(g0)) <= tolerance_grad,
+                  x=x0, f=f0, g=g0, H=H0,
+                  nfev=jnp.ones((), jnp.int32))
+    out = jax.lax.while_loop(cond, body, init)
+    return (Tensor(out.converged), Tensor(out.nfev), Tensor(out.x),
+            Tensor(out.f), Tensor(out.g))

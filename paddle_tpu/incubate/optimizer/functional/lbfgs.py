@@ -1,0 +1,152 @@
+"""minimize_lbfgs: limited-memory BFGS (two-loop recursion) with
+strong-Wolfe line search.
+
+Reference analog: python/paddle/incubate/optimizer/functional/lbfgs.py
+(minimize_lbfgs, Nocedal & Wright Alg 7.4/7.5 with a circular history).
+TPU-native: fixed-shape [m, n] history buffers updated in a single
+lax.while_loop; the two-loop recursion runs as lax.fori_loop passes so
+the whole call jits to one XLA program.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+from .bfgs import _unwrap_fn
+from .line_search import strong_wolfe
+
+__all__ = ["minimize_lbfgs"]
+
+
+class _State(NamedTuple):
+    k: jnp.ndarray
+    done: jnp.ndarray
+    converged: jnp.ndarray
+    x: jnp.ndarray
+    f: jnp.ndarray
+    g: jnp.ndarray
+    S: jnp.ndarray        # [m, n] s-history (circular)
+    Y: jnp.ndarray        # [m, n] y-history
+    rho: jnp.ndarray      # [m]
+    count: jnp.ndarray    # total updates stored
+    gamma: jnp.ndarray    # H0 scaling sy/yy
+    nfev: jnp.ndarray
+
+
+def _two_loop(g, S, Y, rho, count, gamma, m):
+    """Nocedal Alg 7.4 on circular buffers: oldest-to-newest order is
+    positions [count-valid .. count-1] mod m."""
+    valid = jnp.minimum(count, m)
+
+    def bwd(i, carry):
+        q, alphas = carry
+        # newest first: j = count-1-i
+        j = (count - 1 - i) % m
+        use = i < valid
+        a = jnp.where(use, rho[j] * (S[j] @ q), 0.0)
+        q = q - jnp.where(use, a, 0.0) * Y[j]
+        return q, alphas.at[i].set(a)
+
+    q, alphas = jax.lax.fori_loop(
+        0, m, bwd, (g, jnp.zeros((m,), g.dtype)))
+    r = gamma * q
+
+    def fwd(i, r):
+        # oldest first: reverse of the backward order
+        ii = m - 1 - i
+        j = (count - 1 - ii) % m
+        use = ii < valid
+        b = jnp.where(use, rho[j] * (Y[j] @ r), 0.0)
+        return r + jnp.where(use, alphas[ii] - b, 0.0) * S[j]
+
+    return jax.lax.fori_loop(0, m, fwd, r)
+
+
+def minimize_lbfgs(objective_func: Callable, initial_position,
+                   history_size: int = 100, max_iters: int = 50,
+                   tolerance_grad: float = 1e-7,
+                   tolerance_change: float = 1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn: str = "strong_wolfe",
+                   max_line_search_iters: int = 50,
+                   initial_step_length: float = 1.0,
+                   dtype: str = "float32", name=None):
+    """Minimize `objective_func` (1-D Tensor -> scalar) from
+    `initial_position` keeping `history_size` curvature pairs. Returns
+    (is_converge, num_func_calls, position, objective_value,
+    objective_gradient) — the reference's signature."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"only line_search_fn='strong_wolfe' is supported, got "
+            f"{line_search_fn!r}")
+    if initial_inverse_hessian_estimate is not None:
+        raise NotImplementedError(
+            "minimize_lbfgs scales H0 from the latest curvature pair; "
+            "an explicit initial_inverse_hessian_estimate is a "
+            "full-matrix (BFGS) concept — use minimize_bfgs")
+    raw = _unwrap_fn(objective_func)
+    x0 = initial_position._data if isinstance(initial_position, Tensor) \
+        else jnp.asarray(initial_position)
+    x0 = x0.astype(dtype)
+    n = x0.shape[0]
+    m = int(history_size)
+    vg = jax.value_and_grad(raw)
+    f0, g0 = vg(x0)
+
+    def body(s: _State) -> _State:
+        p = -_two_loop(s.g, s.S, s.Y, s.rho, s.count, s.gamma, m)
+        dphi0 = s.g @ p
+
+        def phi(a):
+            fv, gv = vg(s.x + a * p)
+            return fv, gv @ p
+
+        alpha, _, _, ls_nfev, ls_ok = strong_wolfe(
+            phi, s.f, dphi0, alpha0=initial_step_length,
+            max_iters=max_line_search_iters)
+        x1 = s.x + alpha * p
+        f1, g1 = vg(x1)
+        sk = x1 - s.x
+        yk = g1 - s.g
+        sy = sk @ yk
+        store = sy > 1e-10
+        slot = s.count % m
+        S1 = jnp.where(store, s.S.at[slot].set(sk), s.S)
+        Y1 = jnp.where(store, s.Y.at[slot].set(yk), s.Y)
+        rho1 = jnp.where(
+            store, s.rho.at[slot].set(1.0 / jnp.where(sy == 0, 1.0, sy)),
+            s.rho)
+        count1 = jnp.where(store, s.count + 1, s.count)
+        gamma1 = jnp.where(store, sy / (yk @ yk), s.gamma)
+        gnorm = jnp.max(jnp.abs(g1))
+        xchange = jnp.max(jnp.abs(sk))
+        # a failed line search (alpha=0) makes xchange=0 — that is a
+        # breakdown, not convergence
+        ls_failed = (~ls_ok) & (alpha == 0)
+        converged = (gnorm <= tolerance_grad) | \
+                    ((xchange <= tolerance_change) & ~ls_failed)
+        return _State(k=s.k + 1, done=converged | ls_failed,
+                      converged=converged,
+                      x=x1, f=f1, g=g1, S=S1, Y=Y1, rho=rho1,
+                      count=count1, gamma=gamma1,
+                      nfev=s.nfev + ls_nfev + 1)
+
+    def cond(s: _State):
+        return (~s.done) & (s.k < max_iters)
+
+    init = _State(
+        k=jnp.zeros((), jnp.int32),
+        done=jnp.max(jnp.abs(g0)) <= tolerance_grad,
+        converged=jnp.max(jnp.abs(g0)) <= tolerance_grad,
+        x=x0, f=f0, g=g0,
+        S=jnp.zeros((m, n), x0.dtype), Y=jnp.zeros((m, n), x0.dtype),
+        rho=jnp.zeros((m,), x0.dtype),
+        count=jnp.zeros((), jnp.int32),
+        gamma=jnp.ones((), x0.dtype),
+        nfev=jnp.ones((), jnp.int32))
+    out = jax.lax.while_loop(cond, body, init)
+    return (Tensor(out.converged), Tensor(out.nfev), Tensor(out.x),
+            Tensor(out.f), Tensor(out.g))

@@ -1,0 +1,128 @@
+"""Strong-Wolfe line search as a single lax.while_loop state machine.
+
+Reference analog: python/paddle/incubate/optimizer/functional/
+line_search.py (strong_wolfe built from static-graph while ops).
+TPU-native: one jittable while_loop whose state carries the
+bracket/zoom phase flag, so the whole minimize_* call compiles to one
+XLA program. Algorithm: Nocedal & Wright, Numerical Optimization 2e,
+Algorithms 3.5 (bracketing) + 3.6 (zoom, bisection variant).
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class _LSState(NamedTuple):
+    i: jnp.ndarray          # iteration counter
+    stage: jnp.ndarray      # 0 = bracketing, 1 = zoom
+    done: jnp.ndarray
+    failed: jnp.ndarray
+    nfev: jnp.ndarray
+    a_prev: jnp.ndarray
+    phi_prev: jnp.ndarray
+    a_cur: jnp.ndarray
+    a_lo: jnp.ndarray
+    phi_lo: jnp.ndarray
+    a_hi: jnp.ndarray
+    phi_hi: jnp.ndarray
+    a_star: jnp.ndarray
+    phi_star: jnp.ndarray
+    dphi_star: jnp.ndarray
+
+
+def strong_wolfe(phi_fn: Callable, f0, dphi0, *, c1=1e-4, c2=0.9,
+                 alpha0=1.0, max_iters=50
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                            jnp.ndarray, jnp.ndarray]:
+    """Find alpha satisfying the strong Wolfe conditions for the 1-D
+    slice phi(alpha): phi_fn(alpha) -> (value, dvalue/dalpha).
+
+    Returns (alpha, phi(alpha), dphi(alpha), n_evals, ok)."""
+    dt = f0.dtype
+    c1 = jnp.asarray(c1, dt)
+    c2 = jnp.asarray(c2, dt)
+
+    def armijo_fail(a, phi):
+        return phi > f0 + c1 * a * dphi0
+
+    def curvature_ok(dphi):
+        return jnp.abs(dphi) <= -c2 * dphi0
+
+    def body(s: _LSState) -> _LSState:
+        a = jnp.where(s.stage == 0, s.a_cur, 0.5 * (s.a_lo + s.a_hi))
+        phi, dphi = phi_fn(a)
+        nfev = s.nfev + 1
+
+        # ---------------- bracketing phase (Alg 3.5)
+        to_zoom_hi = armijo_fail(a, phi) | ((phi >= s.phi_prev)
+                                           & (s.i > 0))
+        br_done = (~to_zoom_hi) & curvature_ok(dphi)
+        to_zoom_rev = (~to_zoom_hi) & (~br_done) & (dphi >= 0)
+        # continue bracketing with a doubled step otherwise
+        b_stage = jnp.where(to_zoom_hi | to_zoom_rev, 1, 0)
+        b_alo = jnp.where(to_zoom_hi, s.a_prev,
+                          jnp.where(to_zoom_rev, a, s.a_prev))
+        b_plo = jnp.where(to_zoom_hi, s.phi_prev,
+                          jnp.where(to_zoom_rev, phi, s.phi_prev))
+        b_ahi = jnp.where(to_zoom_hi, a,
+                          jnp.where(to_zoom_rev, s.a_prev, s.a_hi))
+        b_phi = jnp.where(to_zoom_hi, phi,
+                          jnp.where(to_zoom_rev, s.phi_prev, s.phi_hi))
+
+        # ---------------- zoom phase (Alg 3.6, bisection)
+        z_hi_shrink = armijo_fail(a, phi) | (phi >= s.phi_lo)
+        z_done = (~z_hi_shrink) & curvature_ok(dphi)
+        z_flip = (~z_hi_shrink) & (~z_done) \
+            & (dphi * (s.a_hi - s.a_lo) >= 0)
+        z_alo = jnp.where(z_hi_shrink, s.a_lo, a)
+        z_plo = jnp.where(z_hi_shrink, s.phi_lo, phi)
+        z_ahi = jnp.where(z_hi_shrink, a,
+                          jnp.where(z_flip, s.a_lo, s.a_hi))
+        z_phi = jnp.where(z_hi_shrink, phi,
+                          jnp.where(z_flip, s.phi_lo, s.phi_hi))
+        # zoom interval collapsed without meeting curvature: accept lo
+        z_fail = (~z_done) & (jnp.abs(s.a_hi - s.a_lo)
+                              < jnp.asarray(1e-8, dt))
+
+        in_zoom = s.stage == 1
+        done = jnp.where(in_zoom, z_done | z_fail, br_done)
+        stage = jnp.where(in_zoom, 1, b_stage)
+        a_lo = jnp.where(in_zoom, z_alo, b_alo)
+        phi_lo = jnp.where(in_zoom, z_plo, b_plo)
+        a_hi = jnp.where(in_zoom, z_ahi, b_ahi)
+        phi_hi = jnp.where(in_zoom, z_phi, b_phi)
+        a_star = jnp.where(done, jnp.where(in_zoom & z_fail, s.a_lo, a),
+                           s.a_star)
+        phi_star = jnp.where(done,
+                             jnp.where(in_zoom & z_fail, s.phi_lo, phi),
+                             s.phi_star)
+        dphi_star = jnp.where(done, dphi, s.dphi_star)
+        return _LSState(
+            i=s.i + 1, stage=stage, done=s.done | done,
+            failed=s.failed | (in_zoom & z_fail),
+            nfev=nfev, a_prev=a, phi_prev=phi,
+            a_cur=jnp.where(stage == 0, 2.0 * a, s.a_cur),
+            a_lo=a_lo, phi_lo=phi_lo, a_hi=a_hi, phi_hi=phi_hi,
+            a_star=a_star, phi_star=phi_star, dphi_star=dphi_star)
+
+    def cond(s: _LSState):
+        return (~s.done) & (s.i < max_iters)
+
+    z = jnp.zeros((), dt)
+    init = _LSState(
+        i=jnp.zeros((), jnp.int32), stage=jnp.zeros((), jnp.int32),
+        done=jnp.zeros((), bool), failed=jnp.zeros((), bool),
+        nfev=jnp.zeros((), jnp.int32),
+        a_prev=z, phi_prev=f0, a_cur=jnp.asarray(alpha0, dt),
+        a_lo=z, phi_lo=f0, a_hi=z, phi_hi=f0,
+        a_star=z, phi_star=f0, dphi_star=dphi0)
+    out = jax.lax.while_loop(cond, body, init)
+    # never satisfied within the budget: fall back to the best bracket
+    a = jnp.where(out.done, out.a_star, out.a_lo)
+    phi = jnp.where(out.done, out.phi_star, out.phi_lo)
+    dphi = out.dphi_star
+    ok = out.done & ~out.failed
+    return a, phi, dphi, out.nfev, ok
