@@ -28,16 +28,37 @@ def export(layer, path: str, input_spec: Optional[Sequence] = None,
     loads with paddle_tpu.jit.load / inference.Config(path).
     """
     if configs.pop("format", "stablehlo") == "onnx":
-        from .onnx_proto import export_onnx
-        shape = None
-        if input_spec:
-            s = input_spec[0]
-            shape = list(getattr(s, "shape", None) or np.shape(s))
-        if shape is None:
-            raise ValueError("format='onnx' needs input_spec with a "
-                             "shape for the graph input")
-        return export_onnx(layer, path, shape,
-                           opset=opset_version or 13)
+        if input_spec is None:
+            raise ValueError("format='onnx' needs input_spec (example "
+                             "tensors or shaped specs) to trace")
+        # trace-based path (r4): jaxpr -> ONNX handles any traceable
+        # model (residuals, attention, ...). The Sequential walker
+        # (onnx_proto.export_onnx) stays for shape-only input_spec.
+        example = []
+        spec_shapes = []
+        for s in input_spec:
+            if hasattr(s, "data") or isinstance(s, np.ndarray):
+                example.append(s)
+                spec_shapes.append(list(np.shape(np.asarray(
+                    s.data if hasattr(s, "data") else s))))
+            else:
+                shape = list(getattr(s, "shape", s))
+                spec_shapes.append([None if d is None or d < 0 else d
+                                    for d in shape])
+                # dynamic (None/-1) dims trace at a concrete size
+                dtype = getattr(s, "dtype", None) or np.float32
+                example.append(np.zeros(
+                    [1 if d is None or d < 0 else d for d in shape],
+                    dtype))
+        try:
+            from .onnx_trace import trace_to_onnx
+            return trace_to_onnx(layer, example, path,
+                                 opset=opset_version or 13)
+        except NotImplementedError:
+            # Sequential walker fallback keeps dynamic dims dynamic
+            from .onnx_proto import export_onnx
+            return export_onnx(layer, path, spec_shapes[0],
+                               opset=opset_version or 13)
     from .jit.save_load import save
     save(layer, path, input_spec=input_spec)
     return path + ".stablehlo"

@@ -64,13 +64,17 @@ def _f_float(field: int, value: float) -> bytes:
 _AT_FLOAT, _AT_INT, _AT_STRING, _AT_TENSOR = 1, 2, 3, 4
 _AT_FLOATS, _AT_INTS = 6, 7
 # onnx.TensorProto.DataType
-DT_FLOAT, DT_INT64 = 1, 7
+DT_FLOAT, DT_INT64, DT_INT32, DT_BOOL = 1, 7, 6, 9
 
 
 def _tensor(name: str, arr: np.ndarray) -> bytes:
     arr = np.asarray(arr)
     if arr.dtype == np.int64:
         dtype = DT_INT64
+    elif arr.dtype == np.int32:
+        dtype = DT_INT32
+    elif arr.dtype == np.bool_:
+        dtype = DT_BOOL
     else:
         arr = arr.astype(np.float32)
         dtype = DT_FLOAT

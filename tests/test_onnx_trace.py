@@ -1,0 +1,120 @@
+"""Trace-based ONNX export (VERDICT r3 Next #3): jaxpr -> ONNX for
+real models — ResNet-18 (residual adds, convs, pools) and an ERNIE
+encoder block (attention einsums, softmax, layernorm, gelu) — each
+numerically validated by EXECUTING the emitted graph with the in-repo
+numpy evaluator (onnx_eval) against the framework forward. Reference
+analog: python/paddle/onnx/export.py:21 (paddle2onnx trace path).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.onnx_eval import load_model, run_onnx
+from paddle_tpu.onnx_trace import trace_to_onnx
+
+
+def _roundtrip(layer, inputs, tmp_path, name, atol=2e-4):
+    p = trace_to_onnx(layer, inputs, str(tmp_path / name))
+    ref = layer(*[paddle.to_tensor(a) for a in inputs])
+    ref = np.asarray(ref.data)
+    feed = {"input" if i == 0 else f"input_{i}": a
+            for i, a in enumerate(inputs)}
+    out = run_onnx(p, feed)[0]
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=atol)
+    return p
+
+
+def test_resnet18_onnx_roundtrip(tmp_path):
+    from paddle_tpu.models.resnet import resnet18
+    paddle.seed(0)
+    m = resnet18(num_classes=10)
+    m.eval()
+    x = np.random.RandomState(0).randn(1, 3, 32, 32).astype(np.float32)
+    p = _roundtrip(m, [x], tmp_path, "resnet18", atol=5e-4)
+    nodes, inits, _, _ = load_model(p)
+    ops = {n.op for n in nodes}
+    # the graph really contains the structural ops of a residual net
+    assert "Conv" in ops and "Add" in ops and "MaxPool" in ops
+    assert sum(1 for n in nodes if n.op == "Conv") == 20  # 18 + 2 downsample
+
+
+def test_ernie_block_onnx_roundtrip(tmp_path):
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieLayer
+    paddle.seed(1)
+    cfg = ErnieConfig(hidden_size=64, num_layers=2, num_heads=4)
+    blk = ErnieLayer(cfg)
+    blk.eval()
+    x = np.random.RandomState(0).randn(2, 8, 64).astype(np.float32)
+    p = _roundtrip(blk, [x], tmp_path, "ernie_block", atol=5e-4)
+    nodes, _, _, _ = load_model(p)
+    ops = {n.op for n in nodes}
+    # attention contractions ride Einsum; softmax decomposes to
+    # exp/reduce/div; layernorm to mul/sub/sqrt
+    assert "Einsum" in ops and "Exp" in ops and "Sqrt" in ops
+
+
+def test_mlp_residual_function_export(tmp_path):
+    """Plain function (not a Layer) with a residual add + softmax."""
+    from paddle_tpu import nn
+    paddle.seed(2)
+    fc1 = nn.Linear(16, 16)
+    fc2 = nn.Linear(16, 16)
+
+    def f(x):
+        h = paddle.nn.functional.relu(fc1(x))
+        h = fc2(h) + x           # residual
+        return paddle.nn.functional.softmax(h, axis=-1)
+
+    x = np.random.RandomState(3).randn(4, 16).astype(np.float32)
+    p = trace_to_onnx(f, [x], str(tmp_path / "mlp_res"))
+    ref = np.asarray(f(paddle.to_tensor(x)).data)
+    out = run_onnx(p, {"input": x})[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_api_routes_models(tmp_path):
+    """paddle.onnx.export(format='onnx') handles non-Sequential models
+    through the trace path (r3 raised NotImplementedError here)."""
+    from paddle_tpu import onnx as onnx_api
+    from paddle_tpu.models.resnet import resnet18
+    paddle.seed(0)
+    m = resnet18(num_classes=4)
+    m.eval()
+    x = np.random.RandomState(1).randn(1, 3, 32, 32).astype(np.float32)
+    path = onnx_api.export(m, str(tmp_path / "r18"),
+                           input_spec=[paddle.to_tensor(x)],
+                           format="onnx")
+    ref = np.asarray(m(paddle.to_tensor(x)).data)
+    out = run_onnx(path, {"input": x})[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=5e-4)
+
+
+def test_legacy_sequential_artifact_now_executes(tmp_path):
+    """The r3 Sequential walker's artifact runs under the evaluator too
+    (discharges the 'loads anywhere' claim numerically)."""
+    from paddle_tpu import nn
+    from paddle_tpu.onnx_proto import export_onnx
+    paddle.seed(4)
+    m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                      nn.LayerNorm(32), nn.Linear(32, 4),
+                      nn.Softmax())
+    m.eval()
+    x = np.random.RandomState(5).randn(6, 8).astype(np.float32)
+    p = export_onnx(m, str(tmp_path / "seq"), [None, 8])
+    ref = np.asarray(m(paddle.to_tensor(x)).data)
+    out = run_onnx(p, {"input": x})[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_unmappable_primitive_raises(tmp_path):
+    """Genuinely unmappable ops fail loudly, not silently."""
+    def f(x):
+        # data-dependent gather: dynamic indices have no static ONNX
+        # mapping in this exporter
+        idx = (x[:, 0] > 0).astype("int64")
+        return paddle.gather(x, idx)
+
+    x = np.random.RandomState(6).randn(4, 3).astype(np.float32)
+    with pytest.raises(NotImplementedError):
+        trace_to_onnx(f, [x], str(tmp_path / "bad"))
