@@ -132,8 +132,8 @@ from .nn.functional.common import (pixel_shuffle,  # noqa: F401,E402
                                    pixel_unshuffle)
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
-_LAZY = {"audio", "distributed", "distribution", "fft", "geometric",
-         "linalg", "version",
+_LAZY = {"audio", "callbacks", "distributed", "distribution", "fft",
+         "geometric", "linalg", "version",
          "models", "vision", "kernels", "hapi", "onnx", "profiler",
          "incubate", "inference", "quantization", "signal", "sparse",
          "static", "text", "utils"}

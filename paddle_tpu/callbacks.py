@@ -1,0 +1,14 @@
+"""paddle.callbacks namespace (reference python/paddle/callbacks.py
+re-exports hapi.callbacks)."""
+from .hapi.callbacks import Callback  # noqa: F401
+from .hapi.callbacks import ProgBarLogger  # noqa: F401
+from .hapi.callbacks import ModelCheckpoint  # noqa: F401
+from .hapi.callbacks import VisualDL  # noqa: F401
+from .hapi.callbacks import LRSchedulerCallback as LRScheduler  # noqa: F401
+from .hapi.callbacks import EarlyStopping  # noqa: F401
+from .hapi.callbacks import ReduceLROnPlateau  # noqa: F401
+from .hapi.callbacks import TerminateOnNaN  # noqa: F401
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
+           "TerminateOnNaN"]

@@ -144,6 +144,27 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
+def _infer_mode(monitor: str, mode: str) -> str:
+    if mode == "auto":
+        return "max" if "acc" in monitor else "min"
+    return mode
+
+
+def _metric_value(logs, monitor):
+    cur = (logs or {}).get(monitor)
+    if cur is None:
+        return None
+    if isinstance(cur, (list, tuple, np.ndarray)):
+        cur = float(np.asarray(cur).reshape(-1)[0])
+    return float(cur)
+
+
+def _improved(cur, best, mode, min_delta):
+    if mode == "min":
+        return cur < best - min_delta
+    return cur > best + min_delta
+
+
 class EarlyStopping(Callback):
     """Stop when a monitored metric stops improving (≈ hapi
     EarlyStopping; mode auto-infers direction from the name)."""
@@ -158,17 +179,13 @@ class EarlyStopping(Callback):
         self.min_delta = abs(min_delta)
         self.baseline = baseline
         self.save_best_model = save_best_model
-        if mode == "auto":
-            mode = "max" if "acc" in monitor else "min"
-        self.mode = mode
+        self.mode = _infer_mode(monitor, mode)
         self.stopped = False
         self.wait = 0
         self.best = None
 
     def _better(self, cur, best):
-        if self.mode == "min":
-            return cur < best - self.min_delta
-        return cur > best + self.min_delta
+        return _improved(cur, best, self.mode, self.min_delta)
 
     def on_train_begin(self, logs=None):
         self.stopped = False
@@ -176,12 +193,9 @@ class EarlyStopping(Callback):
         self.best = self.baseline
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        if self.monitor not in logs:
+        cur = _metric_value(logs, self.monitor)
+        if cur is None:
             return
-        cur = logs[self.monitor]
-        if isinstance(cur, (list, tuple, np.ndarray)):
-            cur = float(np.asarray(cur).reshape(-1)[0])
         if self.best is None or self._better(cur, self.best):
             self.best = cur
             self.wait = 0
@@ -193,3 +207,159 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait > self.patience:
                 self.stopped = True
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer learning rate when a monitored metric
+    plateaus (reference hapi/callbacks.py:996): after `patience`
+    epochs without improvement, lr <- max(lr * factor, min_lr), then
+    `cooldown` epochs of grace."""
+
+    def __init__(self, monitor: str = "loss", factor: float = 0.1,
+                 patience: int = 10, verbose: int = 1,
+                 mode: str = "auto", min_delta: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError(
+                "ReduceLROnPlateau does not support a factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = _infer_mode(monitor, mode)
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+        self._eval_mode = False
+
+    def _better(self, cur, best):
+        return _improved(cur, best, self.mode, self.min_delta)
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+        self._eval_mode = False
+
+    def on_eval_end(self, logs=None):
+        cur = _metric_value(logs, self.monitor)
+        if cur is None:
+            return
+        if not self._eval_mode:
+            # eval provides the metric: it owns the plateau tracker
+            # from here on; drop any train-metric history so train and
+            # eval losses never mix in one comparison
+            self._eval_mode = True
+            self.wait = 0
+            self.cooldown_counter = 0
+            self.best = None
+        self._step_metric(cur)
+
+    def on_epoch_end(self, epoch, logs=None):
+        # train-metric monitoring only while no eval has ever run
+        if not self._eval_mode:
+            self._step_metric(_metric_value(logs, self.monitor))
+
+    def _step_metric(self, cur):
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait < self.patience:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        from ..optimizer.lr import LRScheduler
+        if isinstance(getattr(opt, "_lr", None), LRScheduler):
+            # a schedule owns the lr; reduce its base rate
+            sched = opt._lr
+            new = max(float(sched.base_lr) * self.factor, self.min_lr)
+            if self.verbose:
+                print(f"ReduceLROnPlateau: base_lr -> {new:.3e}")
+            sched.base_lr = new
+        else:
+            new = max(float(opt.get_lr()) * self.factor, self.min_lr)
+            if self.verbose:
+                print(f"ReduceLROnPlateau: lr -> {new:.3e}")
+            opt.set_lr(new)
+        self.cooldown_counter = self.cooldown
+        self.wait = 0
+
+
+class TerminateOnNaN(Callback):
+    """Stop training when the loss turns NaN/Inf (keras-style guard the
+    reference ships inside its trainer loop)."""
+
+    def __init__(self, monitor: str = "loss"):
+        super().__init__()
+        self.monitor = monitor
+        self.stopped = False
+
+    def on_train_begin(self, logs=None):
+        self.stopped = False
+
+    def on_train_batch_end(self, step, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = np.asarray(cur, np.float64).reshape(-1)
+        if not np.isfinite(cur).all():
+            print(f"TerminateOnNaN: non-finite {self.monitor} at "
+                  f"step {step}; stopping")
+            self.stopped = True
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference hapi/callbacks.py:880 writes
+    VisualDL event files). The visualdl package is absent here, so the
+    TPU-native artifact is a JSONL stream of {tag, step, value} rows —
+    readable by any dashboard, greppable in CI."""
+
+    def __init__(self, log_dir: str = "vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+        self._fh = None
+
+    def _write(self, tag, value, step):
+        import json
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            # line-buffered so rows survive a mid-fit crash and
+            # standalone evaluate() use (no on_train_end to flush)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                            "a", buffering=1)
+        if isinstance(value, (list, tuple, np.ndarray)):
+            value = float(np.asarray(value).reshape(-1)[0])
+        if isinstance(value, (int, float, np.floating, np.integer)):
+            self._fh.write(json.dumps(
+                {"tag": tag, "step": int(step),
+                 "value": float(value)}) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            self._write(f"train/{k}", v, self._step)
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            self._write(f"eval/{k}", v, self._step)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None

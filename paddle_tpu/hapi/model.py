@@ -132,15 +132,22 @@ class Model:
                 loss = self.train_batch(inputs, labels)
                 losses.append(loss)
                 cbs.on_train_batch_end(step, {"loss": loss})
+                if any(getattr(cb, "stopped", False)
+                       for cb in cbs.callbacks):
+                    stop = True  # e.g. TerminateOnNaN
+                    break
+            if stop:
+                # a mid-epoch stop (NaN loss) skips the epoch tail:
+                # no checkpoint of poisoned weights, no wasted eval
+                break
             logs = {"loss": float(np.mean(losses)) if losses else None}
             cbs.on_epoch_end(epoch, logs)
 
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self._run_eval(eval_loader, cbs)
-                for cb in cbs.callbacks:
-                    if isinstance(cb, EarlyStopping) and cb.stopped:
-                        stop = True
-            if stop:
+                self._run_eval(eval_loader, cbs)
+            # any callback may request a stop (EarlyStopping, ...)
+            if any(getattr(cb, "stopped", False)
+                   for cb in cbs.callbacks):
                 break
         cbs.on_train_end()
         return self

@@ -307,3 +307,72 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None):
         losses = losses + jnp.where(active, ce, 0.0)
         node = parent
     return losses[:, None]
+
+
+@op("edit_distance", differentiable=False)
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per batch row (reference
+    python/paddle/nn/functional/loss.py:472,
+    phi/kernels/cpu/edit_distance_kernel.cc). TPU-native: the DP's
+    in-row dependency row[j] = min(cand[j], row[j-1]+1) is closed-form
+    row[j] = j + cummin(cand - iota)[j], so each row is one vectorized
+    cummin and the whole table is a lax.scan — jittable, vmapped over
+    the batch. Returns (distance [B,1] float32, sequence_num [1])."""
+    import jax as _jax
+
+    a = input.astype(jnp.int32)
+    b = label.astype(jnp.int32)
+    bsz, sa = a.shape
+    sb = b.shape[1]
+    la = input_length.astype(jnp.int32) if input_length is not None \
+        else jnp.full((bsz,), sa, jnp.int32)
+    lb = label_length.astype(jnp.int32) if label_length is not None \
+        else jnp.full((bsz,), sb, jnp.int32)
+
+    if ignored_tokens:
+        if isinstance(a, _jax.core.Tracer):
+            raise NotImplementedError(
+                "edit_distance(ignored_tokens=...) filters variable-"
+                "length prefixes — concrete (eager) inputs only")
+        import numpy as _np
+        ign = set(int(t) for t in ignored_tokens)
+
+        def _filter(arr, lens):
+            rows, ls = [], []
+            for r, ln in zip(_np.asarray(arr), _np.asarray(lens)):
+                keep = [t for t in r[:ln] if int(t) not in ign]
+                rows.append(keep)
+                ls.append(len(keep))
+            width = max(max(ls), 1)
+            out = _np.zeros((len(rows), width), _np.int64)
+            for i, keep in enumerate(rows):
+                out[i, :len(keep)] = keep
+            return jnp.asarray(out), jnp.asarray(ls, _np.int32)
+
+        a, la = _filter(a, la)
+        b, lb = _filter(b, lb)
+        sa, sb = a.shape[1], b.shape[1]
+
+    jot = jnp.arange(sb + 1, dtype=jnp.float32)
+
+    def one(ar, br, lar, lbr):
+        row0 = jot  # dp[0, j] = j
+
+        def step(prev, ai):
+            cost = (ai != br).astype(jnp.float32)
+            cand = jnp.concatenate(
+                [prev[:1] + 1.0,                       # dp[i,0]=i base
+                 jnp.minimum(prev[1:] + 1.0, prev[:-1] + cost)])
+            row = jot + _jax.lax.associative_scan(
+                jnp.minimum, cand - jot)
+            return row, row
+
+        _, rows = _jax.lax.scan(step, row0, ar)
+        table = jnp.concatenate([row0[None], rows])   # [sa+1, sb+1]
+        return table[lar, lbr]
+
+    dist = _jax.vmap(one)(a, b, la, lb)
+    if normalized:
+        dist = dist / jnp.maximum(lb.astype(jnp.float32), 1.0)
+    return dist[:, None], jnp.asarray([bsz], jnp.float32)

@@ -129,6 +129,7 @@ class _StaticNN:
 # the control-flow ops attached — one namespace serving both the
 # layer-helper and cond/while_loop surfaces like the reference
 from . import nn as _nn_mod  # noqa: E402
+from . import amp  # noqa: E402,F401  (static AMP namespace)
 
 _nn_mod.cond = _StaticNN.cond
 _nn_mod.while_loop = _StaticNN.while_loop

@@ -1,0 +1,186 @@
+"""paddle.static.amp analog (reference python/paddle/static/amp/
+__init__.py re-exports fluid.contrib.mixed_precision: decorate,
+AutoMixedPrecisionLists/CustomOpLists, fp16_guard,
+cast_model_to_fp16/cast_parameters_to_fp16, bf16 submodule).
+
+TPU-native: static Programs replay dynamic ops, so static AMP is the
+dynamic auto_cast machinery under the static API names — `decorate`
+wraps the optimizer so minimize() runs backward under auto_cast with a
+GradScaler, the op lists are the dynamic WHITE/BLACK lists, and the
+cast helpers are Layer.bfloat16()/astype on parameters (bf16 is the
+native TPU low precision; the fp16 names are kept for API parity and
+produce bf16 on TPU, documented here rather than silently)."""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from ...amp.auto_cast import (BLACK_LIST, WHITE_LIST, auto_cast)
+from ...amp.grad_scaler import GradScaler
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists",
+           "fp16_guard", "cast_model_to_fp16",
+           "cast_parameters_to_fp16", "bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op lists (reference fp16_lists.py): start from the
+    framework defaults, apply custom additions/removals."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(WHITE_LIST)
+        self.black_list = set(BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class _DecoratedOptimizer:
+    """OptimizerWithMixedPrecision analog. The reference rewrites the
+    static Program; here the forward must run inside amp_guard() (the
+    dynamic-replay equivalent of the rewritten region):
+
+        opt = static.amp.decorate(sgd)
+        with opt.amp_guard():
+            loss = net(x).mean()
+        opt.minimize(loss)
+
+    minimize()/backward() apply loss scaling via GradScaler
+    (dynamic or fixed-static per use_dynamic_loss_scaling, all tuning
+    knobs forwarded; bf16 needs none, but the API is honored)."""
+
+    def __init__(self, optimizer, amp_lists=None,
+                 init_loss_scaling=2.0 ** 15,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8,
+                 use_dynamic_loss_scaling=True, dtype="bfloat16",
+                 level="O1", **_):
+        self._opt = optimizer
+        self._lists = amp_lists or AutoMixedPrecisionLists()
+        self._level = level
+        self._dtype = dtype
+        self._scaler = GradScaler(
+            enable=True, init_loss_scaling=init_loss_scaling,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def amp_guard(self):
+        """The mixed-precision region: wrap the forward pass in it
+        (public analog of the reference's rewritten Program region)."""
+        return auto_cast(
+            enable=True,
+            custom_white_list=self._lists.white_list - set(WHITE_LIST),
+            custom_black_list=self._lists.black_list - set(BLACK_LIST),
+            level=self._level, dtype=self._dtype)
+
+    _cast = amp_guard  # back-compat alias
+
+    def backward(self, loss, **kw):
+        scaled = self._scaler.scale(loss)
+        scaled.backward()
+        return []
+
+    def apply_gradients(self, params_grads=None):
+        self._scaler.step(self._opt)
+        self._scaler.update()
+        return []
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.backward(loss)
+        self.apply_gradients()
+        self._opt.clear_grad()
+        return [], []
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        """Reference amp_init casts params after startup; here the
+        cast helper below does it directly."""
+        return None
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=True):
+    """reference static/amp decorate: wrap the optimizer for mixed
+    precision. level O2 == use_pure_fp16 (params themselves cast).
+    use_dynamic_loss_scaling=False keeps a FIXED init_loss_scaling
+    static scale (the reference semantics), not no scaling."""
+    return _DecoratedOptimizer(
+        optimizer, amp_lists=amp_lists,
+        init_loss_scaling=init_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling,
+        level="O2" if use_pure_fp16 else "O1")
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """Region marker (reference fp16_utils.fp16_guard): ops inside run
+    in low precision — here it simply enables auto_cast O1."""
+    with auto_cast(enable=True, level="O1"):
+        yield
+
+
+def cast_model_to_fp16(program_or_layer, amp_lists=None,
+                       use_fp16_guard=True):
+    """Cast a Layer's parameters to the TPU low precision (bf16).
+    Accepts a Layer (static Programs replay dynamic layers)."""
+    if hasattr(program_or_layer, "bfloat16"):
+        program_or_layer.bfloat16()
+    return program_or_layer
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None, layer=None):
+    """Parameter-only cast (reference fp16_utils): bf16 on TPU."""
+    target = layer if layer is not None else program
+    if hasattr(target, "bfloat16"):
+        target.bfloat16()
+    return target
+
+
+class _BF16Namespace:
+    """static.amp.bf16 sub-namespace (reference static/amp/bf16):
+    bf16 is this framework's default low precision, so the names remap
+    onto the same machinery."""
+    AutoMixedPrecisionListsBF16 = AutoMixedPrecisionLists
+
+    @staticmethod
+    def decorate_bf16(optimizer, **kw):
+        kw.setdefault("use_dynamic_loss_scaling", False)
+        return decorate(optimizer, **kw)
+
+    @staticmethod
+    def cast_model_to_bf16(program_or_layer, *a, **kw):
+        return cast_model_to_fp16(program_or_layer)
+
+    @staticmethod
+    def cast_parameters_to_bf16(*a, **kw):
+        return cast_parameters_to_fp16(*a, **kw)
+
+    @staticmethod
+    @contextlib.contextmanager
+    def bf16_guard():
+        with auto_cast(enable=True, level="O1", dtype="bfloat16"):
+            yield
+
+
+bf16 = _BF16Namespace()

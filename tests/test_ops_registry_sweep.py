@@ -487,6 +487,8 @@ MANUAL_SPECS = {
                         np.array([5, 4], np.int64), False], {}),
     "fftshift": ([T34], {}),
     "ifftshift": ([T34], {}),
+    "edit_distance": ([rng.randint(0, 5, (3, 4)).astype(np.int64),
+                       rng.randint(0, 5, (3, 5)).astype(np.int64)], {}),
     # fused conv+BN training ops (kernels/fused_resnet.py; interpret-mode
     # pallas on CPU). NHWC activations, paddle-layout [O,I,kh,kw] weights.
     "conv1x1_bn_stats": ([rng.randn(2, 4, 4, 8).astype(np.float32),
