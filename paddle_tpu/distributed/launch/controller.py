@@ -37,6 +37,10 @@ class JobSpec:
     log_dir: Optional[str] = None
     envs: Dict[str, str] = field(default_factory=dict)
     max_restarts: int = 0
+    # fault-tolerant elastic (reference fleet/elastic/manager.py:128):
+    # restart the pod on ANY abnormal worker death — including signal
+    # kills (preemption) — not just the cooperative 101/102 codes
+    elastic_on_failure: bool = False
 
 
 class Pod:
@@ -132,7 +136,10 @@ class Controller:
                 if code is None:
                     time.sleep(0.2)
                     continue
-                if code in (ELASTIC_EXIT_CODE, ELASTIC_SCALE_CODE) and \
+                restartable = code in (ELASTIC_EXIT_CODE,
+                                       ELASTIC_SCALE_CODE) or \
+                    (self.spec.elastic_on_failure and code != 0)
+                if restartable and \
                         restarts < self.spec.max_restarts:
                     restarts += 1
                     self.pod.stop()

@@ -39,7 +39,14 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--log_dir", type=str, default=None,
                    help="write per-rank workerlog.N files here")
     p.add_argument("--max_restarts", type=int, default=0,
-                   help="elastic restarts allowed on exit codes 101/102")
+                   help="restart budget for elastic exits (101/102) "
+                        "and, with --elastic_on_failure, any abnormal "
+                        "worker death")
+    p.add_argument("--elastic_on_failure", action="store_true",
+                   help="also restart (up to max_restarts) on ANY "
+                        "abnormal worker death, incl. signal kills — "
+                        "pair with auto checkpoint for preemption "
+                        "recovery")
     p.add_argument("--devices", type=str, default=None,
                    help="visible device ids for this node (TPU chips)")
     p.add_argument("script", type=str)
@@ -62,7 +69,8 @@ def launch(argv: Optional[List[str]] = None) -> int:
                    nnodes=args.nnodes, node_rank=args.node_rank,
                    nproc_per_node=args.nproc_per_node, master=master,
                    job_id=args.job_id, log_dir=args.log_dir,
-                   envs=envs, max_restarts=args.max_restarts)
+                   envs=envs, max_restarts=args.max_restarts,
+                   elastic_on_failure=args.elastic_on_failure)
     return Controller(spec).run()
 
 
