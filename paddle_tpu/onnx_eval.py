@@ -316,6 +316,9 @@ def run_onnx(path_or_bytes, inputs: Dict[str, np.ndarray]
             r = i[0] | i[1]
         elif op == "Not":
             r = ~i[0]
+        elif op == "Gather":
+            r = np.take(i[0], i[1].astype(np.int64),
+                        axis=a.get("axis", 0))
         elif op == "Cast":
             r = i[0].astype(_DT_NP[a["to"]])
         else:

@@ -376,26 +376,51 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
                 g.init_const(np.asarray(p["strides"] or [1] * nd,
                                         np.int64))])
         elif prim == "gather":
-            # the static-index pattern (unbind/x[i]): scalar constant
-            # start index along one axis -> Slice + Reshape
             dn = eqn.params["dimension_numbers"]
             idx = g.const_vals.get(ins[1])
-            if idx is None or np.asarray(idx).size != 1 \
-                    or len(dn.start_index_map) != 1:
-                raise NotImplementedError(
-                    "only static single-index gather (unbind/select) "
-                    "is ONNX-exportable")
-            d = dn.start_index_map[0]
-            i0 = int(np.asarray(idx).ravel()[0])
-            in_shape = eqn.invars[0].aval.shape
-            out = g.emit("Slice", [
-                ins[0],
-                g.init_const(np.asarray([i0], np.int64)),
-                g.init_const(np.asarray([i0 + 1], np.int64)),
-                g.init_const(np.asarray([d], np.int64)),
-                g.init_const(np.asarray([1], np.int64))])
-            out = g.emit("Reshape", [out, g.shape_const(
-                eqn.outvars[0].aval.shape)])
+            op_shape = tuple(eqn.invars[0].aval.shape)
+            idx_shape = tuple(eqn.invars[1].aval.shape)
+            ss = tuple(eqn.params["slice_sizes"])
+            if idx is not None and np.asarray(idx).size == 1 \
+                    and len(dn.start_index_map) == 1:
+                # static-index pattern (unbind/x[i]): Slice + Reshape
+                d = dn.start_index_map[0]
+                i0 = int(np.asarray(idx).ravel()[0])
+                out = g.emit("Slice", [
+                    ins[0],
+                    g.init_const(np.asarray([i0], np.int64)),
+                    g.init_const(np.asarray([i0 + 1], np.int64)),
+                    g.init_const(np.asarray([d], np.int64)),
+                    g.init_const(np.asarray([1], np.int64))])
+                out = g.emit("Reshape", [out, g.shape_const(
+                    eqn.outvars[0].aval.shape)])
+            else:
+                # dynamic axis-gather (jnp.take / embedding lookup):
+                # indices [..., 1], one collapsed slice dim d, full
+                # slice sizes elsewhere — exactly ONNX Gather(axis=d).
+                # NB: jax's out-of-range fill semantics are NOT
+                # preserved; the export assumes in-range indices (the
+                # same contract paddle2onnx emits).
+                d = dn.start_index_map[0] \
+                    if len(dn.start_index_map) == 1 else -1
+                K = len(idx_shape) - 1
+                R = len(op_shape)
+                expected_ss = op_shape[:d] + (1,) + op_shape[d + 1:] \
+                    if d >= 0 else None
+                expected_off = tuple(
+                    list(range(0, d)) + list(range(d + K, R - 1 + K))) \
+                    if d >= 0 else None
+                if (d < 0 or idx_shape[-1:] != (1,)
+                        or dn.collapsed_slice_dims != (d,)
+                        or ss != expected_ss
+                        or tuple(dn.offset_dims) != expected_off):
+                    raise NotImplementedError(
+                        "gather outside the axis-gather (jnp.take) "
+                        "and static-index patterns is not "
+                        "ONNX-exportable")
+                flat_idx = g.emit("Reshape", [
+                    ins[1], g.shape_const(idx_shape[:-1])])
+                out = g.emit("Gather", [ins[0], flat_idx], axis=d)
         elif prim == "iota":
             aval = eqn.outvars[0].aval
             dim = eqn.params["dimension"]

@@ -107,14 +107,55 @@ def test_legacy_sequential_artifact_now_executes(tmp_path):
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
 
 
+def test_full_ernie_model_onnx_roundtrip(tmp_path):
+    """WHOLE ErnieForPretraining (embedding Gather, 2 encoder blocks,
+    pooler, MLM + SOP heads) through export -> numpy-execute ->
+    compare. The dynamic embedding lookup rides ONNX Gather."""
+    from paddle_tpu.models.ernie import ernie
+    paddle.seed(0)
+    m = ernie("test-tiny")
+    m.eval()
+    ids = np.random.RandomState(0).randint(0, 512, (2, 8)).astype(np.int32)
+    p = trace_to_onnx(m, [ids], str(tmp_path / "ernie_full"))
+    outs = run_onnx(p, {"input": ids})
+    ref = m(paddle.to_tensor(ids))
+    refs = [np.asarray(r.data) for r in
+            (ref if isinstance(ref, (list, tuple)) else [ref])]
+    assert len(outs) == len(refs)
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o, r, rtol=1e-3, atol=5e-4)
+    nodes, _, _, _ = load_model(p)
+    assert any(n.op == "Gather" for n in nodes)
+
+
+def test_full_gpt_model_onnx_roundtrip(tmp_path):
+    """WHOLE GPT (tied embeddings, causal mask via Where, LM head)."""
+    from paddle_tpu.models.gpt import gpt
+    paddle.seed(0)
+    m = gpt("test-tiny", num_layers=2)
+    m.eval()
+    ids = np.random.RandomState(0).randint(0, 512, (2, 8)).astype(np.int32)
+    p = trace_to_onnx(m, [ids], str(tmp_path / "gpt_full"))
+    out = run_onnx(p, {"input": ids})[0]
+    ref = np.asarray(m(paddle.to_tensor(ids)).data)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=5e-4)
+
+
 def test_unmappable_primitive_raises(tmp_path):
     """Genuinely unmappable ops fail loudly, not silently."""
     def f(x):
-        # data-dependent gather: dynamic indices have no static ONNX
-        # mapping in this exporter
-        idx = (x[:, 0] > 0).astype("int64")
-        return paddle.gather(x, idx)
+        # lax.sort has no mapping in this exporter
+        return paddle.sort(x, axis=-1)
 
     x = np.random.RandomState(6).randn(4, 3).astype(np.float32)
     with pytest.raises(NotImplementedError):
         trace_to_onnx(f, [x], str(tmp_path / "bad"))
+
+    def g(x):
+        # two-axis advanced indexing produces a gather outside the
+        # axis-gather (jnp.take) and static-index patterns
+        idx = paddle.to_tensor(np.array([0, 2], np.int64))
+        return x[idx, idx]
+
+    with pytest.raises(NotImplementedError):
+        trace_to_onnx(g, [x], str(tmp_path / "bad2"))
