@@ -11,9 +11,12 @@ available, dynamic absmax otherwise), so the dot itself runs
 int8 x int8 with `preferred_element_type=int32`, then rescales once.
 
 convert_to_int8_compute() walks a model (plain, or PTQ.convert()
-output) and swaps Linear layers in place. Conv stays weight-only: XLA
-TPU lowers int8 convolutions through an upcast today, so there is no
-compute win to claim (documented limitation).
+output) and swaps Linear AND Conv2D layers in place. The r3 build
+documented int8 convs as upcast-blocked; the r4 measurement
+(experiments/int8_conv_probe.py, BASELINE.md) shows current XLA:TPU
+emits a DIRECT int8 convolution (no convert in the HLO) running ~1.3x
+over bf16 at ResNet-layer3 shapes, so `Int8ComputeConv2D` now claims
+the conv compute win too.
 """
 from __future__ import annotations
 
@@ -25,14 +28,34 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
-from ..nn.layers_common import Linear
+from ..nn.layers_common import Conv2D, Linear
 from .fake_quant import quantize_int8
 
-__all__ = ["Int8ComputeLinear", "convert_to_int8_compute"]
+__all__ = ["Int8ComputeLinear", "Int8ComputeConv2D",
+           "convert_to_int8_compute"]
 
 
 def _raw(x):
     return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _quantize_activation(xr, act_scale: Optional[float]):
+    """Per-tensor activation quantization shared by the Linear/Conv
+    compute paths: calibrated PTQ scale when present, dynamic absmax
+    otherwise. Returns (int8 values, float scale)."""
+    if act_scale is not None:
+        sx = jnp.float32(act_scale) / 127.0
+    else:
+        sx = jnp.max(jnp.abs(xr)) / 127.0
+        sx = jnp.where(sx == 0, 1.0, sx)
+    qx = jnp.clip(jnp.round(xr / sx), -127, 127).astype(jnp.int8)
+    return qx, sx
+
+
+def _restore_dtype(out, x):
+    return Tensor(out.astype(_raw(x).dtype)
+                  if jnp.issubdtype(_raw(x).dtype, jnp.floating)
+                  else out)
 
 
 class Int8ComputeLinear(Layer):
@@ -72,29 +95,84 @@ class Int8ComputeLinear(Layer):
         xr = _raw(x).astype(jnp.float32)
         qw = _raw(self.weight_int8)
         sw = _raw(self.weight_scale).astype(jnp.float32)
-        if self._act_scale is not None:
-            sx = jnp.float32(self._act_scale) / 127.0
-        else:
-            sx = jnp.max(jnp.abs(xr)) / 127.0
-            sx = jnp.where(sx == 0, 1.0, sx)
-        qx = jnp.clip(jnp.round(xr / sx), -127, 127).astype(jnp.int8)
+        qx, sx = _quantize_activation(xr, self._act_scale)
         acc = jax.lax.dot_general(
             qx, qw, (((xr.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32)
         out = acc.astype(jnp.float32) * (sx * sw)
         if self.bias is not None:
             out = out + _raw(self.bias).astype(jnp.float32)
-        return Tensor(out.astype(_raw(x).dtype)
-                      if jnp.issubdtype(_raw(x).dtype, jnp.floating)
-                      else out)
+        return _restore_dtype(out, x)
+
+
+class Int8ComputeConv2D(Layer):
+    """Conv2D whose convolution executes int8 x int8 -> int32 (the MXU
+    runs int8 convs natively on current XLA — measured r4, see module
+    docstring). Weight stored int8 in paddle layout [O, I, kh, kw]
+    with a per-out-channel scale; activations quantize per tensor
+    (calibrated PTQ scale, or dynamic absmax)."""
+
+    def __init__(self, weight_int8, w_scale, bias, stride, padding,
+                 dilation, groups, data_format,
+                 act_scale: Optional[float] = None):
+        super().__init__()
+        self.register_buffer(
+            "weight_int8", Tensor(jnp.asarray(_raw(weight_int8),
+                                              jnp.int8)))
+        self.register_buffer(
+            "weight_scale",
+            Tensor(jnp.asarray(_raw(w_scale), jnp.float32) / 127.0))
+        if bias is not None:
+            self.register_buffer("bias", Tensor(_raw(bias)))
+        else:
+            self.bias = None
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self._act_scale = None if act_scale is None else float(act_scale)
+
+    @classmethod
+    def from_conv(cls, conv: Conv2D, act_scale=None):
+        q, s = quantize_int8(conv.weight._data, axis=0)  # per-O-channel
+        return cls(q, s.reshape(-1),
+                   None if conv.bias is None else conv.bias._data,
+                   conv.stride, conv.padding, conv.dilation,
+                   conv.groups, conv.data_format, act_scale)
+
+    def forward(self, x):
+        from ..nn.functional.conv import _padding, _tuple
+        xr = _raw(x).astype(jnp.float32)
+        qw = _raw(self.weight_int8)                   # [O, I, kh, kw]
+        sw = _raw(self.weight_scale).astype(jnp.float32)
+        qx, sx = _quantize_activation(xr, self._act_scale)
+        if self.data_format == "NHWC":
+            dn = ("NHWC", "OIHW", "NHWC")
+            ch_shape = (1, 1, 1, -1)
+        else:
+            dn = ("NCHW", "OIHW", "NCHW")
+            ch_shape = (1, -1, 1, 1)
+        acc = jax.lax.conv_general_dilated(
+            qx, qw, _tuple(self.stride, 2), _padding(self.padding, 2),
+            rhs_dilation=_tuple(self.dilation, 2),
+            dimension_numbers=dn,
+            feature_group_count=self.groups,
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (sx * sw.reshape(ch_shape))
+        if self.bias is not None:
+            out = out + _raw(self.bias).astype(
+                jnp.float32).reshape(ch_shape)
+        return _restore_dtype(out, x)
 
 
 def convert_to_int8_compute(model: Layer,
                             act_scales: Optional[Dict[str, float]] = None,
                             inplace: bool = True) -> Layer:
-    """Swap Linear sublayers for Int8ComputeLinear. `act_scales` maps
-    layer paths to calibrated activation scales (PTQ.quant_info's
-    act_scale entries); layers without one use dynamic quantization."""
+    """Swap Linear sublayers for Int8ComputeLinear and Conv2D for
+    Int8ComputeConv2D. `act_scales` maps layer paths to calibrated
+    activation scales (PTQ.quant_info's act_scale entries); layers
+    without one use dynamic quantization."""
     if not inplace:
         import copy
         model = copy.deepcopy(model)
@@ -105,12 +183,18 @@ def convert_to_int8_compute(model: Layer,
             if sub is None:
                 continue
             full = f"{prefix}{name}"
-            from .ptq import _FrozenQuantLinear
+            from .ptq import _FrozenQuantConv2D, _FrozenQuantLinear
             if isinstance(sub, _FrozenQuantLinear):
                 layer._sub_layers[name] = Int8ComputeLinear.from_linear(
                     sub.inner, act_scale=sub.act_scale)
+            elif isinstance(sub, _FrozenQuantConv2D):
+                layer._sub_layers[name] = Int8ComputeConv2D.from_conv(
+                    sub.inner, act_scale=sub.act_scale)
             elif isinstance(sub, Linear):
                 layer._sub_layers[name] = Int8ComputeLinear.from_linear(
+                    sub, act_scale=act_scales.get(full))
+            elif type(sub) is Conv2D:
+                layer._sub_layers[name] = Int8ComputeConv2D.from_conv(
                     sub, act_scale=act_scales.get(full))
             else:
                 walk(sub, full + ".")

@@ -102,3 +102,110 @@ def test_predictor_int8_compute_path():
     out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     rel = np.abs(out - ref).max() / np.abs(ref).max()
     assert rel < 0.06, rel
+
+
+def test_int8_conv_accuracy_bounded():
+    """r4: XLA:TPU runs int8 convolutions natively (the r3 'upcast
+    wall' was re-measured and falsified — experiments/
+    int8_conv_probe.py); Int8ComputeConv2D must stay within a few
+    percent of the float conv across stride/padding/groups/layouts."""
+    import itertools
+    from paddle_tpu import nn
+    from paddle_tpu.quantization.int8_compute import Int8ComputeConv2D
+    rng = np.random.RandomState(0)
+    for stride, padding, groups, df in [
+            (1, 0, 1, "NCHW"), (2, 1, 1, "NCHW"),
+            (1, 1, 2, "NCHW"), (1, 1, 1, "NHWC")]:
+        paddle.seed(1)
+        conv = nn.Conv2D(8, 12, 3, stride=stride, padding=padding,
+                         groups=groups, data_format=df)
+        qconv = Int8ComputeConv2D.from_conv(conv)
+        shape = (2, 8, 10, 10) if df == "NCHW" else (2, 10, 10, 8)
+        x = paddle.to_tensor(rng.randn(*shape).astype(np.float32))
+        ref = np.asarray(conv(x).data)
+        got = np.asarray(qconv(x).data)
+        rel = np.linalg.norm(got - ref) / (np.linalg.norm(ref) + 1e-9)
+        assert rel < 0.05, (stride, padding, groups, df, rel)
+
+
+def test_int8_conv_emits_int8_convolution():
+    """The compiled HLO must contain a DIRECT s8 convolution — the
+    measured premise of the conv compute path."""
+    import jax
+    from paddle_tpu import nn
+    from paddle_tpu.quantization.int8_compute import Int8ComputeConv2D
+    paddle.seed(2)
+    conv = nn.Conv2D(8, 8, 1)
+    qconv = Int8ComputeConv2D.from_conv(conv)
+
+    def f(x):
+        return qconv(paddle.to_tensor(x)).data
+
+    x = np.random.RandomState(3).randn(1, 8, 6, 6).astype(np.float32)
+    hlo = jax.jit(f).lower(x).as_text()
+    # the traced program feeds i8 operands straight into the
+    # convolution (no upcast inserted by OUR code; the TPU backend
+    # compiles this to a native s8 conv — measured in
+    # experiments/int8_conv_probe.py)
+    assert "convolution" in hlo
+    conv_line = next(l for l in hlo.splitlines()
+                     if "stablehlo.convolution" in l)
+    assert "i8" in conv_line, conv_line
+
+
+def test_convert_swaps_convs():
+    from paddle_tpu import nn
+    from paddle_tpu.quantization.int8_compute import (
+        Int8ComputeConv2D, convert_to_int8_compute)
+    paddle.seed(3)
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Conv2D(8, 4, 1), nn.Flatten(),
+                        nn.Linear(4 * 36, 10))
+    convert_to_int8_compute(net)
+    kinds = [type(l).__name__ for l in net]
+    assert kinds.count("Int8ComputeConv2D") == 2
+    assert kinds.count("Int8ComputeLinear") == 1
+    x = paddle.to_tensor(
+        np.random.RandomState(4).randn(2, 3, 6, 6).astype(np.float32))
+    out = net(x)
+    assert np.isfinite(np.asarray(out.data)).all()
+
+
+def test_ptq_converted_convs_swap_to_int8_compute():
+    """PTQ.convert() output with convs must swap cleanly (the r4
+    review repro: _FrozenQuantConv2D previously crashed the walk)."""
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import PTQ, QuantConfig
+    from paddle_tpu.quantization.int8_compute import (
+        Int8ComputeConv2D, convert_to_int8_compute)
+    paddle.seed(5)
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 36, 4))
+    ptq = PTQ(QuantConfig())
+    qnet = ptq.quantize(net)
+    rng = np.random.RandomState(6)
+    for _ in range(2):
+        qnet(paddle.to_tensor(rng.randn(2, 3, 6, 6).astype(np.float32)))
+    final = ptq.convert(qnet)
+    convert_to_int8_compute(final)
+    names = [type(l).__name__ for l in final]
+    assert "Int8ComputeConv2D" in names, names
+    out = final(paddle.to_tensor(
+        rng.randn(2, 3, 6, 6).astype(np.float32)))
+    assert np.isfinite(np.asarray(out.data)).all()
+
+
+def test_int8_conv_string_and_asymmetric_padding():
+    from paddle_tpu import nn
+    from paddle_tpu.quantization.int8_compute import Int8ComputeConv2D
+    rng = np.random.RandomState(7)
+    for padding in ("SAME", [1, 0, 2, 1]):
+        paddle.seed(8)
+        conv = nn.Conv2D(4, 6, 3, padding=padding)
+        qconv = Int8ComputeConv2D.from_conv(conv)
+        x = paddle.to_tensor(rng.randn(2, 4, 8, 8).astype(np.float32))
+        ref = np.asarray(conv(x).data)
+        got = np.asarray(qconv(x).data)
+        assert got.shape == ref.shape, padding
+        rel = np.linalg.norm(got - ref) / (np.linalg.norm(ref) + 1e-9)
+        assert rel < 0.05, (padding, rel)
