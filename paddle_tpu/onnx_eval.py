@@ -174,7 +174,10 @@ def run_onnx(path_or_bytes, inputs: Dict[str, np.ndarray]
         raise ValueError(f"missing graph inputs: {missing}")
 
     for nd in nodes:
-        i = [env[x] for x in nd.inputs if x]
+        try:
+            i = [env[x] for x in nd.inputs if x]
+        except KeyError as e:
+            raise KeyError(f"{nd.op}({nd.inputs}): missing input {e}")
         a = nd.attrs
         op = nd.op
         if op == "Identity":
@@ -249,11 +252,18 @@ def run_onnx(path_or_bytes, inputs: Dict[str, np.ndarray]
             r = i[0].mean(axis=tuple(range(2, i[0].ndim)),
                           keepdims=True)
         elif op == "Reshape":
-            r = i[0].reshape([int(d) for d in i[1]])
+            dims = [int(d) for d in i[1]]
+            # ONNX semantics: 0 copies the input's dim (allowzero=0)
+            dims = [i[0].shape[k] if d == 0 else d
+                    for k, d in enumerate(dims)]
+            r = i[0].reshape(dims)
         elif op == "Transpose":
             r = np.transpose(i[0], a["perm"])
         elif op == "Expand":
-            r = np.broadcast_to(i[0], [int(d) for d in i[1]])
+            # ONNX Expand broadcasts input against the given shape
+            tgt = np.broadcast_shapes(i[0].shape,
+                                      tuple(int(d) for d in i[1]))
+            r = np.broadcast_to(i[0], tgt)
         elif op == "Flatten":
             ax = a.get("axis", 1)
             r = i[0].reshape(int(np.prod(i[0].shape[:ax]) or 1), -1)
@@ -316,6 +326,8 @@ def run_onnx(path_or_bytes, inputs: Dict[str, np.ndarray]
             r = i[0] | i[1]
         elif op == "Not":
             r = ~i[0]
+        elif op == "Shape":
+            r = np.asarray(i[0].shape, np.int64)
         elif op == "Gather":
             r = np.take(i[0], i[1].astype(np.int64),
                         axis=a.get("axis", 0))

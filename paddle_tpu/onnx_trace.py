@@ -45,6 +45,24 @@ class _Graph:
         self.const_vals: Dict[str, np.ndarray] = {}  # initializer values
         self.counter = 0
         self.min_opset = 13
+        self.dyn_batch: Optional[int] = None  # traced batch size when
+        # the export declares the leading input dim symbolic
+        self.batch_src: Optional[str] = None  # graph input whose dim 0
+        # IS the runtime batch (Shape-of feeds dynamic Expand targets)
+        self._batch_1d: Optional[str] = None
+
+    def runtime_batch_1d(self):
+        """[1]-shaped int64 tensor holding the RUNTIME batch size,
+        emitted once: Shape(input)[0:1]."""
+        if self._batch_1d is None:
+            shp = self.emit("Shape", [self.batch_src])
+            self._batch_1d = self.emit("Slice", [
+                shp,
+                self.init_const(np.asarray([0], np.int64)),
+                self.init_const(np.asarray([1], np.int64)),
+                self.init_const(np.asarray([0], np.int64)),
+                self.init_const(np.asarray([1], np.int64))])
+        return self._batch_1d
 
     def fresh(self, base="t"):
         self.counter += 1
@@ -59,6 +77,17 @@ class _Graph:
 
     def shape_const(self, dims):
         return self.init_const(np.asarray(dims, np.int64), "shape")
+
+    def reshape_to(self, x_name, sizes, in_shape):
+        """Emit a Reshape, keeping the graph batch-agnostic when the
+        target's leading dim is the (symbolic) traced batch: ONNX
+        Reshape dim 0 copies the input's runtime dim."""
+        sizes = list(sizes)
+        if self.dyn_batch and sizes and in_shape \
+                and sizes[0] == self.dyn_batch \
+                and in_shape[0] == self.dyn_batch:
+            sizes[0] = 0
+        return self.emit("Reshape", [x_name, self.shape_const(sizes)])
 
     def emit(self, op, inputs, n_out=1, **attrs):
         outs = [self.fresh(op.lower()) for _ in range(n_out)]
@@ -184,9 +213,21 @@ def _broadcast_node(g, eqn, in_names):
         mid[dst] = in_aval.shape[src]
     x = in_names[0]
     if list(in_aval.shape) != mid:
-        x = g.emit("Reshape", [x, g.shape_const(mid)])
+        x = g.reshape_to(x, mid, in_aval.shape)
     if mid != shape:
-        x = g.emit("Expand", [x, g.shape_const(shape)])
+        if g.dyn_batch and shape and shape[0] == g.dyn_batch:
+            # target's leading dim is the batch: build the Expand
+            # shape at RUNTIME from Shape(input), so non-broadcasting
+            # consumers (Concat, Einsum) see the true batch too
+            rest = g.shape_const(shape[1:]) if len(shape) > 1 else None
+            parts = [g.runtime_batch_1d()]
+            if rest is not None:
+                parts.append(rest)
+            tgt = parts[0] if len(parts) == 1 else \
+                g.emit("Concat", parts, axis=0)
+            x = g.emit("Expand", [x, tgt])
+        else:
+            x = g.emit("Expand", [x, g.shape_const(shape)])
     return x
 
 
@@ -257,7 +298,14 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
         cvals = [g.const_of(v, frame) for v in eqn.invars]
         foldable = (all(c is not None for c in cvals)
                     and all(int(np.prod(ov.aval.shape or (1,))) <= 4096
-                            for ov in eqn.outvars))
+                            for ov in eqn.outvars)
+                    # a dynamic-batch export must not bake
+                    # batch-leading constants (e.g. zeros_like(ids)
+                    # token-type ids) into the graph
+                    and not (g.dyn_batch and any(
+                        ov.aval.shape
+                        and ov.aval.shape[0] == g.dyn_batch
+                        for ov in eqn.outvars)))
         if foldable:
             try:
                 if prim in _SUBJAXPR_PRIMS:
@@ -333,19 +381,16 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
         elif prim == "reduce_min":
             out = _reduce_node(g, "ReduceMin", eqn, ins)
         elif prim == "reshape":
-            out = g.emit("Reshape", [ins[0], g.shape_const(
-                eqn.params["new_sizes"])])
+            out = g.reshape_to(ins[0], eqn.params["new_sizes"],
+                               eqn.invars[0].aval.shape)
         elif prim == "transpose":
             out = g.emit("Transpose", [ins[0]],
                          perm=list(eqn.params["permutation"]))
         elif prim == "broadcast_in_dim":
             out = _broadcast_node(g, eqn, ins)
-        elif prim == "squeeze":
-            out = g.emit("Reshape", [ins[0], g.shape_const(
-                eqn.outvars[0].aval.shape)])
-        elif prim == "expand_dims":
-            out = g.emit("Reshape", [ins[0], g.shape_const(
-                eqn.outvars[0].aval.shape)])
+        elif prim in ("squeeze", "expand_dims"):
+            out = g.reshape_to(ins[0], eqn.outvars[0].aval.shape,
+                               eqn.invars[0].aval.shape)
         elif prim == "concatenate":
             out = g.emit("Concat", ins,
                          axis=int(eqn.params["dimension"]))
@@ -368,10 +413,18 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
         elif prim == "slice":
             p = eqn.params
             nd = len(p["start_indices"])
+            limits = list(p["limit_indices"])
+            in_shape = eqn.invars[0].aval.shape
+            if g.dyn_batch and limits and in_shape \
+                    and p["start_indices"][0] == 0 \
+                    and limits[0] == in_shape[0] == g.dyn_batch:
+                # full-extent batch slice: ONNX clamps out-of-range
+                # ends, so a huge end keeps the graph batch-agnostic
+                limits[0] = 2 ** 62
             out = g.emit("Slice", [
                 ins[0],
                 g.init_const(np.asarray(p["start_indices"], np.int64)),
-                g.init_const(np.asarray(p["limit_indices"], np.int64)),
+                g.init_const(np.asarray(limits, np.int64)),
                 g.init_const(np.asarray(range(nd), np.int64)),
                 g.init_const(np.asarray(p["strides"] or [1] * nd,
                                         np.int64))])
@@ -392,8 +445,10 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
                     g.init_const(np.asarray([i0 + 1], np.int64)),
                     g.init_const(np.asarray([d], np.int64)),
                     g.init_const(np.asarray([1], np.int64))])
-                out = g.emit("Reshape", [out, g.shape_const(
-                    eqn.outvars[0].aval.shape)])
+                slice_shape = list(eqn.invars[0].aval.shape)
+                slice_shape[d] = 1
+                out = g.reshape_to(out, eqn.outvars[0].aval.shape,
+                                   slice_shape)
             else:
                 # dynamic axis-gather (jnp.take / embedding lookup):
                 # indices [..., 1], one collapsed slice dim d, full
@@ -418,8 +473,8 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
                         "gather outside the axis-gather (jnp.take) "
                         "and static-index patterns is not "
                         "ONNX-exportable")
-                flat_idx = g.emit("Reshape", [
-                    ins[1], g.shape_const(idx_shape[:-1])])
+                flat_idx = g.reshape_to(ins[1], idx_shape[:-1],
+                                        idx_shape)
                 out = g.emit("Gather", [ins[0], flat_idx], axis=d)
         elif prim == "iota":
             aval = eqn.outvars[0].aval
@@ -447,6 +502,14 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
         elif prim == "not":
             out = g.emit("Not", ins)
         else:
+            if all(c is not None for c in cvals) and g.dyn_batch:
+                raise NotImplementedError(
+                    f"jaxpr primitive {prim!r} has no ONNX mapping, "
+                    f"and dynamic_batch=True blocked constant-folding "
+                    f"its batch-leading result (folding would bake "
+                    f"the traced batch size); export with "
+                    f"dynamic_batch=False or rewrite the model to "
+                    f"compute this from the input")
             raise NotImplementedError(
                 f"jaxpr primitive {prim!r} has no ONNX mapping yet "
                 f"(eqn: {eqn})")
@@ -458,10 +521,20 @@ def _walk(g: _Graph, jaxpr, in_names: List[str],
 
 def trace_to_onnx(fn, example_inputs: Sequence, path: str,
                   opset: int = 13, input_names: Optional[List[str]]
-                  = None) -> str:
+                  = None, dynamic_batch: bool = False) -> str:
     """Trace `fn(*example_inputs)` (a pure function or an eval-mode
     Layer) to a jaxpr and serialize it as ONNX at `{path}.onnx`.
-    Weights/constants become initializers. Returns the file path."""
+    Weights/constants become initializers. Returns the file path.
+
+    dynamic_batch=True declares batch-sized leading input dims as the
+    symbolic 'N' (the reference's dynamic-batch export): Reshapes that
+    preserve the batch emit ONNX dim 0 (copy-from-input), Expand
+    targets with a batch-leading dim are built at runtime from
+    Shape(input), full-extent batch Slices get clamped huge ends, and
+    constant folding refuses to bake batch-shaped constants. Caveat:
+    the traced batch size is identified by VALUE, so trace with a
+    batch unlikely to collide with fixed model dims (e.g. not 3 for a
+    3-channel NCHW input ... use 5 or 7)."""
     from .core.tensor import Tensor
     from .nn.layer import Layer
 
@@ -498,13 +571,21 @@ def trace_to_onnx(fn, example_inputs: Sequence, path: str,
                    for c in closed.consts]
     in_names = input_names or [f"input_{i}" if i else "input"
                                for i in range(len(raw_inputs))]
+    if dynamic_batch and raw_inputs and np.asarray(raw_inputs[0]).ndim:
+        g.dyn_batch = int(np.asarray(raw_inputs[0]).shape[0])
+        g.batch_src = in_names[0]
     out_names = _walk(g, closed.jaxpr, in_names,
                       const_bind=list(zip(closed.jaxpr.constvars,
                                           const_names)))
 
     def vi(name, arr):
         elem = _onnx_dtype(np.asarray(arr).dtype) or 1
-        return _value_info(name, list(np.asarray(arr).shape), elem)
+        shape = list(np.asarray(arr).shape)
+        # only dims that ARE the traced batch become symbolic; other
+        # inputs keep their concrete (baked) shapes honestly
+        if g.dyn_batch and shape and shape[0] == g.dyn_batch:
+            shape[0] = None          # dim_param "N" in the writer
+        return _value_info(name, shape, elem)
 
     model = encode_model(
         g.nodes, g.inits,

@@ -121,7 +121,9 @@ from paddle_tpu import nn, optimizer
 from paddle_tpu.distributed.auto_checkpoint import (ExeTrainStatus,
                                                     train_epoch_range)
 
-KILL_EPOCH = int(os.environ.get("KILL_EPOCH", "-1"))
+rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+KILL_EPOCH = int(os.environ.get("KILL_EPOCH", "-1")) \
+    if rank == os.environ.get("KILL_RANK", "0") else -1
 marker = os.environ.get("KILL_MARKER", "")
 
 paddle.seed(0)
@@ -131,6 +133,7 @@ rng = np.random.RandomState(0)
 x = paddle.to_tensor(rng.randn(16, 4).astype(np.float32))
 y = paddle.to_tensor(rng.randn(16, 1).astype(np.float32))
 
+os.environ["PADDLE_JOB_ID"] = os.environ["PADDLE_JOB_ID"] + "_r" + rank
 status = ExeTrainStatus()
 final = None
 for epoch in train_epoch_range(6, status=status):
@@ -151,7 +154,7 @@ for epoch in train_epoch_range(6, status=status):
                            for k, v in net.state_dict().items()},
                   loss=final)
 
-with open(os.environ["RESULT_JSON"], "w") as f:
+with open(os.environ["RESULT_JSON"] + "." + rank, "w") as f:
     json.dump({"loss": final}, f)
 """
 
@@ -168,33 +171,40 @@ def test_preemption_chaos_resume_parity(tmp_path):
         env = dict(os.environ, REPO=REPO, PYTHONPATH=REPO,
                    PADDLE_RUNNING_ENV="PADDLE_EDL_AUTO_CHECKPOINT",
                    PADDLE_EDL_HDFS_CHECKPOINT_PATH=str(tmp_path / job),
-                   KILL_EPOCH=str(kill_epoch),
+                   KILL_EPOCH=str(kill_epoch), KILL_RANK="0",
                    KILL_MARKER=str(tmp_path / f"{job}.killed"),
                    RESULT_JSON=str(tmp_path / f"{job}.json"))
         env["PADDLE_JOB_ID"] = job
         r = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--nproc_per_node", "1", "--job_id", job, *extra_args,
+             "--nproc_per_node", "2", "--job_id", job, *extra_args,
              str(script)],
             env=env, capture_output=True, text=True, timeout=300)
         return r
 
-    # uninterrupted reference run
+    # uninterrupted reference run (2-worker pod)
     r0 = run("plain", -1, [])
     assert r0.returncode == 0, r0.stderr
     import json
-    ref_loss = json.load(open(tmp_path / "plain.json"))["loss"]
+    ref = [json.load(open(str(tmp_path / "plain.json") + f".{i}"))
+           ["loss"] for i in range(2)]
 
-    # chaos run: SIGKILL mid-epoch-2, fault-elastic relaunch, resume
+    # chaos run: SIGKILL rank 0 mid-epoch-2; the controller tears the
+    # POD down (rank 1 dies with it, possibly mid-epoch too),
+    # fault-elastic relaunches everyone, each rank resumes from its
+    # own auto checkpoint
     r1 = run("chaos", 2, ["--max_restarts", "2",
                           "--elastic_on_failure"])
     assert r1.returncode == 0, r1.stderr
     assert (tmp_path / "chaos.killed").exists(), \
         "the kill never happened — the chaos leg tested nothing"
-    chaos_loss = json.load(open(tmp_path / "chaos.json"))["loss"]
-    # epoch 2 was interrupted BEFORE its snapshot: the restart redoes
-    # it from the epoch-1 state, so the trajectory is identical
-    assert abs(chaos_loss - ref_loss) < 1e-6, (chaos_loss, ref_loss)
+    # interrupted epochs were never snapshotted: the restart redoes
+    # them from the last completed state, so BOTH ranks' trajectories
+    # are identical to the uninterrupted run
+    for i in range(2):
+        chaos = json.load(open(str(tmp_path / "chaos.json")
+                               + f".{i}"))["loss"]
+        assert abs(chaos - ref[i]) < 1e-6, (i, chaos, ref[i])
 
     # without elastic_on_failure a signal death still propagates
     r2 = run("nofault", 2, ["--max_restarts", "2"])

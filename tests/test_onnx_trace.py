@@ -141,6 +141,71 @@ def test_full_gpt_model_onnx_roundtrip(tmp_path):
     np.testing.assert_allclose(out, ref, rtol=1e-3, atol=5e-4)
 
 
+def test_dynamic_batch_export(tmp_path):
+    """dynamic_batch=True: trace at batch 3, execute at batch 5 — the
+    reference's dynamic-batch export. Covers the batch-agnostic
+    Reshape-0 / Expand-broadcast / huge-end Slice rewrites and the
+    no-batch-constant-folding rule across all three model families."""
+    from paddle_tpu.models.ernie import ernie
+    from paddle_tpu.models.gpt import gpt
+    from paddle_tpu.models.resnet import resnet18
+    rng = np.random.RandomState(0)
+    ids3 = rng.randint(0, 512, (3, 8)).astype(np.int32)
+    ids5 = rng.randint(0, 512, (5, 8)).astype(np.int32)
+
+    paddle.seed(0)
+    m = ernie("test-tiny")
+    m.eval()
+    p = trace_to_onnx(m, [ids3], str(tmp_path / "ernie_dyn"),
+                      dynamic_batch=True)
+    outs = run_onnx(p, {"input": ids5})
+    refs = [np.asarray(r.data) for r in m(paddle.to_tensor(ids5))]
+    for o, r in zip(outs, refs):
+        assert o.shape == r.shape
+        np.testing.assert_allclose(o, r, rtol=1e-3, atol=5e-4)
+
+    paddle.seed(0)
+    g = gpt("test-tiny", num_layers=2)
+    g.eval()
+    p = trace_to_onnx(g, [ids3], str(tmp_path / "gpt_dyn"),
+                      dynamic_batch=True)
+    o = run_onnx(p, {"input": ids5})[0]
+    np.testing.assert_allclose(
+        o, np.asarray(g(paddle.to_tensor(ids5)).data),
+        rtol=1e-3, atol=5e-4)
+
+    paddle.seed(0)
+    r18 = resnet18(num_classes=10)
+    r18.eval()
+    # traced batch 5, run at 7: must NOT collide with the 3-channel
+    # input dim (docstring caveat)
+    x5i = rng.randn(5, 3, 16, 16).astype(np.float32)
+    x7 = rng.randn(7, 3, 16, 16).astype(np.float32)
+    p = trace_to_onnx(r18, [x5i], str(tmp_path / "r18_dyn"),
+                      dynamic_batch=True)
+    o = run_onnx(p, {"input": x7})[0]
+    np.testing.assert_allclose(
+        o, np.asarray(r18(paddle.to_tensor(x7)).data),
+        rtol=1e-3, atol=5e-4)
+
+    # non-broadcasting consumer of a batch-shaped broadcast: the
+    # Expand target is built from Shape(input) at runtime
+    fc = paddle.nn.Linear(4, 4)
+
+    def f(x):
+        ones = paddle.ones([x.shape[0], 1])
+        return paddle.concat([fc(x), ones], axis=1)
+
+    xa = rng.randn(3, 4).astype(np.float32)
+    xb = rng.randn(6, 4).astype(np.float32)
+    p2 = trace_to_onnx(f, [xa], str(tmp_path / "cat_dyn"),
+                       dynamic_batch=True)
+    o2 = run_onnx(p2, {"input": xb})[0]
+    np.testing.assert_allclose(
+        o2, np.asarray(f(paddle.to_tensor(xb)).data),
+        rtol=1e-4, atol=1e-5)
+
+
 def test_unmappable_primitive_raises(tmp_path):
     """Genuinely unmappable ops fail loudly, not silently."""
     def f(x):
