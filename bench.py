@@ -87,11 +87,18 @@ def bench_gpt2(dev, on_tpu):
     # the [B, S, vocab] logits and wins ~10% MFU at s1024, ~16% at
     # s2048 (see BASELINE.md sweeps). BENCH_FUSED=0 opts out.
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
-    # fused-loss chunk: measured optimum is ~8192 logit rows per chunk
-    # (b16: chunk 512 -> MFU 0.497 vs 0.491 at 256; b32: chunk 256
-    # beats 512 — the [batch*chunk, vocab] buffer is what matters)
+    # fused-loss chunk: when the whole fp32 [B, S, vocab] logits fit in
+    # ~4 GB HBM alongside the step, a single un-rematerialized chunk is
+    # fastest (b16-s1024: MFU 0.499 -> 0.529 measured r4 — saving the
+    # logits beats recomputing the vocab matmul); beyond that, scan
+    # chunks of ~8192 logit rows with per-chunk remat (b32 chunk 256,
+    # s2048 chunk 512 — the [batch*chunk, vocab] live buffer matters)
+    from paddle_tpu.models.gpt import CONFIGS
+    base_cfg = CONFIGS[name]
+    logit_bytes = batch * (seq - 1) * base_cfg.vocab_size * 4
     chunk = int(os.environ.get("BENCH_CHUNK", 0)) or \
-        max(8192 // batch, 128)
+        (seq if logit_bytes <= base_cfg.lm_loss_save_logits_budget
+         else max(8192 // batch, 128))
 
     paddle.seed(0)
     model = gpt(name, max_position_embeddings=seq,

@@ -1,0 +1,57 @@
+"""End-to-end flagship bench with the in-tree flash kernel vs jax's
+reference TPU flash kernel as the attention backend. Decides whether
+the jax kernel's s1024 microbench edge is real in the full program.
+
+Usage: python experiments/bench_attn_backend.py [jax|ours]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+
+def patch_jax_backend():
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as jax_fa, BlockSizes)
+    import paddle_tpu.kernels.flash_attention as fa_mod
+
+    def flash_attention(query, key, value, causal=False, scale=None,
+                        block_q=1024, block_k=1024):
+        b, s, h, d = query.shape
+        if scale is None:
+            scale = 1.0 / (d ** 0.5)
+        bq = min(1024, s)
+        bk = min(1024, s)
+        bs = BlockSizes(
+            block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+            block_q_major_dkv=bq, block_k_major_dkv=bk,
+            block_k_dkv=bk, block_q_dkv=bq,
+            block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
+        qt = jnp.swapaxes(query, 1, 2)
+        kt = jnp.swapaxes(key, 1, 2)
+        vt = jnp.swapaxes(value, 1, 2)
+        out = jax_fa(qt, kt, vt, causal=causal, sm_scale=float(scale),
+                     block_sizes=bs)
+        return jnp.swapaxes(out, 1, 2)
+
+    fa_mod.flash_attention = flash_attention
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "ours"
+    if which == "jax":
+        patch_jax_backend()
+    import bench
+    dev, on_tpu = bench._setup()
+    res = bench.bench_gpt2(dev, on_tpu)
+    res["backend"] = which
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

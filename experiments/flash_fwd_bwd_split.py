@@ -1,0 +1,91 @@
+"""Locate the s1024-causal gap vs jax's reference flash kernel:
+time forward-only and fwd+bwd separately, scan-amortized.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.flash_attention import flash_attention as ours
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    flash_attention as jax_fa, BlockSizes)
+
+REPS = 10
+B, H, S, D = 16, 12, 1024, 64
+
+
+def timeit(fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    float(jnp.sum(out.astype(jnp.float32)))
+    t0 = time.perf_counter()
+    out = f(*args)
+    float(jnp.sum(out.astype(jnp.float32)))
+    return (time.perf_counter() - t0) / REPS
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+    qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+
+    bs = BlockSizes(
+        block_q=1024, block_k_major=1024, block_k=1024, block_b=1,
+        block_q_major_dkv=1024, block_k_major_dkv=1024,
+        block_k_dkv=1024, block_q_dkv=1024,
+        block_k_major_dq=1024, block_k_dq=1024, block_q_dq=1024)
+
+    def fwd_ours(q):
+        def f(c, _):
+            o = ours(c, k, v, causal=True)
+            return c + o.astype(c.dtype) * 1e-6, None
+        return jax.lax.scan(f, q, None, length=REPS)[0]
+
+    def fwd_jax(q):
+        def f(c, _):
+            o = jax_fa(c, kt, vt, causal=True, sm_scale=D ** -0.5,
+                       block_sizes=bs)
+            return c + o.astype(c.dtype) * 1e-6, None
+        return jax.lax.scan(f, q, None, length=REPS)[0]
+
+    def g_ours(q):
+        gf = jax.grad(lambda q, k, v: ours(
+            q, k, v, causal=True).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))
+
+        def f(c, _):
+            dq, dk, dv = gf(c, k, v)
+            return c + (dq + dk + dv).astype(c.dtype) * 1e-6, None
+        return jax.lax.scan(f, q, None, length=REPS)[0]
+
+    def g_jax(q):
+        gf = jax.grad(lambda q, k, v: jax_fa(
+            q, k, v, causal=True, sm_scale=D ** -0.5,
+            block_sizes=bs).astype(jnp.float32).sum(), argnums=(0, 1, 2))
+
+        def f(c, _):
+            dq, dk, dv = gf(c, kt, vt)
+            return c + (dq + dk + dv).astype(c.dtype) * 1e-6, None
+        return jax.lax.scan(f, q, None, length=REPS)[0]
+
+    for _ in range(2):  # two passes to see run variance
+        tfo = timeit(fwd_ours, q)
+        tfj = timeit(fwd_jax, qt)
+        tgo = timeit(g_ours, q)
+        tgj = timeit(g_jax, qt)
+        print(f"fwd: ours {tfo*1e3:6.2f}  jax {tfj*1e3:6.2f} | "
+              f"fwd+bwd: ours {tgo*1e3:6.2f}  jax {tgj*1e3:6.2f} | "
+              f"bwd-only est: ours {(tgo-tfo)*1e3:6.2f} jax {(tgj-tfj)*1e3:6.2f}")
+
+
+if __name__ == "__main__":
+    main()

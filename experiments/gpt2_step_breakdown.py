@@ -1,0 +1,80 @@
+"""Decompose the flagship GPT-2 b16 s1024 train step on the real chip:
+forward-only vs forward+backward vs full step (optimizer cost), and
+12- vs 6-layer variants to split per-layer trunk cost from the fixed
+embedding + fused-LM-loss cost.
+
+Usage: python experiments/gpt2_step_breakdown.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.jit.api import functional_call, _wrap, _unwrap
+from paddle_tpu.models.gpt import gpt
+
+BATCH, SEQ, ITERS = 16, 1024, 20
+
+
+def time_fn(fn, *args):
+    out = fn(*args)
+    loss = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(loss, dtype=np.float32).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    loss = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(loss, dtype=np.float32).ravel()[0])
+    return (time.perf_counter() - t0) / ITERS
+
+
+def main():
+    rng = np.random.RandomState(0)
+    for layers in (12, 6):
+        paddle.seed(0)
+        chunk = max(8192 // BATCH, 128)
+        model = gpt("gpt2-small", max_position_embeddings=SEQ,
+                    fused_lm_loss=True, lm_loss_chunk=chunk,
+                    num_layers=layers)
+        model.bfloat16()
+        names = [n for n, _ in model.named_parameters()]
+        pvals = [p._data for _, p in model.named_parameters()]
+
+        ids = rng.randint(0, model.cfg.vocab_size,
+                          (BATCH, SEQ)).astype(np.int32)
+        x = np.asarray(ids)
+        y = ids.astype(np.int64)
+
+        def loss_of(plist, x, y):
+            pdict = dict(zip(names, plist))
+            out = functional_call(model, pdict, _wrap(x))
+            return _unwrap(model.loss(out, _wrap(y)))
+
+        fwd = jax.jit(loss_of)
+        t_fwd = time_fn(fwd, pvals, x, y)
+
+        grad_fn = jax.jit(jax.value_and_grad(loss_of))
+        t_grad = time_fn(grad_fn, pvals, x, y)
+
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        step = paddle.jit.TrainStep(
+            model, opt, lambda logits, labels: model.loss(logits, labels))
+        xt = paddle.to_tensor(ids)
+        yt = paddle.to_tensor(y)
+        t_step = time_fn(step, xt, yt)
+        print(f"layers={layers:2d}: fwd {t_fwd*1e3:7.2f} | fwd+bwd "
+              f"{t_grad*1e3:7.2f} | full step {t_step*1e3:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Isolate the fused LM-head+CE loss cost (b16 s1024 gpt2-small shapes):
+grad wrt (hidden, tied-W) across chunk sizes, remat on/off, and an
+fp32-preferred matmul variant. The step breakdown shows the fixed
+embedding+loss cost is ~43 ms of the 143 ms step; ideal-with-remat is
+~26 ms — find where the rest goes.
+
+Usage: python experiments/lm_loss_head_probe.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B, S1, H, V = 16, 1023, 768, 50257
+ITERS = 10
+
+
+def make_loss(chunk, remat, pref32):
+    n_chunks = -(-S1 // chunk)
+    pad = n_chunks * chunk - S1
+
+    def chunk_ce(hc, yc, w):
+        wmat = w.T
+        if pref32:
+            logits = jax.lax.dot_general(
+                hc, wmat.astype(hc.dtype), (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            logits = (hc @ wmat.astype(hc.dtype)).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        yc_safe = jnp.maximum(yc, 0)
+        gold = jnp.take_along_axis(
+            logits, yc_safe[..., None], axis=-1)[..., 0]
+        valid = (yc >= 0).astype(jnp.float32)
+        return jnp.sum((lse - gold) * valid), jnp.sum(valid)
+
+    def loss(hs, ys, w):
+        hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+        ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=-1)
+        hsc = hs.reshape(B, n_chunks, chunk, H).transpose(1, 0, 2, 3)
+        ysc = ys.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+        ce = jax.checkpoint(chunk_ce) if remat else chunk_ce
+
+        def body(carry, xs):
+            hc, yc = xs
+            ssum, cnt = ce(hc, yc, w)
+            return (carry[0] + ssum, carry[1] + cnt), None
+
+        (total, count), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (hsc, ysc))
+        return total / jnp.maximum(count, 1.0)
+
+    return loss
+
+
+def bench(loss_fn, hs, ys, w):
+    g = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 2)))
+    out = g(hs, ys, w)
+    float(out[0])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = g(hs, ys, w)
+    float(out[0])
+    return (time.perf_counter() - t0) / ITERS
+
+
+def main():
+    rng = np.random.RandomState(0)
+    hs = jnp.asarray(rng.randn(B, S1, H), jnp.bfloat16)
+    ys = jnp.asarray(rng.randint(0, V, (B, S1)), jnp.int32)
+    w = jnp.asarray(rng.randn(V, H) * 0.02, jnp.bfloat16)
+
+    for chunk in (256, 512, 1024):
+        for remat in (True, False):
+            for pref32 in (False, True):
+                try:
+                    t = bench(make_loss(chunk, remat, pref32), hs, ys, w)
+                    tag = f"chunk{chunk:5d} remat={int(remat)} p32={int(pref32)}"
+                    print(f"{tag}: {t*1e3:7.2f} ms")
+                except Exception as e:  # noqa: BLE001
+                    print(f"chunk{chunk} remat={remat} p32={pref32} "
+                          f"FAILED {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

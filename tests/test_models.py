@@ -153,15 +153,18 @@ def test_ernie_state_dict_roundtrip(tmp_path):
                                np.asarray(b.numpy()), rtol=1e-6)
 
 
-def test_fused_lm_loss_matches_plain():
+@pytest.mark.parametrize("chunk", [7, 32])
+def test_fused_lm_loss_matches_plain(chunk):
     """Chunked fused LM-head+CE == plain logits+CE (the HBM fix for
-    long-seq configs; BASELINE.md r2). Also trains through TrainStep."""
+    long-seq configs; BASELINE.md r2). Also trains through TrainStep.
+    chunk=7 exercises the remat scan; chunk=32 >= seq-1 exercises the
+    r4 single-chunk save-logits fast path."""
     from paddle_tpu.models.gpt import gpt
     paddle.seed(0)
     plain = gpt("test-tiny")
     plain.eval()
     paddle.seed(0)
-    fused = gpt("test-tiny", fused_lm_loss=True, lm_loss_chunk=7)
+    fused = gpt("test-tiny", fused_lm_loss=True, lm_loss_chunk=chunk)
     fused.eval()
     ids = np.random.RandomState(0).randint(0, 512, (2, 19)).astype(np.int32)
     x = paddle.to_tensor(ids)
@@ -179,9 +182,11 @@ def test_fused_lm_loss_matches_plain():
     assert ln < l0
 
 
-def test_fused_lm_loss_head_gradient_matches_plain():
+@pytest.mark.parametrize("chunk", [8, 16])
+def test_fused_lm_loss_head_gradient_matches_plain(chunk):
     """Regression: the fused path must propagate the LM-head/wte weight
-    gradient (it was captured as a constant and silently dropped)."""
+    gradient (it was captured as a constant and silently dropped).
+    chunk=8 is the remat scan, chunk=16 the single-chunk fast path."""
     from paddle_tpu.models.gpt import gpt
     ids = np.random.RandomState(0).randint(0, 512, (2, 16)).astype(np.int32)
     x = paddle.to_tensor(ids)
@@ -189,7 +194,7 @@ def test_fused_lm_loss_head_gradient_matches_plain():
 
     def wte_grad(fused):
         paddle.seed(0)
-        m = gpt("test-tiny", fused_lm_loss=fused, lm_loss_chunk=8)
+        m = gpt("test-tiny", fused_lm_loss=fused, lm_loss_chunk=chunk)
         m.eval()
         loss = m.loss(m(x), y)
         loss.backward()
