@@ -1,0 +1,169 @@
+"""Measure the Pallas fused linear+CE kernel against the save-logits
+and chunked-remat loss-head baselines at the bench shapes (grad wrt
+hidden + tied W, mean-over-valid loss), real chip, in-program repeats
+via the dependent-carry harness.
+
+Usage: python experiments/fused_ce_probe.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.fused_ce import fused_linear_ce
+
+H, V = 768, 50257
+ITERS = 10
+
+
+def save_logits_loss(hs, ys, w):
+    logits = (hs @ w.T.astype(hs.dtype)).astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, jnp.maximum(ys, 0)[..., None], axis=-1)[..., 0]
+    valid = (ys >= 0).astype(jnp.float32)
+    return jnp.sum((lse - gold) * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def remat_chunk_loss(chunk):
+    def loss(hs, ys, w):
+        b, s1, hd = hs.shape
+        n_chunks = -(-s1 // chunk)
+        pad = n_chunks * chunk - s1
+        hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
+        ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=-1)
+        hsc = hs.reshape(b, n_chunks, chunk, hd).transpose(1, 0, 2, 3)
+        ysc = ys.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+
+        def chunk_ce(hc, yc):
+            logits = (hc @ w.T.astype(hc.dtype)).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, jnp.maximum(yc, 0)[..., None], axis=-1)[..., 0]
+            valid = (yc >= 0).astype(jnp.float32)
+            return jnp.sum((lse - gold) * valid), jnp.sum(valid)
+
+        def body(carry, xs):
+            ssum, cnt = jax.checkpoint(chunk_ce)(*xs)
+            return (carry[0] + ssum, carry[1] + cnt), None
+
+        (t, c), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (hsc, ysc))
+        return t / jnp.maximum(c, 1.0)
+    return loss
+
+
+def make_bf16_residual_loss():
+    """Explicit-residual CE: save ONLY the bf16 logits (+ lse) for
+    backward — half the residual memory of fp32 save-logits, XLA-peak
+    matmuls in both passes, softmax recomputed elementwise from the
+    saved bf16 logits."""
+
+    @jax.custom_vjp
+    def ce_rows(hs2, w, y2):
+        logits16 = hs2 @ w.T.astype(hs2.dtype)
+        lf = logits16.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lf, axis=-1)
+        gold = jnp.take_along_axis(
+            lf, jnp.maximum(y2, 0)[:, None], axis=-1)[:, 0]
+        return jnp.where(y2 >= 0, lse - gold, 0.0)
+
+    def fwd(hs2, w, y2):
+        logits16 = hs2 @ w.T.astype(hs2.dtype)
+        lf = logits16.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lf, axis=-1)
+        gold = jnp.take_along_axis(
+            lf, jnp.maximum(y2, 0)[:, None], axis=-1)[:, 0]
+        ce = jnp.where(y2 >= 0, lse - gold, 0.0)
+        return ce, (hs2, w, y2, logits16, lse)
+
+    def bwd(res, dce):
+        hs2, w, y2, logits16, lse = res
+        s = jnp.where(y2 >= 0, dce, 0.0).astype(jnp.float32)
+        p = jnp.exp(logits16.astype(jnp.float32) - lse[:, None])
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+                  == y2[:, None])
+        d16 = ((p - onehot.astype(jnp.float32)) * s[:, None]
+               ).astype(hs2.dtype)
+        dh = d16 @ w.astype(hs2.dtype)
+        dw = jax.lax.dot_general(
+            d16, hs2, (((0,), (0,)), ((), ()))).astype(w.dtype)
+        return dh, dw, None
+
+    ce_rows.defvjp(fwd, bwd)
+
+    def loss(hs, ys, w):
+        b, s1, hd = hs.shape
+        ce = ce_rows(hs.reshape(b * s1, hd), w, ys.reshape(-1))
+        valid = (ys.reshape(-1) >= 0).astype(jnp.float32)
+        return jnp.sum(ce) / jnp.maximum(jnp.sum(valid), 1.0)
+    return loss
+
+
+def kernel_loss(bn, bv):
+    def loss(hs, ys, w):
+        b, s1, hd = hs.shape
+        ce = fused_linear_ce(hs.reshape(b * s1, hd), w,
+                             ys.reshape(b * s1), True, bn, bv)
+        valid = (ys.reshape(-1) >= 0).astype(jnp.float32)
+        return jnp.sum(ce) / jnp.maximum(jnp.sum(valid), 1.0)
+    return loss
+
+
+def bench(loss_fn, hs, ys, w):
+    g = jax.value_and_grad(loss_fn, argnums=(0, 2))
+
+    def prog(hs, ys, w):
+        def f(carry, _):
+            h_c, w_c = carry
+            val, (dh, dw) = g(h_c, ys, w_c)
+            return (h_c + dh.astype(h_c.dtype) * 1e-6,
+                    w_c + dw.astype(w_c.dtype) * 1e-6), val
+        (_, _), vals = jax.lax.scan(f, (hs, w), None, length=ITERS)
+        return vals[-1]
+
+    fn = jax.jit(prog)
+    out = fn(hs, ys, w)
+    float(out)
+    t0 = time.perf_counter()
+    out = fn(hs, ys, w)
+    v = float(out)
+    return (time.perf_counter() - t0) / ITERS, v
+
+
+def main():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(V, H) * 0.02, jnp.bfloat16)
+    for tag, b, s1 in (("b16-s1024", 16, 1023), ("b32-s1024", 32, 1023),
+                      ("b16-s2048", 16, 2047)):
+        hs = jnp.asarray(rng.randn(b, s1, H), jnp.bfloat16)
+        ys = jnp.asarray(rng.randint(0, V, (b, s1)), jnp.int32)
+        print(tag)
+        fits = b * s1 * V * 4 <= 4 << 30
+        if fits:
+            t, v = bench(save_logits_loss, hs, ys, w)
+            print(f"  save-logits      : {t*1e3:7.2f} ms (loss {v:.4f})")
+        t, v = bench(remat_chunk_loss(max(8192 // b, 128)), hs, ys, w)
+        print(f"  remat-chunk      : {t*1e3:7.2f} ms (loss {v:.4f})")
+        t, v = bench(make_bf16_residual_loss(), hs, ys, w)
+        print(f"  bf16-residual    : {t*1e3:7.2f} ms (loss {v:.4f})")
+        for bn, bv in ((512, 1024),):
+            try:
+                t, v = bench(kernel_loss(bn, bv), hs, ys, w)
+                print(f"  kernel {bn:4d}/{bv:<4d} : {t*1e3:7.2f} ms "
+                      f"(loss {v:.4f})")
+            except Exception as e:  # noqa: BLE001
+                print(f"  kernel {bn}/{bv} FAILED {type(e).__name__}: "
+                      f"{str(e)[:100]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,316 @@
+"""Fused LM-head + cross-entropy as Pallas TPU kernels.
+
+The LM loss needs softmax statistics of `h @ W` over a huge vocab axis;
+materializing the [N, V] logits (fp32) is a multi-GB HBM round-trip that
+dominates the loss-head cost (BASELINE.md r4 loss-head attack: 35-41 ms
+measured vs ~19 ms matmul ideal at b16-s1024/gpt2). These kernels stream
+vocab tiles through VMEM with an online max/sumexp — the flash-attention
+trick applied to the classifier head — so the logits never exist in HBM:
+
+  forward:  per n-block, scan v-blocks; keep running row max `m`,
+            normalizer `l`, and the gold logit picked up in whichever
+            v-block holds the label. Emits lse [N] and gold [N].
+  backward: two passes recompute the logits tile and its softmax from
+            the saved lse (no O(N*V) residuals), exactly like the
+            flash dq/dkv split:
+              dh kernel (grid n-major): dh += (p - onehot)*s @ W-tile
+              dW kernel (grid v-major): dW-tile += h^T @ (p - onehot)*s
+
+Reference analog: the reference fuses softmax+CE on GPU
+(paddle/phi/kernels/gpu/cross_entropy_kernel.cu) and model-parallel
+vocab CE (c_softmax_with_cross_entropy_op.cu); on TPU the win is not
+kernel launch overhead but HBM traffic, so the fusion includes the
+matmul itself.
+
+Public entry: `fused_linear_ce(h, w, y, w_layout)` -> per-row CE [N]
+fp32 (0 where y < 0), differentiable wrt h and w.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_N = 512
+DEFAULT_BLOCK_V = 1024
+_NEG_INF = -1e30
+_STAT_LANES = 8  # lse/gold stored 8 lanes wide (min sublane tile), the
+                 # same HBM-stat trick as flash_attention._LSE_LANES
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dot_hw(h, w, vocab_major):
+    """h [bn, H] @ w-tile -> [bn, bv] fp32. w-tile is [bv, H] when the
+    weight is vocab-major ([V, H], tied embedding) else [H, bv]."""
+    dims = (((1,), (1,)), ((), ())) if vocab_major else (((1,), (0,)), ((), ()))
+    return jax.lax.dot_general(h, w, dims,
+                               preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(h_ref, w_ref, y_ref, lse_ref, gold_ref,
+                m_scr, l_scr, g_scr, *, vocab, vocab_major,
+                block_v, num_vblocks):
+    iv = pl.program_id(1)
+
+    @pl.when(iv == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        g_scr[:] = jnp.zeros_like(g_scr)
+
+    h = h_ref[...]
+    w = w_ref[...]
+    logits = _dot_hw(h, w, vocab_major)              # [bn, bv]
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) \
+        + iv * block_v
+    logits = jnp.where(cols < vocab, logits, _NEG_INF)  # mask pad vocab
+
+    y = y_ref[:, 0:1]                                # [bn, 1]
+    m_prev = m_scr[:, 0:1]
+    m_cur = jnp.max(logits, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(logits - m_new)
+    l_scr[:] = jnp.broadcast_to(
+        l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True),
+        l_scr.shape)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    # gold logit: picked up when this v-block holds the label
+    hit = (cols == y)                                # [bn, bv]
+    g_scr[:] = g_scr[:] + jnp.broadcast_to(
+        jnp.sum(jnp.where(hit, logits, 0.0), axis=1, keepdims=True),
+        g_scr.shape)
+
+    @pl.when(iv == num_vblocks - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        lse = m_scr[:, 0:1] + jnp.log(l_safe)
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+        gold_ref[...] = jnp.broadcast_to(g_scr[:, 0:1], gold_ref.shape)
+
+
+def _fwd(h, w, y, vocab_major, block_n, block_v):
+    n, hd = h.shape
+    vocab = w.shape[0] if vocab_major else w.shape[1]
+    bn = min(block_n, n)
+    bv = min(block_v, vocab)
+    n_pad = (-n) % bn
+    v_pad = (-vocab) % bv
+    if n_pad:
+        h = jnp.pad(h, ((0, n_pad), (0, 0)))
+        y = jnp.pad(y, (0, n_pad), constant_values=-1)
+    if v_pad:
+        pad_spec = ((0, v_pad), (0, 0)) if vocab_major else ((0, 0), (0, v_pad))
+        w = jnp.pad(w, pad_spec)
+    np_, vp = n + n_pad, vocab + v_pad
+    nb, nv = np_ // bn, vp // bv
+    y2 = jnp.broadcast_to(y[:, None], (np_, _STAT_LANES)).astype(jnp.int32)
+
+    w_spec = pl.BlockSpec((bv, hd), lambda i, j: (j, 0)) if vocab_major \
+        else pl.BlockSpec((hd, bv), lambda i, j: (0, j))
+    lse, gold = pl.pallas_call(
+        functools.partial(_fwd_kernel, vocab=vocab,
+                          vocab_major=vocab_major, block_v=bv,
+                          num_vblocks=nv),
+        grid=(nb, nv),
+        in_specs=[
+            pl.BlockSpec((bn, hd), lambda i, j: (i, 0)),
+            w_spec,
+            pl.BlockSpec((bn, _STAT_LANES), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, _STAT_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, _STAT_LANES), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((np_, _STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((np_, _STAT_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, 128), jnp.float32),
+            pltpu.VMEM((bn, 128), jnp.float32),
+            pltpu.VMEM((bn, 128), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * np_ * vp * hd,
+            bytes_accessed=np_ * hd * 2 + vp * hd * 2,
+            transcendentals=np_ * vp),
+        interpret=_interpret(),
+    )(h, w, y2)
+    return lse[:n, 0], gold[:n, 0]
+
+
+# --------------------------------------------------------------- backward
+
+def _bwd_dh_kernel(h_ref, w_ref, y_ref, lse_ref, s_ref, dh_ref, dh_scr,
+                   *, vocab, vocab_major, block_v, num_vblocks):
+    iv = pl.program_id(1)
+
+    @pl.when(iv == 0)
+    def _init():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+
+    h = h_ref[...]
+    w = w_ref[...]
+    logits = _dot_hw(h, w, vocab_major)
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) \
+        + iv * block_v
+    logits = jnp.where(cols < vocab, logits, _NEG_INF)
+    lse = lse_ref[:, 0:1]
+    s = s_ref[:, 0:1]                                  # upstream * valid
+    y = y_ref[:, 0:1]
+    p = jnp.exp(logits - lse)
+    d = (p - (cols == y).astype(jnp.float32)) * s      # [bn, bv]
+    # dh += d @ W-tile (contract the vocab axis)
+    wd = w.dtype
+    if vocab_major:   # w [bv, H]
+        acc = jax.lax.dot_general(d.astype(wd), w, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    else:             # w [H, bv]
+        acc = jax.lax.dot_general(d.astype(wd), w, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    dh_scr[:] += acc
+
+    @pl.when(iv == num_vblocks - 1)
+    def _finalize():
+        dh_ref[...] = dh_scr[:].astype(dh_ref.dtype)
+
+
+def _bwd_dw_kernel(h_ref, w_ref, y_ref, lse_ref, s_ref, dw_ref, dw_scr,
+                   *, vocab, vocab_major, block_v, num_nblocks):
+    # grid: (v-block, n-block) — v major so the dW tile accumulates
+    iv = pl.program_id(0)
+    i_n = pl.program_id(1)
+
+    @pl.when(i_n == 0)
+    def _init():
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    h = h_ref[...]
+    w = w_ref[...]
+    logits = _dot_hw(h, w, vocab_major)
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1) \
+        + iv * block_v
+    logits = jnp.where(cols < vocab, logits, _NEG_INF)
+    lse = lse_ref[:, 0:1]
+    s = s_ref[:, 0:1]
+    y = y_ref[:, 0:1]
+    p = jnp.exp(logits - lse)
+    d = (p - (cols == y).astype(jnp.float32)) * s      # [bn, bv]
+    hd_ = h.dtype
+    if vocab_major:   # dW-tile [bv, H] += d^T @ h
+        acc = jax.lax.dot_general(d.astype(hd_), h, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    else:             # dW-tile [H, bv] += h^T @ d
+        acc = jax.lax.dot_general(h, d.astype(hd_), (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    dw_scr[:] += acc
+
+    @pl.when(i_n == num_nblocks - 1)
+    def _finalize():
+        dw_ref[...] = dw_scr[:].astype(dw_ref.dtype)
+
+
+def _bwd(h, w, y, lse, dce, vocab_major, block_n, block_v):
+    n, hd = h.shape
+    vocab = w.shape[0] if vocab_major else w.shape[1]
+    bn = min(block_n, n)
+    bv = min(block_v, vocab)
+    n_pad = (-n) % bn
+    v_pad = (-vocab) % bv
+    valid = (y >= 0)
+    s = jnp.where(valid, dce, 0.0).astype(jnp.float32)
+    if n_pad:
+        h = jnp.pad(h, ((0, n_pad), (0, 0)))
+        y = jnp.pad(y, (0, n_pad), constant_values=-1)
+        lse = jnp.pad(lse, (0, n_pad))
+        s = jnp.pad(s, (0, n_pad))
+    if v_pad:
+        pad_spec = ((0, v_pad), (0, 0)) if vocab_major else ((0, 0), (0, v_pad))
+        w = jnp.pad(w, pad_spec)
+    np_, vp = n + n_pad, vocab + v_pad
+    nb, nv = np_ // bn, vp // bv
+    y2 = jnp.broadcast_to(y[:, None], (np_, _STAT_LANES)).astype(jnp.int32)
+    lse2 = jnp.broadcast_to(lse[:, None], (np_, _STAT_LANES)).astype(jnp.float32)
+    s2 = jnp.broadcast_to(s[:, None], (np_, _STAT_LANES))
+
+    w_spec_n = pl.BlockSpec((bv, hd), lambda i, j: (j, 0)) if vocab_major \
+        else pl.BlockSpec((hd, bv), lambda i, j: (0, j))
+    stat = pl.BlockSpec((bn, _STAT_LANES), lambda i, j: (i, 0))
+    dh = pl.pallas_call(
+        functools.partial(_bwd_dh_kernel, vocab=vocab,
+                          vocab_major=vocab_major, block_v=bv,
+                          num_vblocks=nv),
+        grid=(nb, nv),
+        in_specs=[pl.BlockSpec((bn, hd), lambda i, j: (i, 0)),
+                  w_spec_n, stat, stat, stat],
+        out_specs=pl.BlockSpec((bn, hd), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_, hd), h.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, hd), jnp.float32)],
+        interpret=_interpret(),
+    )(h, w, y2, lse2, s2)
+
+    w_spec_v = pl.BlockSpec((bv, hd), lambda j, i: (j, 0)) if vocab_major \
+        else pl.BlockSpec((hd, bv), lambda j, i: (0, j))
+    stat_v = pl.BlockSpec((bn, _STAT_LANES), lambda j, i: (i, 0))
+    dw_shape = (vp, hd) if vocab_major else (hd, vp)
+    dw_block = (bv, hd) if vocab_major else (hd, bv)
+    dw_index = (lambda j, i: (j, 0)) if vocab_major else (lambda j, i: (0, j))
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, vocab=vocab,
+                          vocab_major=vocab_major, block_v=bv,
+                          num_nblocks=nb),
+        grid=(nv, nb),
+        in_specs=[pl.BlockSpec((bn, hd), lambda j, i: (i, 0)),
+                  w_spec_v, stat_v, stat_v, stat_v],
+        out_specs=pl.BlockSpec(dw_block, dw_index),
+        out_shape=jax.ShapeDtypeStruct(dw_shape, w.dtype),
+        scratch_shapes=[pltpu.VMEM(dw_block, jnp.float32)],
+        interpret=_interpret(),
+    )(h, w, y2, lse2, s2)
+
+    dh = dh[:n]
+    dw = dw[:vocab] if vocab_major else dw[:, :vocab]
+    return dh, dw
+
+
+# ------------------------------------------------------------- public API
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_linear_ce(h, w, y, vocab_major=True,
+                    block_n=DEFAULT_BLOCK_N, block_v=DEFAULT_BLOCK_V):
+    """Per-row cross entropy of `softmax(h @ W)` against labels `y`
+    without materializing the [N, V] logits.
+
+    h: [N, H] activations (bf16/fp32). w: [V, H] when `vocab_major`
+    (tied embedding layout) else [H, V]. y: [N] int labels, < 0 =
+    ignored (returns 0 for that row). Differentiable wrt h and w.
+    """
+    lse, gold = _fwd(h, w, y, vocab_major, block_n, block_v)
+    valid = (y >= 0)
+    return jnp.where(valid, lse - gold, 0.0)
+
+
+def _fwd_rule(h, w, y, vocab_major, block_n, block_v):
+    lse, gold = _fwd(h, w, y, vocab_major, block_n, block_v)
+    valid = (y >= 0)
+    ce = jnp.where(valid, lse - gold, 0.0)
+    return ce, (h, w, y, lse)
+
+
+def _bwd_rule(vocab_major, block_n, block_v, res, dce):
+    h, w, y, lse = res
+    dh, dw = _bwd(h, w, y, lse, dce, vocab_major, block_n, block_v)
+    return dh, dw, None
+
+
+fused_linear_ce.defvjp(_fwd_rule, _bwd_rule)

@@ -306,22 +306,27 @@ class GPTForCausalLM(Layer):
             return jnp.sum((lse - gold) * valid), jnp.sum(valid)
 
         vocab = w.shape[0] if tied else w.shape[-1]
-        logit_bytes = b * s1 * vocab * 4
-        if n_chunks == 1 and logit_bytes <= \
-                self.cfg.lm_loss_save_logits_budget:
+        budget = self.cfg.lm_loss_save_logits_budget
+        if n_chunks == 1 and b * s1 * vocab * 4 <= budget:
             # single chunk within the HBM budget: skip the scan AND the
             # remat — saving the logits for backward beats recomputing
             # the vocab matmul (measured: 35.3 vs 40.8 ms for the
             # b16-s1024 head, experiments/lm_loss_head_probe.py)
             total, count = chunk_ce(hs, ys)
             return total / jnp.maximum(count, 1.0)
-
         pad = n_chunks * chunk - s1
         hs = jnp.pad(hs, ((0, 0), (0, pad), (0, 0)))
         ys = jnp.pad(ys, ((0, 0), (0, pad)), constant_values=-1)
         hs = hs.reshape(b, n_chunks, chunk, hd).transpose(1, 0, 2, 3)
         ys = ys.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
 
+        # NOTE r4: a middle tier (explicit bf16-logit residuals via
+        # custom_vjp — see experiments/fused_ce_probe.py) wins the
+        # isolated head by ~22% at b32/s2048 but LOSES end-to-end
+        # (b32 MFU 0.468 -> 0.440, s2048 0.452 -> 0.428): the ~3.3 GB
+        # of residuals resident across the trunk backward cost more in
+        # scheduling/spill than the saved vocab-matmul remat. Measured
+        # and reverted — over-budget configs keep the remat scan.
         def body(carry, xs):
             hc, yc = xs
             ssum, cnt = jax.checkpoint(chunk_ce)(hc, yc)

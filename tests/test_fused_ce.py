@@ -1,0 +1,102 @@
+"""Golden tests for the Pallas fused linear+CE kernel
+(paddle_tpu/kernels/fused_ce.py) in interpret mode: forward and both
+operand gradients vs a dense jax reference, both weight layouts,
+ignored labels, block-ragged shapes, and jit.
+
+The kernel is a measured NEGATIVE for the bench configs (BASELINE.md
+r4 loss-head attack: the twice-recomputed vocab matmul in backward
+costs more than the save-logits / remat-scan paths it replaces) but
+stays in-tree as a correct, available op — these tests pin it.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.fused_ce import fused_linear_ce
+
+N, H, V = 70, 32, 150  # deliberately not multiples of the blocks
+
+
+def _dense_ce(h, w_vh, y):
+    logits = h @ w_vh.T
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, jnp.maximum(y, 0)[:, None], axis=-1)[:, 0]
+    return jnp.where(y >= 0, lse - gold, 0.0)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(N, H), jnp.float32)
+    w = jnp.asarray(rng.randn(V, H) * 0.1, jnp.float32)
+    y_np = rng.randint(0, V, (N,))
+    y_np[::7] = -1  # deterministic ignored rows
+    y = jnp.asarray(y_np, jnp.int32)
+    return h, w, y
+
+
+def test_forward_vocab_major(data):
+    h, w, y = data
+    ce = fused_linear_ce(h, w, y, True, 32, 64)
+    np.testing.assert_allclose(np.asarray(ce), np.asarray(_dense_ce(h, w, y)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_hidden_major(data):
+    h, w, y = data
+    ce = fused_linear_ce(h, w.T, y, False, 32, 64)
+    np.testing.assert_allclose(np.asarray(ce), np.asarray(_dense_ce(h, w, y)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ignored_rows_are_zero_and_gradless(data):
+    h, w, y = data
+    ce = fused_linear_ce(h, w, y, True, 32, 64)
+    ignored = np.asarray(y) < 0
+    assert ignored.any()
+    assert np.all(np.asarray(ce)[ignored] == 0.0)
+    dh = jax.grad(lambda h: jnp.sum(
+        fused_linear_ce(h, w, y, True, 32, 64)))(h)
+    assert np.all(np.asarray(dh)[ignored] == 0.0)
+
+
+def test_grads_match_dense_both_layouts(data):
+    h, w, y = data
+    rng = np.random.RandomState(1)
+    wvec = jnp.asarray(rng.rand(N), jnp.float32)  # non-trivial cotangent
+
+    gd = jax.grad(lambda h, w: jnp.sum(_dense_ce(h, w, y) * wvec),
+                  argnums=(0, 1))(h, w)
+    gk = jax.grad(lambda h, w: jnp.sum(
+        fused_linear_ce(h, w, y, True, 32, 64) * wvec),
+        argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(gk[0]), np.asarray(gd[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk[1]), np.asarray(gd[1]),
+                               rtol=1e-4, atol=1e-5)
+
+    gk2 = jax.grad(lambda h, wt: jnp.sum(
+        fused_linear_ce(h, wt, y, False, 32, 64) * wvec),
+        argnums=(0, 1))(h, w.T)
+    np.testing.assert_allclose(np.asarray(gk2[0]), np.asarray(gd[0]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk2[1]), np.asarray(gd[1].T),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_jit_and_mean_loss(data):
+    h, w, y = data
+
+    @jax.jit
+    def mean_ce(h, w, y):
+        ce = fused_linear_ce(h, w, y, True, 32, 64)
+        valid = (y >= 0).astype(jnp.float32)
+        return jnp.sum(ce) / jnp.maximum(jnp.sum(valid), 1.0)
+
+    got = float(mean_ce(h, w, y))
+    valid = np.asarray(y) >= 0
+    want = float(np.asarray(_dense_ce(h, w, y))[valid].mean())
+    assert abs(got - want) < 1e-5

@@ -205,6 +205,50 @@ def test_fused_lm_loss_head_gradient_matches_plain(chunk):
     np.testing.assert_allclose(g_fused, g_plain, rtol=1e-3, atol=1e-5)
 
 
+def test_fused_lm_loss_budget_override_forces_remat(monkeypatch):
+    """The save-logits budget gate must actually steer the path: an
+    over-budget config takes the remat scan (jax.checkpoint fires), an
+    in-budget one takes the fast path (no checkpoint) — and both match
+    the plain path numerically (loss AND head gradient)."""
+    import jax as _jax
+    from paddle_tpu.models.gpt import gpt
+    ids = np.random.RandomState(0).randint(0, 512, (2, 16)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(ids.astype(np.int64))
+
+    ckpt_calls = []
+    orig_ckpt = _jax.checkpoint
+
+    def spy(fn, *a, **kw):
+        ckpt_calls.append(fn)
+        return orig_ckpt(fn, *a, **kw)
+
+    monkeypatch.setattr(_jax, "checkpoint", spy)
+
+    def run(fused, **kw):
+        paddle.seed(0)
+        m = gpt("test-tiny", fused_lm_loss=fused, **kw)
+        m.eval()
+        loss = m.loss(m(x), y)
+        loss.backward()
+        return float(loss), np.asarray(m.gpt.embed.wte.weight.grad.numpy())
+
+    l_plain, g_plain = run(False)
+
+    ckpt_calls.clear()
+    l_gated, g_gated = run(True, lm_loss_chunk=16,
+                           lm_loss_save_logits_budget=1)
+    assert ckpt_calls, "over-budget config must take the remat scan"
+    assert abs(l_plain - l_gated) < 2e-3, (l_plain, l_gated)
+    np.testing.assert_allclose(g_gated, g_plain, rtol=1e-3, atol=1e-5)
+
+    ckpt_calls.clear()
+    l_fast, g_fast = run(True, lm_loss_chunk=16)  # default budget: fits
+    assert not ckpt_calls, "in-budget config must skip the remat scan"
+    assert abs(l_plain - l_fast) < 2e-3, (l_plain, l_fast)
+    np.testing.assert_allclose(g_fast, g_plain, rtol=1e-3, atol=1e-5)
+
+
 def test_fused_lm_loss_pipeline_loss_fn_still_works():
     # gpt_pipe builds loss_fn with self=None; the fused branch must not
     # dereference cfg on None
