@@ -1,0 +1,77 @@
+"""Decompose the ERNIE-Base b32 s512 train step (the north-star config):
+fwd vs fwd+bwd vs full step, 12- vs 6-layer variants, and flash on/off.
+
+Usage: python experiments/ernie_step_breakdown.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.jit.api import functional_call, _wrap, _unwrap
+from paddle_tpu.models.ernie import ernie
+
+BATCH, SEQ, ITERS = 32, 512, 20
+
+
+def time_fn(fn, *args):
+    out = fn(*args)
+    loss = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(loss, dtype=np.float32).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    loss = jax.tree_util.tree_leaves(out)[0]
+    float(np.asarray(loss, dtype=np.float32).ravel()[0])
+    return (time.perf_counter() - t0) / ITERS
+
+
+def main():
+    rng = np.random.RandomState(0)
+    for layers in (12, 6):
+        paddle.seed(0)
+        model = ernie("ernie-3.0-base", fused_mlm_loss=True,
+                      max_predictions=max(int(SEQ * 0.19), 8),
+                      num_layers=layers)
+        model.bfloat16()
+        names = [n for n, _ in model.named_parameters()]
+        pvals = [p._data for _, p in model.named_parameters()]
+
+        ids = rng.randint(0, model.cfg.vocab_size,
+                          (BATCH, SEQ)).astype(np.int32)
+        mlm = ids.astype(np.int64)
+        mlm[rng.rand(*mlm.shape) > 0.15] = -100
+        sop = rng.randint(0, 2, (BATCH,)).astype(np.int64)
+
+        def loss_of(plist, x, y1, y2):
+            pdict = dict(zip(names, plist))
+            out = functional_call(model, pdict, _wrap(x))
+            return _unwrap(model.loss(out, (_wrap(y1), _wrap(y2))))
+
+        fwd = jax.jit(loss_of)
+        t_fwd = time_fn(fwd, pvals, ids, mlm, sop)
+        grad_fn = jax.jit(jax.value_and_grad(loss_of))
+        t_grad = time_fn(grad_fn, pvals, ids, mlm, sop)
+
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        step = paddle.jit.TrainStep(
+            model, opt, lambda out, lab: model.loss(out, lab))
+        x = paddle.to_tensor(ids)
+        y = (paddle.to_tensor(mlm), paddle.to_tensor(sop))
+        t_step = time_fn(step, x, y)
+        print(f"layers={layers:2d}: fwd {t_fwd*1e3:7.2f} | fwd+bwd "
+              f"{t_grad*1e3:7.2f} | full step {t_step*1e3:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -133,7 +133,8 @@ from .nn.functional.common import (pixel_shuffle,  # noqa: F401,E402
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
 _LAZY = {"audio", "callbacks", "distributed", "distribution", "fft",
-         "geometric", "linalg", "version",
+         "geometric", "hub", "linalg", "regularizer", "sysconfig",
+         "version",
          "models", "vision", "kernels", "hapi", "onnx", "profiler",
          "incubate", "inference", "quantization", "signal", "sparse",
          "static", "text", "utils"}

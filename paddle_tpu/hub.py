@@ -1,0 +1,89 @@
+"""Model hub: load entrypoints from a repo's `hubconf.py` (reference:
+python/paddle/hapi/hub.py — list/help/load over github/gitee/local
+sources; `import paddle; paddle.hub.load(...)`).
+
+The local source is fully supported (a directory containing
+`hubconf.py` whose public callables are the entrypoints, with an
+optional `dependencies` list). The github/gitee sources require
+network egress and archive download; in this environment they are
+gated with a clear error (the same policy as the dataset downloads) —
+point `source='local'` at a checkout instead.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_MODULE_HUBCONF = "hubconf.py"
+_VAR_DEPENDENCY = "dependencies"
+
+
+def _import_hubconf(repo_dir: str):
+    repo_dir = os.path.expanduser(repo_dir)
+    path = os.path.join(repo_dir, _MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {_MODULE_HUBCONF} found under '{repo_dir}'")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(module, _VAR_DEPENDENCY, None)
+    if deps:
+        def _exists(name):
+            try:  # find_spec raises for dotted names w/ missing parent
+                return importlib.util.find_spec(name) is not None
+            except ModuleNotFoundError:
+                return False
+        missing = [d for d in deps if not _exists(d)]
+        if missing:
+            raise RuntimeError(
+                "Missing dependencies: " + ", ".join(missing))
+    return module
+
+
+def _resolve(repo_dir: str, source: str, force_reload: bool):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: '
+            '"github" | "gitee" | "local".')
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"paddle_tpu.hub: source='{source}' needs network egress to "
+            "download the repo archive, which is unavailable here; clone "
+            "the repo and use source='local' with its path instead")
+    return _import_hubconf(repo_dir)
+
+
+def list(repo_dir: str, source: str = "github", force_reload: bool = False):
+    """List all entrypoints (public callables) in the repo's hubconf."""
+    module = _resolve(repo_dir, source, force_reload)
+    return [f for f in dir(module)
+            if callable(getattr(module, f)) and not f.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False):
+    """Return the docstring of entrypoint `model`."""
+    module = _resolve(repo_dir, source, force_reload)
+    if not hasattr(module, model) or not callable(getattr(module, model)):
+        raise RuntimeError(f"Cannot find callable entrypoint '{model}' "
+                           f"in {_MODULE_HUBCONF}")
+    return getattr(module, model).__doc__
+
+
+def load(repo_dir: str, model: str, *args, source: str = "github",
+         force_reload: bool = False, **kwargs):
+    """Call entrypoint `model` from the repo's hubconf with args/kwargs
+    and return its result (typically a constructed Layer)."""
+    module = _resolve(repo_dir, source, force_reload)
+    if not hasattr(module, model) or not callable(getattr(module, model)):
+        raise RuntimeError(f"Cannot find callable entrypoint '{model}' "
+                           f"in {_MODULE_HUBCONF}")
+    return getattr(module, model)(*args, **kwargs)

@@ -96,7 +96,15 @@ class Layer:
             initializer = (init_mod.Constant(0.0) if is_bias
                            else init_mod.XavierNormal())
         data = initializer(shape, dtype)
-        return Parameter(data, dtype=dtype, trainable=trainable)
+        p = Parameter(data, dtype=dtype, trainable=trainable)
+        # per-parameter weight-decay override (reference: ParamAttr
+        # regularizer takes precedence over the optimizer-level one);
+        # consumed by Optimizer._apply_decay
+        reg = getattr(attr, "regularizer", None) if attr is not None \
+            and attr is not False else None
+        if reg is not None:
+            p.regularizer = reg
+        return p
 
     # ------------------------------------------------------------ traversal
     def parameters(self, include_sublayers: bool = True) -> List[Parameter]:

@@ -109,9 +109,8 @@ class Optimizer:
         for p, g in live:
             garr = g.data.astype(p.data.dtype) if g.data.dtype != p.data.dtype \
                 else g.data
-            if isinstance(self._weight_decay, float) and \
-                    self._weight_decay and not self._decoupled_decay():
-                garr = garr + self._weight_decay * p.data
+            garr = self._apply_decay(garr, p.data,
+                                     getattr(p, "regularizer", None))
             sid = opt_key(p)
             if sid not in self._state:
                 self._state[sid] = self._init_state(p.data.shape,
@@ -125,6 +124,33 @@ class Optimizer:
 
     def _decoupled_decay(self) -> bool:
         return False
+
+    def _apply_decay(self, garr, parr, reg=None):
+        """Fold weight decay into the gradient: a per-parameter
+        regularizer (ParamAttr(regularizer=...)) takes precedence over
+        the optimizer-level weight_decay, matching the reference; a
+        float coeff is classic L2-style coupled decay (skipped by
+        decoupled optimizers, i.e. AdamW); L1Decay/L2Decay objects
+        (paddle_tpu.regularizer) are applied as grad terms the way the
+        reference's regularizer appends them."""
+        if reg is not None:
+            return reg(garr, parr)
+        wd = self._weight_decay
+        if callable(wd) and not isinstance(wd, float):
+            return wd(garr, parr)
+        if isinstance(wd, float) and wd and not self._decoupled_decay():
+            return garr + wd * parr
+        return garr
+
+    def _param_regularizers(self, n=None):
+        """Positional per-param regularizer list for the functional
+        update path (leaves align with _parameter_list order; None
+        when the counts do not match or no list was given)."""
+        plist = self._parameter_list
+        if plist is None or (n is not None and len(plist) != n):
+            return None
+        regs = [getattr(p, "regularizer", None) for p in plist]
+        return regs if any(r is not None for r in regs) else None
 
     def clear_grad(self):
         if self._parameter_list is not None:
@@ -192,15 +218,15 @@ class Optimizer:
             grads_tree, is_leaf=lambda x: isinstance(x, Tensor))
         s_leaves = jax.tree_util.tree_leaves(
             state_tree, is_leaf=lambda x: isinstance(x, dict))
+        regs = self._param_regularizers(len(p_leaves))
         new_p, new_s = [], []
-        for p, g, s in zip(p_leaves, g_leaves, s_leaves):
+        for i, (p, g, s) in enumerate(zip(p_leaves, g_leaves, s_leaves)):
             parr = p.data if isinstance(p, Tensor) else p
             garr = g.data if isinstance(g, Tensor) else g
             if garr.dtype != parr.dtype:
                 garr = garr.astype(parr.dtype)
-            if isinstance(self._weight_decay, float) and \
-                    self._weight_decay and not self._decoupled_decay():
-                garr = garr + self._weight_decay * parr
+            garr = self._apply_decay(garr, parr,
+                                     regs[i] if regs else None)
             np_, ns_ = self._update(parr, garr, s, lr, step)
             new_p.append(np_)
             new_s.append(ns_)

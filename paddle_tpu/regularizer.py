@@ -1,0 +1,52 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py:20,82
+— L1Decay/L2Decay objects passed as `weight_decay=` to optimizers or per
+parameter via ParamAttr in the reference).
+
+TPU-native semantics: a regularizer is a pure function folded into the
+gradient inside the (jitted or eager) update — `grad + coeff * sign(p)`
+for L1, `grad + coeff * p` for L2 — so XLA fuses it into the optimizer
+kernel; there is no separate "append regularization op" pass like the
+reference's static-graph regularizer appending.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    """loss += coeff * sum(|p|)  ⇒  grad += coeff * sign(p)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __call__(self, grad, param):
+        return grad + jnp.asarray(self._coeff, grad.dtype) * jnp.sign(
+            param).astype(grad.dtype)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._coeff})"
+
+
+class L2Decay:
+    """loss += coeff/2 * sum(p^2)  ⇒  grad += coeff * p (the reference's
+    L2DecayRegularizer convention: the appended gradient is coeff*p)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __call__(self, grad, param):
+        return grad + jnp.asarray(self._coeff, grad.dtype) * param.astype(
+            grad.dtype)
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._coeff})"

@@ -1,0 +1,24 @@
+"""Paths for building extensions against the framework (reference:
+python/paddle/sysconfig.py:20,38 — get_include/get_lib point at the
+shipped headers and libpaddle; here they point at the package and its
+native/ directory, which is what utils.cpp_extension compiles against).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the C headers for custom-op/native builds
+    (the C ABI consumed by utils.cpp_extension lives in native/)."""
+    return os.path.join(_PKG_DIR, "native")
+
+
+def get_lib() -> str:
+    """Directory containing compiled native libraries (populated by the
+    lazy builds in paddle_tpu.native / utils.cpp_extension)."""
+    return os.path.join(_PKG_DIR, "native")
