@@ -1,0 +1,100 @@
+"""MNIST LeNet — the minimum end-to-end slice (BASELINE config #1).
+
+Shows the two training styles side by side:
+  1. eager dygraph: forward / loss.backward() / opt.step()
+  2. paddle.jit.TrainStep: the whole step as ONE compiled XLA program
+
+Usage: python examples/mnist_lenet.py [--smoke]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def lenet(num_classes=10):
+    from paddle_tpu import nn
+    return nn.Sequential(
+        nn.Conv2D(1, 6, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(),
+        nn.Linear(400, 120), nn.ReLU(),
+        nn.Linear(120, 84), nn.ReLU(),
+        nn.Linear(84, num_classes))
+
+
+def synthetic_mnist(n):
+    """Separable synthetic digits (class-dependent blob position) so the
+    example converges without downloading MNIST."""
+    rng = np.random.RandomState(42)
+    labels = rng.randint(0, 10, n)
+    imgs = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i, lab in enumerate(labels):
+        imgs[i, 0, 2 + 2 * (lab // 5): 10 + 2 * (lab // 5),
+             2 + 2 * (lab % 5): 10 + 2 * (lab % 5)] += 1.0
+    return imgs, labels.astype(np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny/CPU-fast run")
+    args = ap.parse_args()
+    if args.smoke:  # force CPU before any jax backend init (hermetic)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.io import DataLoader, TensorDataset
+    from paddle_tpu.nn import functional as F
+    n, epochs = (128, 2) if args.smoke else (4096, 3)
+
+    paddle.seed(0)
+    imgs, labels = synthetic_mnist(n)
+    loader = DataLoader(TensorDataset([imgs, labels]), batch_size=32,
+                        shuffle=True)
+
+    # ---- style 1: eager dygraph loop
+    model = lenet()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    first = last = None
+    for _ in range(epochs):
+        for x, y in loader:
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = float(loss) if first is None else first
+            last = float(loss)
+    print(f"eager:     loss {first:.3f} -> {last:.3f}")
+    assert last < first
+
+    # ---- style 2: one compiled train step (the performance path)
+    paddle.seed(0)
+    model = lenet()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt, F.cross_entropy)
+    first = last = None
+    for _ in range(epochs):
+        for x, y in loader:
+            loss = step(x, y)
+            first = float(loss) if first is None else first
+            last = float(loss)
+    print(f"TrainStep: loss {first:.3f} -> {last:.3f}")
+    assert last < first
+
+    # checkpoint round-trip
+    paddle.save(model.state_dict(), "/tmp/lenet.pdparams")
+    model2 = lenet()
+    model2.set_state_dict(paddle.load("/tmp/lenet.pdparams"))
+    x, y = next(iter(loader))
+    a, b = float(F.cross_entropy(model(x), y)), \
+        float(F.cross_entropy(model2(x), y))
+    assert abs(a - b) < 1e-5
+    print("checkpoint round-trip ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""ResNet-50 training step — the conv/vision path (BASELINE config #2:
+2082 img/s at the memory roofline on one v5e). NHWC trunk, bf16 with
+fp32-master Momentum + L2 weight decay, space-to-depth stem.
+
+Usage: python examples/resnet_train.py [--smoke] [--batch 128]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    if args.smoke:  # force CPU before any jax backend init (hermetic)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.nn import functional as F
+
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.smoke:
+        from paddle_tpu.models.resnet import resnet18
+        batch, hw, steps = 4, 32, 2
+        model = resnet18(num_classes=10)
+    else:
+        from paddle_tpu.models.resnet import resnet50
+        batch, hw, steps = args.batch, 224, args.steps
+        model = resnet50(data_format="NHWC", stem_space_to_depth=True)
+    paddle.seed(0)
+    if on_tpu and not args.smoke:
+        model.bfloat16()
+
+    opt = optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9,
+        parameters=model.parameters(),
+        weight_decay=1e-4, multi_precision=on_tpu)
+    step = paddle.jit.TrainStep(
+        model, opt,
+        lambda logits, lab: F.cross_entropy(logits.astype("float32"), lab))
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(batch, 3, hw, hw).astype(np.float32)
+    labels = rng.randint(0, 10 if args.smoke else 1000,
+                         (batch,)).astype(np.int64)
+    x = paddle.to_tensor(imgs)
+    if on_tpu and not args.smoke:
+        x = x.astype("bfloat16")  # bf16 model wants bf16 activations
+    y = paddle.to_tensor(labels)
+
+    loss = step(x, y)
+    print(f"step 0 loss {float(loss):.3f} (compiled)")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    print(f"loss {final:.3f} | {batch * steps / dt:,.0f} images/sec "
+          f"({dt / steps * 1e3:.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()

@@ -87,9 +87,11 @@ class Layer:
         dtype = dtype or self._dtype
         initializer = None
         trainable = True
+        reg = None
         if attr is not None and attr is not False:
             initializer = getattr(attr, "initializer", None)
             trainable = getattr(attr, "trainable", True)
+            reg = getattr(attr, "regularizer", None)
         if initializer is None:
             initializer = default_initializer
         if initializer is None:
@@ -100,8 +102,6 @@ class Layer:
         # per-parameter weight-decay override (reference: ParamAttr
         # regularizer takes precedence over the optimizer-level one);
         # consumed by Optimizer._apply_decay
-        reg = getattr(attr, "regularizer", None) if attr is not None \
-            and attr is not False else None
         if reg is not None:
             p.regularizer = reg
         return p

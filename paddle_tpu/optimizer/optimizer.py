@@ -144,13 +144,25 @@ class Optimizer:
 
     def _param_regularizers(self, n=None):
         """Positional per-param regularizer list for the functional
-        update path (leaves align with _parameter_list order; None
-        when the counts do not match or no list was given)."""
+        update path (leaves align with _parameter_list order). Returns
+        None when no parameter carries a regularizer. Raises when
+        regularizers exist but the leaf count differs from
+        _parameter_list — silently dropping them would make the jitted
+        path train differently from eager opt.step()."""
         plist = self._parameter_list
-        if plist is None or (n is not None and len(plist) != n):
+        if plist is None:
             return None
         regs = [getattr(p, "regularizer", None) for p in plist]
-        return regs if any(r is not None for r in regs) else None
+        if not any(r is not None for r in regs):
+            return None
+        if n is not None and len(plist) != n:
+            raise ValueError(
+                f"per-parameter regularizers are set but the functional "
+                f"update received {n} params vs the optimizer's "
+                f"{len(plist)} — construct the optimizer with the same "
+                f"parameter list the train step uses (e.g. "
+                f"model.parameters()) so they can be matched")
+        return regs
 
     def clear_grad(self):
         if self._parameter_list is not None:

@@ -1,0 +1,28 @@
+"""Every script in examples/ must run end-to-end with --smoke (tiny
+CPU-fast settings). Mirrors the reference's book/e2e tests
+(python/paddle/fluid/tests/book/) which keep the documented user
+journeys executable.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = os.path.join(_ROOT, "examples")
+
+SCRIPTS = [f for f in sorted(os.listdir(_EXAMPLES)) if f.endswith(".py")]
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_example_smoke(script):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, script), "--smoke"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=_ROOT)
+    assert proc.returncode == 0, (
+        f"{script} failed\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}")

@@ -92,6 +92,20 @@ def hub_repo(tmp_path):
     return str(tmp_path)
 
 
+def test_param_regularizer_count_mismatch_raises():
+    """If parameters carry regularizers but the functional update gets a
+    different leaf count, the optimizer must raise instead of silently
+    skipping them (jitted path would otherwise diverge from eager)."""
+    from paddle_tpu.nn.initializer import ParamAttr
+
+    paddle.seed(0)
+    m = nn.Linear(4, 4, weight_attr=ParamAttr(regularizer=L2Decay(0.1)))
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=[m.weight])  # bias excluded
+    with pytest.raises(ValueError, match="per-parameter regularizers"):
+        opt._param_regularizers(2)
+
+
 def test_hub_list_help_load_local(hub_repo):
     names = paddle.hub.list(hub_repo, source="local")
     assert "tiny_mlp" in names and "_private_helper" not in names
