@@ -1,0 +1,84 @@
+"""How much of the GPT-2 trunk's 8.3 ms/layer is attention? Time the
+full 12-layer step against a variant whose scaled_dot_product_attention
+is replaced by an identity (same shapes, no attention math) — the
+difference is the true end-to-end attention cost incl. its backward.
+
+Usage: python experiments/attention_share_probe.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.models.gpt import gpt
+
+BATCH, SEQ, ITERS = 16, 1024, 20
+
+
+def time_step(step, x, y):
+    loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step(x, y)
+    float(loss)
+    return (time.perf_counter() - t0) / ITERS
+
+
+def build_step():
+    paddle.seed(0)
+    model = gpt("gpt2-small", max_position_embeddings=SEQ,
+                fused_lm_loss=True, lm_loss_chunk=SEQ)
+    model.bfloat16()
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=True)
+    return paddle.jit.TrainStep(
+        model, opt, lambda out, labels: model.loss(out, labels)), model
+
+
+def main():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50257, (BATCH, SEQ)).astype(np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(ids.astype(np.int64))
+
+    step, _ = build_step()
+    t_full = time_step(step, x, y)
+
+    import paddle_tpu.nn.functional.attention as attn_mod
+
+    def identity_sdpa(query, key, value, attn_mask=None, dropout_p=0.0,
+                      is_causal=False, training=True, scale=None,
+                      dropout_rng=None):
+        return query + 0.0 * (key + value)  # keep all grads flowing
+
+    saved = attn_mod.scaled_dot_product_attention
+    attn_mod.scaled_dot_product_attention = identity_sdpa
+    # the models call F.scaled_dot_product_attention — rebind there too
+    import paddle_tpu.nn.functional as F
+    saved_f = F.scaled_dot_product_attention
+    F.scaled_dot_product_attention = identity_sdpa
+    try:
+        step2, _ = build_step()
+        t_noattn = time_step(step2, x, y)
+    finally:
+        attn_mod.scaled_dot_product_attention = saved
+        F.scaled_dot_product_attention = saved_f
+
+    print(f"full step        : {t_full * 1e3:7.2f} ms")
+    print(f"identity attention: {t_noattn * 1e3:7.2f} ms")
+    print(f"attention share  : {(t_full - t_noattn) * 1e3:7.2f} ms "
+          f"({(t_full - t_noattn) / 12 * 1e3:5.2f} ms/layer)")
+
+
+if __name__ == "__main__":
+    main()

@@ -159,13 +159,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                                   # [bq, bk]
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
                 + iq * block_q + offset
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
                 + ik * block_k
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                   # [bq, bk]
+            # explicit zero: fully-masked rows carry lse = _NEG_INF, so
+            # exp(masked_s - lse) = 1 would inject phantom gradients
+            p = jnp.where(rows >= cols, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                # [bq, bk]
@@ -202,13 +204,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale        # [bq, bk]
+        p = jnp.exp(s - lse)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
                 + iq * block_q + offset
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
                 + ik * block_k
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+            # explicit zero: fully-masked rows carry lse = _NEG_INF, so
+            # exp(masked_s - lse) = 1 would inject phantom gradients
+            p = jnp.where(rows >= cols, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                # [bk, D]
@@ -226,6 +230,99 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
+                      causal, offset, block_q, num_qblocks):
+    """Single-k-block backward: the whole K/V stays resident, so s, p,
+    dp, ds are computed ONCE and all three grads come out of the same
+    pass — 5 matmuls + 1 exp pass vs the split kernels' 7 + 2. Engaged
+    when sk <= _FUSED_BWD_MAX_SK and head_dim <= 128 (the flagship
+    s1024 / ERNIE / BERT s512 / long-seq s2048-4096 configs); measured
+    end-to-end in BASELINE.md r4."""
+    iq = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q, k, v = q_ref[0], k_ref[0], v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][:, 0:1]
+    delta = delta_ref[0][:, 0:1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [bq, sk]
+    p = jnp.exp(s - lse)                                     # ONE exp pass
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+            + iq * block_q + offset
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # explicit zero (NOT exp of masked s): a fully-masked row has
+        # lse = _NEG_INF from the forward, so exp(s - lse) would be
+        # exp(0) = 1 on its masked entries — phantom gradients
+        p = jnp.where(rows >= cols, p, 0.0)
+    dv_scr[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [sk, D]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [bq, sk]
+    ds = p * (dp - delta) * scale
+    dq_ref[0] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_scr[:] += jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [sk, D]
+
+    @pl.when(iq == num_qblocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+_FUSED_BWD_MAX_SK = 4096  # whole-K resident limit: [bq, sk] fp32
+# score/softmax/grad tiles bound VMEM, so bq shrinks as sk grows
+# (sk<=1024 -> bq 512, sk<=2048 -> bq 256; ~3x2 MB tiles either way)
+
+
+def _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(sq, 512 if sk <= 1024 else (256 if sk <= 2048 else 128))
+    nq = sq // bq
+    stat = pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i: (b, i, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          offset=sk - sq, block_q=bq, num_qblocks=nq),
+        grid=(bh, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # k (whole)
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # do
+            stat, stat,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sk, d), jnp.float32),
+            pltpu.VMEM((sk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+    return dq, dk, dv
+
+
 def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -237,6 +334,13 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
                     axis=-1)                                    # [bh, sq]
     delta_b = jnp.broadcast_to(delta[:, :, None], (bh, sq, _LSE_LANES))
     lse_b = lse  # already [bh, sq, _LSE_LANES] from the forward
+
+    # fused single-pass backward: whole K/V + [bq, sk] fp32 score tiles
+    # + sk*d fp32 dk/dv scratch must fit VMEM — bounded by capping sk
+    # and head_dim (d=256 at s4096 would need ~20 MB; the tiled split
+    # path below stays the fallback there and beyond _FUSED_BWD_MAX_SK)
+    if sk <= _FUSED_BWD_MAX_SK and d <= 128:
+        return _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal)
 
     row_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),      # q
