@@ -148,7 +148,7 @@ def test_cost_model_calibration():
             (r["name"], pred, r["measured_s"])
     me, he, worst = calibrate(rows)
     assert worst < 0.30
-    assert abs(me - 0.39) < 0.05 and abs(he - 0.90) < 0.1, (me, he)
+    assert abs(me - 0.41) < 0.05 and abs(he - 0.91) < 0.1, (me, he)
     gpt_rows = [r for r in rows if r["name"].startswith("gpt2")]
     assert len(gpt_rows) == 3
     _, _, worst_gpt = calibrate(gpt_rows)
@@ -175,7 +175,9 @@ def test_northstar_plan_artifact():
     winner = min(cands, key=lambda r: r["pred_ms"])
     assert winner["dp"] == 256 and winner["sharding"] == 1
     assert winner["pred_scaling_eff"] >= 0.95
-    assert 0.428 * winner["pred_scaling_eff"] >= 0.40  # north-star MFU
+    # measured single-chip MFU (BASELINE.md r4 ERNIE row) x predicted
+    # scaling efficiency must clear the 0.40 north-star target
+    assert 0.457 * winner["pred_scaling_eff"] >= 0.40
     assert winner["pred_ms_2slice"] > winner["pred_ms"]
     # grad all-reduce payload ~ fp32 param bytes (118M params)
     assert 4.0e8 < winner["coll_bytes"] < 8.0e8
