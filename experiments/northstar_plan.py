@@ -98,17 +98,17 @@ def compile_candidate(dp, sharding, n_devices):
 def _measured_anchor() -> float:
     """Single source of truth: the 'ernie-base b32 s512' row of
     experiments/tuner_calibration.json (the same chip run that fit the
-    tuner constants). Falls back to the last recorded value if the
-    artifact is absent."""
+    tuner constants)."""
     import json
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tuner_calibration.json")
-    try:
-        rows = json.load(open(path))["rows"]
-        return [r for r in rows
-                if r["name"] == "ernie-base b32 s512"][0]["measured_s"]
-    except Exception:
-        return 0.10295
+    rows = json.load(open(path))["rows"]
+    hits = [r for r in rows if r["name"] == "ernie-base b32 s512"]
+    if not hits:  # fail loudly — a silent constant would desync the plan
+        raise RuntimeError(
+            "tuner_calibration.json has no 'ernie-base b32 s512' row; "
+            "run 'python experiments/tuner_calibration.py measure' first")
+    return hits[0]["measured_s"]
 
 
 MEASURED_1CHIP_S = _measured_anchor()  # 102.95 ms r4 (was 109.74 r3)

@@ -1,0 +1,83 @@
+"""ViT-L/16 b64: how much of the step is the XLA attention path
+(s197 sits below the flash gate)? Identity-attention ablation, same
+method as attention_share_probe.py.
+
+Usage: python experiments/vit_attention_share.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.nn import functional as F
+
+ITERS = 10
+
+
+def time_step(step, x, y):
+    loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step(x, y)
+    float(loss)
+    return (time.perf_counter() - t0) / ITERS
+
+
+def build_step():
+    from paddle_tpu.models.vit import vit
+    paddle.seed(0)
+    model = vit("vit-l-16", num_classes=1000)
+    model.bfloat16()
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=True)
+    return paddle.jit.TrainStep(
+        model, opt,
+        lambda logits, lab: F.cross_entropy(
+            logits.astype("float32"), lab))
+
+
+def main():
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(64, 3, 224, 224).astype(np.float32)
+    labels = rng.randint(0, 1000, (64,)).astype(np.int64)
+    x = paddle.to_tensor(imgs).astype("bfloat16")
+    y = paddle.to_tensor(labels)
+
+    step = build_step()
+    t_full = time_step(step, x, y)
+
+    import paddle_tpu.nn.functional.attention as attn_mod
+    import paddle_tpu.nn.functional as Fmod
+
+    def identity_sdpa(query, key, value, attn_mask=None, dropout_p=0.0,
+                      is_causal=False, training=True, scale=None,
+                      dropout_rng=None):
+        return query + 0.0 * (key + value)
+
+    saved = attn_mod.scaled_dot_product_attention
+    saved_f = Fmod.scaled_dot_product_attention
+    attn_mod.scaled_dot_product_attention = identity_sdpa
+    Fmod.scaled_dot_product_attention = identity_sdpa
+    try:
+        step2 = build_step()
+        t_noattn = time_step(step2, x, y)
+    finally:
+        attn_mod.scaled_dot_product_attention = saved
+        Fmod.scaled_dot_product_attention = saved_f
+
+    print(f"full step         : {t_full * 1e3:7.2f} ms")
+    print(f"identity attention: {t_noattn * 1e3:7.2f} ms")
+    print(f"attention share   : {(t_full - t_noattn) * 1e3:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,7 @@ def _pick_block(seq: int, preferred: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, offset,
-                block_q, block_k, num_kblocks):
+                block_q, block_k, num_kblocks, kv_len=None):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -74,6 +74,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
                 + ik * block_k
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if kv_len is not None:  # padded keys: mask cols beyond kv_len
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + ik * block_k
+            s = jnp.where(cols < kv_len, s, _NEG_INF)
         m_prev = m_scr[:, 0:1]                      # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)   # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
@@ -95,7 +99,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], _LSE_LANES))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_len=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = _pick_block(sq, block_q)
@@ -104,7 +108,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     grid = (bh, nq, nk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, offset=sk - sq,
-        block_q=bq, block_k=bk, num_kblocks=nk)
+        block_q=bq, block_k=bk, num_kblocks=nk, kv_len=kv_len)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -139,7 +143,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, scale, causal, offset, block_q, block_k,
-                   num_kblocks):
+                   num_kblocks, kv_len=None):
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -168,6 +172,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             # explicit zero: fully-masked rows carry lse = _NEG_INF, so
             # exp(masked_s - lse) = 1 would inject phantom gradients
             p = jnp.where(rows >= cols, p, 0.0)
+        if kv_len is not None:
+            cols = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1) \
+                + ik * block_k
+            p = jnp.where(cols < kv_len, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)                # [bq, bk]
@@ -183,7 +191,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    offset, block_q, block_k, num_qblocks):
+                    offset, block_q, block_k, num_qblocks, kv_len=None):
     ik = pl.program_id(1)
     iq = pl.program_id(2)
 
@@ -213,6 +221,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # explicit zero: fully-masked rows carry lse = _NEG_INF, so
             # exp(masked_s - lse) = 1 would inject phantom gradients
             p = jnp.where(rows >= cols, p, 0.0)
+        if kv_len is not None:
+            cols = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1) \
+                + ik * block_k
+            p = jnp.where(cols < kv_len, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                # [bk, D]
@@ -232,7 +244,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale,
-                      causal, offset, block_q, num_qblocks):
+                      causal, offset, block_q, num_qblocks, kv_len=None):
     """Single-k-block backward: the whole K/V stays resident, so s, p,
     dp, ds are computed ONCE and all three grads come out of the same
     pass — 5 matmuls + 1 exp pass vs the split kernels' 7 + 2. Engaged
@@ -262,6 +274,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # lse = _NEG_INF from the forward, so exp(s - lse) would be
         # exp(0) = 1 on its masked entries — phantom gradients
         p = jnp.where(rows >= cols, p, 0.0)
+    if kv_len is not None:
+        cols = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+        p = jnp.where(cols < kv_len, p, 0.0)
     dv_scr[:] += jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)                  # [sk, D]
@@ -287,7 +302,8 @@ _FUSED_BWD_MAX_SK = 4096  # whole-K resident limit: [bq, sk] fp32
 # (sk<=1024 -> bq 512, sk<=2048 -> bq 256; ~3x2 MB tiles either way)
 
 
-def _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal):
+def _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal,
+                     kv_len=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = _pick_block(sq, 512 if sk <= 1024 else (256 if sk <= 2048 else 128))
@@ -295,7 +311,8 @@ def _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal):
     stat = pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i: (b, i, 0))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          offset=sk - sq, block_q=bq, num_qblocks=nq),
+                          offset=sk - sq, block_q=bq, num_qblocks=nq,
+                          kv_len=kv_len),
         grid=(bh, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),   # q
@@ -323,7 +340,8 @@ def _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal):
     return dq, dk, dv
 
 
-def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+               kv_len=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq = _pick_block(sq, block_q)
@@ -340,7 +358,8 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     # and head_dim (d=256 at s4096 would need ~20 MB; the tiled split
     # path below stays the fallback there and beyond _FUSED_BWD_MAX_SK)
     if sk <= _FUSED_BWD_MAX_SK and d <= 128:
-        return _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal)
+        return _flash_bwd_fused(q, k, v, lse_b, delta_b, do, scale, causal,
+                                kv_len=kv_len)
 
     row_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),      # q
@@ -353,7 +372,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           offset=sk - sq, block_q=bq, block_k=bk,
-                          num_kblocks=nk),
+                          num_kblocks=nk, kv_len=kv_len),
         grid=(bh, nq, nk),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -373,7 +392,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           offset=sk - sq, block_q=bq, block_k=bk,
-                          num_qblocks=nq),
+                          num_qblocks=nq, kv_len=kv_len),
         grid=(bh, nk, nq),
         in_specs=col_specs,
         out_specs=[
@@ -395,21 +414,23 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
 
 # ------------------------------------------------------------- public API
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k, kv_len=None):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        kv_len=kv_len)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_bhsd_fwd(q, k, v, scale, causal, block_q, block_k, kv_len=None):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          kv_len=kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhsd_bwd(scale, causal, block_q, block_k, res, do):
+def _flash_bhsd_bwd(scale, causal, block_q, block_k, kv_len, res, do):
     q, k, v, out, lse = res
     return _flash_bwd(q, k, v, out, lse, do, scale, causal,
-                      block_q, block_k)
+                      block_q, block_k, kv_len=kv_len)
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -436,6 +457,30 @@ def flash_attention(query, key, value, causal=False, scale=None,
     qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
     kt = jnp.swapaxes(key, 1, 2).reshape(b * hq, sk, d)
     vt = jnp.swapaxes(value, 1, 2).reshape(b * hq, sk, d)
-    out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
-                      int(block_q), int(block_k))
+    q_pad = (-sq) % 128
+    k_pad = (-sk) % 128
+    if (q_pad or k_pad) and causal:
+        # the diagonal offset under asymmetric padding is not worth the
+        # complexity; fail clearly so scaled_dot_product_attention's
+        # fallback takes the XLA path instead of a degenerate block
+        # size crashing deep inside Mosaic
+        raise NotImplementedError(
+            "flash_attention: causal attention requires sequence "
+            f"lengths divisible by 128, got q={sq} k={sk}; use the XLA "
+            "attention path for ragged causal shapes")
+    if q_pad or k_pad:
+        # ragged sequence (e.g. ViT's 197 patches): pad to the 128-lane
+        # grid and mask the phantom key columns inside the kernels.
+        # Padded q rows produce discarded outputs and zero cotangents
+        # (the pad/slice live in the autodiff graph), so only the key
+        # side needs in-kernel masking.
+        qt = jnp.pad(qt, ((0, 0), (0, q_pad), (0, 0)))
+        kt = jnp.pad(kt, ((0, 0), (0, k_pad), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, k_pad), (0, 0)))
+        out = _flash_bhsd(qt, kt, vt, float(scale), False,
+                          int(block_q), int(block_k), int(sk))
+        out = out[:, :sq]
+    else:
+        out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal),
+                          int(block_q), int(block_k))
     return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)

@@ -58,6 +58,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             dropout_rng = random_mod.next_key()
     if not training:
         dropout_p = 0.0
+    # NOTE r4: widening this gate to big-batch short sequences (ViT-L
+    # b64 s197, 35% of whose step is the XLA attention path —
+    # experiments/vit_attention_share.py) was measured and REJECTED:
+    # the padded flash path (197 -> 256 via the kernel's kv_len
+    # masking) ran 210.3 img/s vs 234.9 on the XLA path — the +69%
+    # padded score compute and the kernel's exp cost outweigh the
+    # materialized-buffer traffic at this size. The ragged/kv_len
+    # support stays in the kernel (tests/test_kernels.py) for callers
+    # that need it; the gate stays at seq >= 512.
     use_flash = (attn_mask is None and dropout_p == 0.0
                  and query.shape[1] >= _FLASH_MIN_SEQ
                  and query.shape[1] == key.shape[1]

@@ -177,6 +177,47 @@ class TestFlashAttention:
                                        np.where(np.isnan(b_), 0.0, b_),
                                        atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_ragged_seq_padded_path(self, fused, monkeypatch):
+        """Non-divisible sequence (ViT's 197 patches): the wrapper pads
+        to the 128 grid and masks phantom key columns in-kernel —
+        forward AND grads must match XLA on the real length."""
+        if not fused:
+            import importlib
+            fa_mod = importlib.import_module(
+                "paddle_tpu.kernels.flash_attention")
+            monkeypatch.setattr(fa_mod, "_FUSED_BWD_MAX_SK", 0)
+        b, s, h, d = 2, 197, 2, 64
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+        out = flash_attention(q, k, v, block_q=128, block_k=128)
+        ref = _sdpa_xla(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, block_q=128, block_k=128)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = _sdpa_xla(q, k, v)
+            return jnp.sum(o * o)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_ragged_cross_length(self):
+        """Ragged query vs key lengths (both padded independently)."""
+        q = _rand(1, 100, 2, 64, seed=0)
+        k = _rand(1, 197, 2, 64, seed=1)
+        v = _rand(1, 197, 2, 64, seed=2)
+        out = flash_attention(q, k, v, block_q=128, block_k=128)
+        ref = _sdpa_xla(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
     def test_jit_and_multiblock(self):
         # seq > block so the online-softmax accumulation loop runs >1 step
         q, k, v = (_rand(1, 512, 1, 64, seed=i) for i in range(3))
