@@ -102,6 +102,10 @@ def _measured_anchor() -> float:
     import json
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "tuner_calibration.json")
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"{path} is missing; run 'python experiments/"
+            "tuner_calibration.py measure' on the chip first")
     rows = json.load(open(path))["rows"]
     hits = [r for r in rows if r["name"] == "ernie-base b32 s512"]
     if not hits:  # fail loudly — a silent constant would desync the plan

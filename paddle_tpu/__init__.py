@@ -132,9 +132,10 @@ from .nn.functional.common import (pixel_shuffle,  # noqa: F401,E402
                                    pixel_unshuffle)
 
 # `paddle.distributed`-style access is heavy: import lazily ---------------
-_LAZY = {"audio", "callbacks", "distributed", "distribution", "fft",
-         "geometric", "hub", "linalg", "regularizer", "sysconfig",
-         "version",
+_LAZY = {"audio", "callbacks", "compat", "dataset", "distributed",
+         "distribution", "fft",
+         "geometric", "hub", "linalg", "reader", "regularizer",
+         "sysconfig", "version",
          "models", "vision", "kernels", "hapi", "onnx", "profiler",
          "incubate", "inference", "quantization", "signal", "sparse",
          "static", "text", "utils"}

@@ -1,0 +1,54 @@
+"""String compatibility helpers (reference: python/paddle/compat.py:21,
+117 — `to_text` / `to_bytes` convert str/bytes and nested containers
+between encodings; kept for API parity with code ported from the
+py2-era fluid surface).
+"""
+from __future__ import annotations
+
+__all__ = ["to_text", "to_bytes"]
+
+
+def _convert(obj, conv, inplace):
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set)):
+        if inplace:
+            items = [_convert(o, conv, inplace) for o in obj]
+            if isinstance(obj, list):
+                obj[:] = items
+                return obj
+            obj.clear()
+            obj.update(items)
+            return obj
+        return type(obj)(_convert(o, conv, False) for o in obj)
+    if isinstance(obj, dict):
+        items = {_convert(k, conv, False): _convert(v, conv, False)
+                 for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(items)
+            return obj
+        return items
+    if isinstance(obj, tuple):
+        return tuple(_convert(o, conv, False) for o in obj)
+    return conv(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert bytes (possibly inside list/set/dict/tuple containers)
+    to str using `encoding`; str and other types pass through."""
+
+    def conv(o):
+        return o.decode(encoding) if isinstance(o, bytes) else o
+
+    return _convert(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert str (possibly inside containers) to bytes using
+    `encoding`; bytes and other types pass through."""
+
+    def conv(o):
+        return o.encode(encoding) if isinstance(o, str) else o
+
+    return _convert(obj, conv, inplace)

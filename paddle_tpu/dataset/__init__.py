@@ -1,0 +1,67 @@
+"""Legacy `paddle.dataset` namespace (reference:
+python/paddle/dataset/ — uci_housing/imdb/imikolov/... modules whose
+`train()`/`test()` return *reader creators* consumed by the
+`paddle.reader` decorators and `paddle.batch`).
+
+Each submodule here wraps the modern Dataset class (paddle_tpu.text
+.datasets) in the reader-creator protocol: `train()` returns a no-arg
+callable yielding the dataset's sample tuples. Downloads follow the
+same policy as the underlying datasets (standard archive layouts,
+egress-gated with a clear error when absent).
+"""
+from __future__ import annotations
+
+from types import ModuleType as _Module
+import sys as _sys
+
+__all__ = ["uci_housing", "imdb", "imikolov", "movielens", "conll05",
+           "wmt14", "wmt16"]
+
+
+def _reader_module(name, dataset_cls, modes=("train", "test"),
+                   pass_mode=True):
+    mod = _Module(f"{__name__}.{name}")
+    mod.__doc__ = (f"Reader-creator wrappers over "
+                   f"paddle_tpu.text.datasets.{dataset_cls.__name__}")
+
+    def _make(mode):
+        def creator(**kwargs):
+            if pass_mode:
+                kwargs.setdefault("mode", mode)
+
+            def reader():
+                ds = dataset_cls(**kwargs)
+                for i in range(len(ds)):
+                    yield ds[i]
+            return reader
+        creator.__name__ = mode
+        creator.__doc__ = (f"Reader creator over the {mode} split of "
+                           f"{dataset_cls.__name__}; pass the class's "
+                           f"kwargs (data paths etc.) through.")
+        return creator
+
+    for mode in modes:
+        setattr(mod, mode, _make(mode))
+    _sys.modules[mod.__name__] = mod
+    return mod
+
+
+def __getattr__(name):
+    from ..text import datasets as _d
+    table = {
+        "uci_housing": (_d.UCIHousing, ("train", "test"), True),
+        "imdb": (_d.Imdb, ("train", "test"), True),
+        "imikolov": (_d.Imikolov, ("train", "test"), True),
+        "movielens": (_d.Movielens, ("train", "test"), True),
+        # the reference ships the test split only; Conll05st takes no mode
+        "conll05": (_d.Conll05st, ("test",), False),
+        "wmt14": (_d.WMT14, ("train", "test"), True),
+        "wmt16": (_d.WMT16, ("train", "test"), True),
+    }
+    if name in table:
+        cls, modes, pass_mode = table[name]
+        mod = _reader_module(name, cls, modes, pass_mode)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.dataset' has no "
+                         f"attribute {name!r}")

@@ -1,0 +1,237 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py —
+the legacy data-reader composition surface: a *reader* is a no-arg
+callable returning an iterable of samples; decorators wrap readers).
+
+Kept for API parity with fluid-era input pipelines; the modern path is
+paddle.io.DataLoader. All implementations are fresh generator code.
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache the reader's full output in memory on first iteration."""
+    all_data = tuple(reader())
+
+    def cached():
+        return iter(all_data)
+
+    return cached
+
+
+def map_readers(func, *readers):
+    """Yield func(*samples) over the zipped readers."""
+
+    def mapped():
+        for items in zip(*(r() for r in readers)):
+            yield func(*items)
+
+    return mapped
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of `buf_size` samples."""
+
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers: all of r1, then all of r2, ..."""
+
+    def chained():
+        return itertools.chain(*(r() for r in readers))
+
+    return chained
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined tuples per sample. check_alignment
+    (default True) raises if the readers have different lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs: {sorted(kwargs)}")
+
+    def _flatten(item):
+        return item if isinstance(item, tuple) else (item,)
+
+    def composed():
+        iters = [iter(r()) for r in readers]
+        while True:
+            outputs = []
+            done = 0
+            for it in iters:
+                try:
+                    outputs.append(next(it))
+                except StopIteration:
+                    done += 1
+            if done:
+                if check_alignment and done != len(iters):
+                    raise RuntimeError(
+                        "readers to compose are not aligned (different "
+                        "lengths)")
+                return
+            yield sum((_flatten(o) for o in outputs), ())
+
+    return composed
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples on a background thread. Reader
+    exceptions propagate to the consumer; abandoning the generator
+    early (break / close) releases the feeder thread."""
+
+    _END = object()
+
+    def buffered_reader():
+        from threading import Event
+        q: Queue = Queue(maxsize=size)
+        abandoned = Event()
+
+        def _put(item):
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except Exception:  # Full — retry unless abandoned
+                    continue
+            return False
+
+        def fill():
+            try:
+                for item in reader():
+                    if not _put(item):
+                        return
+            except BaseException as e:  # surface errors, don't truncate
+                _put((_END, e))
+                return
+            _put((_END, None))
+
+        t = Thread(target=fill, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is _END:
+                    if item[1] is not None:
+                        raise item[1]
+                    return
+                yield item
+        finally:
+            abandoned.set()
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """Limit the reader to its first n samples."""
+
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map `mapper` over the reader with a pool of worker THREADS
+    (the reference uses threads too); `order=True` preserves input
+    order."""
+
+    def ordered():
+        # bounded in-flight window (buffer_size) preserving input order
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            window: deque = deque()
+            it = iter(reader())
+            try:
+                while True:
+                    while len(window) < max(buffer_size, 1):
+                        try:
+                            window.append(pool.submit(mapper, next(it)))
+                        except StopIteration:
+                            break
+                    if not window:
+                        return
+                    yield window.popleft().result()
+            finally:
+                for fut in window:
+                    fut.cancel()
+
+    def unordered():
+        from concurrent.futures import ThreadPoolExecutor, as_completed
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            pending = set()
+            it = iter(reader())
+            try:
+                for _ in range(buffer_size):
+                    pending.add(pool.submit(mapper, next(it)))
+            except StopIteration:
+                it = iter(())
+            while pending:
+                for fut in as_completed(list(pending)):
+                    pending.discard(fut)
+                    yield fut.result()
+                    try:
+                        pending.add(pool.submit(mapper, next(it)))
+                    except StopIteration:
+                        pass
+                    break
+
+    return ordered if order else unordered
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers, each drained on its own thread.
+    (The reference forks processes; fork is unsafe under a live jax
+    runtime — see io/DataLoader which uses a pre-fork worker pool —
+    so this compat shim drains on threads with the same semantics:
+    samples from all readers, arbitrary interleaving.)"""
+
+    _END = object()
+
+    def combined():
+        q: Queue = Queue(maxsize=queue_size)
+
+        def drain(r):
+            try:
+                for item in r():
+                    q.put(item)
+            except BaseException as e:  # surface, don't truncate
+                q.put((_END, e))
+                return
+            q.put((_END, None))
+
+        for r in readers:
+            Thread(target=drain, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] is _END:
+                if item[1] is not None:
+                    raise item[1]
+                finished += 1
+                continue
+            yield item
+
+    return combined

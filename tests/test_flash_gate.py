@@ -1,0 +1,71 @@
+"""The scaled_dot_product_attention flash gate is load-bearing: r3
+measured +36% ERNIE / +34% BERT from engaging at s512, and r4 measured
+ViT REGRESSING when the gate was widened to big-batch s197 (BASELINE.md
+negatives). Pin exactly when the Pallas path engages.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle  # noqa: F401
+import paddle_tpu.nn.functional.attention as attn_mod
+
+
+@pytest.fixture()
+def spy(monkeypatch):
+    calls = []
+
+    def fake_flash(query, key, value, causal=False, scale=None, **kw):
+        calls.append((query.shape, causal))
+        # cheap stand-in so the dispatch path completes
+        return query
+
+    import importlib
+    fa_mod = importlib.import_module("paddle_tpu.kernels.flash_attention")
+    monkeypatch.setattr(fa_mod, "flash_attention", fake_flash)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    return calls
+
+
+def _sdpa(b, s, h, d, causal=False, sk=None, mask=None, dropout=0.0):
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, sk or s, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, sk or s, h, d), jnp.float32)
+    return attn_mod.scaled_dot_product_attention(
+        q, k, v, attn_mask=mask, dropout_p=dropout, is_causal=causal)
+
+
+@pytest.mark.parametrize("s,causal", [(512, False), (512, True),
+                                      (1024, True), (2048, False)])
+def test_gate_engages_at_512_and_beyond(spy, s, causal):
+    _sdpa(2, s, 2, 64, causal=causal)
+    assert spy, f"flash must engage at s={s}"
+
+
+def test_gate_stays_off_below_512(spy):
+    _sdpa(2, 256, 2, 64)
+    assert not spy
+
+
+def test_gate_stays_off_for_vit_shape(spy):
+    """b64 h16 s197: measured SLOWER on the padded flash path
+    (BASELINE.md r4 ViT negative) — must stay on XLA."""
+    _sdpa(64, 197, 16, 64)
+    assert not spy
+
+
+def test_gate_stays_off_with_mask_or_dropout(spy):
+    import jax.numpy as jnp
+    mask = jnp.zeros((2, 2, 512, 512), jnp.float32)
+    _sdpa(2, 512, 2, 64, mask=mask)
+    assert not spy
+    _sdpa(2, 512, 2, 64, dropout=0.5)
+    assert not spy
+
+
+def test_gate_stays_off_for_unsupported_head_dim(spy):
+    _sdpa(2, 512, 2, 80)
+    assert not spy

@@ -154,6 +154,13 @@ for epoch in train_epoch_range(6, status=status):
                            for k, v in net.state_dict().items()},
                   loss=final)
 
+if final is None:
+    # a relaunched incarnation can resume PAST the last epoch (this
+    # rank had already completed every epoch before the pod teardown
+    # got to it — a real scheduling race under load): the loop yields
+    # nothing, and the honest result is the checkpointed final loss
+    final = status.state.get("loss")
+
 with open(os.environ["RESULT_JSON"] + "." + rank, "w") as f:
     json.dump({"loss": final}, f)
 """

@@ -1,0 +1,130 @@
+"""Tests for the legacy-parity namespaces added in r4: paddle.compat
+(to_text/to_bytes), paddle.reader (decorators), and paddle.dataset
+(reader-creator wrappers). Reference: python/paddle/compat.py,
+reader/decorator.py, dataset/.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import compat, reader
+
+
+# ----------------------------------------------------------------- compat
+
+def test_to_text_and_bytes_scalars_and_containers():
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_bytes("abc") == b"abc"
+    assert compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert compat.to_bytes(("a", "b")) == (b"a", b"b")
+    assert compat.to_text({b"k": b"v"}) == {"k": "v"}
+    assert compat.to_text(None) is None
+    assert compat.to_text(7) == 7
+
+
+def test_to_text_inplace_list():
+    data = [b"x", b"y"]
+    out = compat.to_text(data, inplace=True)
+    assert out is data and data == ["x", "y"]
+
+
+# ----------------------------------------------------------------- reader
+
+def _r(n):
+    def rd():
+        return iter(range(n))
+    return rd
+
+
+def test_cache_and_firstn_and_chain():
+    calls = []
+
+    def rd():
+        calls.append(1)
+        return iter([1, 2, 3])
+
+    c = reader.cache(rd)
+    assert list(c()) == [1, 2, 3]
+    assert list(c()) == [1, 2, 3]
+    assert len(calls) == 1  # source consumed once
+    assert list(reader.firstn(_r(10), 3)()) == [0, 1, 2]
+    assert list(reader.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+
+
+def test_map_readers_and_compose():
+    doubled = reader.map_readers(lambda a, b: a + b, _r(3), _r(3))
+    assert list(doubled()) == [0, 2, 4]
+    comp = reader.compose(_r(3), _r(3))
+    assert list(comp()) == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(RuntimeError, match="not aligned"):
+        list(reader.compose(_r(2), _r(3))())
+    ok = reader.compose(_r(2), _r(3), check_alignment=False)
+    assert list(ok()) == [(0, 0), (1, 1)]
+
+
+def test_shuffle_buffered_multiprocess():
+    import random
+    random.seed(0)
+    out = sorted(reader.shuffle(_r(10), 4)())
+    assert out == list(range(10))
+    assert sorted(reader.buffered(_r(10), 2)()) == list(range(10))
+    combined = reader.multiprocess_reader([_r(3), _r(4)])
+    assert sorted(combined()) == sorted(list(range(3)) + list(range(4)))
+
+
+@pytest.mark.parametrize("order", [True, False])
+def test_xmap_readers(order):
+    xm = reader.xmap_readers(lambda x: x * 10, _r(6), 2, 3, order=order)
+    got = list(xm())
+    assert sorted(got) == [0, 10, 20, 30, 40, 50]
+    if order:
+        assert got == [0, 10, 20, 30, 40, 50]
+
+
+def test_buffered_propagates_reader_errors():
+    def bad():
+        yield 1
+        raise IOError("disk gone")
+
+    with pytest.raises(IOError, match="disk gone"):
+        list(reader.buffered(lambda: bad(), 4)())
+
+
+def test_multiprocess_reader_propagates_errors():
+    def bad():
+        yield 1
+        raise ValueError("corrupt shard")
+
+    with pytest.raises(ValueError, match="corrupt shard"):
+        list(reader.multiprocess_reader([lambda: bad()])())
+
+
+def test_buffered_early_abandon_does_not_hang():
+    for i, _ in enumerate(reader.buffered(_r(10_000), 4)()):
+        if i >= 3:
+            break  # feeder must release via the abandoned flag
+    # reaching here without deadlock is the assertion
+
+
+# ---------------------------------------------------------------- dataset
+
+def test_dataset_uci_housing_reader(tmp_path):
+    # standard housing.data layout: 14 whitespace-separated floats/row
+    rng = np.random.RandomState(0)
+    rows = rng.rand(20, 14)
+    path = tmp_path / "housing.data"
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(" ".join(f"{v:.6f}" for v in r) + "\n")
+    rd = paddle.dataset.uci_housing.train(data_file=str(path))
+    samples = list(rd())
+    assert len(samples) > 0
+    x, y = samples[0]
+    assert len(x) == 13 and len(y) == 1
+    # works with paddle.reader decorators end-to-end
+    assert len(list(reader.firstn(rd, 2)())) == 2
+
+
+def test_dataset_unknown_module():
+    with pytest.raises(AttributeError):
+        paddle.dataset.nonexistent_set
