@@ -1,0 +1,91 @@
+"""Object-detection training — the vision/detection path (BASELINE
+config #5 family): a CSPResNet backbone with YOLOv3-style multi-scale
+heads trained with `yolo_loss` on synthetic boxes, then post-processed
+with `matrix_nms`. Exercises the detection op set end-to-end
+(reference analog: the PP-YOLOE/YOLOv3 training pipelines).
+
+Usage: python examples/detection_train.py [--smoke]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ANCHORS9 = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45,
+            59, 119, 116, 90, 156, 198, 373, 326]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    # always CPU: this example demonstrates the detection op set and
+    # the eager tape (per-op dispatch), not device throughput — eager
+    # round-trips every op, which is exactly what the jitted TrainStep
+    # examples exist to avoid on the TPU
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.models.ppyoloe import CSPResNet
+    from paddle_tpu.vision.ops import yolo_loss, matrix_nms
+
+    steps, hw = (6, 64) if args.smoke else (12, 96)
+    paddle.seed(0)
+    num_classes = 4
+    mask_num, per_scale = 3, 5 + num_classes
+    backbone = CSPResNet(widths=(16, 32, 64, 128))
+    heads = [nn.Conv2D(c, mask_num * per_scale, 1)
+             for c in (32, 64, 128)]
+    params = backbone.parameters()
+    for h in heads:
+        params += h.parameters()
+    opt = paddle.optimizer.Adam(learning_rate=2e-3, parameters=params)
+
+    rng = np.random.RandomState(0)
+    img = paddle.to_tensor(rng.randn(2, 3, hw, hw).astype(np.float32))
+    gt = paddle.to_tensor(np.asarray(
+        [[[0.3, 0.4, 0.4, 0.5], [0.7, 0.6, 0.2, 0.25]],
+         [[0.5, 0.5, 0.6, 0.6], [0.0, 0.0, 0.0, 0.0]]], np.float32))
+    lab = paddle.to_tensor(
+        rng.randint(0, num_classes, (2, 2)).astype(np.int32))
+    masks = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+    downs = [32, 16, 8]
+
+    first = last = None
+    for step_no in range(steps):
+        feats = backbone(img)[-3:]          # strides 8/16/32 pyramid
+        total = None
+        for feat, m, d, head in zip(feats[::-1], masks, downs,
+                                    heads[::-1]):
+            loss = yolo_loss(head(feat), gt, lab, ANCHORS9, m,
+                             num_classes, 0.7, d).sum()
+            total = loss if total is None else total + loss
+        total.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(total)
+        last = float(total)
+    print(f"detection loss {first:.2f} -> {last:.2f} over {steps} steps")
+    assert last < first * 0.9, (first, last)
+
+    # post-processing path: score some synthetic boxes through matrix_nms
+    boxes = paddle.to_tensor(np.asarray(
+        [[[10, 10, 50, 50], [12, 12, 52, 52], [100, 100, 150, 150]]],
+        np.float32))
+    scores = paddle.to_tensor(np.asarray(
+        [[[0.9, 0.85, 0.7], [0.1, 0.2, 0.6]]], np.float32))
+    out, index, rois_num = matrix_nms(
+        boxes, scores, score_threshold=0.3, post_threshold=0.0,
+        nms_top_k=10, keep_top_k=5, return_index=True,
+        return_rois_num=True)
+    print("matrix_nms kept", int(np.asarray(rois_num.numpy())[0]),
+          "boxes")
+    print("detection ok")
+
+
+if __name__ == "__main__":
+    main()
