@@ -206,6 +206,24 @@ def test_dynamic_batch_export(tmp_path):
         rtol=1e-4, atol=1e-5)
 
 
+def test_vit_exports_and_matches(tmp_path):
+    """ViT rounds out the exported families (conv stem + patch reshape
+    + pre-norm attention blocks + CLS-token head) — the artifact must
+    execute to parity on the numpy evaluator."""
+    from paddle_tpu import onnx as onnx_api
+    from paddle_tpu.models.vit import vit
+    paddle.seed(0)
+    m = vit("test-tiny", num_classes=4)
+    m.eval()
+    x = np.random.RandomState(0).randn(1, 3, 32, 32).astype(np.float32)
+    path = onnx_api.export(m, str(tmp_path / "vit"),
+                           input_spec=[paddle.to_tensor(x)],
+                           format="onnx")
+    ref = np.asarray(m(paddle.to_tensor(x)).data)
+    out = run_onnx(path, {"input": x})[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
 def test_unmappable_primitive_raises(tmp_path):
     """Genuinely unmappable ops fail loudly, not silently."""
     def f(x):
