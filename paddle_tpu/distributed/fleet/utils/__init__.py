@@ -1,0 +1,5 @@
+"""Fleet utilities (reference: python/paddle/distributed/fleet/utils/
+— the FS client family used by checkpoint/elastic paths)."""
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]

@@ -1,0 +1,297 @@
+"""Filesystem clients for checkpoint/elastic storage (reference:
+python/paddle/distributed/fleet/utils/fs.py:111 `LocalFS`, :381+
+`HDFSClient` — the same FS interface the reference's auto-checkpoint
+and fleet save/load paths program against).
+
+`LocalFS` is fully implemented over the local filesystem. `HDFSClient`
+shells out to the `hadoop fs` CLI exactly like the reference; when no
+hadoop binary is available (this environment) construction fails with
+a clear error rather than a broken client.
+"""
+from __future__ import annotations
+
+import abc
+import os
+import shutil
+import subprocess
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS(abc.ABC):
+    """Abstract FS interface (mirrors the reference's method set)."""
+
+    @abc.abstractmethod
+    def ls_dir(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def is_file(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def is_dir(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def is_exist(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def upload(self, local_path, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def download(self, fs_path, local_path):
+        ...
+
+    @abc.abstractmethod
+    def mkdirs(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def delete(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def need_upload_download(self):
+        ...
+
+    @abc.abstractmethod
+    def rename(self, fs_src_path, fs_dst_path):
+        ...
+
+    @abc.abstractmethod
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        ...
+
+    @abc.abstractmethod
+    def list_dirs(self, fs_path):
+        ...
+
+    @abc.abstractmethod
+    def touch(self, fs_path, exist_ok=True):
+        ...
+
+
+class LocalFS(FS):
+    """Local filesystem client (reference fs.py:111)."""
+
+    def ls_dir(self, fs_path):
+        """-> ([subdir names], [file names]) under fs_path."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, f))
+             else files).append(f)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def upload(self, local_path, fs_path):
+        # local->local: a copy (parity with the reference's semantics)
+        if not os.path.exists(local_path):
+            raise FSFileNotExistsError(local_path)
+        if os.path.isdir(local_path):
+            if os.path.exists(fs_path):
+                raise FSFileExistsError(fs_path)
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        else:
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(dst_path)
+            self.delete(dst_path)
+        os.replace(src_path, dst_path) if os.path.isfile(src_path) \
+            else shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        """Subdirectory names only."""
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def cat(self, fs_path=None):
+        with open(fs_path, "rb") as f:
+            return f.read().decode()
+
+
+class HDFSClient(FS):
+    """HDFS client over the `hadoop fs` CLI (reference fs.py:381+).
+    Requires a hadoop binary; in environments without one (this
+    container) construction raises with remediation instead of
+    returning a client whose every call would fail."""
+
+    def __init__(self, hadoop_home=None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        hadoop_home = hadoop_home or os.environ.get("HADOOP_HOME", "")
+        cand = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        if shutil.which(cand) is None:
+            raise RuntimeError(
+                "HDFSClient needs the hadoop CLI; none found (set "
+                "HADOOP_HOME or install hadoop). For local storage use "
+                "LocalFS — the checkpoint subsystems accept either.")
+        self._bin = cand
+        self._configs = configs or {}
+        self._time_out = time_out          # total budget, ms
+        self._sleep_inter = sleep_inter    # retry sleep, ms
+
+    def _run(self, *args, _retries=True):
+        """Run `hadoop fs <args>`, retrying transient failures with
+        sleep_inter pauses until the time_out budget is spent (the
+        reference's _handle_errors contract). Every failure mode —
+        nonzero exit, CLI hang — surfaces as ExecuteError."""
+        import time as _time
+        cmd = [self._bin, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        deadline = _time.monotonic() + self._time_out / 1000
+        last = None
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise ExecuteError(
+                    f"{' '.join(cmd)}: timed out after "
+                    f"{self._time_out} ms ({last})")
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=remaining)
+            except subprocess.TimeoutExpired:
+                raise ExecuteError(
+                    f"{' '.join(cmd)}: hadoop CLI hung past the "
+                    f"{self._time_out} ms budget")
+            if r.returncode == 0:
+                return r.stdout
+            last = r.stderr.strip()
+            if not _retries or args[0].startswith("-test"):
+                # predicates use nonzero exit as their answer
+                raise ExecuteError(f"{' '.join(cmd)}: {last}")
+            _time.sleep(self._sleep_inter / 1000)
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        try:
+            self._run("-test", "-f", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run("-test", "-d", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def is_exist(self, fs_path):
+        try:
+            self._run("-test", "-e", fs_path)
+            return True
+        except ExecuteError:
+            return False
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        if self.is_exist(fs_path):
+            self._run("-rm", "-r", fs_path)
+
+    def need_upload_download(self):
+        return True
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(fs_src_path)
+            if self.is_exist(fs_dst_path) and not overwrite:
+                # hadoop -mv into an existing dir silently NESTS src
+                # inside it — checkpoint renames must fail instead
+                raise FSFileExistsError(fs_dst_path)
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        self._run("-mv", fs_src_path, fs_dst_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        self._run("-touchz", fs_path)
+
+    def cat(self, fs_path=None):
+        return self._run("-cat", fs_path)

@@ -1,0 +1,68 @@
+"""Tests for fleet.utils FS clients (reference:
+python/paddle/distributed/fleet/utils/fs.py — LocalFS fully, HDFSClient
+construction gating in a hadoop-less environment).
+"""
+import os
+
+import pytest
+
+from paddle_tpu.distributed.fleet.utils import FS, LocalFS, HDFSClient
+from paddle_tpu.distributed.fleet.utils.fs import (FSFileExistsError,
+                                                   FSFileNotExistsError)
+
+
+def test_localfs_roundtrip(tmp_path):
+    fs = LocalFS()
+    assert isinstance(fs, FS)
+    root = str(tmp_path / "store")
+    fs.mkdirs(root)
+    assert fs.is_dir(root) and fs.is_exist(root)
+
+    fs.touch(os.path.join(root, "a.txt"))
+    fs.mkdirs(os.path.join(root, "sub"))
+    dirs, files = fs.ls_dir(root)
+    assert dirs == ["sub"] and files == ["a.txt"]
+    assert fs.list_dirs(root) == ["sub"]
+    assert fs.is_file(os.path.join(root, "a.txt"))
+    assert not fs.need_upload_download()
+
+    with open(os.path.join(root, "a.txt"), "w") as f:
+        f.write("payload")
+    assert fs.cat(os.path.join(root, "a.txt")) == "payload"
+
+    fs.upload(os.path.join(root, "a.txt"), os.path.join(root, "b.txt"))
+    assert fs.is_file(os.path.join(root, "b.txt"))
+    fs.rename(os.path.join(root, "b.txt"), os.path.join(root, "c.txt"))
+    assert fs.is_file(os.path.join(root, "c.txt"))
+
+    with pytest.raises(FSFileExistsError):
+        fs.mv(os.path.join(root, "a.txt"), os.path.join(root, "c.txt"))
+    fs.mv(os.path.join(root, "a.txt"), os.path.join(root, "c.txt"),
+          overwrite=True)
+    assert fs.cat(os.path.join(root, "c.txt")) == "payload"
+    with pytest.raises(FSFileNotExistsError):
+        fs.mv(os.path.join(root, "nope"), os.path.join(root, "d"))
+
+    fs.delete(os.path.join(root, "sub"))
+    assert not fs.is_exist(os.path.join(root, "sub"))
+    fs.delete(root)
+    assert not fs.is_exist(root)
+    assert fs.ls_dir(root) == ([], [])
+
+
+def test_localfs_touch_exists(tmp_path):
+    fs = LocalFS()
+    p = str(tmp_path / "x")
+    fs.touch(p)
+    fs.touch(p, exist_ok=True)
+    with pytest.raises(FSFileExistsError):
+        fs.touch(p, exist_ok=False)
+
+
+def test_hdfs_client_gated_without_hadoop(monkeypatch):
+    monkeypatch.delenv("HADOOP_HOME", raising=False)
+    import shutil
+    if shutil.which("hadoop"):
+        pytest.skip("hadoop present; gating not applicable")
+    with pytest.raises(RuntimeError, match="hadoop"):
+        HDFSClient()
