@@ -17,6 +17,10 @@ Other configs (BASELINE configs #2-#5; `python bench.py <name>`):
 
 MFU for the non-GPT configs uses XLA's own cost model for the compiled
 step (TrainStep.cost_analysis) instead of hand formulas.
+
+Shape overrides reproduce the BASELINE.md sweep rows on the flagship,
+e.g. the long-context sweep: BENCH_SEQ=4096 BENCH_BATCH=4,
+BENCH_SEQ=8192 BENCH_BATCH=2, BENCH_SEQ=16384 BENCH_BATCH=1.
 """
 from __future__ import annotations
 
