@@ -220,7 +220,16 @@ class HDFSClient(FS):
             _time.sleep(self._sleep_inter / 1000)
 
     def ls_dir(self, fs_path):
-        out = self._run("-ls", fs_path)
+        try:
+            out = self._run("-ls", fs_path)
+        except ExecuteError:
+            # only after the retry budget: a missing path yields ([], [])
+            # per LocalFS.ls_dir and the reference HDFSClient.ls_dir
+            # (fs.py:547); anything else (transient cluster failure that
+            # outlived the retries) still surfaces as the error
+            if not self.is_exist(fs_path):
+                return [], []
+            raise
         dirs, files = [], []
         for line in out.splitlines():
             parts = line.split()
@@ -271,7 +280,10 @@ class HDFSClient(FS):
         self._run("-mv", fs_src_path, fs_dst_path)
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False,
-           test_exists=False):
+           test_exists=True):
+        # test_exists defaults True per the reference HDFSClient.mv
+        # contract (fs.py:916): missing src / existing dst fail fast with
+        # typed errors instead of an ExecuteError after the retry budget
         if test_exists:
             if not self.is_exist(fs_src_path):
                 raise FSFileNotExistsError(fs_src_path)

@@ -77,7 +77,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             from ...kernels.flash_attention import flash_attention
             return flash_attention(query, key, value, causal=is_causal,
                                    scale=scale)
-        except Exception:
-            pass  # fall through to XLA path
+        except NotImplementedError:
+            pass  # declared unsupported shape (e.g. ragged causal):
+            #      the XLA path is the intended fallback
+        except Exception as e:  # pragma: no cover - kernel regression
+            # a genuine kernel/compile failure must NOT silently degrade
+            # to the (much slower) XLA path — that would hide a
+            # performance bug; warn loudly and fall back once per site
+            import warnings
+            warnings.warn(
+                f"flash_attention kernel failed unexpectedly and the XLA "
+                f"attention path was used instead ({type(e).__name__}: "
+                f"{e}); performance will be degraded", RuntimeWarning)
     return _sdpa_xla(query, key, value, attn_mask, dropout_p, is_causal,
                      scale, dropout_rng)

@@ -142,27 +142,31 @@ class Optimizer:
             return garr + wd * parr
         return garr
 
-    def _param_regularizers(self, n=None):
-        """Positional per-param regularizer list for the functional
-        update path (leaves align with _parameter_list order). Returns
-        None when no parameter carries a regularizer. Raises when
-        regularizers exist but the leaf count differs from
-        _parameter_list — silently dropping them would make the jitted
-        path train differently from eager opt.step()."""
+    def _param_regularizers(self, leaves):
+        """Per-leaf regularizer list for the functional update path.
+        When every leaf is one of the optimizer's own Tensor objects the
+        match is by identity — immune to params trees whose flatten
+        order differs from _parameter_list (dict-keyed trees, reordered
+        lists). Raw-array leaves fall back to positional alignment,
+        which REQUIRES the tree to flatten in _parameter_list order;
+        a count mismatch raises rather than silently training the
+        jitted path differently from eager opt.step()."""
         plist = self._parameter_list
         if plist is None:
             return None
-        regs = [getattr(p, "regularizer", None) for p in plist]
-        if not any(r is not None for r in regs):
+        by_id = {id(p): getattr(p, "regularizer", None) for p in plist}
+        if not any(r is not None for r in by_id.values()):
             return None
-        if n is not None and len(plist) != n:
+        if all(isinstance(p, Tensor) and id(p) in by_id for p in leaves):
+            return [by_id[id(p)] for p in leaves]
+        if len(plist) != len(leaves):
             raise ValueError(
                 f"per-parameter regularizers are set but the functional "
-                f"update received {n} params vs the optimizer's "
+                f"update received {len(leaves)} params vs the optimizer's "
                 f"{len(plist)} — construct the optimizer with the same "
                 f"parameter list the train step uses (e.g. "
                 f"model.parameters()) so they can be matched")
-        return regs
+        return [getattr(p, "regularizer", None) for p in plist]
 
     def clear_grad(self):
         if self._parameter_list is not None:
@@ -230,7 +234,7 @@ class Optimizer:
             grads_tree, is_leaf=lambda x: isinstance(x, Tensor))
         s_leaves = jax.tree_util.tree_leaves(
             state_tree, is_leaf=lambda x: isinstance(x, dict))
-        regs = self._param_regularizers(len(p_leaves))
+        regs = self._param_regularizers(p_leaves)
         new_p, new_s = [], []
         for i, (p, g, s) in enumerate(zip(p_leaves, g_leaves, s_leaves)):
             parr = p.data if isinstance(p, Tensor) else p

