@@ -113,14 +113,102 @@ class TestFlashAttention:
                                        atol=2e-4, rtol=2e-4)
 
     @pytest.mark.parametrize("causal", [False, True])
-    def test_grads_match_xla_split_path(self, causal, monkeypatch):
-        """The tiled split dq/dkv backward stays the live path for
-        sk > _FUSED_BWD_MAX_SK (s8192+ long-context); force it via the
-        gate and keep it parity-covered."""
+    def test_grads_match_xla_tiled_fused_path(self, causal, monkeypatch):
+        """The k-tiled fused backward is the live path for
+        sk > _FUSED_BWD_MAX_SK at head_dim <= _TILED_BWD_MAX_D (s8192/s16384
+        long-context); force it via the gates with a small k-chunk so
+        multi-chunk dk/dv/dq accumulation and the per-chunk causal skip
+        are exercised."""
         import importlib
         fa_mod = importlib.import_module(
             "paddle_tpu.kernels.flash_attention")
         monkeypatch.setattr(fa_mod, "_FUSED_BWD_MAX_SK", 0)
+        monkeypatch.setattr(fa_mod, "_TILED_BWD_K_CHUNK", 128)
+        b, s, h, d = 1, 512, 2, 64
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=128, block_k=128)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = _sdpa_xla(q, k, v, is_causal=causal)
+            return jnp.sum(o * o)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_tiled_dispatch_recursion(self, causal, monkeypatch):
+        """Past the dq-accumulator cap the tiled dispatch halves the q
+        range recursively (causal low halves drop their masked high
+        keys; dk/dv halves recombine in fp32) — force two recursion
+        levels with a tiny cap and check grads against XLA."""
+        import importlib
+        fa_mod = importlib.import_module(
+            "paddle_tpu.kernels.flash_attention")
+        monkeypatch.setattr(fa_mod, "_FUSED_BWD_MAX_SK", 0)
+        monkeypatch.setattr(fa_mod, "_TILED_BWD_K_CHUNK", 128)
+        monkeypatch.setattr(fa_mod, "_TILED_BWD_DQ_CAP", 128 * 64)
+        b, s, h, d = 1, 512, 2, 64
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=128, block_k=128)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = _sdpa_xla(q, k, v, is_causal=causal)
+            return jnp.sum(o * o)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_grads_tiled_fused_cross_length(self, monkeypatch):
+        """Tiled fused backward with sq != sk (causal diagonal offset)
+        and a chunked K."""
+        import importlib
+        fa_mod = importlib.import_module(
+            "paddle_tpu.kernels.flash_attention")
+        monkeypatch.setattr(fa_mod, "_FUSED_BWD_MAX_SK", 0)
+        monkeypatch.setattr(fa_mod, "_TILED_BWD_K_CHUNK", 128)
+        q = _rand(1, 128, 2, 64, seed=0)
+        k = _rand(1, 384, 2, 64, seed=1)
+        v = _rand(1, 384, 2, 64, seed=2)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=True,
+                                block_q=64, block_k=128)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = _sdpa_xla(q, k, v, is_causal=True)
+            return jnp.sum(o * o)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_xla_split_path(self, causal, monkeypatch):
+        """The tiled split dq/dkv backward stays the live path for
+        sk*d beyond the tiled-fused cap (d=128 at s16384); force it via
+        both gates and keep it parity-covered."""
+        import importlib
+        fa_mod = importlib.import_module(
+            "paddle_tpu.kernels.flash_attention")
+        monkeypatch.setattr(fa_mod, "_FUSED_BWD_MAX_SK", 0)
+        monkeypatch.setattr(fa_mod, "_TILED_BWD_MAX_D", 0)
         b, s, h, d = 1, 256, 2, 64
         q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
 
@@ -139,23 +227,36 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        atol=2e-4, rtol=2e-4)
 
-    @pytest.mark.parametrize("fused", [True, False])
-    def test_grads_causal_sq_gt_sk_fully_masked_rows(self, fused,
+    @pytest.mark.parametrize("path", ["fused", "tiled", "split"])
+    def test_grads_causal_sq_gt_sk_fully_masked_rows(self, path,
                                                      monkeypatch):
         """causal with sq > sk: q rows below offset are FULLY masked
         (forward emits zeros with lse = -inf). Their backward must be
         exactly zero — the lse = _NEG_INF sentinel made exp(s - lse)
         = 1 on masked entries (phantom gradients) before the r4 fix,
-        in both the fused and split kernels."""
-        if not fused:
+        in all three backward kernels."""
+        if path != "fused":
             import importlib
             fa_mod = importlib.import_module(
                 "paddle_tpu.kernels.flash_attention")
             monkeypatch.setattr(fa_mod, "_FUSED_BWD_MAX_SK", 0)
+            if path == "split":
+                monkeypatch.setattr(fa_mod, "_TILED_BWD_MAX_D", 0)
+            else:
+                monkeypatch.setattr(fa_mod, "_TILED_BWD_K_CHUNK", 64)
         q = _rand(1, 256, 2, 64, seed=0)
         k = _rand(1, 128, 2, 64, seed=1)
         v = _rand(1, 128, 2, 64, seed=2)
         # offset = sk - sq = -128: q rows 0..127 attend to nothing
+
+        # forward must emit zeros on the masked rows in EVERY kernel
+        # variant (the r5 whole-K kernel initially shipped mean(v)
+        # there — caught in review because only the grads were checked)
+        for blocks in [dict(block_q=64, block_k=64),
+                       dict(block_q=64, block_k=128)]:  # multi/whole-K
+            fwd = flash_attention(q, k, v, causal=True, **blocks)
+            assert np.all(np.asarray(fwd)[:, :128] == 0.0), \
+                f"masked-row forward not zero under {blocks}"
 
         def loss_flash(q, k, v):
             o = flash_attention(q, k, v, causal=True,
@@ -177,16 +278,20 @@ class TestFlashAttention:
                                        np.where(np.isnan(b_), 0.0, b_),
                                        atol=2e-4, rtol=2e-4)
 
-    @pytest.mark.parametrize("fused", [True, False])
-    def test_ragged_seq_padded_path(self, fused, monkeypatch):
+    @pytest.mark.parametrize("path", ["fused", "tiled", "split"])
+    def test_ragged_seq_padded_path(self, path, monkeypatch):
         """Non-divisible sequence (ViT's 197 patches): the wrapper pads
         to the 128 grid and masks phantom key columns in-kernel —
         forward AND grads must match XLA on the real length."""
-        if not fused:
+        if path != "fused":
             import importlib
             fa_mod = importlib.import_module(
                 "paddle_tpu.kernels.flash_attention")
             monkeypatch.setattr(fa_mod, "_FUSED_BWD_MAX_SK", 0)
+            if path == "split":
+                monkeypatch.setattr(fa_mod, "_TILED_BWD_MAX_D", 0)
+            else:
+                monkeypatch.setattr(fa_mod, "_TILED_BWD_K_CHUNK", 64)
         b, s, h, d = 2, 197, 2, 64
         q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
         out = flash_attention(q, k, v, block_q=128, block_k=128)
