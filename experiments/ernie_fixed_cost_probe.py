@@ -1,0 +1,155 @@
+"""Ablate ERNIE's fixed (non-trunk) step cost component by component.
+
+r4's 12-vs-6-layer ablation put ~14.6 ms (now ~16 ms post-kernel-wave)
+of the b32-s512 step outside the trunk: gathered MLM head, embedding
+backward, SOP head, optimizer. This probe stubs one component at a time
+on the real chip to price each:
+
+  full          — the bench step as measured
+  no_sop        — loss drops the SOP term (head + pooler still run fwd)
+  no_mlm        — loss is mean(hidden): no gather/transform/decode
+  no_embed_bwd  — stop_gradient around the three embedding lookups
+                  (kills the [b*s, h] -> [vocab, h] scatter-add grad;
+                  wte still gets grads through the tied MLM decode)
+  fwd_bwd_only  — no optimizer update (prices AdamW)
+"""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _sync(out):
+    """Force completion: float() the first loss-like leaf (the XLA
+    program is atomic, so the whole step is done when it lands)."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(
+        out, is_leaf=lambda t: hasattr(t, "data"))
+    first = leaves[0]
+    return float(np.asarray(first.data if hasattr(first, "data")
+                            else first).ravel()[0])
+
+
+def time_fn(fn, *args, iters=20):
+    out = fn(*args)  # compile
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ernie import ernie, ErnieEmbeddings
+
+    b, s = 32, 512
+    paddle.seed(0)
+    model = ernie("ernie-3.0-base", fused_mlm_loss=True,
+                  max_predictions=max(int(s * 0.19), 8))
+    model.bfloat16()
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          multi_precision=True)
+    from paddle_tpu.jit import TrainStep
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, model.cfg.vocab_size, (b, s)).astype(np.int32)
+    mlm_y = np.full((b, s), -100, np.int64)
+    for i in range(b):
+        pos = rng.choice(s, 76, replace=False)
+        mlm_y[i, pos] = ids[i, pos]
+    sop_y = rng.randint(0, 2, (b,)).astype(np.int64)
+    x = paddle.to_tensor(ids)
+    y = (paddle.to_tensor(mlm_y), paddle.to_tensor(sop_y))
+
+    def build_step(loss_fn):
+        return TrainStep(model, opt, loss_fn)
+
+    results = {}
+
+    full_loss = lambda out, lab: model.loss(out, lab)
+    results["full"] = time_fn(build_step(full_loss), x, y)
+
+    def no_sop(out, lab):
+        import paddle_tpu.nn.functional as F
+        seq, sop_logits, wp = out
+        from paddle_tpu.core.tensor import dispatch
+        return dispatch("fused_mlm_loss",
+                        lambda h, yy, *w: model._fused_mlm(h, yy, *w),
+                        (seq, lab[0]) + tuple(wp), {})
+    results["no_sop"] = time_fn(build_step(no_sop), x, y)
+
+    def no_mlm(out, lab):
+        import paddle_tpu.nn.functional as F
+        seq, sop_logits, wp = out
+        sop = F.cross_entropy(sop_logits, lab[1])
+        return seq.astype("float32").mean() + sop
+    results["no_mlm"] = time_fn(build_step(no_mlm), x, y)
+
+    # stop-grad embedding lookups: patch the forward
+    orig_fwd = ErnieEmbeddings.forward
+
+    def sg_forward(self, input_ids, token_type_ids=None):
+        out = orig_fwd(self, input_ids, token_type_ids)
+        return out  # patched below at the lookup level instead
+    from paddle_tpu.core.tensor import Tensor
+    import paddle_tpu.ops as ops
+
+    def sg_fwd(self, input_ids, token_type_ids=None):
+        bb, ss = input_ids.shape
+        pos = ops.creation.arange(ss, dtype="int32")
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = ops.creation.zeros([bb, ss], dtype="int32")
+        x = x + self.token_type_embeddings(token_type_ids)
+        x = Tensor(jax.lax.stop_gradient(x._data)) \
+            if isinstance(x, Tensor) else jax.lax.stop_gradient(x)
+        return self.dropout(self.layer_norm(x))
+
+    ErnieEmbeddings.forward = sg_fwd
+    try:
+        results["no_embed_bwd"] = time_fn(build_step(full_loss), x, y)
+    finally:
+        ErnieEmbeddings.forward = orig_fwd
+
+    # fwd+bwd only (no optimizer): grads via jax directly
+    step = build_step(full_loss)
+    step(x, y)  # init opt state/tree
+    import jax as _jax
+    # reuse the TrainStep's internals: time a value_and_grad-only jit
+    from paddle_tpu.jit.api import functional_call, _wrap, _unwrap
+    names = [n for n, _ in model.named_parameters()]
+    vals = [p.data for _, p in model.named_parameters()]
+
+    @_jax.jit
+    def fwd_bwd(vals, xx, yy):
+        def loss_of(vs):
+            pdict = dict(zip(names, vs))
+            out = functional_call(model, pdict, _wrap(xx))
+            return _unwrap(model.loss(out, _jax.tree_util.tree_map(
+                _wrap, yy)))
+        return _jax.value_and_grad(loss_of)(vals)
+
+    xx = x.data
+    yy = (y[0].data, y[1].data)
+    results["fwd_bwd_only"] = time_fn(fwd_bwd, vals, xx, yy)
+
+    print()
+    for k, v in results.items():
+        print(f"{k:>14}: {v:8.2f} ms")
+    fullt = results["full"]
+    print(f"\n  sop cost       ~ {fullt - results['no_sop']:.2f} ms")
+    print(f"  mlm head cost  ~ {fullt - results['no_mlm']:.2f} ms")
+    print(f"  embed bwd cost ~ {fullt - results['no_embed_bwd']:.2f} ms")
+    print(f"  optimizer cost ~ {fullt - results['fwd_bwd_only']:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

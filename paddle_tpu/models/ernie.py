@@ -254,7 +254,10 @@ class ErnieForPretraining(Layer):
         p = min(self.cfg.max_predictions, s)
         masked = y >= 0
         # stable argsort of (not masked): masked positions first, in
-        # original order
+        # original order. Measured r5 against lax.top_k (0.473 vs
+        # 0.481 e2e) and a cumsum+scatter compaction (0.474, and its
+        # unfilled slots duplicate position 0) — the full sort WINS on
+        # this shape; see experiments/ernie_fixed_cost_probe.py
         order = jnp.argsort(jnp.where(masked, 0, 1), axis=1,
                             stable=True)[:, :p]
         gh = jnp.take_along_axis(h, order[..., None], axis=1)
@@ -266,7 +269,8 @@ class ErnieForPretraining(Layer):
         var = jnp.var(t, axis=-1, keepdims=True)
         t = (t - mu) / jnp.sqrt(var + self.cfg.layer_norm_epsilon)
         t = t * lw.astype(t.dtype) + lb.astype(t.dtype)
-        logits = (t @ wte.T.astype(t.dtype)).astype(jnp.float32) +             db.astype(jnp.float32)
+        logits = (t @ wte.T.astype(t.dtype)).astype(jnp.float32) + \
+            db.astype(jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
         safe = jnp.maximum(gy, 0)
         gold = jnp.take_along_axis(logits, safe[..., None],
