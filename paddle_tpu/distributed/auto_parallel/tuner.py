@@ -173,8 +173,8 @@ class ParallelTuner:
                  hbm_capacity: float = 16e9,       # v5e chip
                  peak_flops: float = 197e12,       # bf16 v5e
                  hbm_bw: float = 819e9,
-                 mxu_eff: float = 0.41,
-                 hbm_eff: float = 0.91,
+                 mxu_eff: float = 0.43,
+                 hbm_eff: float = 0.90,
                  ici_bw: float = 180e9,            # ~4 links x 45GB/s
                  dcn_bw: float = 12.5e9,
                  ici_latency: float = 1e-6,        # per-collective floor
@@ -192,10 +192,11 @@ class ParallelTuner:
         self.peak_flops = peak_flops
         self.hbm_bw = hbm_bw
         # roofline derates calibrated against the measured BASELINE.md
-        # single-chip rows (experiments/tuner_calibration.json, r4):
-        # the global least-max-error pair is (0.41, 0.91), worst rel
-        # err 28% across model families; per-family calibration via
-        # calibrate() reaches <=20% (tests/test_parallel_tuner.py).
+        # single-chip rows (experiments/tuner_calibration.json, r5
+        # post-attention-wave): the global least-max-error pair is
+        # (0.43, 0.90), worst rel err 26.6% across model families;
+        # per-family calibration via calibrate() reaches <=20%
+        # (tests/test_parallel_tuner.py).
         # Residual error structure: attention flops at head_dim 64
         # occupy half the 128-wide MXU (long-seq underprediction), and
         # XLA cost-model bytes overstate real conv-net traffic.
@@ -311,7 +312,7 @@ def tune_parallel(n_devices: int, step_builder, **kwargs) -> Candidate:
 
 def predict_step_time(flops: float, hbm_bytes: float, *,
                       peak_flops: float = 197e12, hbm_bw: float = 819e9,
-                      mxu_eff: float = 0.41, hbm_eff: float = 0.91
+                      mxu_eff: float = 0.43, hbm_eff: float = 0.90
                       ) -> float:
     """The tuner's compute roofline on its own (no collectives):
     max(flops / (peak * mxu_eff), bytes / (bw * hbm_eff))."""

@@ -175,12 +175,49 @@ def test_northstar_plan_artifact():
     winner = min(cands, key=lambda r: r["pred_ms"])
     assert winner["dp"] == 256 and winner["sharding"] == 1
     assert winner["pred_scaling_eff"] >= 0.95
-    # measured single-chip MFU (BASELINE.md r4 ERNIE row) x predicted
-    # scaling efficiency must clear the 0.40 north-star target
-    assert 0.457 * winner["pred_scaling_eff"] >= 0.40
+    # measured single-chip MFU (BASELINE.md r5 ERNIE row, conservative
+    # end of the 0.475-0.481 drift band) x predicted scaling efficiency
+    # must clear the 0.40 north-star target
+    assert 0.475 * winner["pred_scaling_eff"] >= 0.40
     assert winner["pred_ms_2slice"] > winner["pred_ms"]
     # grad all-reduce payload ~ fp32 param bytes (118M params)
     assert 4.0e8 < winner["coll_bytes"] < 8.0e8
+
+
+def test_northstar_gradient_accumulation_model():
+    """The 2-slice DCN penalty's published fix (gradient merge) is
+    MODELED in the plan artifact: the accumulation curve recovers the
+    per-sample efficiency monotonically toward 1 with the exact
+    amortization algebra (collective paid once per K microsteps), and
+    the K the dryrun exercises (mesh #4) sits on the curve. The link
+    sensitivity rows carry the prediction's error bars."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "experiments",
+                        "northstar_plan.json")
+    data = json.load(open(path))
+    winner = min((r for r in data["rows"]
+                  if r["kind"] == "candidate-256"),
+                 key=lambda r: r["pred_ms"])
+    curve = {int(k): v for k, v in winner["accum_2slice"].items()}
+    ks = sorted(curve)
+    assert ks[0] == 1 and curve[1] == winner["pred_scaling_eff_2slice"]
+    # monotone recovery, approaching the single-slice ceiling
+    for a, b in zip(ks, ks[1:]):
+        assert curve[b] > curve[a]
+    assert curve[max(ks)] > 0.95
+    # exact amortization algebra: eff(K) = T1 / (T1 + t_coll/K) where
+    # t_coll = T1 * (1/eff(1) - 1) — closed form from the model
+    t1 = data["measured_1chip_ms"]
+    t_coll = t1 * (1.0 / curve[1] - 1.0)
+    for k in ks:
+        expect = t1 / (t1 + t_coll / k)
+        assert abs(curve[k] - expect) < 2e-3, (k, curve[k], expect)
+    # sensitivity rows exist and bracket the nominal prediction
+    sens = winner["sensitivity"]
+    assert sens["ici_0.5x"] < winner["pred_scaling_eff"] < sens["ici_2x"]
+    assert sens["dcn_0.5x_2slice"] < winner["pred_scaling_eff_2slice"] \
+        < sens["dcn_2x_2slice"]
 
 
 def test_abstract_lowering_matches_concrete():
