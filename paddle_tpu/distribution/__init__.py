@@ -14,7 +14,9 @@ from .distributions import (Bernoulli, Beta, Categorical, Independent,  # noqa: 
                             Poisson, StudentT, Uniform)
 from .kl import kl_divergence, register_kl  # noqa: F401
 from .transform import (AbsTransform, AffineTransform,  # noqa: F401
-                        ChainTransform, ExpTransform, PowerTransform,
-                        SigmoidTransform, SoftmaxTransform,
+                        ChainTransform, ExpTransform,
+                        IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
                         StickBreakingTransform, TanhTransform, Transform,
                         TransformedDistribution)

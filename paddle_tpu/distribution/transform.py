@@ -12,8 +12,9 @@ from ..core.tensor import Tensor
 from .distributions import Distribution, _raw, _shape, _wrap
 
 __all__ = ["Transform", "AbsTransform", "AffineTransform",
-           "ChainTransform", "ExpTransform", "PowerTransform",
-           "SigmoidTransform", "SoftmaxTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform",
            "StickBreakingTransform", "TanhTransform",
            "TransformedDistribution"]
 
@@ -177,6 +178,115 @@ class StickBreakingTransform(Transform):
         lead = jnp.concatenate(
             [jnp.ones_like(z[..., :1]), zc[..., :-1]], -1)
         return (jnp.log(z) + jnp.log1p(-z) + jnp.log(lead)).sum(-1)
+
+
+class IndependentTransform(Transform):
+    """Reinterprets the rightmost `reinterpreted_batch_rank` batch axes
+    as event axes: forward/inverse unchanged, but the log-det-Jacobian
+    sums over those axes (reference transform.py:672)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError(
+                f"base must be a Transform, got {type(base).__name__}")
+        if int(reinterpreted_batch_rank) <= 0:
+            raise ValueError("reinterpreted_batch_rank must be positive")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        self.event_dim = base.event_dim + self._rank
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _fldj(self, x):
+        ldj = self._base._fldj(x)
+        axes = tuple(range(ldj.ndim - self._rank, ldj.ndim))
+        return ldj.sum(axis=axes)
+
+
+class ReshapeTransform(Transform):
+    """Reshapes the event part of the shape; volume-preserving, so the
+    log-det-Jacobian is zero over the batch shape (reference
+    transform.py:831)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self._in = tuple(int(s) for s in in_event_shape)
+        self._out = tuple(int(s) for s in out_event_shape)
+        import math as _m
+        if _m.prod(self._in) != _m.prod(self._out):
+            raise ValueError(
+                f"in_event_shape {self._in} and out_event_shape "
+                f"{self._out} have different sizes")
+        self.event_dim = len(self._in)
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self._in)]
+        if tuple(x.shape[x.ndim - len(self._in):]) != self._in:
+            raise ValueError(f"trailing shape {x.shape} does not match "
+                             f"in_event_shape {self._in}")
+        return x.reshape(batch + self._out)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self._out)]
+        return y.reshape(batch + self._in)
+
+    def _fldj(self, x):
+        batch = x.shape[: x.ndim - len(self._in)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    """Applies a sequence of transforms slice-wise along `axis`
+    (reference transform.py:1046): slice i of the input goes through
+    transforms[i]; outputs and log-det-Jacobians restack on that axis."""
+
+    def __init__(self, transforms, axis: int = 0):
+        transforms = list(transforms)
+        if not transforms or not all(isinstance(t, Transform)
+                                     for t in transforms):
+            raise TypeError("transforms must be a non-empty sequence "
+                            "of Transform")
+        self._ts = transforms
+        self._axis = int(axis)
+        self.event_dim = max(t.event_dim for t in transforms)
+
+    @property
+    def transforms(self):
+        return self._ts
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _map(self, x, fn_name):
+        n = x.shape[self._axis]
+        if n != len(self._ts):
+            raise ValueError(
+                f"axis {self._axis} has size {n} but {len(self._ts)} "
+                f"transforms were given")
+        parts = [getattr(t, fn_name)(jnp.take(x, i, axis=self._axis))
+                 for i, t in enumerate(self._ts)]
+        return jnp.stack(parts, axis=self._axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._map(x, "_fldj")
 
 
 class ChainTransform(Transform):

@@ -8,4 +8,6 @@ reductions, which is the efficient TPU pattern for GNN aggregation.
 """
 from .math import (segment_max, segment_mean, segment_min,  # noqa: F401
                    segment_sum)
-from .message_passing import send_u_recv, send_ue_recv  # noqa: F401
+from .message_passing import send_u_recv, send_ue_recv, send_uv  # noqa: F401
+from .reindex import reindex_graph, reindex_heter_graph  # noqa: F401
+from .sampling import sample_neighbors  # noqa: F401

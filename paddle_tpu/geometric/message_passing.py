@@ -13,7 +13,7 @@ from ..ops.op_registry import op
 from .math import (_segment_max_impl, _segment_mean_impl,
                    _segment_min_impl, _segment_sum_impl)
 
-__all__ = ["send_u_recv", "send_ue_recv"]
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv"]
 
 _REDUCERS = {"sum": _segment_sum_impl.raw, "mean": _segment_mean_impl.raw,
              "max": _segment_max_impl.raw, "min": _segment_min_impl.raw}
@@ -58,6 +58,33 @@ def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
     return _send_u_recv_impl(x, src_index, dst_index,
                              pool_type=reduce_op,
                              out_size=_out_size(x, out_size))
+
+
+@op("graph_send_uv")
+def _send_uv_impl(x, y, src, dst, message_op):
+    xs = jnp.take(x, src.astype(jnp.int32), axis=0)
+    yd = jnp.take(y, dst.astype(jnp.int32), axis=0)
+    if message_op == "add":
+        return xs + yd
+    if message_op == "sub":
+        return xs - yd
+    if message_op == "mul":
+        return xs * yd
+    if message_op == "div":
+        return xs / yd
+    raise ValueError(f"unknown message_op {message_op!r}")
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add",
+            name=None):
+    """Per-edge features from both endpoints: op(x[src], y[dst]) — no
+    intermediate [num_edges, ...] gather materialized by the caller
+    (reference python/paddle/geometric/message_passing/send_recv.py:387,
+    graph_send_uv kernel)."""
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError("message_op must be add/sub/mul/div")
+    return _send_uv_impl(x, y, src_index, dst_index,
+                         message_op=message_op)
 
 
 def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",

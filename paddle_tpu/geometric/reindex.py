@@ -1,0 +1,86 @@
+"""Graph reindexing (≈ python/paddle/geometric/reindex.py:24
+reindex_graph, :136 reindex_heter_graph, over the phi graph_reindex
+kernel).
+
+Host-side numpy by design: reindexing happens in the GNN input
+pipeline between neighbor sampling and the device step — it is
+integer bookkeeping over dynamic-size id lists, not accelerator math
+(the reference's GPU hashtable variant exists to keep the sampler
+resident on-device; on TPU the sampler feeds the infeed like every
+other data-loading stage)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["reindex_graph", "reindex_heter_graph"]
+
+
+def _raw_1d(t, name, dtype=None):
+    a = np.asarray(t.numpy() if isinstance(t, Tensor) else t)
+    a = a.reshape(-1)
+    if dtype is not None:
+        a = a.astype(dtype)
+    return a
+
+
+def _reindex(x, neighbor_lists):
+    """Shared body: build out_nodes (x first, then unseen neighbors in
+    first-appearance order across all graphs) and remap each list.
+    Fully vectorized — million-edge batches must not be bottlenecked
+    by a Python per-element loop in the input pipeline."""
+    x = x.astype(np.int64)
+    all_ids = np.concatenate([x] + [nb.astype(np.int64)
+                                    for nb in neighbor_lists])
+    uniq, first = np.unique(all_ids, return_index=True)  # uniq sorted
+    order = np.argsort(first, kind="stable")  # first-appearance order
+    out_nodes = uniq[order]
+    new_index = np.empty(len(uniq), np.int64)
+    new_index[order] = np.arange(len(uniq))
+    remapped = [new_index[np.searchsorted(uniq, nb.astype(np.int64))]
+                for nb in neighbor_lists]
+    return remapped, out_nodes
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Reindex sampled neighbors to a dense [0, n) id space: returns
+    (reindex_src, reindex_dst, out_nodes) with the input nodes first in
+    out_nodes. Reference python/paddle/geometric/reindex.py:24; the
+    value/index hashtable buffers are a GPU-kernel affordance and are
+    accepted-and-ignored here."""
+    xa = _raw_1d(x, "x")
+    nb = _raw_1d(neighbors, "neighbors")
+    ct = _raw_1d(count, "count", np.int64)
+    if ct.sum() != len(nb):
+        raise ValueError(
+            f"count sums to {int(ct.sum())} but neighbors has "
+            f"{len(nb)} entries")
+    (src,), out_nodes = _reindex(xa, [nb])
+    dst = np.repeat(np.arange(len(xa), dtype=np.int64), ct)
+    dt = xa.dtype
+    return (Tensor(src.astype(dt)), Tensor(dst.astype(dt)),
+            Tensor(out_nodes.astype(dt)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists
+    sharing ONE id space; outputs concatenate the per-type edge lists
+    (reference python/paddle/geometric/reindex.py:136)."""
+    xa = _raw_1d(x, "x")
+    nbs = [_raw_1d(n, "neighbors") for n in neighbors]
+    cts = [_raw_1d(c, "count", np.int64) for c in count]
+    for nb, ct in zip(nbs, cts):
+        if ct.sum() != len(nb):
+            raise ValueError("count/neighbors length mismatch")
+    remapped, out_nodes = _reindex(xa, nbs)
+    srcs = np.concatenate(remapped) if remapped else \
+        np.zeros(0, np.int64)
+    dsts = np.concatenate([
+        np.repeat(np.arange(len(xa), dtype=np.int64), ct)
+        for ct in cts]) if cts else np.zeros(0, np.int64)
+    dt = xa.dtype
+    return (Tensor(srcs.astype(dt)), Tensor(dsts.astype(dt)),
+            Tensor(out_nodes.astype(dt)))

@@ -1,0 +1,70 @@
+"""Graph neighbor sampling (≈ python/paddle/geometric/sampling/
+neighbors.py:23 sample_neighbors, phi graph_sample_neighbors kernel).
+
+Host-side numpy by design: sampling is input-pipeline work — a
+random, dynamic-size selection per node that feeds the device step
+(the reference's fisher-yates GPU path exists to keep sampling
+on-device next to a GPU trainer; a TPU trainer streams samples through
+the infeed like any other data loader stage)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["sample_neighbors"]
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Sample up to `sample_size` neighbors for each input node from a
+    CSC graph (row, colptr). Returns (out_neighbors, out_count) and,
+    with return_eids=True, the sampled edges' ids. perm_buffer is the
+    reference's GPU fisher-yates affordance — accepted and ignored."""
+    r = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    r = r.reshape(-1)
+    cp = np.asarray(colptr.numpy() if isinstance(colptr, Tensor)
+                    else colptr).reshape(-1)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor)
+                       else input_nodes).reshape(-1)
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    ea = None
+    if eids is not None:
+        ea = np.asarray(eids.numpy() if isinstance(eids, Tensor)
+                        else eids).reshape(-1)
+        if len(ea) != len(r):
+            raise ValueError("eids must have one entry per edge")
+    # fresh draw per call: fold a split of the global PRNG key into a
+    # host seed, so repeated calls sample fresh neighbors while
+    # paddle.seed() still makes the SEQUENCE reproducible (a fixed
+    # RandomState(get_seed()) would freeze every minibatch's sample)
+    import jax as _jax
+    from ..core import random as random_mod
+    key = random_mod.next_key()
+    rng = np.random.RandomState(
+        int(_jax.random.randint(key, (), 0, np.iinfo(np.int32).max)))
+    out_nb, out_ct, out_eid = [], [], []
+    n_nodes = len(cp) - 1
+    for n in nodes:
+        n = int(n)
+        if not 0 <= n < n_nodes:
+            raise ValueError(f"node {n} outside [0, {n_nodes})")
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            pick = lo + rng.choice(deg, sample_size, replace=False)
+        out_nb.append(r[pick])
+        out_ct.append(len(pick))
+        if return_eids:
+            out_eid.append(ea[pick])
+    nb = np.concatenate(out_nb) if out_nb else np.zeros(0, r.dtype)
+    ct = np.asarray(out_ct, np.int32)
+    if return_eids:
+        ei = np.concatenate(out_eid) if out_eid else np.zeros(0, r.dtype)
+        return Tensor(nb), Tensor(ct), Tensor(ei)
+    return Tensor(nb), Tensor(ct)

@@ -1,15 +1,43 @@
 """paddle.sparse.nn analog (≈ python/paddle/sparse/nn/) — layer-style
-wrappers over sparse functional ops."""
+wrappers over sparse functional ops.
+
+r5 adds the 3-D sparse layer family (reference
+python/paddle/sparse/nn/layer/conv.py:133 Conv3D, :268 SubmConv3D,
+norm.py:23 BatchNorm, pooling.py:19 MaxPool3D): convolutions run as
+dense MXU matmuls per kernel offset over gathered active sites (see
+nn_functional), BatchNorm normalizes the [nnz, C] value rows with the
+dense BatchNorm1D machinery — the reference's own formulation.
+"""
 from __future__ import annotations
 
-from . import unary
+import math
 
-__all__ = ["ReLU", "Softmax"]
+from . import nn_functional as functional  # noqa: F401  (sparse.nn.functional)
+from . import unary
+from .creation import SparseCooTensor
+from ..nn import BatchNorm1D as _BatchNorm1D, Layer as _Layer
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv3D",
+           "SubmConv3D", "BatchNorm", "SyncBatchNorm", "MaxPool3D",
+           "functional"]
 
 
 class ReLU:
     def __call__(self, x):
         return unary.relu(x)
+
+
+class ReLU6:
+    def __call__(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU:
+    def __init__(self, negative_slope=0.01):
+        self._slope = negative_slope
+
+    def __call__(self, x):
+        return functional.leaky_relu(x, self._slope)
 
 
 class Softmax:
@@ -34,5 +62,114 @@ class Softmax:
         coo = jsparse.BCOO.fromdense(sm)
         if isinstance(x, SparseCsrTensor):
             return SparseCsrTensor(jsparse.BCSR.from_bcoo(coo))
-        from .creation import SparseCooTensor
         return SparseCooTensor(coo)
+
+
+class _Conv3D(_Layer):
+    """Shared sparse Conv3D/SubmConv3D body: a real framework Layer, so
+    state_dict/named_parameters/optimizers and weight_attr/bias_attr
+    behave exactly like the dense convs. Weight layout
+    [kd, kh, kw, C_in, C_out] (the reference's NDHWC layout,
+    sparse/nn/layer/conv.py:97)."""
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 key=None):
+        super().__init__()
+        if padding_mode != "zeros":
+            raise ValueError("only padding_mode='zeros' is supported "
+                             "(the reference has the same restriction)")
+        if groups != 1:
+            raise ValueError("only groups=1 is supported")
+        if data_format != "NDHWC":
+            raise ValueError("only NDHWC is supported")
+        from ..nn import initializer as I
+        ks = functional._triple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = ks
+        fan_in = in_channels * ks[0] * ks[1] * ks[2]
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            ks + (in_channels, out_channels), attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        fn = functional.subm_conv3d if self._subm else functional.conv3d
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation)
+
+
+class Conv3D(_Conv3D):
+    """Sparse 3-D convolution layer (reference
+    python/paddle/sparse/nn/layer/conv.py:133)."""
+    _subm = False
+
+
+class SubmConv3D(_Conv3D):
+    """Submanifold sparse 3-D convolution layer — output sites equal
+    input sites (reference python/paddle/sparse/nn/layer/conv.py:268)."""
+    _subm = True
+
+
+class BatchNorm(_BatchNorm1D):
+    """Sparse BatchNorm: a real BatchNorm1D over the [nnz, C] value
+    rows, index set unchanged — the reference's own formulation
+    (python/paddle/sparse/nn/layer/norm.py:23 calls the dense
+    functional on values). Subclassing the dense layer means
+    state_dict, running stats, and train/eval behave identically."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None,
+                 data_format="NDHWC", use_global_stats=None, name=None):
+        if data_format != "NDHWC":
+            raise ValueError("sparse BatchNorm supports NDHWC only")
+        super().__init__(num_features, momentum=momentum,
+                         epsilon=epsilon, weight_attr=weight_attr,
+                         bias_attr=bias_attr,
+                         use_global_stats=use_global_stats)
+
+    def forward(self, x):
+        from jax.experimental import sparse as jsparse
+        out_vals = super().forward(x.values())
+        mat = x._mat
+        new = jsparse.BCOO(
+            (out_vals._data, mat.indices), shape=mat.shape,
+            indices_sorted=bool(mat.indices_sorted),
+            unique_indices=bool(mat.unique_indices))
+        return SparseCooTensor(new)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BatchNorm (reference norm.py:231). Under
+    GSPMD the value rows are sharded along nnz; the dense batch-norm
+    reduction compiles to a global psum over the mesh, so the single
+    implementation serves both — this alias exists for API parity."""
+
+
+class MaxPool3D:
+    """Sparse 3-D max pooling layer (reference
+    python/paddle/sparse/nn/layer/pooling.py:19)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False,
+                 data_format="NDHWC", name=None):
+        if ceil_mode or return_mask:
+            raise ValueError("ceil_mode/return_mask are not supported")
+        self._ks, self._st, self._pd = kernel_size, stride, padding
+        if data_format != "NDHWC":
+            raise ValueError("sparse MaxPool3D supports NDHWC only")
+
+    def __call__(self, x):
+        return functional.max_pool3d(x, self._ks, stride=self._st,
+                                     padding=self._pd)
+
+    forward = __call__

@@ -1,0 +1,301 @@
+"""paddle.sparse.nn.functional analog — sparse 3-D conv / pooling.
+
+Reference: python/paddle/sparse/nn/functional/conv.py (conv3d:31,
+subm_conv3d:130) and pooling.py (max_pool3d:20), backed by the phi
+sparse conv kernels (paddle/phi/kernels/sparse/conv_kernel.h). The
+reference gathers rulebook pairs on GPU; the TPU-native formulation
+here is the same math expressed as dense MXU work per kernel offset:
+
+    for each of the K^3 kernel offsets:
+        map every OUTPUT site to its contributing INPUT site
+        (sorted-key binary search over the flattened coordinates),
+        gather those value rows -> [n_out, C_in],
+        one dense matmul with W[offset] -> accumulate [n_out, C_out].
+
+Index structure (which sites exist, who contributes where) is computed
+on the host in numpy — it is data-layout, not math, and stays constant
+under autodiff; the value path is pure jnp, so gradients w.r.t. input
+values / weight / bias flow through jax.grad. Output index sets are
+data-dependent (except submanifold conv), so these ops are eager-only —
+the same constraint the reference's dynamic rulebook has.
+
+Layout: NDHWC only (the reference's only supported layout), indices
+[nnz, 4] = (n, d, h, w) with dense trailing channels.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from .creation import SparseCooTensor
+
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d", "relu", "relu6",
+           "leaky_relu", "softmax", "attention"]
+
+
+def _triple(v) -> tuple:
+    if isinstance(v, (list, tuple)):
+        assert len(v) == 3, f"expected 3 elements, got {v}"
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _flat(idx: np.ndarray, dims: Sequence[int]) -> np.ndarray:
+    """Flatten (n, d, h, w) integer coords to one sortable key."""
+    n, d, h, w = idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]
+    return ((n.astype(np.int64) * dims[0] + d) * dims[1] + h) \
+        * dims[2] + w
+
+
+def _out_dim(size, k, s, p, dil) -> int:
+    return (size + 2 * p - dil * (k - 1) - 1) // s + 1
+
+
+def _check_coo(x, name):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"{name} expects a SparseCooTensor, got "
+                        f"{type(x).__name__}")
+    if len(x.shape) != 5:
+        raise ValueError(f"{name} expects a 5-D NDHWC sparse input, "
+                         f"got shape {x.shape}")
+
+
+def _sorted_index(in_idx: np.ndarray, in_dims):
+    """Sort the input coordinate keys ONCE per op call (hoisted out of
+    the K^3 offset loop — re-sorting per offset multiplies host setup
+    cost 27x for a 3-cubed kernel)."""
+    keys = _flat(in_idx, in_dims)
+    order = np.argsort(keys, kind="stable")
+    return keys[order], order
+
+
+def _gather_rows(sorted_keys, order, in_dims, query: np.ndarray):
+    """For each query coord row, the input row index holding it, and a
+    found mask (binary search over the pre-sorted flattened keys)."""
+    skeys = sorted_keys
+    qkeys = _flat(query, in_dims)
+    pos = np.searchsorted(skeys, qkeys)
+    pos_c = np.minimum(pos, len(skeys) - 1) if len(skeys) else pos * 0
+    found = (len(skeys) > 0) & (skeys[pos_c] == qkeys)
+    rows = order[pos_c] if len(skeys) else pos_c
+    return rows, found
+
+
+def _conv_out_sites(in_idx, n_batch, in_dims, out_dims, ks, st, pd, dl):
+    """Standard sparse conv output site set: every out site whose
+    receptive field touches >= 1 input site (union of shifted inputs)."""
+    cands = []
+    for kd in range(ks[0]):
+        for kh in range(ks[1]):
+            for kw in range(ks[2]):
+                # i = o*s - p + k*dil  =>  o = (i + p - k*dil) / s
+                num = in_idx[:, 1:4] + np.array(pd) \
+                    - np.array((kd, kh, kw)) * np.array(dl)
+                ok = (num % np.array(st) == 0).all(1)
+                o = num // np.array(st)
+                ok &= (o >= 0).all(1) & (o < np.array(out_dims)).all(1)
+                if ok.any():
+                    cands.append(np.concatenate(
+                        [in_idx[ok, :1], o[ok]], axis=1))
+    if not cands:
+        return np.zeros((0, 4), np.int32)
+    allc = np.concatenate(cands, axis=0)
+    keys = _flat(allc, out_dims)
+    _, first = np.unique(keys, return_index=True)
+    return allc[first]  # unique() sorts keys -> rows in row-major order
+
+
+def _sparse_conv3d(x, weight, bias, stride, padding, dilation, subm,
+                   name):
+    _check_coo(x, name)
+    mat = x._mat
+    wv = weight._data if hasattr(weight, "_data") else jnp.asarray(weight)
+    if wv.ndim != 5:
+        raise ValueError(f"{name} weight must be [kd, kh, kw, C_in, "
+                         f"C_out], got shape {wv.shape}")
+    N, D, H, W, C = mat.shape
+    ks = tuple(int(s) for s in wv.shape[:3])
+    cin, cout = int(wv.shape[3]), int(wv.shape[4])
+    if cin != C:
+        raise ValueError(f"{name}: weight C_in {cin} != input C {C}")
+    st, pd, dl = _triple(stride), _triple(padding), _triple(dilation)
+    in_idx = np.asarray(mat.indices)
+    vals = mat.data  # [nnz, C] — jnp, stays differentiable
+    in_dims = (D, H, W)
+    if subm:
+        if st != (1, 1, 1):
+            raise ValueError("subm_conv3d requires stride 1 (the output "
+                             "index set equals the input's)")
+        out_dims, out_idx = in_dims, in_idx
+    else:
+        out_dims = tuple(_out_dim(s, k, t, p, d) for s, k, t, p, d
+                         in zip((D, H, W), ks, st, pd, dl))
+        out_idx = _conv_out_sites(in_idx, N, in_dims, out_dims,
+                                  ks, st, pd, dl)
+    n_out = len(out_idx)
+    skeys, korder = _sorted_index(in_idx, in_dims)
+    acc = jnp.zeros((n_out, cout), vals.dtype)
+    for kd in range(ks[0]):
+        for kh in range(ks[1]):
+            for kw in range(ks[2]):
+                src = out_idx.copy()
+                src[:, 1:4] = out_idx[:, 1:4] * np.array(st) \
+                    - np.array(pd) + np.array((kd, kh, kw)) * np.array(dl)
+                inb = ((src[:, 1:4] >= 0).all(1)
+                       & (src[:, 1:4] < np.array(in_dims)).all(1))
+                src_c = np.where(inb[:, None], src, 0)
+                rows, found = _gather_rows(skeys, korder, in_dims, src_c)
+                found = found & inb
+                if not found.any():
+                    continue
+                g = jnp.take(vals, jnp.asarray(rows), axis=0) \
+                    * jnp.asarray(found[:, None], vals.dtype)
+                acc = acc + g @ wv[kd, kh, kw].astype(vals.dtype)
+    if bias is not None:
+        bv = bias._data if hasattr(bias, "_data") else jnp.asarray(bias)
+        acc = acc + bv.astype(acc.dtype)
+    flags = dict(indices_sorted=bool(mat.indices_sorted),
+                 unique_indices=bool(mat.unique_indices)) if subm else \
+        dict(indices_sorted=True, unique_indices=True)
+    out = jsparse.BCOO((acc, jnp.asarray(out_idx.astype(np.int32))),
+                       shape=(N,) + tuple(out_dims) + (cout,), **flags)
+    return SparseCooTensor(out)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """Sparse 3-D convolution over a SparseCooTensor [N, D, H, W, C].
+    Reference: python/paddle/sparse/nn/functional/conv.py:31."""
+    if groups != 1:
+        raise ValueError("sparse conv3d supports groups=1 only "
+                         "(the reference has the same restriction)")
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only")
+    return _sparse_conv3d(x, weight, bias, stride, padding, dilation,
+                          False, "conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv: the output index set IS the input's —
+    no dilation of the active site set through depth, the property that
+    keeps sparse 3-D backbones sparse. Reference:
+    python/paddle/sparse/nn/functional/conv.py:130."""
+    if groups != 1:
+        raise ValueError("sparse subm_conv3d supports groups=1 only")
+    if data_format != "NDHWC":
+        raise ValueError("sparse subm_conv3d supports NDHWC only")
+    return _sparse_conv3d(x, weight, bias, stride, padding, dilation,
+                          True, "subm_conv3d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse 3-D max pooling: output sites are the conv-style site
+    union; each pools the max over PRESENT inputs in its window (absent
+    sites do not contribute zeros — reference
+    python/paddle/sparse/nn/functional/pooling.py:20 semantics)."""
+    _check_coo(x, "max_pool3d")
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d supports NDHWC only")
+    mat = x._mat
+    N, D, H, W, C = mat.shape
+    ks = _triple(kernel_size)
+    st = _triple(stride) if stride is not None else ks
+    pd = _triple(padding)
+    dl = (1, 1, 1)
+    in_idx = np.asarray(mat.indices)
+    vals = mat.data
+    in_dims = (D, H, W)
+    out_dims = tuple(_out_dim(s, k, t, p, 1) for s, k, t, p
+                     in zip((D, H, W), ks, st, pd))
+    out_idx = _conv_out_sites(in_idx, N, in_dims, out_dims, ks, st, pd,
+                              dl)
+    n_out = len(out_idx)
+    skeys, korder = _sorted_index(in_idx, in_dims)
+    neg = jnp.asarray(-jnp.inf, vals.dtype)
+    acc = jnp.full((n_out, C), neg)
+    for kd in range(ks[0]):
+        for kh in range(ks[1]):
+            for kw in range(ks[2]):
+                src = out_idx.copy()
+                src[:, 1:4] = out_idx[:, 1:4] * np.array(st) \
+                    - np.array(pd) + np.array((kd, kh, kw))
+                inb = ((src[:, 1:4] >= 0).all(1)
+                       & (src[:, 1:4] < np.array(in_dims)).all(1))
+                src_c = np.where(inb[:, None], src, 0)
+                rows, found = _gather_rows(skeys, korder, in_dims, src_c)
+                found = found & inb
+                if not found.any():
+                    continue
+                g = jnp.take(vals, jnp.asarray(rows), axis=0)
+                g = jnp.where(jnp.asarray(found[:, None]), g, neg)
+                acc = jnp.maximum(acc, g)
+    out = jsparse.BCOO((acc, jnp.asarray(out_idx.astype(np.int32))),
+                       shape=(N,) + tuple(out_dims) + (C,),
+                       indices_sorted=True, unique_indices=True)
+    return SparseCooTensor(out)
+
+
+def relu(x, name=None):
+    """Zero-preserving ReLU over stored values (reference
+    sparse/nn/functional/activation.py:22)."""
+    from . import unary
+    return unary.relu(x)
+
+
+def relu6(x, name=None):
+    """min(max(v, 0), 6) over stored values (activation.py:60)."""
+    from .unary import _map_values
+    return _map_values(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    """Leaky ReLU over stored values (activation.py:98)."""
+    from .unary import _map_values
+    return _map_values(
+        x, lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored entries (activation.py:136)."""
+    from .nn import Softmax
+    return Softmax(axis)(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask scaled-dot-product attention (reference
+    sparse/nn/functional/transformer.py:24): scores are computed ONLY
+    at the CSR mask's stored positions, softmax-normalized per row,
+    then applied to V. Dense q/k/v [B, H, S, D]; sparse_mask a
+    SparseCsrTensor with batch*head stacked rows ([B*H*S] row space)."""
+    import jax
+    q = query._data if hasattr(query, "_data") else jnp.asarray(query)
+    k = key._data if hasattr(key, "_data") else jnp.asarray(key)
+    v = value._data if hasattr(value, "_data") else jnp.asarray(value)
+    b, h, s, d = q.shape
+    scale = 1.0 / float(np.sqrt(d))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    mask_dense = sparse_mask.to_dense()
+    md = mask_dense._data if hasattr(mask_dense, "_data") \
+        else jnp.asarray(mask_dense)
+    md = md.reshape(b, h, s, s)
+    keep = md != 0
+    if key_padding_mask is not None:
+        kp = key_padding_mask._data if hasattr(key_padding_mask, "_data") \
+            else jnp.asarray(key_padding_mask)
+        keep = keep & (kp[:, None, None, :] != 0)
+    if attn_mask is not None:
+        am = attn_mask._data if hasattr(attn_mask, "_data") \
+            else jnp.asarray(attn_mask)
+        keep = keep & (am[None, None] != 0 if am.ndim == 2 else am != 0)
+    scores = jnp.where(keep, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    from ..core.tensor import Tensor
+    return Tensor(out)

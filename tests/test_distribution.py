@@ -213,3 +213,62 @@ def test_stickbreaking_roundtrip():
     np.testing.assert_allclose(y.numpy().sum(), 1.0, rtol=1e-5)
     np.testing.assert_allclose(sb.inverse(y).numpy(), x.numpy(),
                                rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# r5: IndependentTransform / ReshapeTransform / StackTransform
+# (VERDICT r4 Next #8; reference transform.py:672, :831, :1046)
+
+def test_independent_transform_reference_example():
+    """The reference docstring's own numbers: Exp with
+    reinterpreted_batch_rank=1 over [[1,2,3],[4,5,6]] -> fldj [6, 15]."""
+    x = paddle.to_tensor(np.array([[1., 2., 3.], [4., 5., 6.]],
+                                  np.float32))
+    t = D.IndependentTransform(
+        D.ExpTransform(), 1)
+    np.testing.assert_allclose(t.forward(x).numpy(), np.exp(x.numpy()),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        t.forward_log_det_jacobian(x).numpy(), [6.0, 15.0], rtol=1e-6)
+    np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(),
+                               x.numpy(), rtol=1e-5)
+    assert t.event_dim == 1
+    with pytest.raises(TypeError):
+        D.IndependentTransform(object(), 1)
+    with pytest.raises(ValueError):
+        D.IndependentTransform(D.ExpTransform(), 0)
+
+
+def test_reshape_transform_roundtrip_and_zero_ldj():
+    t = D.ReshapeTransform((2, 3), (3, 2))
+    x = paddle.to_tensor(
+        np.arange(12, dtype=np.float32).reshape(2, 2, 3))
+    y = t.forward(x)
+    assert list(y.shape) == [2, 3, 2]
+    np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy())
+    np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                               np.zeros(2))
+    assert t.in_event_shape == (2, 3) and t.out_event_shape == (3, 2)
+    with pytest.raises(ValueError):
+        D.ReshapeTransform((2, 3), (4,))
+
+
+def test_stack_transform_slicewise():
+    t = D.StackTransform(
+        [D.ExpTransform(),
+         D.AffineTransform(paddle.to_tensor(1.0),
+                                      paddle.to_tensor(2.0))], axis=1)
+    x = paddle.to_tensor(np.array([[0.5, 3.0], [1.0, -1.0]], np.float32))
+    y = t.forward(x).numpy()
+    np.testing.assert_allclose(y[:, 0], np.exp([0.5, 1.0]), rtol=1e-6)
+    np.testing.assert_allclose(y[:, 1], 1.0 + 2.0 * np.array([3., -1.]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(),
+                               x.numpy(), rtol=1e-5)
+    ldj = t.forward_log_det_jacobian(x).numpy()
+    np.testing.assert_allclose(ldj[:, 0], [0.5, 1.0], rtol=1e-6)
+    np.testing.assert_allclose(ldj[:, 1], np.log(2.0), rtol=1e-6)
+    with pytest.raises(ValueError):
+        t.forward(paddle.to_tensor(np.zeros((2, 3), np.float32)))
+    with pytest.raises(TypeError):
+        D.StackTransform([])

@@ -249,7 +249,10 @@ NAMESPACE_LISTS = {
     "metric": "paddle_tpu.metric",
     "distribution": "paddle_tpu.distribution",
     "signal": "paddle_tpu.signal",
+    "geometric": "paddle_tpu.geometric",
     "sparse": "paddle_tpu.sparse",
+    "sparse_nn": "paddle_tpu.sparse.nn",
+    "sparse_nn_functional": "paddle_tpu.sparse.nn_functional",
     "utils": "paddle_tpu.utils",
 }
 

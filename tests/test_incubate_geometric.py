@@ -255,3 +255,105 @@ def test_fused_multi_transformer_trains():
         opt.clear_grad()
         first = first if first is not None else float(loss)
     assert float(loss) < first
+
+
+# ---------------------------------------------------------------------------
+# r5: send_uv / reindex / sample_neighbors (VERDICT r4 Next #6) —
+# goldens are the reference docstring examples (exact expected outputs)
+# plus numpy oracles.
+
+def test_send_uv_reference_example_and_grads():
+    import jax
+    import jax.numpy as jnp
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                  np.float32))
+    y = paddle.to_tensor(np.array([[0, 1, 2], [2, 3, 4], [4, 5, 6]],
+                                  np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = geometric.send_uv(x, y, src, dst, message_op="add")
+    np.testing.assert_allclose(
+        out.numpy(), [[2, 5, 7], [5, 9, 11], [4, 9, 11], [0, 3, 5]])
+    for op, fn in [("sub", np.subtract), ("mul", np.multiply),
+                   ("div", np.divide)]:
+        got = geometric.send_uv(x, y, src, dst, message_op=op).numpy()
+        want = fn(x.numpy()[[0, 1, 2, 0]], y.numpy()[[1, 2, 1, 0]])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    # differentiable wrt both node tensors
+    def loss(xv, yv):
+        from paddle_tpu.geometric.message_passing import _send_uv_impl
+        return jnp.sum(_send_uv_impl.raw(
+            xv, yv, jnp.asarray([0, 1], jnp.int32),
+            jnp.asarray([1, 0], jnp.int32), message_op="mul") ** 2)
+    gx, gy = jax.grad(loss, argnums=(0, 1))(
+        jnp.asarray(x.numpy()), jnp.asarray(y.numpy()))
+    assert np.isfinite(np.asarray(gx)).all()
+    assert np.abs(np.asarray(gx)[2]).sum() == 0  # node 2 unused
+
+
+def test_reindex_graph_reference_example():
+    x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    neighbors = paddle.to_tensor(
+        np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+    count = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+    src, dst, out_nodes = geometric.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(out_nodes.numpy(),
+                                  [0, 1, 2, 8, 9, 4, 7, 6])
+    # invariant: out_nodes[src] recovers the original neighbor ids
+    np.testing.assert_array_equal(out_nodes.numpy()[src.numpy()],
+                                  neighbors.numpy())
+
+
+def test_reindex_heter_graph_reference_example():
+    x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+    nA = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+    cA = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+    nB = paddle.to_tensor(np.array([0, 2, 3, 5, 1], np.int64))
+    cB = paddle.to_tensor(np.array([1, 3, 1], np.int32))
+    src, dst, out_nodes = geometric.reindex_heter_graph(
+        x, [nA, nB], [cA, cB])
+    np.testing.assert_array_equal(
+        src.numpy(), [3, 4, 0, 5, 6, 7, 6, 0, 2, 8, 9, 1])
+    np.testing.assert_array_equal(
+        dst.numpy(), [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2])
+    np.testing.assert_array_equal(
+        out_nodes.numpy(), [0, 1, 2, 8, 9, 4, 7, 6, 3, 5])
+
+
+def test_sample_neighbors_csc():
+    paddle.seed(0)
+    row = paddle.to_tensor(np.array(
+        [3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7], np.int64))
+    colptr = paddle.to_tensor(np.array(
+        [0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13], np.int64))
+    nodes = paddle.to_tensor(np.array([0, 8, 1, 2], np.int64))
+    nb, ct = geometric.sample_neighbors(row, colptr, nodes,
+                                        sample_size=2)
+    counts = ct.numpy()
+    np.testing.assert_array_equal(counts, [2, 2, 2, 1])
+    # every sampled neighbor is a true CSC neighbor of its node
+    r, cp = row.numpy(), colptr.numpy()
+    flat = nb.numpy()
+    ofs = 0
+    for n, c in zip(nodes.numpy(), counts):
+        true = set(r[cp[n]:cp[n + 1]])
+        got = flat[ofs:ofs + c]
+        assert set(got) <= true and len(set(got)) == c
+        ofs += c
+    # sample_size=-1 returns all neighbors in order
+    nb_all, ct_all = geometric.sample_neighbors(row, colptr, nodes)
+    np.testing.assert_array_equal(ct_all.numpy(), [2, 2, 2, 1])
+    # eids returned when asked
+    eids = paddle.to_tensor(np.arange(13, np.int64) if False
+                            else np.arange(13, dtype=np.int64))
+    nb3, ct3, ei = geometric.sample_neighbors(
+        row, colptr, nodes, sample_size=2, eids=eids, return_eids=True)
+    ofs = 0
+    for n, c in zip(nodes.numpy(), ct3.numpy()):
+        for e, v in zip(ei.numpy()[ofs:ofs + c],
+                        nb3.numpy()[ofs:ofs + c]):
+            assert r[e] == v  # eid points at the sampled edge
+        ofs += c
