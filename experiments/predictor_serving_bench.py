@@ -4,12 +4,15 @@ int8-compute, batch 1 and 32.
 
 CAVEAT (measured 2026-07-31): on the axon-TUNNELED chip every
 pred.run() is a remote host round-trip (~150 ms floor at b1, input
-upload dominating at b32), so the numbers measure the tunnel, not the
-predictor — which is why BASELINE.md carries no serving-latency row
-from this environment. The harness is correct for a real TPU host
-where dispatch is local; run it there.
+upload dominating at b32), so WALL-CLOCK numbers measure the tunnel,
+not the predictor. The r5 `--device-time` mode sidesteps this with
+paddle_tpu.inference.device_time_per_run (scan-slope extraction: the
+predict program runs N times inside one dispatch as a dependent chain;
+the slope over two N cancels the fixed dispatch cost exactly) — those
+ARE honest per-inference device times and feed the BASELINE serving
+row. Wall-clock mode stays for real (untunneled) TPU hosts.
 
-Usage: python experiments/predictor_serving_bench.py
+Usage: python experiments/predictor_serving_bench.py [--device-time]
 """
 from __future__ import annotations
 
@@ -38,6 +41,7 @@ def bench(pred, x):
 
 
 def main():
+    device_time = "--device-time" in sys.argv
     from paddle_tpu.models.resnet import resnet50
     paddle.seed(0)
     model = resnet50(num_classes=1000, data_format="NHWC")
@@ -60,7 +64,11 @@ def main():
             setup(cfg)
             try:
                 pred = create_predictor(cfg)
-                dt = bench(pred, x)
+                if device_time:
+                    from paddle_tpu.inference import device_time_per_run
+                    dt = device_time_per_run(pred, [x])
+                else:
+                    dt = bench(pred, x)
                 results.append(
                     f"{tag} {dt * 1e3:6.2f} ms ({batch / dt:7.1f} img/s)")
             except Exception as e:  # noqa: BLE001

@@ -342,8 +342,12 @@ class TransformedDistribution(Distribution):
         x = self.transform.inverse(value)
         base_lp = _raw(self.base.log_prob(x))
         fldj = _raw(self.transform.forward_log_det_jacobian(x))
-        if self.transform.event_dim > 0 and base_lp.ndim >= 1:
-            # event-dim transforms reduce their ldj over the event axis;
-            # match by reducing the base log_prob the same way
-            base_lp = base_lp.sum(-1)
+        # event-dim transforms reduce their ldj over the event axes;
+        # match by reducing the base log_prob over the SAME number of
+        # rightmost axes (IndependentTransform/ReshapeTransform can
+        # carry event_dim >= 2)
+        ed = min(self.transform.event_dim, base_lp.ndim)
+        if ed > 0:
+            base_lp = base_lp.sum(
+                axis=tuple(range(base_lp.ndim - ed, base_lp.ndim)))
         return _wrap(base_lp - fldj)

@@ -11,6 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.tensor import Tensor
+from .reindex import _raw_1d
 
 __all__ = ["sample_neighbors"]
 
@@ -22,19 +23,14 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     CSC graph (row, colptr). Returns (out_neighbors, out_count) and,
     with return_eids=True, the sampled edges' ids. perm_buffer is the
     reference's GPU fisher-yates affordance — accepted and ignored."""
-    r = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
-    r = r.reshape(-1)
-    cp = np.asarray(colptr.numpy() if isinstance(colptr, Tensor)
-                    else colptr).reshape(-1)
-    nodes = np.asarray(input_nodes.numpy()
-                       if isinstance(input_nodes, Tensor)
-                       else input_nodes).reshape(-1)
+    r = _raw_1d(row, "row")
+    cp = _raw_1d(colptr, "colptr")
+    nodes = _raw_1d(input_nodes, "input_nodes")
     if return_eids and eids is None:
         raise ValueError("return_eids=True requires eids")
     ea = None
     if eids is not None:
-        ea = np.asarray(eids.numpy() if isinstance(eids, Tensor)
-                        else eids).reshape(-1)
+        ea = _raw_1d(eids, "eids")
         if len(ea) != len(r):
             raise ValueError("eids must have one entry per edge")
     # fresh draw per call: fold a split of the global PRNG key into a

@@ -6,6 +6,7 @@ analysis_predictor.cc:263,893,1643 — AnalysisConfig + AnalysisPredictor
 subgraph engines collapse into XLA AOT compilation of an exported
 StableHLO artifact; precision conversion happens at trace time.
 """
+from .benchmark import Benchmark, device_time_per_run  # noqa: F401
 from .config import Config, PrecisionType  # noqa: F401
 from .predictor import (InferTensor, Predictor,  # noqa: F401
                         create_predictor)

@@ -84,7 +84,7 @@ def _gather_rows(sorted_keys, order, in_dims, query: np.ndarray):
     return rows, found
 
 
-def _conv_out_sites(in_idx, n_batch, in_dims, out_dims, ks, st, pd, dl):
+def _conv_out_sites(in_idx, in_dims, out_dims, ks, st, pd, dl):
     """Standard sparse conv output site set: every out site whose
     receptive field touches >= 1 input site (union of shifted inputs)."""
     cands = []
@@ -133,7 +133,7 @@ def _sparse_conv3d(x, weight, bias, stride, padding, dilation, subm,
     else:
         out_dims = tuple(_out_dim(s, k, t, p, d) for s, k, t, p, d
                          in zip((D, H, W), ks, st, pd, dl))
-        out_idx = _conv_out_sites(in_idx, N, in_dims, out_dims,
+        out_idx = _conv_out_sites(in_idx, in_dims, out_dims,
                                   ks, st, pd, dl)
     n_out = len(out_idx)
     skeys, korder = _sorted_index(in_idx, in_dims)
@@ -212,7 +212,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
     in_dims = (D, H, W)
     out_dims = tuple(_out_dim(s, k, t, p, 1) for s, k, t, p
                      in zip((D, H, W), ks, st, pd))
-    out_idx = _conv_out_sites(in_idx, N, in_dims, out_dims, ks, st, pd,
+    out_idx = _conv_out_sites(in_idx, in_dims, out_dims, ks, st, pd,
                               dl)
     n_out = len(out_idx)
     skeys, korder = _sorted_index(in_idx, in_dims)

@@ -347,8 +347,7 @@ def test_sample_neighbors_csc():
     nb_all, ct_all = geometric.sample_neighbors(row, colptr, nodes)
     np.testing.assert_array_equal(ct_all.numpy(), [2, 2, 2, 1])
     # eids returned when asked
-    eids = paddle.to_tensor(np.arange(13, np.int64) if False
-                            else np.arange(13, dtype=np.int64))
+    eids = paddle.to_tensor(np.arange(13, dtype=np.int64))
     nb3, ct3, ei = geometric.sample_neighbors(
         row, colptr, nodes, sample_size=2, eids=eids, return_eids=True)
     ofs = 0

@@ -164,3 +164,32 @@ def test_predictor_int8_after_ptq():
     err = np.abs(out.astype(np.float32) - ref_out).max()
     scale = np.abs(ref_out).max()
     assert err < 0.1 * scale + 0.1, (err, scale)
+
+
+def test_device_time_per_run_extraction():
+    """The scan-slope device-time extractor (the serving-latency path
+    that sidesteps the tunnel dispatch floor) returns a positive,
+    batch-scaling latency and leaves the predictor's outputs intact."""
+    from paddle_tpu.inference import (Benchmark, Config,
+                                      create_predictor,
+                                      device_time_per_run)
+    from paddle_tpu import nn
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(64, 256), nn.ReLU(),
+                          nn.Linear(256, 10))
+    model.eval()
+    x1 = np.random.RandomState(0).randn(4, 64).astype(np.float32)
+    cfg = Config().from_layer(model, input_spec=[paddle.to_tensor(x1)])
+    pred = create_predictor(cfg)
+    t = device_time_per_run(pred, [x1], iters=(4, 16), repeats=2)
+    assert t >= 0.0 and np.isfinite(t)
+    # outputs after benchmarking still match a direct run
+    out = pred.run([x1])
+    want = np.asarray(model(paddle.to_tensor(x1)).data)
+    np.testing.assert_allclose(out[0], want, rtol=1e-5, atol=1e-5)
+
+    bm = Benchmark("mlp", batch_size=4)
+    bm.measure(pred, [x1], iters=(4, 16), repeats=2)
+    line = bm.report()
+    assert "name=mlp" in line and "batch=4" in line
+    assert bm.qps is None or bm.qps > 0

@@ -180,9 +180,8 @@ def test_sparse_conv3d_matches_dense_oracle(stride, padding):
     k, cin, cout = 3, 3, 4
     w = rs.standard_normal((k, k, k, cin, cout)).astype(np.float32) * 0.3
     b = rs.standard_normal((cout,)).astype(np.float32)
-    out = sparse.nn.functional.conv3d(
-        paddle.to_tensor if False else x, w, b,
-        stride=stride, padding=padding)
+    out = sparse.nn.functional.conv3d(x, w, b,
+                                      stride=stride, padding=padding)
     dense_in = x.to_dense().numpy()
     oracle = _dense_conv3d_oracle(dense_in, w, None, stride, padding, 1)
     got = out.to_dense().numpy()
