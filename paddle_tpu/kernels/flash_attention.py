@@ -493,7 +493,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 _FUSED_BWD_MAX_SK = 4096  # whole-K resident limit: [bq, sk] fp32
 # score/softmax/grad tiles bound VMEM, so bq shrinks as sk grows
-# (sk<=1024 -> bq 512, sk<=2048 -> bq 256; ~3x2 MB tiles either way)
+# (sk<=1024 -> bq 512, sk<=2048 -> bq 256; ~3x2 MB tiles either way).
+# Gate placement measured r5: forcing the k-tiled kernel below this
+# limit LOSES (s2048 0.525 -> 0.516, s4096 0.582 -> 0.564 MFU) —
+# whole-K residency beats tile streaming whenever it fits
 
 _TILED_BWD_K_CHUNK = 1024   # in-body k-tile for the long-context kernel
 _TILED_BWD_MAX_D = 128   # head-dim cap for the tiled fused backward
