@@ -484,6 +484,10 @@ MANUAL_SPECS = {
                             np.array([0, 1, 2], np.int64),
                             np.array([1, 2, 3], np.int64), "add",
                             "sum", 4], {}),
+    "graph_send_uv": ([rng.randn(4, 3).astype(np.float32),
+                       rng.randn(4, 3).astype(np.float32),
+                       np.array([0, 1, 2], np.int64),
+                       np.array([1, 2, 3], np.int64), "add"], {}),
     "viterbi_decode": ([rng.randn(2, 5, 4).astype(np.float32),
                         rng.randn(4, 4).astype(np.float32),
                         np.array([5, 4], np.int64), False], {}),
