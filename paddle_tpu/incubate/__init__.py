@@ -6,6 +6,18 @@ sparsity, distributed models). Populated incrementally; see submodules.
 from . import asp  # noqa: F401
 
 __all__ = ["asp"]
+__all__.append("distributed")
+
+
+def __getattr__(name):
+    # paddle.incubate.distributed pulls the whole fleet/auto_parallel
+    # stack — keep it lazy, mirroring the top-level _LAZY design
+    if name == "distributed":
+        import importlib
+        mod = importlib.import_module(".distributed", __name__)
+        globals()["distributed"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from . import autograd  # noqa: F401,E402
 
 __all__.append("autograd")

@@ -1,0 +1,6 @@
+"""paddle.incubate.distributed analog — legacy import paths kept for
+migrating users; the real implementations live in
+paddle_tpu.distributed.parallel."""
+from . import models  # noqa: F401
+
+__all__ = ["models"]

@@ -1,0 +1,11 @@
+"""paddle.incubate.distributed.models.moe analog (reference:
+python/paddle/incubate/distributed/models/moe/). The modern MoE layer
+lives in paddle_tpu.distributed.parallel.moe and is re-exported here
+under the reference's import path."""
+from paddle_tpu.distributed.parallel.moe import (  # noqa: F401
+    MoEMLP as MoELayer)
+from .grad_clip import (ClipGradForMOEByGlobalNorm,  # noqa: F401
+                        ClipGradForMoEByGlobalNorm)
+
+__all__ = ["MoELayer", "ClipGradForMOEByGlobalNorm",
+           "ClipGradForMoEByGlobalNorm"]

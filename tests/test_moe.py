@@ -298,3 +298,18 @@ def test_moe_global_norm_clip_parity_witness():
             np.asarray(p.data), single[n], rtol=2e-4, atol=2e-5,
             err_msg=f"clipped update diverged on {n} — the global-norm "
                     f"clip is NOT ep-sharding-correct")
+
+
+def test_moe_grad_clip_reference_import_path():
+    """Reference code importing ClipGradForMOEByGlobalNorm /
+    MoELayer from paddle.incubate.distributed.models.moe keeps working;
+    the clip aliases the plain global-norm clip (the parity witness
+    above proves GSPMD makes the special re-aggregation unnecessary)."""
+    from paddle_tpu.incubate.distributed.models.moe import (
+        ClipGradForMOEByGlobalNorm, MoELayer)
+    from paddle_tpu.optimizer import ClipGradByGlobalNorm
+    clip = ClipGradForMOEByGlobalNorm(
+        0.5, is_expert_param_func=lambda p: False, moe_group=None)
+    assert isinstance(clip, ClipGradByGlobalNorm)
+    assert clip.clip_norm == 0.5
+    assert MoELayer is MoEMLP
