@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import monitor
 from ..core.tensor import Tensor
 
 
@@ -60,6 +61,8 @@ class GradScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        if monitor.enabled:
+            monitor.record_scaler_step(self._found_inf, self._scale)
         self._update_scale()
 
     def minimize(self, optimizer, scaled_loss):

@@ -8,7 +8,8 @@ from .hapi.callbacks import LRSchedulerCallback as LRScheduler  # noqa: F401
 from .hapi.callbacks import EarlyStopping  # noqa: F401
 from .hapi.callbacks import ReduceLROnPlateau  # noqa: F401
 from .hapi.callbacks import TerminateOnNaN  # noqa: F401
+from .hapi.callbacks import MetricsCallback  # noqa: F401
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
            "LRScheduler", "EarlyStopping", "ReduceLROnPlateau",
-           "TerminateOnNaN"]
+           "TerminateOnNaN", "MetricsCallback"]

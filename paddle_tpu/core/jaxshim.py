@@ -1,0 +1,30 @@
+"""Version shims over jax APIs that moved or were renamed between
+releases, so the rest of the codebase writes the current spelling once.
+
+`shard_map`: new jax exposes `jax.shard_map(..., check_vma=, axis_names=)`;
+older releases have `jax.experimental.shard_map.shard_map(..., check_rep=,
+auto=)` where `auto` is the complement of `axis_names` over the mesh.
+"""
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _native = jax.shard_map
+    _NEW_API = True
+else:
+    from jax.experimental.shard_map import shard_map as _native
+    _NEW_API = False
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma=None, axis_names=None, **kw):
+    if axis_names is not None:
+        if _NEW_API:
+            kw["axis_names"] = set(axis_names)
+        else:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    if check_vma is not None:
+        kw["check_vma" if _NEW_API else "check_rep"] = check_vma
+    return _native(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kw)

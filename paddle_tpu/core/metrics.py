@@ -1,0 +1,340 @@
+"""Runtime metrics: a process-global counter/gauge/histogram registry.
+
+Reference analog: the reference scatters observability over several C++
+singletons — HostEventRecorder counters, memory/stats.h StatRegistry
+(paddle.device.cuda.memory_allocated reads it), and the per-collective
+stats the Fleet executor keeps. Here one registry serves every subsystem
+(jit retraces, collective bytes, dataloader throughput, AMP skips,
+device memory), and the profiler drains it into the Chrome trace as
+`"ph": "C"` counter events so spans + memory + comm share one timeline.
+
+Design constraints:
+
+- near-zero overhead when disabled: every mutator's first action is a
+  plain module-global bool check (no lock, no dict lookup);
+- thread-safe when enabled: one lock per metric instance, registry
+  creation guarded by a registry lock;
+- values survive enable()/disable() cycles — disable stops *recording*,
+  it does not zero history (reset() does that explicitly);
+- optional time-series sampling while a Profiler records: each mutation
+  appends (perf_counter_ns, value) to a bounded ring so the trace shows
+  counters evolving, capped so a hot loop can't balloon memory.
+
+The module deliberately imports nothing from paddle_tpu — it sits below
+every other layer (core.monitor is the instrumentation facade over it;
+profiler.metrics re-exports it as the user-facing address).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_enabled = False      # module-global fast path; read unlocked on purpose
+_sampling = False
+_SAMPLE_CAP = 16384   # per-metric ring bound while sampling
+
+_registry_lock = threading.Lock()
+_metrics: "Dict[str, _Metric]" = {}
+
+# listeners told on enable/disable so facades (core.monitor) can mirror
+# the flag into their own module global without importing us on the
+# hot path
+_listeners: List = []
+
+
+def _now_ns() -> int:
+    return time.perf_counter_ns()
+
+
+class _Metric:
+    kind = "metric"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._samples: List[Tuple[int, float]] = []
+
+    def _sample(self, value: float):
+        # caller holds self._lock
+        if _sampling and len(self._samples) < _SAMPLE_CAP:
+            self._samples.append((_now_ns(), float(value)))
+
+    def drain_samples(self) -> List[Tuple[int, float]]:
+        with self._lock:
+            out, self._samples = self._samples, []
+        return out
+
+
+class Counter(_Metric):
+    """Monotonic counter (ops, bytes, retraces, skips)."""
+    kind = "counter"
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._value = 0
+
+    def inc(self, n: int = 1):
+        if not _enabled:
+            return
+        with self._lock:
+            self._value += n
+            self._sample(self._value)
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+            self._samples = []
+
+
+class Gauge(_Metric):
+    """Point-in-time value with a high-water mark (memory, loss scale)."""
+    kind = "gauge"
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._value = 0.0
+        self._peak = 0.0
+
+    def set(self, v: float):
+        if not _enabled:
+            return
+        with self._lock:
+            self._value = float(v)
+            if self._value > self._peak:
+                self._peak = self._value
+            self._sample(self._value)
+
+    def add(self, dv: float):
+        if not _enabled:
+            return
+        with self._lock:
+            self._value += float(dv)
+            if self._value > self._peak:
+                self._peak = self._value
+            self._sample(self._value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    @property
+    def peak(self) -> float:
+        return self._peak
+
+    def reset_peak(self):
+        """Drop the high-water mark to the current value (works even
+        while disabled — it is an explicit management call, not a
+        hot-path mutation)."""
+        with self._lock:
+            self._peak = self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0.0
+            self._peak = 0.0
+            self._samples = []
+
+
+class Histogram(_Metric):
+    """Power-of-two bucketed distribution (batch bytes, span durations).
+    Buckets are upper bounds; observations above the last bound land in
+    the overflow bucket."""
+    kind = "histogram"
+
+    DEFAULT_BOUNDS = tuple(2 ** i for i in range(4, 31, 2))  # 16 .. 1 GiB
+
+    def __init__(self, name: str, bounds: Optional[Tuple[float, ...]] = None):
+        super().__init__(name)
+        self.bounds = tuple(bounds) if bounds else self.DEFAULT_BOUNDS
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v: float):
+        if not _enabled:
+            return
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+            self._sample(v)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def buckets(self) -> Dict[str, int]:
+        with self._lock:
+            out = {f"le_{b}": c for b, c in zip(self.bounds, self._counts)}
+            out["overflow"] = self._counts[-1]
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._samples = []
+
+
+# ------------------------------------------------------------- registry
+
+def _labeled(name: str, labels: Optional[dict]) -> str:
+    if not labels:
+        return name
+    tag = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{tag}}}"
+
+
+def _get(name: str, cls, labels=None, **kwargs):
+    key = _labeled(name, labels)
+    m = _metrics.get(key)
+    if m is None:
+        with _registry_lock:
+            m = _metrics.get(key)
+            if m is None:
+                m = cls(key, **kwargs)
+                _metrics[key] = m
+    if not isinstance(m, cls):
+        raise TypeError(f"metric {key!r} is a {m.kind}, not "
+                        f"{cls.__name__.lower()}")
+    return m
+
+
+def counter(name: str, **labels) -> Counter:
+    return _get(name, Counter, labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _get(name, Gauge, labels)
+
+
+def histogram(name: str, bounds: Optional[Tuple[float, ...]] = None,
+              **labels) -> Histogram:
+    return _get(name, Histogram, labels, bounds=bounds)
+
+
+# ------------------------------------------------------------ lifecycle
+
+def enable():
+    global _enabled
+    _enabled = True
+    for fn in list(_listeners):
+        fn(True)
+
+
+def disable():
+    global _enabled
+    _enabled = False
+    for fn in list(_listeners):
+        fn(False)
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def on_state_change(fn):
+    """Register fn(enabled: bool), called from enable()/disable(); fires
+    immediately with the current state so late registrants sync up."""
+    _listeners.append(fn)
+    fn(_enabled)
+    return fn
+
+
+def reset():
+    """Zero every metric (explicit management call; enable/disable never
+    clears history)."""
+    with _registry_lock:
+        for m in _metrics.values():
+            m.reset()
+
+
+_sampling_depth = 0
+
+
+def start_sampling():
+    """Begin time-series capture. Nests: capture stays ON until every
+    start has been matched by a stop, so an inner Profiler cycle cannot
+    switch off an outer recorder's capture. Draining is shared, though:
+    each stop takes the samples accumulated since the previous drain,
+    so with nested recorders the sample stream is split between them
+    rather than duplicated."""
+    global _sampling, _sampling_depth
+    _sampling_depth += 1
+    _sampling = True
+
+
+def is_sampling() -> bool:
+    return _sampling
+
+
+def stop_sampling() -> Dict[str, List[Tuple[int, float]]]:
+    """Drain every metric's samples ({name: [(perf_counter_ns, value)]})
+    and, when this stop matches the outermost start, turn capture off."""
+    global _sampling, _sampling_depth
+    _sampling_depth = max(0, _sampling_depth - 1)
+    if _sampling_depth == 0:
+        _sampling = False
+    with _registry_lock:
+        metrics = list(_metrics.values())
+    out = {}
+    for m in metrics:
+        s = m.drain_samples()
+        if s:
+            out[m.name] = s
+    return out
+
+
+def snapshot() -> Dict[str, dict]:
+    """Point-in-time view of the whole registry, cheap enough to call
+    per epoch: {name: {kind, value, ...}}."""
+    with _registry_lock:
+        metrics = list(_metrics.items())
+    out = {}
+    for name, m in metrics:
+        if isinstance(m, Counter):
+            out[name] = {"kind": "counter", "value": m.value}
+        elif isinstance(m, Gauge):
+            out[name] = {"kind": "gauge", "value": m.value, "peak": m.peak}
+        elif isinstance(m, Histogram):
+            out[name] = {"kind": "histogram", "count": m.count,
+                         "sum": m.sum, "mean": m.mean,
+                         "buckets": m.buckets()}
+    return out
+
+
+def report(prefix: str = "") -> str:
+    """Plain-text dump of the registry (one line per metric), optionally
+    filtered by name prefix."""
+    snap = snapshot()
+    lines = []
+    for name in sorted(snap):
+        if prefix and not name.startswith(prefix):
+            continue
+        d = snap[name]
+        if d["kind"] == "counter":
+            lines.append(f"{name} = {d['value']}")
+        elif d["kind"] == "gauge":
+            lines.append(f"{name} = {d['value']:g} (peak {d['peak']:g})")
+        else:
+            lines.append(f"{name}: count={d['count']} mean={d['mean']:g}")
+    return "\n".join(lines)

@@ -1,0 +1,126 @@
+"""Runtime monitor: the near-zero-cost instrumentation facade hot paths
+call into (same pattern as core.prof_hook — a module-global bool guards
+every entry point, so a disabled monitor costs one attribute load and a
+branch per call site).
+
+Reference analog: the reference wires its stat singletons straight into
+the executors (interpretercore op counters, ProcessGroup collective
+stats, AmpScaler's found_inf bookkeeping). Here those call sites go
+through this one module, which forwards to the generic registry in
+profiler.metrics; the profiler drains that registry into the Chrome
+trace and the summary views.
+
+Metric name scheme (what the summary views group by):
+
+    jit.compile{cause=...}      retraces by cause (first/new_shape/...)
+    jit.compile.total           all retraces
+    static.program_builds       program_guard graph captures
+    static.ops_recorded         ops appended to static programs
+    comm.ops{axis=...,op=...}   collective launches per mesh axis
+    comm.bytes{axis=...,op=...} payload bytes per mesh axis
+    io.batches / io.samples / io.bytes    dataloader throughput
+    amp.scaler.steps / amp.scaler.skipped / amp.loss_scale
+    device.memory.allocated / device.memory.reserved   gauges (bytes)
+"""
+from __future__ import annotations
+
+from . import metrics
+
+enabled = False  # mirrored from metrics.enable()/disable()
+
+
+def _sync(on: bool):
+    global enabled
+    enabled = on
+
+
+metrics.on_state_change(_sync)
+
+enable = metrics.enable
+disable = metrics.disable
+
+
+# ------------------------------------------------------------ jit layer
+
+def record_retrace(cause: str, target: str = "jit"):
+    """One jax.jit cache miss. cause: first | new_shape | new_dtype |
+    new_structure | donation_miss."""
+    if not enabled:
+        return
+    metrics.counter(f"{target}.compile", cause=cause).inc()
+    metrics.counter("jit.compile.total").inc()
+
+
+def record_static_build():
+    if not enabled:
+        return
+    metrics.counter("static.program_builds").inc()
+
+
+def record_static_op():
+    if not enabled:
+        return
+    metrics.counter("static.ops_recorded").inc()
+
+
+# ----------------------------------------------------- distributed layer
+
+def record_collective(op: str, axis: str, nbytes: int):
+    if not enabled:
+        return
+    metrics.counter("comm.ops", axis=axis, op=op).inc()
+    metrics.counter("comm.bytes", axis=axis, op=op).inc(int(nbytes))
+    metrics.counter("comm.bytes").inc(int(nbytes))
+
+
+def record_p2p(op: str, nbytes: int):
+    if not enabled:
+        return
+    metrics.counter("comm.ops", axis="p2p", op=op).inc()
+    metrics.counter("comm.bytes", axis="p2p", op=op).inc(int(nbytes))
+    metrics.counter("comm.bytes").inc(int(nbytes))
+
+
+# -------------------------------------------------------------- io layer
+
+def record_dataloader_batch(nsamples: int, nbytes: int):
+    if not enabled:
+        return
+    metrics.counter("io.batches").inc()
+    metrics.counter("io.samples").inc(int(nsamples))
+    metrics.counter("io.bytes").inc(int(nbytes))
+    metrics.histogram("io.batch_bytes").observe(float(nbytes))
+
+
+# ------------------------------------------------------------- amp layer
+
+def record_scaler_step(skipped: bool, scale: float):
+    if not enabled:
+        return
+    metrics.counter("amp.scaler.steps").inc()
+    if skipped:
+        metrics.counter("amp.scaler.skipped").inc()
+    metrics.gauge("amp.loss_scale").set(float(scale))
+
+
+# ---------------------------------------------------------- device layer
+
+def sample_device_memory():
+    """Poll the current device's allocator into the memory gauges (the
+    profiler calls this at every step boundary while recording, so the
+    trace shows memory as a counter track)."""
+    if not enabled:
+        return
+    try:
+        from .. import device as device_ns
+        # memory_allocated() writes the allocated gauge itself (via the
+        # device module's _observe); only reserved needs setting here
+        device_ns.memory_allocated()
+        metrics.gauge("device.memory.reserved").set(
+            device_ns.memory_reserved())
+    except Exception:
+        pass  # never let telemetry break a training step
+
+
+def report() -> str:
+    return metrics.report()

@@ -26,6 +26,9 @@ def enable(recorder):
     _recorder = recorder
     _count += 1
     enabled = True
+    from . import monitor
+    if monitor.enabled:
+        monitor.record_static_build()
 
 
 def disable():
@@ -37,4 +40,9 @@ def disable():
 
 
 def record(name, impl, treedef, leaves, raw_leaves):
-    return _recorder(name, impl, treedef, leaves, raw_leaves)
+    handled, out = _recorder(name, impl, treedef, leaves, raw_leaves)
+    if handled:
+        from . import monitor
+        if monitor.enabled:
+            monitor.record_static_op()
+    return handled, out

@@ -25,8 +25,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import monitor
+from ..core.jaxshim import shard_map
 from ..core.tensor import Tensor
 from . import topology
+
+
+def _count(op: str, axis: str, x):
+    """Collective telemetry: per-axis op/byte counters (the reference's
+    per-collective stats in the Fleet executor). No-op unless the
+    runtime monitor is enabled."""
+    if monitor.enabled:
+        monitor.record_collective(op, axis, getattr(x, "nbytes", 0))
 
 
 class ReduceOp:
@@ -84,6 +94,7 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None,
     mesh = _mesh(group)
     ax = _axis(axis, mesh)
     x = _raw(tensor)
+    _count("all_reduce", ax, x)
 
     if op == ReduceOp.AVG:
         fn = lambda a: jax.lax.psum(a, ax) / mesh.shape[ax]  # noqa: E731
@@ -95,9 +106,9 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None,
         red = _REDUCERS[op]
         fn = lambda a: red(a, ax)  # noqa: E731
 
-    shard = jax.shard_map(fn, mesh=mesh,
-                          in_specs=_spec_on(ax, x.ndim),
-                          out_specs=_spec_on(ax, x.ndim), check_vma=False)
+    shard = shard_map(fn, mesh=mesh,
+                      in_specs=_spec_on(ax, x.ndim),
+                      out_specs=_spec_on(ax, x.ndim), check_vma=False)
     out = shard(_shard_for(x, mesh, ax))
     result = Tensor(out) if isinstance(tensor, Tensor) else out
     if isinstance(tensor, Tensor):
@@ -114,7 +125,8 @@ def all_gather(tensor_list, tensor, group=None, axis: Optional[str] = None,
     ax = _axis(axis, mesh)
     x = _raw(tensor)
     n = mesh.shape[ax]
-    fn = jax.shard_map(
+    _count("all_gather", ax, x)
+    fn = shard_map(
         lambda a: jax.lax.all_gather(a, ax),  # [n, ...local shape]
         mesh=mesh, in_specs=_spec_on(ax, x.ndim),
         out_specs=P(*([None] * (x.ndim + 1))),
@@ -131,14 +143,15 @@ def broadcast(tensor, src: int = 0, group=None, axis: Optional[str] = None,
     ax = _axis(axis, mesh)
     x = _raw(tensor)
     n = mesh.shape[ax]
+    _count("broadcast", ax, x)
 
     def fn(a):
         # select src's shard and replicate it
         full = jax.lax.all_gather(a, ax)
         return full[src]
 
-    shard = jax.shard_map(fn, mesh=mesh, in_specs=_spec_on(ax, x.ndim),
-                          out_specs=_spec_on(ax, x.ndim), check_vma=False)
+    shard = shard_map(fn, mesh=mesh, in_specs=_spec_on(ax, x.ndim),
+                      out_specs=_spec_on(ax, x.ndim), check_vma=False)
     out = shard(_shard_for(x, mesh, ax))
     if isinstance(tensor, Tensor):
         tensor._replace_data(out)
@@ -154,7 +167,8 @@ def reduce_scatter(output, input, op: str = ReduceOp.SUM, group=None,
     mesh = _mesh(group)
     ax = _axis(axis, mesh)
     x = _raw(input)
-    out = jax.shard_map(
+    _count("reduce_scatter", ax, x)
+    out = shard_map(
         lambda a: jax.lax.psum_scatter(a, ax, scatter_dimension=0,
                                        tiled=True),
         mesh=mesh, in_specs=_spec_on(ax, x.ndim),
@@ -173,7 +187,8 @@ def alltoall_single(tensor, group=None, axis: Optional[str] = None):
     mesh = _mesh(group)
     ax = _axis(axis, mesh)
     x = _raw(tensor)
-    out = jax.shard_map(
+    _count("alltoall", ax, x)
+    out = shard_map(
         lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0,
                                      tiled=True),
         mesh=mesh, in_specs=_spec_on(ax, x.ndim),
@@ -203,13 +218,14 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None,
     ax = _axis(axis, mesh)
     stacked = jnp.stack([_raw(t) for t in tensor_list]) if tensor_list \
         else _raw(tensor)
+    _count("scatter", ax, stacked)
     out = jax.device_put(
         stacked, NamedSharding(mesh, _spec_on(ax, stacked.ndim)))
 
     def fn(a):
         return a[0]
 
-    res = jax.shard_map(fn, mesh=mesh, in_specs=_spec_on(ax, stacked.ndim),
+    res = shard_map(fn, mesh=mesh, in_specs=_spec_on(ax, stacked.ndim),
                         out_specs=_spec_on(ax, stacked.ndim - 1)
                         if stacked.ndim > 1 else P(ax))(out)
     if isinstance(tensor, Tensor):

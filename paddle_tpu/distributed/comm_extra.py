@@ -18,6 +18,7 @@ from typing import List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import monitor
 from ..core.tensor import Tensor
 from . import topology
 
@@ -169,6 +170,8 @@ def send(tensor, dst: int = 0, group=None, sync_op: bool = True):
         raise RuntimeError("send needs a multi-process launch")
     arr = np.asarray(tensor.data if isinstance(tensor, Tensor)
                      else tensor)
+    if monitor.enabled:
+        monitor.record_p2p("send", arr.nbytes)
     store = _store()
     chan = ("s", rank, dst)
     seq = _P2P_SEQ.get(chan, 0)
@@ -187,6 +190,8 @@ def recv(tensor, src: int = 0, group=None, sync_op: bool = True):
     key = f"__p2p/{src}->{rank}/{seq}"
     data = pickle.loads(store.get(key))
     store.delete(key)  # consume
+    if monitor.enabled:
+        monitor.record_p2p("recv", getattr(data, "nbytes", 0))
     if isinstance(tensor, Tensor):
         tensor.set_value(jnp.asarray(data))
         return tensor

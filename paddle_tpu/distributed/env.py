@@ -17,6 +17,8 @@ from typing import Optional
 
 import jax
 
+from ..core.jaxshim import shard_map
+
 _INITIALIZED = False
 
 
@@ -88,5 +90,5 @@ def barrier(group=None):
     mesh = Mesh(np.array(devs), ("all",))
     x = jax.device_put(jnp.zeros(len(devs)),
                        NamedSharding(mesh, P("all")))
-    jax.shard_map(lambda a: jax.lax.psum(a, "all"), mesh=mesh,
+    shard_map(lambda a: jax.lax.psum(a, "all"), mesh=mesh,
                   in_specs=P("all"), out_specs=P())(x).block_until_ready()

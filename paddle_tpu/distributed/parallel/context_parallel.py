@@ -29,7 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ...core.jaxshim import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import topology

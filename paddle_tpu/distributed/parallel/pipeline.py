@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ...core.jaxshim import shard_map
 from ...core.tensor import Parameter, Tensor
 from ...nn.container import Sequential
 from ...nn.layer import Layer
@@ -430,7 +431,7 @@ def _spmd_pipeline(unit_call, names, stacked_vals, specs, seg_counts,
         # stage S-1's slice is real, sliced out by the caller.
         return outs[None]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(), P("pp")) + tuple(specs),
         out_specs=P("pp"),

@@ -1,7 +1,8 @@
 """High-level API (≈ python/paddle/hapi): Model.fit/evaluate/predict +
 callbacks."""
 from .callbacks import (Callback, EarlyStopping,  # noqa: F401
-                        LRSchedulerCallback, ModelCheckpoint, ProgBarLogger,
+                        LRSchedulerCallback, MetricsCallback,
+                        ModelCheckpoint, ProgBarLogger,
                         ReduceLROnPlateau, TerminateOnNaN, VisualDL)
 from .model import Model  # noqa: F401
 from .model_summary import flops, summary  # noqa: F401

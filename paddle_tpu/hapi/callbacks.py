@@ -322,6 +322,89 @@ class TerminateOnNaN(Callback):
             self.stopped = True
 
 
+class MetricsCallback(Callback):
+    """Per-epoch runtime telemetry from the metrics registry: steps/sec,
+    samples/sec (tokens/sec with `tokens_per_sample`), peak device
+    memory, and jit retrace count. Enables the registry for the duration
+    of fit() (restoring the caller's state afterwards) and folds its
+    numbers into the epoch logs so ProgBarLogger/VisualDL pick them up.
+    No reference analog — the reference surfaces these through separate
+    profiler runs; here they are cheap enough to keep on every fit."""
+
+    def __init__(self, tokens_per_sample: int = 0, verbose: int = 1):
+        super().__init__()
+        self.tokens_per_sample = tokens_per_sample
+        self.verbose = verbose
+
+    @staticmethod
+    def _counter(name: str) -> int:
+        from ..profiler import metrics
+        snap = metrics.snapshot().get(name)
+        return int(snap["value"]) if snap else 0
+
+    def on_train_begin(self, logs=None):
+        from ..profiler import metrics
+        self._was_enabled = metrics.is_enabled()
+        metrics.enable()
+
+    def on_train_end(self, logs=None):
+        from ..profiler import metrics
+        # don't switch the registry off under a Profiler still
+        # mid-record (its sampling window owns the enabled state then)
+        if not getattr(self, "_was_enabled", True) and \
+                not metrics.is_sampling():
+            metrics.disable()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        from .. import device
+        self._t0 = time.time()
+        self._steps = 0
+        self._samples0 = self._counter("io.samples")
+        self._retraces0 = self._counter("jit.compile.total")
+        try:
+            device.reset_peak_memory_stats()
+            # per-batch polling advances the tracked high-water, but
+            # only where the backend answers from allocator stats; the
+            # live-arrays fallback is O(live arrays) — too hot per batch
+            self._poll_batches = bool(device.memory_stats())
+        except Exception:
+            self._poll_batches = False
+
+    def on_train_batch_end(self, step, logs=None):
+        self._steps += 1
+        if getattr(self, "_poll_batches", False):
+            try:
+                from .. import device
+                device.memory_allocated()
+            except Exception:
+                pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        from .. import device
+        dt = max(time.time() - self._t0, 1e-9)
+        stats = {
+            "steps_per_sec": self._steps / dt,
+            "retraces": self._counter("jit.compile.total")
+            - self._retraces0,
+        }
+        samples = self._counter("io.samples") - self._samples0
+        if samples:
+            stats["samples_per_sec"] = samples / dt
+            if self.tokens_per_sample:
+                stats["tokens_per_sec"] = \
+                    samples * self.tokens_per_sample / dt
+        try:
+            stats["peak_memory_bytes"] = device.max_memory_allocated()
+        except Exception:
+            pass
+        if logs is not None:
+            logs.update(stats)
+        if self.verbose:
+            parts = [f"{k}: {v:.2f}" if isinstance(v, float)
+                     else f"{k}: {v}" for k, v in stats.items()]
+            print(f"[metrics] epoch {epoch + 1} - " + " - ".join(parts))
+
+
 class VisualDL(Callback):
     """Scalar logging callback (reference hapi/callbacks.py:880 writes
     VisualDL event files). The visualdl package is absent here, so the

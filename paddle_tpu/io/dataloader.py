@@ -21,9 +21,25 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from ..core import monitor
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+
+
+def _batch_stats(batch):
+    """(nsamples, nbytes) of a collated batch — leading dim of the first
+    array leaf, total array bytes. Only walked while the monitor is on."""
+    nbytes = 0
+    nsamples = 0
+    for leaf in jax.tree_util.tree_leaves(
+            batch, is_leaf=lambda x: isinstance(x, Tensor)):
+        arr = leaf.data if isinstance(leaf, Tensor) else leaf
+        if hasattr(arr, "nbytes"):
+            nbytes += arr.nbytes
+            if not nsamples and getattr(arr, "shape", ()):
+                nsamples = int(arr.shape[0])
+    return nsamples, nbytes
 
 
 def default_collate_fn(batch):
@@ -191,6 +207,8 @@ class _PrefetchIterator:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        if monitor.enabled:
+            monitor.record_dataloader_batch(*_batch_stats(item))
         return item
 
     def __iter__(self):

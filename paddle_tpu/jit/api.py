@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import numpy as np
 
+from ..core import monitor
 from ..core.tensor import Parameter, Tensor, no_grad
 from ..optimizer.optimizer import opt_key as _opt_key
 from ..nn.layer import Layer
@@ -24,6 +25,95 @@ from ..nn.layer import Layer
 
 def _unwrap(x):
     return x._data if isinstance(x, Tensor) else x
+
+
+class _RetraceTracker:
+    """Classifies jax.jit cache misses into the metrics registry:
+    first | new_shape | new_dtype | new_structure | donation_miss (the
+    signature was seen but the jit cache still grew — donation or
+    weak-type mismatch). Zero work unless the monitor is enabled."""
+
+    # cap remembered signatures: under pathological dynamic shapes the
+    # classifier degrades gracefully (oldest evicted) instead of scanning
+    # and retaining an unbounded history
+    MAX_SEEN = 256
+
+    def __init__(self):
+        import collections
+        self._seen = collections.deque(maxlen=self.MAX_SEEN)
+        self._seen_set = set()
+
+    @staticmethod
+    def _signature(trees):
+        """(treedef, ((shape, dtype), ...)) — treedef included because
+        it is part of jax's jit cache key (same leaves under a different
+        container nesting still retrace)."""
+        leaves, treedef = jax.tree_util.tree_flatten(trees)
+        sig = []
+        for v in leaves:
+            if hasattr(v, "shape") and hasattr(v, "dtype"):
+                sig.append((tuple(v.shape), str(v.dtype)))
+            else:
+                sig.append((type(v).__name__, ""))
+        return (str(treedef), tuple(sig))
+
+    def _classify(self, sig) -> str:
+        if not self._seen:
+            return "first"
+        tdef, leaves = sig
+        if any(s_leaves == leaves and s_tdef != tdef
+               for s_tdef, s_leaves in self._seen):
+            return "new_structure"
+        same_len = [s_leaves for _, s_leaves in self._seen
+                    if len(s_leaves) == len(leaves)]
+        if not same_len:
+            return "new_structure"
+        shapes = tuple(s for s, _ in leaves)
+        dtypes = tuple(d for _, d in leaves)
+        for s in same_len:
+            if tuple(d for _, d in s) == dtypes:
+                return "new_shape"
+        for s in same_len:
+            if tuple(sh for sh, _ in s) == shapes:
+                return "new_dtype"
+        return "new_structure"
+
+    @staticmethod
+    def _cache_of(jitted):
+        try:
+            return jitted._cache_size()
+        except Exception:
+            return None
+
+    def pre(self, jitted):
+        """Call BEFORE the jitted call: cache size going in, or None
+        when the monitor is off (observe() will no-op)."""
+        if not monitor.enabled:
+            return None
+        return self._cache_of(jitted)
+
+    def observe(self, jitted, trees, pre_cache):
+        """Call AFTER the jitted call with pre()'s value. A retrace is
+        counted only when the compiled cache actually grew during this
+        call, so enabling the monitor against a warmed function never
+        reports phantom compiles; without cache introspection the
+        signature novelty is the (over-approximate) fallback."""
+        if not monitor.enabled:
+            return
+        cache = self._cache_of(jitted)
+        known = cache is not None and pre_cache is not None
+        compiled = known and cache > pre_cache
+        sig = self._signature(trees)
+        if sig in self._seen_set:
+            if compiled:
+                monitor.record_retrace("donation_miss")
+            return
+        if compiled or not known:
+            monitor.record_retrace(self._classify(sig))
+        if len(self._seen) == self.MAX_SEEN:
+            self._seen_set.discard(self._seen[0])  # deque evicts it
+        self._seen_set.add(sig)
+        self._seen.append(sig)
 
 
 def _wrap(x):
@@ -82,6 +172,7 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
                                           is_leaf=lambda x: isinstance(x, Tensor))
 
         jitted._state_names = None
+        tracker = _RetraceTracker()
 
         @functools.wraps(target)
         def wrapper(*args, **kwargs):
@@ -101,7 +192,10 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
                 _unwrap, args, is_leaf=lambda x: isinstance(x, Tensor))
             kw_vals = jax.tree_util.tree_map(
                 _unwrap, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+            pre_cache = tracker.pre(jitted)
             out = jitted(state_vals, arg_vals, kw_vals)
+            tracker.observe(jitted, (state_vals, arg_vals, kw_vals),
+                            pre_cache)
             return jax.tree_util.tree_map(_wrap, out)
 
         wrapper.__wrapped_layer__ = fn if is_layer else None
@@ -221,6 +315,7 @@ class TrainStep:
         self._step_fn = step_fn
         self._donate_argnums = donate_argnums
         self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+        self._tracker = _RetraceTracker()
 
     def _setup_offload(self):
         """Re-jit with the opt state parked in pinned host memory: the
@@ -277,9 +372,14 @@ class TrainStep:
             jax.tree_util.tree_map(
                 _unwrap, b, is_leaf=lambda t: isinstance(t, Tensor))
             for b in batch)
+        pre_cache = self._tracker.pre(self._jitted)
         loss, new_vals, self._opt_state_tree = self._jitted(
             [p._data for p in params], self._opt_state_tree,
             np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)
+        if monitor.enabled:  # donated args keep their aval metadata
+            self._tracker.observe(
+                self._jitted, ([p._data for p in params], raw_batch),
+                pre_cache)
         for p, v in zip(params, new_vals):
             p._data = v
         # mirror the functional state back so optimizer.state_dict()

@@ -27,8 +27,10 @@ Usage (reference API shape):
     p.stop()
     p.summary()
 """
+from . import metrics  # noqa: F401
 from .profiler import (Profiler, ProfilerResult, ProfilerState,  # noqa: F401
                        ProfilerTarget, RecordEvent, SummaryView,
                        export_chrome_tracing, export_protobuf,
                        load_profiler_result, make_scheduler)
-from .statistic import SortedKeys, summary_table  # noqa: F401
+from .statistic import (SortedKeys, summary_report,  # noqa: F401
+                        summary_table, view_table)

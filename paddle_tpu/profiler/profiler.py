@@ -9,6 +9,7 @@ import time
 from typing import Callable, Iterable, List, Optional
 
 from ..core import prof_hook
+from . import metrics
 
 
 class ProfilerState(enum.Enum):
@@ -180,30 +181,59 @@ def _host_collect() -> List[tuple]:
 # ---------------------------------------------------------------- results
 
 class ProfilerResult:
-    def __init__(self, events: List[tuple], device_trace_dir: Optional[str]):
+    def __init__(self, events: List[tuple], device_trace_dir: Optional[str],
+                 counter_samples: Optional[dict] = None,
+                 metrics_snapshot: Optional[dict] = None):
         #: [(name, start_ns, end_ns, tid, mem_bytes)]
         self.events = events
         #: directory holding the jax/XPlane device trace, if captured
         self.device_trace_dir = device_trace_dir
+        #: {metric_name: [(perf_counter_ns, value)]} captured while
+        #: recording — becomes "ph": "C" counter tracks in the trace
+        self.counter_samples = counter_samples or {}
+        #: metrics registry snapshot at end-of-record — feeds the
+        #: Memory/Distributed summary views
+        self.metrics_snapshot = metrics_snapshot or {}
 
     def export_chrome_tracing(self, path: str):
-        """Write a chrome://tracing / Perfetto JSON of the host spans
-        (≈ chrometracing_logger.cc output)."""
-        trace = {"traceEvents": [
+        """Write a chrome://tracing / Perfetto JSON: "ph": "X" span
+        events for host spans plus "ph": "C" counter events for every
+        sampled metric (memory, collective bytes, ...), all under this
+        process's real pid so merged multi-host traces stay
+        distinguishable (≈ chrometracing_logger.cc output)."""
+        pid = os.getpid()
+        trace_events = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"host_{pid}"}}]
+        trace_events += [
             {"name": name, "ph": "X", "cat": "host",
              "ts": start / 1000.0, "dur": max(end - start, 0) / 1000.0,
-             "pid": 0, "tid": tid,
+             "pid": pid, "tid": tid,
              **({"args": {"bytes": mem}} if mem else {})}
-            for name, start, end, tid, mem in self.events]}
+            for name, start, end, tid, mem in self.events]
+        for metric, samples in self.counter_samples.items():
+            trace_events += [
+                {"name": metric, "ph": "C", "cat": "metric",
+                 "ts": ts / 1000.0, "pid": pid,
+                 "args": {metric: value}}
+                for ts, value in samples]
+        trace = {"traceEvents": trace_events}
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         with open(path, "w") as f:
             json.dump(trace, f)
         return path
 
     def summary(self, sorted_by=None, time_unit: str = "ms") -> str:
-        from .statistic import summary_table
-        return summary_table(self.events, sorted_by=sorted_by,
-                             time_unit=time_unit)
+        from . import statistic
+        if isinstance(sorted_by, SummaryView):
+            return statistic.view_table(
+                sorted_by.name, self.events, self.metrics_snapshot,
+                time_unit=time_unit)
+        if sorted_by is None and self.metrics_snapshot:
+            return statistic.summary_report(
+                self.events, self.metrics_snapshot, time_unit=time_unit)
+        return statistic.summary_table(self.events, sorted_by=sorted_by,
+                                       time_unit=time_unit)
 
 
 def export_chrome_tracing(dir_name: str,
@@ -241,8 +271,14 @@ class Profiler:
             self.scheduler = _default_scheduler
         elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
             start, end = scheduler
+            if not all(isinstance(v, int) for v in (start, end)) \
+                    or start < 0 or end <= start:
+                raise ValueError(
+                    f"scheduler={tuple(scheduler)!r}: a (start, end) "
+                    f"tuple needs integers with 0 <= start < end "
+                    f"(records steps [start, end))")
             self.scheduler = make_scheduler(
-                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+                closed=start, ready=0, record=end - start, repeat=1)
         else:
             raise TypeError(f"bad scheduler {scheduler!r}")
         self.on_trace_ready = on_trace_ready
@@ -273,9 +309,16 @@ class Profiler:
         self.current_state = ProfilerState.CLOSED
 
     def step(self):
-        """Advance one iteration; drives the state machine."""
+        """Advance one iteration; drives the state machine. While
+        recording, each step boundary also polls device memory into the
+        metrics gauges so the trace gets a per-step memory track."""
         if not self._started:
             return
+        if not self.timer_only and \
+                self.current_state in (ProfilerState.RECORD,
+                                       ProfilerState.RECORD_AND_RETURN):
+            from ..core import monitor
+            monitor.sample_device_memory()
         self._step += 1
         self._transition(self.scheduler(self._step))
 
@@ -309,6 +352,16 @@ class Profiler:
     def _begin_record(self):
         if not self.timer_only:
             _host_enable()
+        # drive the metrics registry for the duration of the record
+        # window (leave it alone if the user enabled it themselves);
+        # timer_only keeps its minimal-overhead contract: no registry,
+        # no sampling, no memory polling
+        self._metrics_were_enabled = metrics.is_enabled()
+        if not self.timer_only:
+            metrics.enable()
+            metrics.start_sampling()
+            from ..core import monitor
+            monitor.sample_device_memory()
         if ProfilerTarget.TPU in self.targets and not self.timer_only:
             try:
                 import jax
@@ -335,7 +388,17 @@ class Profiler:
             self._pending_events = []
         else:
             events = []
-        self.result = ProfilerResult(events, device_dir)
+        if not self.timer_only:
+            from ..core import monitor
+            monitor.sample_device_memory()
+            snapshot = metrics.snapshot()
+            counter_samples = metrics.stop_sampling()
+            if not getattr(self, "_metrics_were_enabled", False):
+                metrics.disable()
+        else:
+            snapshot, counter_samples = None, None
+        self.result = ProfilerResult(events, device_dir,
+                                     counter_samples, snapshot)
         self._cycle += 1
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -350,7 +413,8 @@ class Profiler:
                                   ProfilerState.RECORD_AND_RETURN) \
                 and not self.timer_only:
             self._pending_events += _host_collect()
-            result = ProfilerResult(list(self._pending_events), None)
+            result = ProfilerResult(list(self._pending_events), None,
+                                    None, metrics.snapshot())
         if result is None:
             print("No profiler data recorded.")
             return
@@ -378,7 +442,9 @@ def export_protobuf(result: "ProfilerResult", path: str):
     import pickle
     with open(path, "wb") as f:
         pickle.dump({"events": result.events,
-                     "device_trace_dir": result.device_trace_dir}, f)
+                     "device_trace_dir": result.device_trace_dir,
+                     "counter_samples": result.counter_samples,
+                     "metrics_snapshot": result.metrics_snapshot}, f)
 
 
 def load_profiler_result(path: str) -> "ProfilerResult":
@@ -387,4 +453,6 @@ def load_profiler_result(path: str) -> "ProfilerResult":
     import pickle
     with open(path, "rb") as f:
         d = pickle.load(f)
-    return ProfilerResult(d["events"], d.get("device_trace_dir"))
+    return ProfilerResult(d["events"], d.get("device_trace_dir"),
+                          d.get("counter_samples"),
+                          d.get("metrics_snapshot"))

@@ -1,0 +1,321 @@
+"""Metrics registry + runtime monitor coverage: lifecycle (values
+survive enable/disable cycles), thread safety, device-memory peak
+tracking/reset, retrace cause classification, counter events in the
+exported Chrome trace, and the summary views built from the registry."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.device as device
+from paddle_tpu.core import monitor
+from paddle_tpu.profiler import (Profiler, ProfilerTarget, RecordEvent,
+                                 SummaryView, metrics)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    metrics.disable()
+    metrics.reset()
+    yield
+    metrics.disable()
+    metrics.reset()
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        metrics.enable()
+        c = metrics.counter("t.counter")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        g = metrics.gauge("t.gauge")
+        g.set(10)
+        g.set(3)
+        assert g.value == 3 and g.peak == 10
+        h = metrics.histogram("t.hist")
+        for v in (10, 100, 1000):
+            h.observe(v)
+        assert h.count == 3 and h.sum == 1110 and h.mean == 370
+
+    def test_same_name_same_instance(self):
+        assert metrics.counter("t.same") is metrics.counter("t.same")
+        assert metrics.counter("t.same", axis="dp") is not \
+            metrics.counter("t.same", axis="mp")
+        with pytest.raises(TypeError):
+            metrics.gauge("t.same")
+
+    def test_values_survive_enable_disable_enable(self):
+        metrics.enable()
+        c = metrics.counter("t.cycle")
+        g = metrics.gauge("t.cycle.gauge")
+        c.inc(7)
+        g.set(42)
+        metrics.disable()
+        c.inc(100)   # dropped: recording is off
+        g.set(1000)
+        assert c.value == 7 and g.value == 42 and g.peak == 42
+        metrics.enable()
+        c.inc(3)
+        assert c.value == 10
+        assert metrics.counter("t.cycle").value == 10
+
+    def test_disabled_mutations_are_noops(self):
+        c = metrics.counter("t.off")
+        c.inc(999)
+        assert c.value == 0
+        assert not metrics.is_enabled()
+
+    def test_reset_zeroes(self):
+        metrics.enable()
+        metrics.counter("t.rst").inc(5)
+        metrics.reset()
+        assert metrics.counter("t.rst").value == 0
+
+    def test_thread_hammer(self):
+        """4 threads x 10k increments land exactly; gauge peak is the
+        true maximum over every thread's writes."""
+        metrics.enable()
+        c = metrics.counter("t.hammer")
+        g = metrics.gauge("t.hammer.gauge")
+        n, per = 4, 10000
+
+        def work(tid):
+            for i in range(per):
+                c.inc()
+                g.set(tid * per + i)
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n * per
+        assert g.peak == (n - 1) * per + per - 1
+
+    def test_sampling_drains(self):
+        metrics.enable()
+        metrics.start_sampling()
+        metrics.counter("t.samp").inc()
+        metrics.counter("t.samp").inc()
+        out = metrics.stop_sampling()
+        assert len(out["t.samp"]) == 2
+        assert [v for _, v in out["t.samp"]] == [1, 2]
+        # drained: a second stop returns nothing for this metric
+        assert "t.samp" not in metrics.stop_sampling()
+
+    def test_sampling_nests(self):
+        """An inner start/stop pair must not switch off an outer
+        recorder's capture."""
+        metrics.enable()
+        metrics.start_sampling()       # outer
+        metrics.start_sampling()       # inner
+        metrics.counter("t.nest").inc()
+        metrics.stop_sampling()        # inner: drains, capture stays on
+        metrics.counter("t.nest").inc()
+        out = metrics.stop_sampling()  # outer
+        assert [v for _, v in out["t.nest"]] == [2]
+
+    def test_monitor_flag_mirrors_registry(self):
+        assert monitor.enabled is False
+        metrics.enable()
+        assert monitor.enabled is True
+        metrics.disable()
+        assert monitor.enabled is False
+
+
+class TestDeviceMemory:
+    def test_allocated_nonzero_with_live_array(self):
+        keep = paddle.to_tensor(np.ones((256, 256), np.float32))
+        assert device.memory_allocated() >= keep.data.nbytes
+
+    def test_reset_peak_memory_stats_resets_high_water(self):
+        metrics.enable()
+        base = device.reset_peak_memory_stats()
+        big = paddle.to_tensor(np.ones((512, 512), np.float32))
+        high = device.max_memory_allocated()
+        assert high >= base + big.data.nbytes
+        del big
+        reset_to = device.reset_peak_memory_stats()
+        assert reset_to < high
+        assert device.max_memory_allocated() < high
+        # the registry gauge's high-water mark was reset too
+        g = metrics.gauge("device.memory.allocated")
+        assert g.peak <= high
+
+    def test_memory_reserved_and_aliases(self):
+        assert device.memory_reserved() >= 0
+        assert device.max_memory_reserved() >= 0
+        # the CUDA-parity names reset their own mark only; the
+        # torch-style name resets both
+        assert device.reset_max_memory_allocated() >= 0
+        assert device.reset_max_memory_reserved() >= 0
+        assert device.reset_peak_memory_stats() >= 0
+
+
+class TestRetraceTracking:
+    def test_causes_classified(self):
+        metrics.enable()
+        fn = paddle.jit.to_static(lambda a: a + 1)
+        fn(paddle.ones([3]))
+        fn(paddle.ones([3]))      # cache hit: no new compile
+        fn(paddle.ones([5]))      # new shape
+        fn(paddle.ones([5]).astype("int32"))  # new dtype
+        snap = metrics.snapshot()
+        assert snap["jit.compile{cause=first}"]["value"] == 1
+        assert snap["jit.compile{cause=new_shape}"]["value"] == 1
+        assert snap["jit.compile{cause=new_dtype}"]["value"] == 1
+        assert snap["jit.compile.total"]["value"] == 3
+
+    def test_no_phantom_retrace_after_warmup(self):
+        fn = paddle.jit.to_static(lambda a: a - 1)
+        fn(paddle.ones([4]))   # warmed while the monitor is off
+        metrics.enable()
+        fn(paddle.ones([4]))   # cache hit: must not count a compile
+        snap = metrics.snapshot()
+        assert snap.get("jit.compile.total", {"value": 0})["value"] == 0
+
+
+class TestCollectiveCounters:
+    def test_all_reduce_counts_bytes(self):
+        metrics.enable()
+        from paddle_tpu.distributed import collective
+        x = paddle.ones([8, 8])
+        nbytes = x.data.nbytes
+        collective.all_reduce(x)
+        snap = metrics.snapshot()
+        key = "comm.bytes{axis=world,op=all_reduce}"
+        assert snap[key]["value"] == nbytes
+        assert snap["comm.ops{axis=world,op=all_reduce}"]["value"] == 1
+
+
+class TestProfilerIntegration:
+    def test_trace_has_span_and_counter_events(self, tmp_path):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        p.start()
+        with RecordEvent("step"):
+            paddle.ones([32, 32]).sum()
+        from paddle_tpu.distributed import collective
+        collective.all_reduce(paddle.ones([8, 8]))
+        p.stop()
+        path = str(tmp_path / "trace.json")
+        p.result.export_chrome_tracing(path)
+        with open(path) as f:
+            events = json.load(f)["traceEvents"]
+        phases = {ev["ph"] for ev in events}
+        assert {"X", "C", "M"} <= phases
+        counters = {ev["name"] for ev in events if ev["ph"] == "C"}
+        assert "device.memory.allocated" in counters
+        assert any(c.startswith("comm.bytes") for c in counters)
+        import os
+        assert {ev["pid"] for ev in events} == {os.getpid()}
+        meta = [ev for ev in events if ev["ph"] == "M"]
+        assert meta and meta[0]["name"] == "process_name"
+
+    def test_summary_views_populated(self, capsys):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        p.start()
+        with RecordEvent("step"):
+            paddle.ones([16, 16]).sum()
+        from paddle_tpu.distributed import collective
+        collective.all_reduce(paddle.ones([8]))
+        p.stop()
+        mem = p.result.summary(sorted_by=SummaryView.MemoryView)
+        assert "MemoryView" in mem and "device.memory.allocated" in mem
+        dist = p.result.summary(sorted_by=SummaryView.DistributedView)
+        assert "DistributedView" in dist and "all_reduce" in dist
+        over = p.result.summary(sorted_by=SummaryView.OverView)
+        assert "host spans" in over
+        ops = p.result.summary(sorted_by=SummaryView.OperatorView)
+        assert "OperatorView" in ops
+
+    def test_profiler_restores_metrics_state(self):
+        assert not metrics.is_enabled()
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        p.start()
+        assert metrics.is_enabled()
+        p.stop()
+        assert not metrics.is_enabled()
+        # ... and leaves a user-enabled registry enabled
+        metrics.enable()
+        p2 = Profiler(targets=[ProfilerTarget.CPU])
+        p2.start()
+        p2.stop()
+        assert metrics.is_enabled()
+
+    def test_bad_tuple_scheduler_raises(self):
+        with pytest.raises(ValueError, match=r"\(5, 3\)"):
+            Profiler(scheduler=(5, 3))
+        with pytest.raises(ValueError, match=r"\(2, 2\)"):
+            Profiler(scheduler=(2, 2))
+        Profiler(scheduler=(0, 4))  # valid: records steps [0, 4)
+
+
+class TestMetricsCallback:
+    def test_epoch_stats_in_logs(self, capsys):
+        from paddle_tpu.hapi.callbacks import MetricsCallback
+        cb = MetricsCallback(tokens_per_sample=128)
+        cb.set_params({"epochs": 1})
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        fn = paddle.jit.to_static(lambda a: a * 2)
+        fn(paddle.ones([4]))
+        metrics.counter("io.samples").inc(64)
+        for step in range(5):
+            cb.on_train_batch_end(step)
+        logs = {}
+        cb.on_epoch_end(0, logs)
+        cb.on_train_end()
+        assert logs["steps_per_sec"] > 0
+        assert logs["retraces"] >= 1
+        assert logs["samples_per_sec"] > 0
+        assert logs["tokens_per_sec"] == \
+            pytest.approx(logs["samples_per_sec"] * 128)
+        assert "peak_memory_bytes" in logs
+        assert "[metrics]" in capsys.readouterr().out
+        assert not metrics.is_enabled()  # restored
+
+    def test_dataloader_counts_batches(self):
+        metrics.enable()
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Ds(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.full((4,), i, np.float32)
+
+        before = metrics.counter("io.batches").value
+        n = sum(1 for _ in DataLoader(Ds(), batch_size=2))
+        assert n == 4
+        snap = metrics.snapshot()
+        assert snap["io.batches"]["value"] - before == 4
+        assert snap["io.samples"]["value"] >= 8
+
+
+class TestGradScalerCounters:
+    def test_skip_counted(self):
+        metrics.enable()
+        from paddle_tpu.amp import GradScaler
+
+        class FakeOpt:
+            _parameter_list = []
+
+            def step(self):
+                pass
+
+        s = GradScaler(init_loss_scaling=8.0, decr_every_n_nan_or_inf=1)
+        s._found_inf = False
+        opt = FakeOpt()
+        s.unscale_ = lambda o: None  # keep _found_inf as set below
+        s.step(opt)
+        s._found_inf = True
+        s.step(opt)
+        snap = metrics.snapshot()
+        assert snap["amp.scaler.steps"]["value"] == 2
+        assert snap["amp.scaler.skipped"]["value"] == 1
+        assert snap["amp.loss_scale"]["value"] > 0

@@ -73,8 +73,13 @@ class TestProfiler:
         with open(path) as f:
             data = json.load(f)
         assert any(ev["name"] == "span_a" for ev in data["traceEvents"])
-        for ev in data["traceEvents"]:
-            assert ev["ph"] == "X" and "ts" in ev and "dur" in ev
+        spans = [ev for ev in data["traceEvents"] if ev["ph"] == "X"]
+        assert spans
+        for ev in spans:
+            assert "ts" in ev and "dur" in ev
+        # counter/metadata events ride along in the same trace
+        assert all(ev["ph"] in ("X", "C", "M")
+                   for ev in data["traceEvents"])
 
     def test_summary_table(self):
         p = Profiler(targets=[ProfilerTarget.CPU])
