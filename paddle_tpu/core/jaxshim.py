@@ -4,8 +4,15 @@ releases, so the rest of the codebase writes the current spelling once.
 `shard_map`: new jax exposes `jax.shard_map(..., check_vma=, axis_names=)`;
 older releases have `jax.experimental.shard_map.shard_map(..., check_rep=,
 auto=)` where `auto` is the complement of `axis_names` over the mesh.
+
+`pcast`: new jax's varying-manual-axes (vma) cast. Old releases have no
+vma type system, so the cast degenerates to `pvary` where that exists and
+to the identity otherwise — replication tracking there is `check_rep`'s
+job, not the program's.
 """
 from __future__ import annotations
+
+import threading as _threading
 
 import jax
 
@@ -17,14 +24,74 @@ else:
     _NEW_API = False
 
 
+# per-thread depth counter: >0 while THIS thread traces a body under the
+# old-jax full-manual fallback below, where sharding constraints over
+# would-be-auto axes are illegal and must degrade to identity (read via
+# in_manual_fallback()). Thread-local: a fallback trace on one thread
+# must not silently drop legitimate constraints traced concurrently on
+# another.
+_fallback_tls = _threading.local()
+
+
+def in_manual_fallback() -> bool:
+    return getattr(_fallback_tls, "depth", 0) > 0
+
+
 def shard_map(f, mesh=None, in_specs=None, out_specs=None,
               check_vma=None, axis_names=None, **kw):
+    full_manual_fallback = False
     if axis_names is not None:
         if _NEW_API:
             kw["axis_names"] = set(axis_names)
         else:
-            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
-    if check_vma is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                # partial-auto shard_map is NotImplemented before the
+                # vma rewrite: run fully manual instead. Specs leave the
+                # would-be-auto axes unmentioned (= replicated), so jax
+                # reshards inputs to match and the body sees the same
+                # per-manual-axis slices — numerically identical, it
+                # only forfeits the auto-axis sharding ride-along.
+                # check_rep can't reason about that replication, so it
+                # is off for this fallback — unconditionally: even an
+                # explicit check_vma=True below must not re-enable it
+                kw["check_rep"] = False
+                full_manual_fallback = True
+            else:
+                kw["auto"] = auto
+    if check_vma is not None and not full_manual_fallback:
         kw["check_vma" if _NEW_API else "check_rep"] = check_vma
-    return _native(f, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, **kw)
+    mapped = _native(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kw)
+    if not full_manual_fallback:
+        return mapped
+
+    def run(*args, **kwargs):
+        # flag the trace so in-body sharding constraints on the (now
+        # manual) auto axes skip themselves instead of failing lowering
+        _fallback_tls.depth = getattr(_fallback_tls, "depth", 0) + 1
+        try:
+            return mapped(*args, **kwargs)
+        finally:
+            _fallback_tls.depth -= 1
+
+    return run
+
+
+# Old jax pairs donated input buffers to outputs by aval (shape+dtype)
+# only: with ZeRO-style state, a replicated param can be aliased to a
+# same-shaped but SHARDED opt-state output and the runtime dies with
+# "Expected aliased input ... to have the same size". New jax matches
+# shardings (and merely warns about unusable donations), so donation of
+# differently-sharded state trees is only safe there.
+SHARDING_AWARE_DONATION = _NEW_API
+
+
+def pcast(x, axis_names, to="varying"):
+    """`jax.lax.pcast` analog that degrades on pre-vma jax releases."""
+    axes = tuple(axis_names)
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    if to == "varying" and hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x

@@ -21,6 +21,11 @@ Metric name scheme (what the summary views group by):
     io.batches / io.samples / io.bytes    dataloader throughput
     amp.scaler.steps / amp.scaler.skipped / amp.loss_scale
     device.memory.allocated / device.memory.reserved   gauges (bytes)
+    resilience.preemptions / resilience.emergency_saves
+    resilience.watchdog.timeouts{label=...}   hang-watchdog expiries
+    resilience.ckpt.fallback    corrupt checkpoint steps skipped on restore
+    train.anomalies / train.anomaly_restores  non-finite-loss guard
+    errors.swallowed{where=...} deliberately swallowed exceptions
 """
 from __future__ import annotations
 
@@ -101,6 +106,60 @@ def record_scaler_step(skipped: bool, scale: float):
     if skipped:
         metrics.counter("amp.scaler.skipped").inc()
     metrics.gauge("amp.loss_scale").set(float(scale))
+
+
+# ------------------------------------------------------ resilience layer
+
+def record_preemption():
+    if not enabled:
+        return
+    metrics.counter("resilience.preemptions").inc()
+
+
+def record_emergency_save(step: int):
+    if not enabled:
+        return
+    metrics.counter("resilience.emergency_saves").inc()
+    metrics.gauge("resilience.emergency_save_step").set(float(step))
+
+
+def record_watchdog_timeout(label: str):
+    if not enabled:
+        return
+    metrics.counter("resilience.watchdog.timeouts", label=label).inc()
+    metrics.counter("resilience.watchdog.timeouts").inc()
+
+
+def record_ckpt_fallback(step):
+    """One checkpoint step skipped as corrupt/uncommitted on restore."""
+    if not enabled:
+        return
+    metrics.counter("resilience.ckpt.fallback").inc()
+    metrics.gauge("resilience.ckpt.last_skipped_step").set(float(step))
+
+
+def record_anomaly():
+    if not enabled:
+        return
+    metrics.counter("train.anomalies").inc()
+
+
+def record_anomaly_restore():
+    if not enabled:
+        return
+    metrics.counter("train.anomaly_restores").inc()
+
+
+def record_swallowed(where: str, exc: BaseException):
+    """A deliberately swallowed exception: always logged (rare, cheap,
+    and silence here is how fault-tolerance bugs hide), counted when the
+    monitor is enabled."""
+    import logging
+    logging.getLogger("paddle_tpu.monitor").warning(
+        "swallowed exception in %s: %s: %s", where, type(exc).__name__, exc)
+    if not enabled:
+        return
+    metrics.counter("errors.swallowed", where=where).inc()
 
 
 # ---------------------------------------------------------- device layer

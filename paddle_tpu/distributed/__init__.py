@@ -35,8 +35,11 @@ from .topology import (HybridCommunicateGroup, create_mesh,  # noqa: F401
 from . import auto_checkpoint  # noqa: F401
 from . import elastic  # noqa: F401
 from . import launch  # noqa: F401
+from . import resilience  # noqa: F401
 from . import rpc  # noqa: F401
 from .elastic import ElasticManager  # noqa: F401
+from .resilience import (AnomalyGuard, GracefulShutdown,  # noqa: F401
+                         Watchdog, WatchdogTimeout)
 from .spawn import spawn  # noqa: F401
 from .store import TCPStore  # noqa: F401
 

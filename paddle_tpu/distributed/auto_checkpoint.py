@@ -7,12 +7,21 @@ resumes at the last completed epoch. Env contract kept:
 PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT enables it,
 PADDLE_JOB_ID keys the checkpoint, PADDLE_EDL_HDFS_CHECKPOINT_PATH
 names the directory (any filesystem path here).
+
+Fault tolerance (resilience layer): the epoch loop runs under a
+GracefulShutdown context — SIGTERM/SIGINT lands, the NEXT epoch boundary
+writes a synchronous emergency checkpoint of ``status.state`` and exits
+with ELASTIC_EXIT_CODE so the elastic launcher relaunches; the restarted
+range resumes at the emergency epoch + 1 (at most one epoch redone).
+Restores go through the corruption-fallback path: a truncated latest
+checkpoint transparently resumes from the previous committed one.
 """
 from __future__ import annotations
 
 import os
 from typing import Any, Dict, Iterator, Optional
 
+from . import resilience
 from .checkpoint import CheckpointManager
 
 __all__ = ["train_epoch_range", "ExeTrainStatus", "AutoCheckpointChecker"]
@@ -43,6 +52,7 @@ class ExeTrainStatus:
 
     def __init__(self):
         self.state: Dict[str, Any] = {}
+        self.epoch: int = -1  # the epoch currently running (resilience)
 
     def update(self, **kwargs):
         self.state.update(kwargs)
@@ -51,11 +61,16 @@ class ExeTrainStatus:
 def train_epoch_range(max_epoch_num: int,
                       save_checkpoint_inter: Optional[int] = None,
                       checker: Optional[AutoCheckpointChecker] = None,
-                      status: Optional[ExeTrainStatus] = None
-                      ) -> Iterator[int]:
+                      status: Optional[ExeTrainStatus] = None,
+                      store=None) -> Iterator[int]:
     """for epoch in train_epoch_range(N): ... — on restart, already
     completed epochs are skipped and `status.state` is restored from
-    the last epoch checkpoint before the first yielded epoch."""
+    the last epoch checkpoint before the first yielded epoch.
+
+    ``store`` (a TCPStore, optional): on multi-host jobs, pass the
+    launcher's store so a preemption on ANY host is broadcast and every
+    host emergency-saves the same epoch; without it the shutdown
+    handling is host-local only (fine single-host)."""
     checker = checker or AutoCheckpointChecker()
     if not checker.enabled:
         yield from range(max_epoch_num)
@@ -67,21 +82,41 @@ def train_epoch_range(max_epoch_num: int,
     mgr = CheckpointManager(checker.get_job_checkpoint_path(),
                             max_to_keep=2, async_save=False,
                             save_interval_steps=1)
+
+    def _epoch_state() -> Dict[str, Any]:
+        return {"user_state": status.state, "epoch": status.epoch}
+
+    mgr.save_on_preemption(_epoch_state)
     try:
-        last = mgr.latest_step()
+        # corruption fallback: a truncated/uncommitted latest epoch
+        # transparently resumes from the previous committed one
+        from .checkpoint import CheckpointCorruption
+        try:
+            restored = mgr.restore()
+        except CheckpointCorruption as e:
+            # every candidate failed: transparent resume means a cold
+            # start, not a crash loop — but never a silent one
+            from ..core import monitor
+            monitor.record_swallowed("auto_checkpoint.restore", e)
+            restored = None
         start = 0
-        if last is not None:
-            restored = mgr.restore(step=last)
-            if restored is not None:
-                status.state = restored.get("user_state", {})
-            start = int(last) + 1
-        for epoch in range(start, max_epoch_num):
-            yield epoch
-            # epoch completed -> snapshot
-            if (epoch + 1) % max(interval, 1) == 0 or \
-                    epoch == max_epoch_num - 1:
-                mgr.save(epoch, {"user_state": status.state,
-                                 "epoch": epoch})
+        if restored is not None:
+            status.state = restored.get("user_state", {})
+            start = int(mgr.last_restored_step) + 1
+        with resilience.GracefulShutdown(store=store) as gs:
+            for epoch in range(start, max_epoch_num):
+                status.epoch = epoch
+                yield epoch
+                # epoch completed -> the emergency state is this epoch
+                # from here on, even if the periodic snapshot is skipped
+                # by the interval
+                if (epoch + 1) % max(interval, 1) == 0 or \
+                        epoch == max_epoch_num - 1:
+                    mgr.save(epoch, _epoch_state())
+                # preempted mid-epoch? -> synchronous emergency save of
+                # the just-completed epoch, then exit(ELASTIC_EXIT_CODE)
+                # for the launcher's relaunch path
+                gs.check(epoch)
         mgr.wait()
     finally:
         mgr.close()

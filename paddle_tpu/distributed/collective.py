@@ -39,6 +39,20 @@ def _count(op: str, axis: str, x):
         monitor.record_collective(op, axis, getattr(x, "nbytes", 0))
 
 
+def _guard(label: str, fn, *args):
+    """Launch an eager collective under the hang watchdog when
+    PADDLE_WATCHDOG_COLLECTIVE_S sets a deadline (a re-forming slice or
+    dead peer can block a collective launch forever on a real pod):
+    past the deadline, thread stacks dump to stderr and WatchdogTimeout
+    raises instead of hanging. Plain call when unconfigured."""
+    from . import resilience
+    t = resilience.env_timeout("PADDLE_WATCHDOG_COLLECTIVE_S")
+    if t is None:
+        return fn(*args)
+    return resilience.Watchdog.run(fn, *args, timeout=t,
+                                   label=f"collective.{label}")
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"
@@ -109,7 +123,7 @@ def all_reduce(tensor, op: str = ReduceOp.SUM, group=None,
     shard = shard_map(fn, mesh=mesh,
                       in_specs=_spec_on(ax, x.ndim),
                       out_specs=_spec_on(ax, x.ndim), check_vma=False)
-    out = shard(_shard_for(x, mesh, ax))
+    out = _guard("all_reduce", shard, _shard_for(x, mesh, ax))
     result = Tensor(out) if isinstance(tensor, Tensor) else out
     if isinstance(tensor, Tensor):
         tensor._replace_data(out)  # paddle all_reduce is in-place
@@ -131,7 +145,7 @@ def all_gather(tensor_list, tensor, group=None, axis: Optional[str] = None,
         mesh=mesh, in_specs=_spec_on(ax, x.ndim),
         out_specs=P(*([None] * (x.ndim + 1))),
         check_vma=False)  # all_gather output IS replicated over ax
-    gathered = fn(_shard_for(x, mesh, ax))
+    gathered = _guard("all_gather", fn, _shard_for(x, mesh, ax))
     if tensor_list is not None:
         tensor_list.extend(Tensor(gathered[i]) for i in range(n))
     return Tensor(gathered)
@@ -152,7 +166,7 @@ def broadcast(tensor, src: int = 0, group=None, axis: Optional[str] = None,
 
     shard = shard_map(fn, mesh=mesh, in_specs=_spec_on(ax, x.ndim),
                       out_specs=_spec_on(ax, x.ndim), check_vma=False)
-    out = shard(_shard_for(x, mesh, ax))
+    out = _guard("broadcast", shard, _shard_for(x, mesh, ax))
     if isinstance(tensor, Tensor):
         tensor._replace_data(out)
         return tensor
@@ -168,11 +182,11 @@ def reduce_scatter(output, input, op: str = ReduceOp.SUM, group=None,
     ax = _axis(axis, mesh)
     x = _raw(input)
     _count("reduce_scatter", ax, x)
-    out = shard_map(
+    out = _guard("reduce_scatter", shard_map(
         lambda a: jax.lax.psum_scatter(a, ax, scatter_dimension=0,
                                        tiled=True),
         mesh=mesh, in_specs=_spec_on(ax, x.ndim),
-        out_specs=_spec_on(ax, x.ndim))(_shard_for(x, mesh, ax))
+        out_specs=_spec_on(ax, x.ndim)), _shard_for(x, mesh, ax))
     if output is not None and isinstance(output, Tensor):
         output._replace_data(out)
         return output
@@ -188,11 +202,11 @@ def alltoall_single(tensor, group=None, axis: Optional[str] = None):
     ax = _axis(axis, mesh)
     x = _raw(tensor)
     _count("alltoall", ax, x)
-    out = shard_map(
+    out = _guard("alltoall", shard_map(
         lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0,
                                      tiled=True),
         mesh=mesh, in_specs=_spec_on(ax, x.ndim),
-        out_specs=_spec_on(ax, x.ndim))(_shard_for(x, mesh, ax))
+        out_specs=_spec_on(ax, x.ndim)), _shard_for(x, mesh, ax))
     return Tensor(out)
 
 
@@ -225,9 +239,10 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None,
     def fn(a):
         return a[0]
 
-    res = shard_map(fn, mesh=mesh, in_specs=_spec_on(ax, stacked.ndim),
-                        out_specs=_spec_on(ax, stacked.ndim - 1)
-                        if stacked.ndim > 1 else P(ax))(out)
+    res = _guard("scatter", shard_map(
+        fn, mesh=mesh, in_specs=_spec_on(ax, stacked.ndim),
+        out_specs=_spec_on(ax, stacked.ndim - 1)
+        if stacked.ndim > 1 else P(ax)), out)
     if isinstance(tensor, Tensor):
         tensor._replace_data(res)
         return tensor

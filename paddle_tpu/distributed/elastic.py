@@ -17,6 +17,7 @@ import os
 import time
 from typing import Callable, List, Optional
 
+from ..core import monitor
 from .store import TCPStore
 
 ELASTIC_EXIT_CODE = 101
@@ -52,8 +53,10 @@ class ElasticManager:
     def deregister(self) -> None:
         try:
             self.store.delete(self._key(self.host))
-        except (TimeoutError, RuntimeError, OSError):
-            pass
+        except (TimeoutError, RuntimeError, OSError) as e:
+            # best-effort by design (the job is going down anyway), but
+            # never silent: a flaky store at teardown is a signal
+            monitor.record_swallowed("elastic.deregister", e)
 
     def heartbeat(self) -> None:
         self.store.set_timestamp(self._key(self.host))

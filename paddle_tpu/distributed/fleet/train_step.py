@@ -148,7 +148,12 @@ class DistributedTrainStep:
                 list(param_vals), grads, opt_state, lr=lr, step=step_no)
             return loss, new_params, new_state
 
-        donate = (0, 1)
+        from ...core.jaxshim import SHARDING_AWARE_DONATION
+        # old jax mispairs donated buffers across the mixed-sharding
+        # param/opt trees (aval-only matching): donate only where the
+        # matcher is sharding-aware; the fallback costs one transient
+        # copy of params+state, it never changes numerics
+        donate = (0, 1) if SHARDING_AWARE_DONATION else ()
         self._jitted = jax.jit(
             step_fn, donate_argnums=donate,
             out_shardings=(NamedSharding(m, P()),

@@ -44,10 +44,16 @@ class JobSpec:
 
 
 class Pod:
-    """The set of worker processes owned by this node's controller."""
+    """The set of worker processes owned by this node's controller.
 
-    def __init__(self, spec: JobSpec):
+    ``restart`` is the elastic incarnation number, exported to workers
+    as PADDLE_RESTART_COUNT so per-incarnation state (the resilience
+    layer's preemption flag in the TCPStore) can be namespaced — a
+    relaunched pod must not see the previous incarnation's flags."""
+
+    def __init__(self, spec: JobSpec, restart: int = 0):
         self.spec = spec
+        self.restart = int(restart)
         self.procs: List[subprocess.Popen] = []
         self.logs: List[object] = []
 
@@ -63,6 +69,7 @@ class Pod:
         env.update(build_rank_env(rank, self.world_size, local_rank,
                                   spec.master, nnodes=spec.nnodes,
                                   job_id=spec.job_id))
+        env["PADDLE_RESTART_COUNT"] = str(self.restart)
         return env
 
     def start(self) -> None:
@@ -143,7 +150,7 @@ class Controller:
                         restarts < self.spec.max_restarts:
                     restarts += 1
                     self.pod.stop()
-                    self.pod = Pod(self.spec)
+                    self.pod = Pod(self.spec, restart=restarts)
                     self.pod.start()
                     continue
                 if code != 0:

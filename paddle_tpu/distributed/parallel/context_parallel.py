@@ -208,6 +208,9 @@ def split_sequence(x, axis: int = 1, axis_name: str = "sp", mesh=None):
     mesh = mesh or topology.get_mesh()
     if mesh is None or _axis_degree(mesh, axis_name) == 1:
         return x
+    from ...core import jaxshim
+    if jaxshim.in_manual_fallback():
+        return x
     parts = [P.UNCONSTRAINED] * x.ndim
     parts[axis] = axis_name
     from jax.sharding import NamedSharding
@@ -220,6 +223,9 @@ def gather_sequence(x, axis: int = 1, axis_name: str = "sp", mesh=None):
     stay UNCONSTRAINED."""
     mesh = mesh or topology.get_mesh()
     if mesh is None or _axis_degree(mesh, axis_name) == 1:
+        return x
+    from ...core import jaxshim
+    if jaxshim.in_manual_fallback():
         return x
     parts = [P.UNCONSTRAINED] * x.ndim
     parts[axis] = None

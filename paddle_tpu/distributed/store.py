@@ -13,14 +13,43 @@ barriers) — this is that plane, pure stdlib, no brpc.
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 _LEN = struct.Struct("!I")
+
+# chaos-test hook (utils.fault_injection.StoreFaults): called server-side
+# with (op, args) before every reply; may sleep (delay) or return "drop"
+# (close the connection without answering). None = no faults installed.
+_FAULT_HOOK: Optional[Callable[[str, tuple], Optional[str]]] = None
+
+
+def set_fault_hook(fn: Optional[Callable[[str, tuple], Optional[str]]]
+                   ) -> None:
+    global _FAULT_HOOK
+    _FAULT_HOOK = fn
+
+
+def _backoff(attempt: int, base: float = 0.05, cap: float = 2.0) -> float:
+    """Full-jittered exponential backoff delay for retry ``attempt`` —
+    uniform in [0, min(cap, base * 2^attempt)) so a fleet of ranks
+    retrying the master after a blip doesn't re-stampede in lockstep."""
+    return random.uniform(0.0, min(cap, base * (2 ** attempt)))
+
+
+def _armed_watchdog():
+    """The resilience watchdog armed on this thread, if any (lazy import:
+    store is imported during package init, resilience only on use)."""
+    try:
+        from . import resilience
+        return resilience._armed_watchdog()
+    except ImportError:
+        return None
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -60,6 +89,9 @@ class _StoreHandler(socketserver.BaseRequestHandler):
         try:
             while True:
                 op, *args = _recv_msg(self.request)
+                hook = _FAULT_HOOK
+                if hook is not None and hook(op, tuple(args)) == "drop":
+                    return  # injected fault: vanish without a reply
                 if op == "set":
                     key, val = args
                     with srv.cond:
@@ -142,21 +174,30 @@ class TCPStore:
             t.start()
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._cancelled = False
 
     # --------------------------------------------------------------- conn
     def _conn(self) -> socket.socket:
         if self._sock is None:
             deadline = time.monotonic() + self.timeout
             last = None
+            attempt = 0
             while time.monotonic() < deadline:
+                if self._cancelled:
+                    # a watchdog aborted this op: the connect-retry loop
+                    # must stop at the deadline it set, not at the (much
+                    # larger) client timeout
+                    raise ConnectionAbortedError(
+                        "TCPStore: connect cancelled by watchdog")
                 try:
                     s = socket.create_connection(
                         (self.host, self.port), timeout=self.timeout)
                     self._sock = s
                     return s
-                except OSError as e:  # master not up yet
+                except OSError as e:  # master not up yet / transient
                     last = e
-                    time.sleep(0.05)
+                    time.sleep(_backoff(attempt))
+                    attempt += 1
             raise TimeoutError(
                 f"TCPStore: cannot reach {self.host}:{self.port}: {last}")
         return self._sock
@@ -164,35 +205,80 @@ class TCPStore:
     # ops safe to re-send after a broken pipe; "add" is NOT (a lost
     # reply would double-count and corrupt barrier generations)
     _IDEMPOTENT = {"set", "get", "delete", "keys", "setts", "now"}
+    # bounded retries on transient socket errors (ECONNRESET, broken
+    # pipe): a single flaky packet must not kill the rank
+    _MAX_RETRIES = 4
 
     def _call(self, *msg):
         with self._lock:
-            sock = self._conn()
-            # the server replies at most at the per-call wait deadline;
-            # pad the socket deadline so the reply always wins the race
-            # and TimeoutError comes from the server's "timeout" status,
-            # not the socket
-            wait = msg[2] if msg[0] == "get" else self.timeout
-            sock.settimeout(float(wait) + 30.0)
+            # an armed hang watchdog on this thread may force-close our
+            # socket to un-block a stalled recv. Registered only once
+            # the lock is HELD (a watchdog expiring while we still wait
+            # for the lock must not close another thread's in-flight op)
+            # and only AFTER the cancelled flag is reset — the reverse
+            # order would let an immediate expiry's cancel be erased and
+            # the aborted op retried, re-hanging past the deadline
+            self._cancelled = False
+            wd = _armed_watchdog()
+            if wd is not None:
+                wd.add_canceller(self.cancel)
             try:
-                _send_msg(sock, msg)
-                status, val = _recv_msg(sock)
-            except TimeoutError:
-                self._sock = None
-                raise
-            except (ConnectionError, OSError):
-                self._sock = None
-                if msg[0] not in self._IDEMPOTENT:
-                    raise
-                sock = self._conn()  # reconnect once on a broken pipe
-                sock.settimeout(self.timeout + 30.0)
-                _send_msg(sock, msg)
-                status, val = _recv_msg(sock)
+                status, val = self._call_locked(msg)
+            finally:
+                if wd is not None:
+                    wd.remove_canceller(self.cancel)
         if status == "timeout":
             raise TimeoutError(f"TCPStore: wait for key {val!r} timed out")
         if status == "error":
             raise RuntimeError(val)
         return val
+
+    def _call_locked(self, msg):
+        # the server replies at most at the per-call wait deadline;
+        # pad the socket deadline so the reply always wins the race
+        # and TimeoutError comes from the server's "timeout" status,
+        # not the socket
+        wait = msg[2] if msg[0] == "get" else self.timeout
+        retriable = msg[0] in self._IDEMPOTENT
+        attempt = 0
+        while True:
+            sock = self._conn()
+            sock.settimeout(float(wait) + 30.0)
+            try:
+                _send_msg(sock, msg)
+                return _recv_msg(sock)
+            except TimeoutError:
+                self._sock = None
+                raise
+            except (ConnectionError, OSError) as e:
+                self._sock = None
+                if self._cancelled:
+                    raise  # watchdog aborted us: do NOT retry
+                if not retriable or attempt >= self._MAX_RETRIES:
+                    raise
+                from ..core import monitor
+                monitor.record_swallowed(
+                    f"tcpstore.retry:{msg[0]}", e)
+                time.sleep(_backoff(attempt))
+                attempt += 1
+
+    def cancel(self) -> None:
+        """Force-close the live client socket WITHOUT taking the call
+        lock (the caller of the in-flight op holds it): the blocked
+        recv aborts with ConnectionError and, with the cancelled flag
+        set, is not retried. The hang watchdog's canceller."""
+        self._cancelled = True
+        s = self._sock
+        self._sock = None  # a later op must reconnect, not reuse EBADF
+        if s is not None:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     # ---------------------------------------------------------------- api
     def set(self, key: str, value) -> None:

@@ -24,6 +24,9 @@ class Callback:
     # lifecycle hooks (all optional)
     def on_train_begin(self, logs=None): pass
     def on_train_end(self, logs=None): pass
+    # fit() aborted early (exception/preemption): on_train_end will NOT
+    # run — release resources acquired in on_train_begin here
+    def on_train_abort(self, logs=None): pass
     def on_epoch_begin(self, epoch, logs=None): pass
     def on_epoch_end(self, epoch, logs=None): pass
     def on_train_batch_begin(self, step, logs=None): pass
@@ -127,12 +130,33 @@ class LRSchedulerCallback(Callback):
 
 class ModelCheckpoint(Callback):
     """Saves model+optimizer state every save_freq epochs
-    (≈ hapi ModelCheckpoint: {dir}/{epoch}.pdparams / final)."""
+    (≈ hapi ModelCheckpoint: {dir}/{epoch}.pdparams / final).
+
+    Routed through the resilience layer: while training runs, the
+    callback is registered for emergency saves — a preemption caught by
+    the active GracefulShutdown writes ``{dir}/emergency.pdparams`` (+
+    ``.pdopt``) synchronously before the process exits for relaunch.
+    The pickle writes themselves are already atomic (tmp + rename in
+    framework_io), so a preempted periodic save never tears the previous
+    checkpoint."""
 
     def __init__(self, save_freq: int = 1, save_dir: str = "checkpoints"):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self._unregister = None
+
+    def on_train_begin(self, logs=None):
+        if not self.save_dir:
+            return
+        from ..distributed import resilience
+
+        def _emergency(step):
+            self.model.save(os.path.join(self.save_dir, "emergency"))
+
+        if self._unregister is not None:  # re-fit with the same callback
+            self._unregister()
+        self._unregister = resilience.register_emergency(_emergency)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and epoch % self.save_freq == 0:
@@ -142,6 +166,16 @@ class ModelCheckpoint(Callback):
     def on_train_end(self, logs=None):
         if self.save_dir:
             self.model.save(os.path.join(self.save_dir, "final"))
+        if self._unregister is not None:
+            self._unregister()
+            self._unregister = None
+
+    def on_train_abort(self, logs=None):
+        # no "final" save of a half-trained model — just release the
+        # process-global emergency-saver registration
+        if self._unregister is not None:
+            self._unregister()
+            self._unregister = None
 
 
 def _infer_mode(monitor: str, mode: str) -> str:
@@ -354,6 +388,10 @@ class MetricsCallback(Callback):
         if not getattr(self, "_was_enabled", True) and \
                 not metrics.is_sampling():
             metrics.disable()
+
+    # an aborted fit must not leave the process-global registry (and
+    # its per-callsite overhead) enabled for the rest of the process
+    on_train_abort = on_train_end
 
     def on_epoch_begin(self, epoch, logs=None):
         from .. import device

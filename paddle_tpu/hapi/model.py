@@ -101,12 +101,25 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1,
             epochs=1, eval_freq=1, log_freq=10, save_dir=None,
-            save_freq=1, verbose=1, shuffle=True, callbacks=None):
+            save_freq=1, verbose=1, shuffle=True, callbacks=None,
+            anomaly_guard=None):
         """≈ hapi model.py:1149 — epochs over train_data with optional
-        periodic eval, checkpointing, logging, early stopping."""
+        periodic eval, checkpointing, logging, early stopping.
+
+        ``anomaly_guard``: resilience.AnomalyGuard instance, True for a
+        default one, or None (also enabled by PADDLE_ANOMALY_GUARD=1) —
+        non-finite losses skip the batch (the TrainStep keeps params
+        unchanged in-jit) and N consecutive anomalies restore network +
+        optimizer from the last good in-memory snapshot. The loop also
+        polls the active resilience.GracefulShutdown each batch, so a
+        preemption lands as emergency-save + exit(ELASTIC_EXIT_CODE) at
+        a batch boundary."""
+        from ..distributed import resilience
         loader = self._loader(train_data, batch_size, shuffle)
         eval_loader = self._loader(eval_data, batch_size, False)
         self._save_dir = save_dir
+
+        guard = self._resolve_anomaly_guard(anomaly_guard, resilience)
 
         cbs = CallbackList([ProgBarLogger(log_freq, verbose=verbose)]
                            + _as_list(callbacks))
@@ -122,7 +135,29 @@ class Model:
                         "verbose": verbose})
 
         cbs.on_train_begin()
+        if guard is not None:
+            self._take_good_snapshot()
+        try:
+            self._fit_loop(loader, eval_loader, epochs, eval_freq, cbs,
+                           guard, resilience)
+        except BaseException:
+            # on_train_end will not run: let callbacks release what
+            # on_train_begin acquired (emergency-saver registrations,
+            # the metrics registry, ...) before the abort propagates.
+            # Cleanup must never mask the original failure — a broken
+            # or duck-typed callback without the hook is swallowed.
+            try:
+                cbs.on_train_abort()
+            except Exception as e:
+                from ..core import monitor
+                monitor.record_swallowed("fit.on_train_abort", e)
+            raise
+        return self
+
+    def _fit_loop(self, loader, eval_loader, epochs, eval_freq, cbs,
+                  guard, resilience):
         stop = False
+        global_step = 0
         for epoch in range(epochs):
             cbs.on_epoch_begin(epoch)
             losses = []
@@ -130,8 +165,17 @@ class Model:
                 cbs.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
                 loss = self.train_batch(inputs, labels)
-                losses.append(loss)
-                cbs.on_train_batch_end(step, {"loss": loss})
+                global_step += 1
+                if guard is not None and not guard.observe(loss):
+                    # anomaly: loss not recorded, params were kept
+                    # unchanged in-jit (skip_nonfinite TrainStep)
+                    cbs.on_train_batch_end(step, {"loss": loss,
+                                                  "skipped_batch": True})
+                else:
+                    losses.append(loss)
+                    cbs.on_train_batch_end(step, {"loss": loss})
+                # preemption lands here: emergency save + exit(101)
+                resilience.poll(global_step)
                 if any(getattr(cb, "stopped", False)
                        for cb in cbs.callbacks):
                     stop = True  # e.g. TerminateOnNaN
@@ -142,6 +186,8 @@ class Model:
                 break
             logs = {"loss": float(np.mean(losses)) if losses else None}
             cbs.on_epoch_end(epoch, logs)
+            if guard is not None:
+                self._take_good_snapshot()
 
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbs)
@@ -150,7 +196,6 @@ class Model:
                    for cb in cbs.callbacks):
                 break
         cbs.on_train_end()
-        return self
 
     def _run_eval(self, loader, cbs):
         cbs.on_eval_begin()
@@ -224,6 +269,60 @@ class Model:
         text = "\n".join(lines)
         print(text)
         return {"total_params": total}
+
+    # --------------------------------------------------------- resilience
+    def _resolve_anomaly_guard(self, anomaly_guard, resilience):
+        """fit()'s anomaly_guard arg -> AnomalyGuard or None. True (or
+        PADDLE_ANOMALY_GUARD=1 in the env) builds a default guard wired
+        to restore from the last good snapshot; a passed guard without a
+        restore_fn gets the same wiring. With a guard active, the
+        TrainStep is rebuilt with the in-jit non-finite skip."""
+        guard = anomaly_guard
+        if guard is None:
+            env = os.environ.get("PADDLE_ANOMALY_GUARD", "").strip()
+            if env and env.lower() not in ("0", "false", "off"):
+                guard = True
+        if guard is True:
+            guard = resilience.AnomalyGuard(
+                restore_fn=self._restore_last_good)
+        elif guard is not None:
+            # wire (or RE-wire) the auto restore to THIS model: a guard
+            # reused across models must not roll back the previous one.
+            # A restore_fn the caller set explicitly is left alone.
+            if getattr(guard, "_auto_wired", False):
+                guard.restore_fn = None
+            if guard.restore_fn is None:
+                guard.restore_fn = self._restore_last_good
+                guard._auto_wired = True
+        if guard is not None and self._train_step is not None and \
+                not self._train_step._skip_nonfinite:
+            self._train_step = TrainStep(self.network, self._optimizer,
+                                         self._loss, skip_nonfinite=True)
+        return guard
+
+    def _take_good_snapshot(self):
+        """Host-memory copy of network + optimizer state — what the
+        anomaly guard restores when a non-finite streak poisons a run."""
+        net = {k: np.array(v.numpy(), copy=True)
+               for k, v in self.network.state_dict().items()}
+        opt = self._optimizer.state_dict() \
+            if self._optimizer is not None else None
+        self._last_good = (net, opt)
+
+    def _restore_last_good(self):
+        """Roll network + optimizer back to the last good snapshot (the
+        anomaly guard's restore_fn)."""
+        snap = getattr(self, "_last_good", None)
+        if snap is None:
+            return
+        net, opt = snap
+        self.network.set_state_dict(net)
+        if opt is not None and self._optimizer is not None:
+            self._optimizer.set_state_dict(opt)
+        if self._train_step is not None:
+            # drop the fused step's cached opt-state tree so the next
+            # call re-seeds from the restored optimizer state
+            self._train_step._opt_state_tree = None
 
     # --------------------------------------------------------------- save
     def save(self, path: str, training: bool = True):

@@ -280,11 +280,18 @@ class TrainStep:
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  donate: bool = True, sharding=None,
-                 offload_opt_state: bool = False):
+                 offload_opt_state: bool = False,
+                 skip_nonfinite: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self._sharding = sharding
+        # skip_nonfinite: the in-jit half of the resilience layer's
+        # anomaly guard — a non-finite loss keeps params/opt state
+        # unchanged (the jnp.where select fuses away; same pattern as
+        # GradScaler's found_inf skip), the poisoned loss still returns
+        # for the host-side AnomalyGuard to count.
+        self._skip_nonfinite = skip_nonfinite
         # offload_opt_state: park optimizer moments in host memory
         # (pinned_host) between steps — HBM relief for big-batch /
         # long-seq configs at the cost of PCIe streaming per step (the
@@ -309,6 +316,14 @@ class TrainStep:
             loss, grads = jax.value_and_grad(loss_of)(list(param_vals))
             new_params, new_state = self.optimizer.apply_gradients(
                 list(param_vals), grads, opt_state, lr=lr, step=step_no)
+            if self._skip_nonfinite:
+                import jax.numpy as jnp
+                ok = jnp.isfinite(loss)
+                new_params = [jnp.where(ok, n, o)
+                              for n, o in zip(new_params, param_vals)]
+                new_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o),
+                    new_state, opt_state)
             return loss, new_params, new_state
 
         donate_argnums = (0, 1) if donate else ()

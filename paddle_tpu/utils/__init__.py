@@ -1,5 +1,7 @@
-"""paddle.utils analog: custom op registration + C++ extensions."""
+"""paddle.utils analog: custom op registration + C++ extensions +
+deterministic fault injection (chaos-test harness)."""
 from . import cpp_extension  # noqa: F401
+from . import fault_injection  # noqa: F401
 from .custom_op import register_custom_op  # noqa: F401
 
 

@@ -1,0 +1,237 @@
+"""Deterministic in-process fault injection — the chaos-test harness.
+
+Everything here is process-local and deterministic: faults fire on exact
+call counts or exact byte offsets, never on wall-clock races, so a chaos
+test that passes once passes always, and no real TPU (or even a second
+process) is needed.
+
+Injectable faults:
+
+- ``KillAfter(n)``              — deliver a signal to this process on the
+                                  n-th ``step()`` call (preemption).
+- ``truncate_checkpoint(...)``  — truncate the largest payload file of a
+                                  checkpoint step (torn write).
+- ``remove_commit_marker(...)`` — delete a step's commit marker
+                                  (writer died between data and commit).
+- ``StoreFaults(...)``          — delay or drop TCPStore responses for
+                                  chosen ops/keys (network stall, hang).
+- ``poison_batch(...)``         — NaN-fill the float leaves of a batch
+                                  (numeric anomaly; trace-compatible:
+                                  the poison is in the data, so in-jit
+                                  non-finite guards see it).
+- ``NaNLoss(loss_fn, at_calls)``— eager loss wrapper returning NaN on
+                                  chosen calls (host-side loops only;
+                                  under jit the call count is a
+                                  trace-time constant — use
+                                  poison_batch there).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Iterable, Optional, Sequence
+
+__all__ = [
+    "KillAfter",
+    "NaNLoss",
+    "StoreFaults",
+    "checkpoint_data_files",
+    "poison_batch",
+    "remove_commit_marker",
+    "truncate_checkpoint",
+]
+
+
+class KillAfter:
+    """Preemption injector: ``step()`` each training step; the ``n``-th
+    call sends ``sig`` (default SIGTERM) to this very process — exactly
+    what a TPU maintenance event looks like from inside the job."""
+
+    def __init__(self, n: int, sig: int = signal.SIGTERM):
+        if n < 1:
+            raise ValueError("KillAfter fires on the n-th step, n >= 1")
+        self.n = int(n)
+        self.sig = sig
+        self.calls = 0
+        self.fired = False
+
+    def step(self) -> bool:
+        """Returns True on the call that delivered the signal."""
+        self.calls += 1
+        if self.calls == self.n and not self.fired:
+            self.fired = True
+            os.kill(os.getpid(), self.sig)
+            return True
+        return False
+
+
+def _step_dirs(directory: str):
+    out = []
+    for name in os.listdir(directory):
+        if name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+            out.append(int(name))
+    return sorted(out)
+
+
+def checkpoint_data_files(directory: str,
+                          step: Optional[int] = None) -> list:
+    """The payload files of a checkpoint step (the latest when ``step``
+    is None): every file under the step dir except metadata/marker
+    files (leading underscore). Sorted — deterministic for a given
+    on-disk state."""
+    steps = _step_dirs(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {directory}")
+    step = steps[-1] if step is None else int(step)
+    root = os.path.join(directory, str(step))
+    out = []
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            # metadata/marker files are covered by remove_commit_marker;
+            # a torn write hits the bulk payload
+            if not f.startswith("_"):
+                out.append(os.path.join(dirpath, f))
+    if not out:
+        raise FileNotFoundError(f"no data files under {root}")
+    return sorted(out)
+
+
+def truncate_checkpoint(directory: str, step: Optional[int] = None,
+                        keep_bytes: int = 0) -> list:
+    """Truncate every payload file of a checkpoint step (the latest
+    when ``step`` is None) to ``keep_bytes`` — a torn write from a
+    preempted saver. All payload files are hit because the storage
+    format keeps redundant copies of small trees (OCDBT manifests plus
+    per-process blobs): corrupting only one blob may leave the step
+    restorable, which would make chaos tests pass or fail on which
+    randomly-named file happened to be chosen. Metadata/marker files
+    survive, so the step still LOOKS committed — exactly the case the
+    restore fallback must catch. Returns the truncated paths."""
+    paths = checkpoint_data_files(directory, step)
+    for path in paths:
+        with open(path, "r+b") as f:
+            f.truncate(int(keep_bytes))
+    return paths
+
+
+def remove_commit_marker(directory: str, step: Optional[int] = None) -> str:
+    """Delete a step's ``_PADDLE_COMMIT`` marker — the writer died after
+    the data landed but before the commit. Returns the removed path."""
+    from ..distributed.checkpoint import COMMIT_MARKER
+    steps = _step_dirs(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {directory}")
+    step = steps[-1] if step is None else int(step)
+    path = os.path.join(directory, str(step), COMMIT_MARKER)
+    os.remove(path)
+    return path
+
+
+class StoreFaults:
+    """Delay or drop TCPStore server responses, deterministically.
+
+    ::
+
+        with StoreFaults(delay=5.0, ops=("get",), count=1):
+            store.get("key")          # this one reply stalls 5s
+
+        with StoreFaults(drop=True, ops=("set",), key_prefix="__barrier"):
+            ...                       # barrier sets are never answered
+
+    ``count`` bounds how many matching requests fault (None = all while
+    installed). Matching is by op name and optional key prefix; the
+    fault applies server-side, so every client of the in-process master
+    sees it — the chaos-test stand-in for a stalled or partitioned host.
+    """
+
+    def __init__(self, delay: float = 0.0, drop: bool = False,
+                 ops: Sequence[str] = ("get",),
+                 key_prefix: Optional[str] = None,
+                 count: Optional[int] = None):
+        self.delay = float(delay)
+        self.drop = bool(drop)
+        self.ops = tuple(ops)
+        self.key_prefix = key_prefix
+        self.count = count
+        self.triggered = 0
+
+    def _matches(self, op: str, args) -> bool:
+        if op not in self.ops:
+            return False
+        if self.key_prefix is not None:
+            key = args[0] if args else ""
+            if not str(key).startswith(self.key_prefix):
+                return False
+        return True
+
+    def __call__(self, op: str, args):
+        if self.count is not None and self.triggered >= self.count:
+            return None
+        if not self._matches(op, args):
+            return None
+        self.triggered += 1
+        if self.delay > 0:
+            time.sleep(self.delay)
+        return "drop" if self.drop else None
+
+    def __enter__(self) -> "StoreFaults":
+        from ..distributed import store
+        store.set_fault_hook(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        from ..distributed import store
+        store.set_fault_hook(None)
+        return False
+
+
+def poison_batch(batch):
+    """NaN-fill every float leaf of a (possibly nested) batch — the
+    deterministic numeric-anomaly injection. Integer/bool leaves pass
+    through (labels stay valid; the NaN reaches the loss through the
+    activations)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    def poison(x):
+        if isinstance(x, Tensor):
+            return Tensor(poison(x._data))
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return x
+
+    def walk(node):
+        if isinstance(node, Tensor):
+            return poison(node)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return poison(node)
+
+    return walk(batch)
+
+
+class NaNLoss:
+    """Eager-path loss wrapper: returns NaN on the given (1-based) call
+    numbers, delegates otherwise. Host-side loops only — under jit the
+    call counter is a trace-time constant (use ``poison_batch``)."""
+
+    def __init__(self, loss_fn, at_calls: Iterable[int]):
+        self.loss_fn = loss_fn
+        self.at_calls = frozenset(int(i) for i in at_calls)
+        self.calls = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        out = self.loss_fn(*args, **kwargs)
+        if self.calls in self.at_calls:
+            import numpy as np
+
+            from ..core.tensor import Tensor
+            return Tensor(np.float32(np.nan)) if isinstance(out, Tensor) \
+                else float("nan")
+        return out

@@ -19,6 +19,15 @@ except Exception:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (in-process, deterministic, <10s "
+        "each — tier-1)")
+
+
 @pytest.fixture(autouse=True)
 def _seed_rng():
     import paddle_tpu

@@ -168,3 +168,89 @@ class TestManager:
         for name in want:
             np.testing.assert_allclose(got[name], want[name], atol=1e-6,
                                        err_msg=name)
+
+
+class TestCorruptionFallback:
+    """Commit markers + restore fallback (resilience layer)."""
+
+    def _mgr(self, tmp_path, name="cf"):
+        return CheckpointManager(str(tmp_path / name), async_save=False)
+
+    def test_truncated_latest_restores_previous_and_counts(self, tmp_path):
+        from paddle_tpu.profiler import metrics
+        from paddle_tpu.utils import fault_injection as fi
+        mgr = self._mgr(tmp_path)
+        a = np.arange(16.0, dtype=np.float32)
+        mgr.save(0, {"w": a})
+        mgr.save(1, {"w": a * 2})
+        fi.truncate_checkpoint(mgr.directory)  # newest step (1)
+
+        was = metrics.is_enabled()
+        metrics.enable()
+        try:
+            before = metrics.snapshot().get("resilience.ckpt.fallback")
+            before = int(before["value"]) if before else 0
+            state = mgr.restore()  # latest -> corrupt -> previous
+            after = int(metrics.snapshot()
+                        ["resilience.ckpt.fallback"]["value"])
+        finally:
+            if not was:
+                metrics.disable()
+        np.testing.assert_allclose(np.asarray(state["w"].data), a)
+        assert mgr.last_restored_step == 0
+        assert after == before + 1
+        mgr.close()
+
+    def test_commit_marker_written_and_validated(self, tmp_path):
+        import json
+        import os
+        from paddle_tpu.distributed.checkpoint import COMMIT_MARKER
+        mgr = self._mgr(tmp_path)
+        mgr.save(0, {"w": np.zeros((4, 2), np.float32)})
+        marker = os.path.join(mgr.directory, "0", COMMIT_MARKER)
+        assert os.path.exists(marker)
+        with open(marker) as f:
+            rec = json.load(f)
+        assert rec["leaves"]["w"]["shape"] == [4, 2]
+        assert mgr.validate(0)
+        # a lying marker (wrong shape) fails validation
+        rec["leaves"]["w"]["shape"] = [999]
+        with open(marker, "w") as f:
+            json.dump(rec, f)
+        assert not mgr.validate(0)
+        mgr.close()
+
+    def test_async_save_marker_flushes_on_wait(self, tmp_path):
+        import os
+        from paddle_tpu.distributed.checkpoint import COMMIT_MARKER
+        mgr = CheckpointManager(str(tmp_path / "as"), async_save=True)
+        mgr.save(0, {"w": np.ones(8, np.float32)})
+        mgr.wait()
+        assert os.path.exists(
+            os.path.join(mgr.directory, "0", COMMIT_MARKER))
+        mgr.close()
+
+    def test_all_steps_corrupt_raises(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import CheckpointCorruption
+        from paddle_tpu.utils import fault_injection as fi
+        mgr = self._mgr(tmp_path)
+        mgr.save(0, {"w": np.ones(64, np.float32)})
+        mgr.save(1, {"w": np.ones(64, np.float32)})
+        fi.truncate_checkpoint(mgr.directory, step=0)
+        fi.truncate_checkpoint(mgr.directory, step=1)
+        with pytest.raises(CheckpointCorruption):
+            mgr.restore()
+        mgr.close()
+
+    def test_forced_resave_of_existing_step_is_success(self, tmp_path):
+        # emergency save racing the periodic save of the same step: the
+        # state is already on disk — success, not an error to swallow
+        mgr = self._mgr(tmp_path, "dup")
+        a = np.ones(8, np.float32)
+        assert mgr.save(0, {"w": a}) is True
+        assert mgr.save(0, {"w": a}, force=True) is True
+        # unforced duplicate: skipped by the interval policy, no error
+        assert mgr.save(0, {"w": a}) is False
+        state = mgr.restore()
+        np.testing.assert_allclose(np.asarray(state["w"].data), a)
+        mgr.close()

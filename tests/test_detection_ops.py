@@ -376,6 +376,7 @@ class TestReferenceStyleDetectorTraining:
     — multi-scale heads + per-scale yolo_loss (downsample 32/16/8) —
     trains end to end on the in-tree CSPResNet backbone."""
 
+    @pytest.mark.slow  # ~27s compile on CPU: tier-2
     def test_multiscale_yolov3_trains(self):
         from paddle_tpu.models.ppyoloe import CSPResNet
         paddle.seed(0)

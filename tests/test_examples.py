@@ -12,7 +12,12 @@ import pytest
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = os.path.join(_ROOT, "examples")
 
-SCRIPTS = [f for f in sorted(os.listdir(_EXAMPLES)) if f.endswith(".py")]
+# detection_train compiles the full PP-YOLOE stack (~30s on CPU — the
+# single longest tier-1 item): tier-2 via the slow marker
+_SLOW_SCRIPTS = {"detection_train.py"}
+SCRIPTS = [pytest.param(f, marks=pytest.mark.slow)
+           if f in _SLOW_SCRIPTS else f
+           for f in sorted(os.listdir(_EXAMPLES)) if f.endswith(".py")]
 
 
 @pytest.mark.parametrize("script", SCRIPTS)

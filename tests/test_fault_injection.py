@@ -1,0 +1,113 @@
+"""The fault-injection harness must itself be deterministic: faults
+fire on exact call counts / exact files, never on wall-clock races —
+otherwise every chaos test built on it is flaky by construction."""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (COMMIT_MARKER,
+                                               CheckpointManager)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+def test_kill_after_fires_on_exact_step():
+    hits = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: hits.append(s))
+    try:
+        kill = fi.KillAfter(3, sig=signal.SIGUSR1)
+        fired = [kill.step() for _ in range(5)]
+        assert fired == [False, False, True, False, False]
+        assert len(hits) == 1  # exactly once, on call 3
+        assert kill.calls == 5 and kill.fired
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_kill_after_rejects_zero():
+    with pytest.raises(ValueError):
+        fi.KillAfter(0)
+
+
+def test_store_faults_trigger_exact_count():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        store.set("a", 1)
+        with fi.StoreFaults(delay=0.0, ops=("get",), count=2) as faults:
+            for _ in range(4):
+                assert store.get("a", timeout=5.0) == 1
+            assert faults.triggered == 2  # not 4: bounded by count
+        # op filter: sets never match a get-only fault
+        with fi.StoreFaults(delay=0.0, ops=("get",)) as faults:
+            store.set("b", 2)
+            assert faults.triggered == 0
+        # key-prefix filter
+        with fi.StoreFaults(delay=0.0, ops=("get",),
+                            key_prefix="__x") as faults:
+            store.get("a", timeout=5.0)
+            assert faults.triggered == 0
+    finally:
+        store.shutdown_server()
+
+
+def test_store_faults_drop_closes_without_reply():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        store.set("k", 41)
+        with fi.StoreFaults(drop=True, ops=("get",), count=1):
+            # the dropped reply looks like a transient reset; the
+            # client's bounded retry gets the answer on reconnect
+            assert store.get("k", timeout=10.0) == 41
+    finally:
+        store.shutdown_server()
+
+
+def test_truncate_checkpoint_is_deterministic(tmp_path):
+    rng = np.random.RandomState(0)
+    tree = {"w": rng.randn(64, 64).astype(np.float32),
+            "b": rng.randn(64).astype(np.float32)}
+    d = str(tmp_path / "run")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(0, dict(tree))
+    mgr.close()
+    # enumeration is a pure function of on-disk state
+    a = fi.checkpoint_data_files(d)
+    assert a == fi.checkpoint_data_files(d)
+    victims = fi.truncate_checkpoint(d)
+    assert victims == a
+    assert all(os.path.getsize(v) == 0 for v in victims)
+    # metadata/markers survive: the step still LOOKS committed
+    assert os.path.exists(os.path.join(d, "0", COMMIT_MARKER))
+
+
+def test_remove_commit_marker(tmp_path):
+    d = str(tmp_path / "c")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(0, {"w": np.zeros(4, np.float32)})
+    mgr.close()
+    p = fi.remove_commit_marker(d, step=0)
+    assert p.endswith(COMMIT_MARKER) and not os.path.exists(p)
+    with pytest.raises(FileNotFoundError):
+        fi.remove_commit_marker(d, step=0)  # already gone
+
+
+def test_poison_batch_nans_floats_only():
+    batch = (np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.array([1, 0], dtype=np.int64),
+             {"aux": paddle.to_tensor(np.ones(2, np.float32))})
+    poisoned = fi.poison_batch(batch)
+    assert np.isnan(poisoned[0]).all()
+    np.testing.assert_array_equal(poisoned[1], batch[1])  # labels intact
+    assert np.isnan(np.asarray(poisoned[2]["aux"].data)).all()
+    assert not np.isnan(batch[0]).any()  # original untouched
+
+
+def test_nan_loss_fires_on_exact_calls():
+    wrapped = fi.NaNLoss(lambda a, b: float(a + b), at_calls=(2, 4))
+    out = [wrapped(1.0, 1.0) for _ in range(5)]
+    assert [np.isnan(v) for v in out] == [False, True, False, True, False]

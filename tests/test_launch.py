@@ -268,6 +268,14 @@ def test_spawn_raises_on_child_failure(tmp_path):
         spawn(_spawn_fail, args=(str(tmp_path),), nprocs=1)
 
 
+def _skip_if_no_multiprocess_cpu(r):
+    """Some jaxlib builds ship a CPU client without cross-process
+    collectives ("Multiprocess computations aren't implemented on the
+    CPU backend") — a toolchain capability gap, not a launcher bug."""
+    if "Multiprocess computations aren't implemented" in (r.stderr or ""):
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+
+
 def test_launch_multiprocess_jax_distributed(tmp_path):
     """Two real processes rendezvous via jax.distributed (the TCPStore
     analog) and run a cross-process allgather — the reference's
@@ -291,6 +299,7 @@ def test_launch_multiprocess_jax_distributed(tmp_path):
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "2", str(script)],
         env=env, capture_output=True, text=True, timeout=240)
+    _skip_if_no_multiprocess_cpu(r)
     assert r.returncode == 0, r.stderr
 
 
@@ -323,8 +332,9 @@ def test_launch_multihost_global_mesh(tmp_path):
         from jax.experimental import multihost_utils
         global_x = multihost_utils.host_local_array_to_global_array(
             local, mesh, P("dp"))
-        out = jax.jit(jax.shard_map(summed, mesh=mesh, in_specs=P("dp"),
-                                    out_specs=P()))(global_x)
+        from paddle_tpu.core.jaxshim import shard_map
+        out = jax.jit(shard_map(summed, mesh=mesh, in_specs=P("dp"),
+                                out_specs=P()))(global_x)
         # fully replicated result: every host reads its local replica
         total = float(np.asarray(out.addressable_data(0)).ravel()[0])
         assert total == sum(range(8)), total
@@ -334,6 +344,7 @@ def test_launch_multihost_global_mesh(tmp_path):
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "2", str(script)],
         env=env, capture_output=True, text=True, timeout=300)
+    _skip_if_no_multiprocess_cpu(r)
     assert r.returncode == 0, r.stderr[-3000:]
 
 
@@ -361,6 +372,62 @@ def test_elastic_membership_and_scale_event():
         assert m1.hosts() == ["h1"]
     finally:
         store.shutdown_server()
+
+
+def test_elastic_watch_dip_below_min_then_rejoin_fires_once():
+    """Scale-event semantics: the alive set dipping below min_np fires
+    NOTHING (not a viable mesh), and the same host rejoining fires
+    EXACTLY one event once the set is viable again."""
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        m1 = el.ElasticManager(store, "job2", (2, 3), host="h1",
+                               heartbeat_timeout=30.0)
+        m2 = el.ElasticManager(store, "job2", (2, 3), host="h2",
+                               heartbeat_timeout=30.0)
+        m1.register()
+        m2.register()
+        events = []
+        w = threading.Thread(
+            target=m1.watch,
+            kwargs=dict(on_scale=events.append, poll=0.05, max_events=1),
+            daemon=True)
+        w.start()
+        time.sleep(0.2)
+        assert events == []  # steady viable membership: no event
+        m2.deregister()      # dip to 1 < min_np=2: tracked, not fired
+        time.sleep(0.3)
+        assert events == []
+        m2.register()        # rejoin: viable again -> exactly one event
+        w.join(10.0)
+        assert not w.is_alive()
+        assert events == [["h1", "h2"]]
+    finally:
+        store.shutdown_server()
+
+
+def test_elastic_deregister_logs_swallowed_store_error():
+    """deregister on a dead store must not raise — and must not be
+    silent either: the swallowed exception is counted via the monitor."""
+    from paddle_tpu.profiler import metrics
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    m = el.ElasticManager(store, "job3", (1, 2), host="h1",
+                          heartbeat_timeout=30.0)
+    m.register()
+    store.shutdown_server()
+    dead = TCPStore("127.0.0.1", store.port, timeout=0.3)
+    m.store = dead
+    was = metrics.is_enabled()
+    metrics.enable()
+    try:
+        m.deregister()  # store is gone: swallowed, logged, counted
+        snap = metrics.snapshot()
+        key = [k for k in snap
+               if k.startswith("errors.swallowed") and "elastic" in k]
+        assert key, list(snap)[:20]
+    finally:
+        if not was:
+            metrics.disable()
+        dead.close()
 
 
 # --------------------------------------------------------------------- rpc

@@ -1,0 +1,310 @@
+"""Chaos tests for the fault-tolerance layer (resilience.py), driven by
+the deterministic in-process fault-injection harness — no real TPU, no
+subprocesses, each test well under 10s.
+
+Covers the acceptance path end to end: SIGTERM mid-run → emergency
+checkpoint → fresh loop resumes losing at most one step; truncated
+latest checkpoint → transparent fallback to the previous step; armed
+watchdog around a stalled store op → WatchdogTimeout with a stack dump
+instead of a hang; non-finite loss → skip + restore-from-last-good."""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import auto_checkpoint as ac
+from paddle_tpu.distributed import resilience
+from paddle_tpu.distributed.checkpoint import (CheckpointCorruption,
+                                               CheckpointManager)
+from paddle_tpu.distributed.elastic import ELASTIC_EXIT_CODE
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.hapi import Model
+from paddle_tpu.profiler import metrics
+from paddle_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Emergency savers are process-global; never leak between tests."""
+    yield
+    resilience._EMERGENCY.clear()
+    resilience._ACTIVE.clear()
+
+
+def _counter(name):
+    snap = metrics.snapshot().get(name)
+    return int(snap["value"]) if snap else 0
+
+
+def _env(tmp_path, monkeypatch, job, interval="100"):
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_JOB_ID", job)
+    monkeypatch.setenv("PADDLE_EDL_HDFS_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("PADDLE_EDL_SAVE_CHECKPOINT_INTER", interval)
+
+
+# ------------------------------------------------- preemption -> resume
+
+def test_sigterm_mid_epoch_emergency_checkpoint_and_resume(
+        tmp_path, monkeypatch):
+    """A SIGTERM landing mid-epoch writes a synchronous emergency
+    checkpoint at the next epoch boundary and exits ELASTIC_EXIT_CODE;
+    the relaunched range resumes having lost at most one epoch."""
+    # interval=100: withOUT the emergency save nothing would be on disk
+    _env(tmp_path, monkeypatch, "chaos-sig")
+    kill = fi.KillAfter(3, signal.SIGTERM)  # delivered during epoch 2
+    status = ac.ExeTrainStatus()
+    seen = []
+    with pytest.raises(SystemExit) as exc:
+        for epoch in ac.train_epoch_range(10, status=status):
+            seen.append(epoch)
+            status.update(last=epoch, w=np.float32(epoch * 2.0))
+            kill.step()
+    assert exc.value.code == ELASTIC_EXIT_CODE
+    assert seen == [0, 1, 2]
+
+    # "relaunched" process: fresh status, same env
+    status2 = ac.ExeTrainStatus()
+    seen2 = list(ac.train_epoch_range(5, status=status2))
+    assert seen2 == [3, 4]  # epoch 2 completed before the boundary check
+    assert int(status2.state["last"]) == 2
+    np.testing.assert_allclose(float(status2.state["w"]), 4.0)
+
+
+def test_fit_preemption_emergency_save_and_resume(tmp_path):
+    """hapi path: a preemption caught by the active GracefulShutdown
+    makes Model.fit write {save_dir}/emergency.pdparams (through the
+    ModelCheckpoint emergency registration) and exit 101; a fresh Model
+    loads it and continues."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    m = Model(net)
+    m.prepare(optimizer.SGD(learning_rate=0.01,
+                            parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(8, 4).astype(np.float32),
+             rng.randint(0, 2, (8,)).astype(np.int64)) for _ in range(6)]
+    save_dir = str(tmp_path / "ckpts")
+
+    kill = fi.KillAfter(3, signal.SIGTERM)
+
+    from paddle_tpu.hapi.callbacks import Callback
+
+    class Chaos(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            kill.step()
+
+    with pytest.raises(SystemExit) as exc:
+        with resilience.GracefulShutdown():
+            m.fit(train_data=data, epochs=3, save_dir=save_dir,
+                  verbose=0, callbacks=[Chaos()])
+    assert exc.value.code == ELASTIC_EXIT_CODE
+    assert os.path.exists(os.path.join(save_dir, "emergency.pdparams"))
+
+    # relaunch: fresh model resumes from the emergency checkpoint
+    paddle.seed(1)
+    net2 = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    m2 = Model(net2)
+    m2.prepare(optimizer.SGD(learning_rate=0.01,
+                             parameters=net2.parameters()),
+               loss=nn.CrossEntropyLoss())
+    m2.load(os.path.join(save_dir, "emergency"))
+    for (k, a), (_, b) in zip(sorted(net2.state_dict().items()),
+                              sorted(net.state_dict().items())):
+        np.testing.assert_allclose(np.asarray(a.data), np.asarray(b.data))
+    m2.fit(train_data=data[:2], epochs=1, verbose=0)  # trains on
+
+
+# --------------------------------------------- corruption -> fallback
+
+def test_truncated_latest_epoch_resumes_previous(tmp_path, monkeypatch):
+    """e2e: finish a few epochs, truncate the newest checkpoint, and the
+    relaunched train_epoch_range transparently resumes from the previous
+    committed epoch (one epoch redone, fallback metric bumped)."""
+    _env(tmp_path, monkeypatch, "chaos-trunc", interval="1")
+    status = ac.ExeTrainStatus()
+    for epoch in ac.train_epoch_range(4, status=status):
+        status.update(last=epoch)
+    job_dir = os.path.join(str(tmp_path), "job_chaos-trunc")
+    fi.truncate_checkpoint(job_dir)  # newest step: torn write
+
+    was = metrics.is_enabled()
+    metrics.enable()
+    try:
+        before = _counter("resilience.ckpt.fallback")
+        status2 = ac.ExeTrainStatus()
+        seen = list(ac.train_epoch_range(6, status=status2))
+        assert _counter("resilience.ckpt.fallback") > before
+    finally:
+        if not was:
+            metrics.disable()
+    # latest (epoch 3) was truncated -> resumed from epoch 2: redo 3
+    assert seen == [3, 4, 5]
+    assert int(status2.state["last"]) == 2
+
+
+def test_checkpoint_manager_explicit_step_raises_on_corruption(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"), async_save=False)
+    mgr.save(0, {"w": np.arange(4.0, dtype=np.float32)})
+    mgr.save(1, {"w": np.arange(4.0, dtype=np.float32) * 3})
+    fi.truncate_checkpoint(str(tmp_path / "c"), step=1)
+    with pytest.raises(CheckpointCorruption):
+        mgr.restore(step=1)  # explicit step, no fallback
+    state = mgr.restore(step=1, fallback=True)
+    np.testing.assert_allclose(np.asarray(state["w"].data),
+                               np.arange(4.0, dtype=np.float32))
+    assert mgr.last_restored_step == 0
+    mgr.close()
+
+
+# ------------------------------------------------------------ watchdog
+
+def test_watchdog_unblocks_stalled_store_op(capfd):
+    """An armed watchdog around a store op whose reply is delayed past
+    the deadline force-closes the socket and raises WatchdogTimeout with
+    a full stack dump — instead of hanging for the op's own timeout."""
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        store.set("k", 1)
+        with fi.StoreFaults(delay=20.0, ops=("get",), count=1):
+            t0 = time.monotonic()
+            with pytest.raises(resilience.WatchdogTimeout):
+                with resilience.watchdog(0.5, "store.get"):
+                    store.get("k", timeout=15.0)
+            assert time.monotonic() - t0 < 5.0  # un-hung, not waited out
+        err = capfd.readouterr().err
+        assert "Watchdog 'store.get' expired" in err
+        assert "thread" in err  # the stack dump
+        # the cancelled socket must not poison the next op
+        assert store.get("k", timeout=5.0) == 1
+    finally:
+        store.shutdown_server()
+
+
+def test_watchdog_run_abandons_hung_callable():
+    ev = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(resilience.WatchdogTimeout):
+        resilience.Watchdog.run(ev.wait, timeout=0.3, label="hung",
+                                dump_stacks=False)
+    assert time.monotonic() - t0 < 5.0
+    ev.set()
+
+
+def test_watchdog_happy_path_no_raise():
+    with resilience.watchdog(5.0, "fast"):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_watchdog_timeout_metric(capfd):
+    was = metrics.is_enabled()
+    metrics.enable()
+    try:
+        before = _counter("resilience.watchdog.timeouts")
+        with pytest.raises(resilience.WatchdogTimeout):
+            resilience.Watchdog.run(time.sleep, 5.0, timeout=0.2,
+                                    label="metric", dump_stacks=False)
+        assert _counter("resilience.watchdog.timeouts") == before + 1
+    finally:
+        if not was:
+            metrics.disable()
+
+
+# -------------------------------------------------------- anomaly guard
+
+def test_fit_anomaly_guard_skips_and_restores(tmp_path):
+    """Poisoned batches produce non-finite losses: each is skipped (the
+    in-jit guard keeps params unchanged), and a streak of
+    max_consecutive anomalies restores network+optimizer from the last
+    good snapshot. Training ends with finite parameters."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m = Model(net)
+    m.prepare(optimizer.AdamW(learning_rate=0.01,
+                              parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    rng = np.random.RandomState(3)
+
+    def batch():
+        return (rng.randn(8, 4).astype(np.float32),
+                rng.randint(0, 2, (8,)).astype(np.int64))
+
+    data = [batch() for _ in range(8)]
+    for i in (2, 3, 4):  # 3 consecutive poisoned batches
+        data[i] = fi.poison_batch(data[i])
+
+    guard = resilience.AnomalyGuard(max_consecutive=2)
+    m.fit(train_data=data, epochs=1, verbose=0, anomaly_guard=guard,
+          shuffle=False)
+    assert guard.total == 3
+    assert guard.restores >= 1
+    for name, p in net.state_dict().items():
+        assert np.isfinite(np.asarray(p.data)).all(), name
+
+
+def test_trainstep_skip_nonfinite_keeps_params():
+    """The in-jit guard alone: a NaN batch leaves parameters bit-exact
+    while still reporting the non-finite loss."""
+    from paddle_tpu.jit.api import TrainStep
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 2))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = TrainStep(net, opt, nn.CrossEntropyLoss(), skip_nonfinite=True)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 4).astype(np.float32)
+    y = rng.randint(0, 2, (4,)).astype(np.int64)
+
+    step(paddle.to_tensor(x), paddle.to_tensor(y))  # warm, good step
+    before = {k: np.array(v.numpy(), copy=True)
+              for k, v in net.state_dict().items()}
+    bad = np.full_like(x, np.nan)
+    loss = step(paddle.to_tensor(bad), paddle.to_tensor(y))
+    assert not np.isfinite(float(loss))
+    for k, v in net.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v.data), before[k]), k
+
+
+# ----------------------------------------- preemption flag cross-host
+
+def test_graceful_shutdown_store_flag_propagates():
+    """Host A is signaled; host B (same store, its own context) sees the
+    preemption through the TCPStore flag and runs its own emergency
+    save — the all-hosts-checkpoint-the-same-step mechanism."""
+    store_a = TCPStore("127.0.0.1", 0, is_master=True)
+    store_b = TCPStore("127.0.0.1", store_a.port)
+    saved = []
+    try:
+        unreg = resilience.register_emergency(saved.append)
+        with resilience.GracefulShutdown(store=store_a,
+                                         exit_on_save=False) as gs_a:
+            gs_b = resilience.GracefulShutdown(store=store_b,
+                                               exit_on_save=False)
+            gs_a.trigger()
+            assert gs_a.check(7) is True  # publishes flag + saves
+            assert saved == [7]
+            # B never got the signal, only the store flag
+            assert gs_b.preempted is True
+            # B is a boundary ahead but ADOPTS the published step so
+            # every host checkpoints under the same step id
+            assert gs_b.check(8) is True
+            assert saved == [7, 7]
+        unreg()
+        # relaunched incarnation (launcher bumps PADDLE_RESTART_COUNT):
+        # the predecessor's flag is namespaced away — no crash loop
+        gs_next = resilience.GracefulShutdown(store=store_b,
+                                              exit_on_save=False,
+                                              incarnation="1")
+        assert gs_next.preempted is False
+        assert gs_next.check(0) is False
+    finally:
+        store_b.close()
+        store_a.shutdown_server()
