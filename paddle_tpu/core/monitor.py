@@ -19,6 +19,8 @@ Metric name scheme (what the summary views group by):
     comm.ops{axis=...,op=...}   collective launches per mesh axis
     comm.bytes{axis=...,op=...} payload bytes per mesh axis
     io.batches / io.samples / io.bytes    dataloader throughput
+    io.worker.deaths / io.worker.respawns{worker=...}   pool supervision
+    io.sample.quarantined       bad/non-finite samples skipped
     amp.scaler.steps / amp.scaler.skipped / amp.loss_scale
     device.memory.allocated / device.memory.reserved   gauges (bytes)
     resilience.preemptions / resilience.emergency_saves
@@ -95,6 +97,31 @@ def record_dataloader_batch(nsamples: int, nbytes: int):
     metrics.counter("io.samples").inc(int(nsamples))
     metrics.counter("io.bytes").inc(int(nbytes))
     metrics.histogram("io.batch_bytes").observe(float(nbytes))
+
+
+def record_worker_death(worker_id: int):
+    """A DataLoader worker process was found dead (crash/OOM/SIGKILL)."""
+    if not enabled:
+        return
+    metrics.counter("io.worker.deaths").inc()
+    metrics.counter("io.worker.deaths", worker=str(worker_id)).inc()
+
+
+def record_worker_respawn(worker_id: int):
+    """A dead DataLoader worker was respawned (its in-flight batches
+    re-dispatched)."""
+    if not enabled:
+        return
+    metrics.counter("io.worker.respawns").inc()
+    metrics.counter("io.worker.respawns", worker=str(worker_id)).inc()
+
+
+def record_sample_quarantined(n: int = 1):
+    """Samples skipped by the DataLoader's bad-sample quarantine
+    (raised during fetch, or contained non-finite data)."""
+    if not enabled:
+        return
+    metrics.counter("io.sample.quarantined").inc(int(n))
 
 
 # ------------------------------------------------------------- amp layer

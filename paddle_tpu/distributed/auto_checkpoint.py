@@ -15,6 +15,15 @@ with ELASTIC_EXIT_CODE so the elastic launcher relaunches; the restarted
 range resumes at the emergency epoch + 1 (at most one epoch redone).
 Restores go through the corruption-fallback path: a truncated latest
 checkpoint transparently resumes from the previous committed one.
+
+Exact mid-epoch resume: pass the training ``DataLoader`` as ``loader=``
+and every checkpoint (periodic AND emergency — including per-STEP
+emergency saves triggered by ``resilience.poll(step)`` from the user's
+inner loop) carries ``loader.state_dict()`` (batch cursor + sampler
+epoch/RNG state). On restart the loader is rewound to the exact batch:
+a job preempted mid-epoch redoes at most one *step*, not one epoch —
+the restarted range re-yields the interrupted epoch and the loader
+replays only its remaining batches.
 """
 from __future__ import annotations
 
@@ -25,6 +34,14 @@ from . import resilience
 from .checkpoint import CheckpointManager
 
 __all__ = ["train_epoch_range", "ExeTrainStatus", "AutoCheckpointChecker"]
+
+
+def _scalar(v, default=None):
+    """int() a checkpoint-restored leaf (Tensor / 0-d array / scalar)."""
+    if v is None:
+        return default
+    from ..io.dataloader import _state_scalar
+    return int(_state_scalar(v))
 
 
 class AutoCheckpointChecker:
@@ -62,7 +79,7 @@ def train_epoch_range(max_epoch_num: int,
                       save_checkpoint_inter: Optional[int] = None,
                       checker: Optional[AutoCheckpointChecker] = None,
                       status: Optional[ExeTrainStatus] = None,
-                      store=None) -> Iterator[int]:
+                      store=None, loader=None) -> Iterator[int]:
     """for epoch in train_epoch_range(N): ... — on restart, already
     completed epochs are skipped and `status.state` is restored from
     the last epoch checkpoint before the first yielded epoch.
@@ -70,7 +87,13 @@ def train_epoch_range(max_epoch_num: int,
     ``store`` (a TCPStore, optional): on multi-host jobs, pass the
     launcher's store so a preemption on ANY host is broadcast and every
     host emergency-saves the same epoch; without it the shutdown
-    handling is host-local only (fine single-host)."""
+    handling is host-local only (fine single-host).
+
+    ``loader`` (a DataLoader, optional): checkpoints carry its
+    ``state_dict()`` (batch cursor + sampler state), and a restore
+    rewinds it — a mid-epoch emergency save (the user's inner loop
+    calling ``resilience.poll(step)``) resumes AT the interrupted epoch
+    with only the remaining batches replayed."""
     checker = checker or AutoCheckpointChecker()
     if not checker.enabled:
         yield from range(max_epoch_num)
@@ -83,10 +106,52 @@ def train_epoch_range(max_epoch_num: int,
                             max_to_keep=2, async_save=False,
                             save_interval_steps=1)
 
-    def _epoch_state() -> Dict[str, Any]:
-        return {"user_state": status.state, "epoch": status.epoch}
+    # completed[0] = the last epoch whose yield has RETURNED (-1 before
+    # any). The checkpointed "epoch" record is always this value, so
+    # resume is one uniform rule: start = recorded epoch + 1, with the
+    # loader cursor (captured live, mid-epoch) rewinding into that
+    # epoch's remaining batches.
+    completed = [-1]
 
-    mgr.save_on_preemption(_epoch_state)
+    def _epoch_state() -> Dict[str, Any]:
+        st = {"user_state": status.state, "epoch": completed[0]}
+        if loader is not None and hasattr(loader, "state_dict"):
+            st["loader"] = loader.state_dict()
+        return st
+
+    # orbax keys checkpoints by a monotonic step id, but this loop saves
+    # at two granularities: epoch boundaries AND (via resilience.poll in
+    # the user's inner loop) arbitrary mid-epoch steps. One id space
+    # covers both: (completed+1)*STRIDE + batch_cursor — a boundary save
+    # of completed epoch e is (e+1)*STRIDE (the SAME id whether periodic
+    # or emergency, so a boundary emergency after a periodic save is the
+    # no-op it should be), a mid-epoch save of the next epoch at batch k
+    # is (e+1)*STRIDE + k — strictly increasing as training progresses.
+    STRIDE = 1 << 20
+
+    def _save_id() -> int:
+        cursor = 0
+        if loader is not None and hasattr(loader, "state_dict"):
+            cursor = min(int(loader.state_dict().get("cursor") or 0),
+                         STRIDE - 1)
+        gs = resilience.active()
+        if gs is not None and getattr(gs, "store", None) is not None:
+            # multi-host: orbax saves are collective, so every host must
+            # use the SAME id — hosts a boundary apart agree on
+            # `completed` but not on a mid-epoch cursor. Drop the cursor
+            # from the id (mid-epoch resume granularity stays a
+            # single-host refinement; multi-host keeps the <=1-epoch
+            # guarantee).
+            cursor = 0
+        return (completed[0] + 1) * STRIDE + cursor
+
+    def _emergency(step: int) -> None:
+        # the elected step number (the caller's inner-loop counter)
+        # lives in a different id space: key by epoch+cursor instead
+        mgr.save(_save_id(), _epoch_state(), force=True)
+        mgr.wait()
+
+    unregister = resilience.register_emergency(_emergency)
     try:
         # corruption fallback: a truncated/uncommitted latest epoch
         # transparently resumes from the previous committed one
@@ -102,21 +167,38 @@ def train_epoch_range(max_epoch_num: int,
         start = 0
         if restored is not None:
             status.state = restored.get("user_state", {})
-            start = int(mgr.last_restored_step) + 1
+            if loader is not None and hasattr(loader, "load_state_dict") \
+                    and restored.get("loader") is not None:
+                # rewinds mid-epoch (cursor > 0) or restores the next
+                # epoch's sampler state (cursor 0) — either way the
+                # resumed epoch replays exactly the right batches
+                loader.load_state_dict(restored["loader"])
+            epoch_rec = _scalar(restored.get("epoch"))
+            if epoch_rec is not None:
+                # "epoch" records the last COMPLETED epoch (old
+                # checkpoints recorded the epoch at a boundary save —
+                # same value): resume at the next one; a mid-epoch save
+                # re-enters it through the rewound loader
+                start = epoch_rec + 1
+            else:  # pre-epoch-record checkpoints: step id IS the epoch
+                start = int(mgr.last_restored_step) + 1
+            completed[0] = start - 1
         with resilience.GracefulShutdown(store=store) as gs:
             for epoch in range(start, max_epoch_num):
                 status.epoch = epoch
                 yield epoch
+                completed[0] = epoch
                 # epoch completed -> the emergency state is this epoch
                 # from here on, even if the periodic snapshot is skipped
                 # by the interval
                 if (epoch + 1) % max(interval, 1) == 0 or \
                         epoch == max_epoch_num - 1:
-                    mgr.save(epoch, _epoch_state())
+                    mgr.save((epoch + 1) * STRIDE, _epoch_state())
                 # preempted mid-epoch? -> synchronous emergency save of
                 # the just-completed epoch, then exit(ELASTIC_EXIT_CODE)
                 # for the launcher's relaunch path
                 gs.check(epoch)
         mgr.wait()
     finally:
+        unregister()
         mgr.close()

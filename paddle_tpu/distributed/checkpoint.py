@@ -23,6 +23,12 @@ Reference analogs:
   `save_on_preemption()` registers the manager with the active
   `resilience.GracefulShutdown` so a SIGTERM triggers a synchronous
   emergency save before the elastic relaunch.
+- Input-pipeline state (this PR): `DataLoader.state_dict()` trees
+  (batch cursor + sampler epoch/seed — plain int leaves) ride inside
+  the same save/restore trees; orbax round-trips them and
+  `DataLoader.load_state_dict` coerces the restored 0-d leaves, so a
+  per-step checkpoint pins the exact mid-epoch resume point alongside
+  model and optimizer state.
 """
 from __future__ import annotations
 

@@ -44,6 +44,7 @@ __all__ = [
     "Watchdog",
     "WatchdogTimeout",
     "active",
+    "dump_stacks",
     "poll",
     "preempted",
     "register_emergency",
@@ -59,6 +60,14 @@ class WatchdogTimeout(RuntimeError):
 
 
 # --------------------------------------------------------------- watchdog
+
+def dump_stacks(label: str, timeout: float) -> None:
+    """Dump every thread's stack to stderr in the watchdog's format —
+    for deadline guards that detect the overrun themselves (the
+    DataLoader's per-fetch supervisor) and want the same diagnostics a
+    fired ``Watchdog`` produces."""
+    _dump_all_stacks(label, timeout)
+
 
 def _dump_all_stacks(label: str, timeout: float) -> None:
     lines = [f"\n=== Watchdog '{label}' expired after {timeout:.1f}s — "

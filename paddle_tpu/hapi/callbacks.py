@@ -153,6 +153,16 @@ class ModelCheckpoint(Callback):
 
         def _emergency(step):
             self.model.save(os.path.join(self.save_dir, "emergency"))
+            # the exact resume point (epoch, step, loader cursor +
+            # sampler state) rides along so fit(resume=True) continues
+            # mid-epoch instead of redoing the whole epoch
+            state_fn = getattr(self.model, "_train_state", None)
+            state = state_fn() if callable(state_fn) else None
+            if state is not None:
+                from .. import framework_io
+                framework_io.save(
+                    state,
+                    os.path.join(self.save_dir, "emergency.pdstate"))
 
         if self._unregister is not None:  # re-fit with the same callback
             self._unregister()

@@ -47,6 +47,7 @@ class Model:
         self._train_step: Optional[TrainStep] = None
         self._eval_fn = None
         self._save_dir = None
+        self._fit_progress = None  # live {epoch, step, loader} during fit
 
     # ------------------------------------------------------------ prepare
     def prepare(self, optimizer=None, loss=None, metrics=None):
@@ -102,7 +103,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1,
             epochs=1, eval_freq=1, log_freq=10, save_dir=None,
             save_freq=1, verbose=1, shuffle=True, callbacks=None,
-            anomaly_guard=None):
+            anomaly_guard=None, resume=None):
         """≈ hapi model.py:1149 — epochs over train_data with optional
         periodic eval, checkpointing, logging, early stopping.
 
@@ -113,11 +114,28 @@ class Model:
         optimizer from the last good in-memory snapshot. The loop also
         polls the active resilience.GracefulShutdown each batch, so a
         preemption lands as emergency-save + exit(ELASTIC_EXIT_CODE) at
-        a batch boundary."""
+        a batch boundary.
+
+        ``resume``: True (with ``save_dir``) or an explicit checkpoint
+        prefix — reload params/optimizer from the emergency checkpoint
+        a preempted fit wrote and continue EXACTLY where it stopped:
+        the saved train state ({prefix}.pdstate) carries the epoch,
+        global step and the DataLoader's cursor + sampler state, so a
+        mid-epoch preemption replays only the remaining batches of the
+        interrupted epoch (at most one step redone). Missing files mean
+        a fresh start, so first launch and relaunch share one call."""
         from ..distributed import resilience
         loader = self._loader(train_data, batch_size, shuffle)
         eval_loader = self._loader(eval_data, batch_size, False)
         self._save_dir = save_dir
+        start_epoch = 0
+        if resume:
+            prefix = resume if isinstance(resume, str) else (
+                os.path.join(save_dir, "emergency") if save_dir else None)
+            if prefix is None:
+                raise ValueError("resume=True requires save_dir "
+                                 "(or pass an explicit prefix)")
+            start_epoch = self._load_resume(prefix, loader)
 
         guard = self._resolve_anomaly_guard(anomaly_guard, resilience)
 
@@ -139,7 +157,7 @@ class Model:
             self._take_good_snapshot()
         try:
             self._fit_loop(loader, eval_loader, epochs, eval_freq, cbs,
-                           guard, resilience)
+                           guard, resilience, start_epoch)
         except BaseException:
             # on_train_end will not run: let callbacks release what
             # on_train_begin acquired (emergency-saver registrations,
@@ -155,10 +173,16 @@ class Model:
         return self
 
     def _fit_loop(self, loader, eval_loader, epochs, eval_freq, cbs,
-                  guard, resilience):
+                  guard, resilience, start_epoch=0):
         stop = False
         global_step = 0
-        for epoch in range(epochs):
+        # live progress the emergency saver (ModelCheckpoint) snapshots:
+        # epoch, step, and the loader whose state_dict pins the batch
+        # cursor — together the exact mid-epoch resume point
+        progress = {"epoch": start_epoch, "step": 0, "loader": loader}
+        self._fit_progress = progress
+        for epoch in range(start_epoch, epochs):
+            progress["epoch"] = epoch
             cbs.on_epoch_begin(epoch)
             losses = []
             for step, batch in enumerate(loader):
@@ -166,6 +190,7 @@ class Model:
                 inputs, labels = self._split_batch(batch)
                 loss = self.train_batch(inputs, labels)
                 global_step += 1
+                progress["step"] = global_step
                 if guard is not None and not guard.observe(loss):
                     # anomaly: loss not recorded, params were kept
                     # unchanged in-jit (skip_nonfinite TrainStep)
@@ -299,6 +324,46 @@ class Model:
             self._train_step = TrainStep(self.network, self._optimizer,
                                          self._loss, skip_nonfinite=True)
         return guard
+
+    def _train_state(self):
+        """The resume point of a fit() in flight: epoch, global step,
+        and the DataLoader's cursor + sampler state. ModelCheckpoint
+        writes this next to the emergency params so a relaunched
+        ``fit(resume=True)`` continues mid-epoch. None outside fit()."""
+        p = self._fit_progress
+        if p is None:
+            return None
+        st = {"epoch": int(p["epoch"]), "step": int(p["step"])}
+        ld = p.get("loader")
+        if ld is not None and hasattr(ld, "state_dict"):
+            st["loader"] = ld.state_dict()
+        return st
+
+    def _load_resume(self, prefix, loader) -> int:
+        """Restore {prefix}.pdparams/.pdopt + {prefix}.pdstate and
+        rewind the loader; returns the epoch to start from. Missing
+        files mean a fresh start (0)."""
+        if not os.path.exists(prefix + ".pdparams"):
+            return 0
+        self.load(prefix)
+        state_path = prefix + ".pdstate"
+        if not os.path.exists(state_path):
+            return 0
+        ts = framework_io.load(state_path)
+        epoch = int(ts.get("epoch", 0))
+        ld_state = ts.get("loader")
+        if ld_state and loader is not None \
+                and hasattr(loader, "load_state_dict"):
+            # cursor > 0: re-enter the interrupted epoch, the rewound
+            # loader yields only its remaining batches; cursor 0 means
+            # the epoch boundary was reached: next epoch
+            mid_epoch = loader.load_state_dict(ld_state) > 0
+            return epoch if mid_epoch else epoch + 1
+        # no loader cursor to pin the position (stateless loader, or
+        # the state predates loader capture): the preemption may have
+        # landed mid-epoch, so conservatively redo the interrupted
+        # epoch (<=1 epoch redone) rather than skip its remainder
+        return epoch
 
     def _take_good_snapshot(self):
         """Host-memory copy of network + optimizer state — what the

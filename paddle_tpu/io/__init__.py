@@ -1,4 +1,5 @@
-from .dataloader import DataLoader, WorkerInfo, get_worker_info  # noqa: F401
+from .dataloader import (DataLoader, DataLoaderWorkerError,  # noqa: F401
+                         WorkerInfo, get_worker_info)
 from .token_loader import TokenLoader  # noqa: F401
 from .dataset import (ChainDataset, ComposeDataset, Dataset,  # noqa: F401
                       IterableDataset, Subset, TensorDataset, random_split)

@@ -9,13 +9,36 @@ a thread (numpy collation releases the GIL); num_workers>0 fans sample
 loading + collation out to forked worker PROCESSES (the reference's
 _DataLoaderIterMultiProcess, dataloader_iter.py:381) for Python-bound
 transforms, with order-preserving handoff.
+
+Fault domain (the reference supervises its workers the same way —
+fluid/dataloader/dataloader_iter.py watches worker exit and re-raises
+instead of hanging): the worker pool here is SUPERVISED. Every batch is
+dispatched with an explicit batch index; the supervisor thread polls
+worker liveness while waiting for results, respawns dead workers within
+a bounded budget (re-dispatching their in-flight batches, so the batch
+stream stays identical), enforces a per-fetch deadline that surfaces a
+wedged worker as ``resilience.WatchdogTimeout`` (with a full stack
+dump) instead of stalling the pod, and propagates worker exceptions to
+the consumer with the failing sample index attached. Opt-in
+``skip_bad_samples`` quarantines samples that raise or contain
+non-finite data (dropped from the batch, counted in
+``io.sample.quarantined``, listed on ``loader.quarantined``).
+
+Exact mid-epoch resume: ``DataLoader.state_dict()`` captures the batch
+cursor of the active iterator plus the sampler's epoch/RNG state (the
+t5x/Grain checkpointable-input-iterator contract);
+``load_state_dict()`` arms the next ``__iter__`` to restore the sampler
+and fast-forward the index stream, so a preempted job replays the exact
+remaining batch sequence.
 """
 from __future__ import annotations
 
-import collections
 import multiprocessing as mp
 import queue
 import threading
+import time
+import traceback
+import weakref
 from typing import Callable, Optional
 
 import jax
@@ -58,99 +81,384 @@ def default_collate_fn(batch):
     return batch
 
 
+class DataLoaderWorkerError(RuntimeError):
+    """A DataLoader worker failed: a sample raised, collation raised, or
+    the worker process died past its respawn budget. Carries the worker
+    id and (when known) the exact sample index that failed."""
+
+    def __init__(self, message, worker_id=None, sample_index=None,
+                 batch_indices=None):
+        super().__init__(message)
+        self.worker_id = worker_id
+        self.sample_index = sample_index
+        self.batch_indices = list(batch_indices) if batch_indices else []
+
+
+def _sample_finite(sample) -> bool:
+    """True when every float array/scalar leaf of a sample is finite."""
+    def walk(x):
+        if isinstance(x, Tensor):
+            x = x.data
+        if isinstance(x, dict):
+            return all(walk(v) for v in x.values())
+        if isinstance(x, (list, tuple)):
+            return all(walk(v) for v in x)
+        arr = np.asarray(x) if not isinstance(x, np.ndarray) else x
+        if np.issubdtype(arr.dtype, np.floating) or \
+                np.issubdtype(arr.dtype, np.complexfloating):
+            return bool(np.isfinite(arr).all())
+        return True
+
+    try:
+        return walk(sample)
+    except (TypeError, ValueError):
+        return True  # non-numeric sample: not this check's business
+
+
+def _format_exc(e: BaseException) -> str:
+    return "".join(traceback.format_exception(type(e), e, e.__traceback__))
+
+
+def _fetch_one(dataset, collate_fn, batch_idx, worker_id, indices,
+               quarantine):
+    """Fetch + collate one index batch; the one envelope format both the
+    worker processes and the in-process paths produce:
+
+    ``("batch", batch_idx, worker_id, batch_or_None, dropped)`` where
+    ``dropped`` is ``[(sample_index, reason), ...]`` (quarantine mode),
+    or ``("error", batch_idx, worker_id, indices, sample_index, tb)``
+    with the exact failing sample attributed."""
+    samples, dropped = [], []
+    for i in indices:
+        try:
+            s = dataset[i]
+        except Exception as e:
+            if quarantine:
+                dropped.append((int(i), f"{type(e).__name__}: {e}"))
+                continue
+            return ("error", batch_idx, worker_id, list(indices), int(i),
+                    _format_exc(e))
+        if quarantine and not _sample_finite(s):
+            dropped.append((int(i), "non-finite sample"))
+            continue
+        samples.append(s)
+    if not samples:
+        return ("batch", batch_idx, worker_id, None, dropped)
+    try:
+        batch = collate_fn(samples)
+    except Exception as e:
+        return ("error", batch_idx, worker_id, list(indices), None,
+                _format_exc(e))
+    return ("batch", batch_idx, worker_id, batch, dropped)
+
+
 # ---------------------------------------------------------------- workers
-# fork-context pool: workers inherit the dataset/collate via these
-# globals set in the initializer (same shared-state shape as the
-# reference's worker loop, minus the shared-memory tensor plumbing —
-# numpy batches pickle efficiently)
-_WORKER_STATE: dict = {}
 
-
-def _worker_init(dataset, collate_fn, user_init_fn, id_counter,
-                 num_workers):
-    _WORKER_STATE["ds"] = dataset
-    _WORKER_STATE["collate"] = collate_fn
-    with id_counter.get_lock():
-        # modulo: Pool respawns a crashed worker re-running this init;
-        # ids must stay in [0, num_workers)
-        worker_id = id_counter.value % num_workers
-        id_counter.value += 1
+def _worker_loop(dataset, collate_fn, user_init_fn, worker_id, num_workers,
+                 index_queue, result_queue, quarantine, base_seed):
+    """Worker-process main: pull (batch_idx, indices) jobs until the
+    None sentinel. Errors travel back as envelopes, never tracebacks to
+    a dead pipe (the reference's _worker_loop contract)."""
     global _WORKER_INFO
+    # a worker forked while the parent runs under GracefulShutdown
+    # inherits its flag-only SIGTERM handler — which would make this
+    # process unkillable by Process.terminate() and hang the parent's
+    # exit-time join. Workers answer to the supervisor, not to signals:
+    # restore the default dispositions.
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+    _signal.signal(_signal.SIGINT, _signal.SIG_DFL)
     # deterministic per-worker seed (reference contract: base_seed +
-    # worker_id, reproducible augmentation across runs)
-    from ..core import flags as _flags
-    base_seed = int(_flags.get_flag("seed") or 0)
+    # worker_id, reproducible augmentation across runs and respawns)
     _WORKER_INFO = WorkerInfo(worker_id, num_workers,
                               base_seed + worker_id, dataset)
-    if user_init_fn is not None:
-        user_init_fn(worker_id)
-
-
-def _worker_fetch(indices):
-    ds = _WORKER_STATE["ds"]
-    return _WORKER_STATE["collate"]([ds[i] for i in indices])
+    np.random.seed((base_seed + worker_id) % (2 ** 32))
+    try:
+        if user_init_fn is not None:
+            user_init_fn(worker_id)
+    except Exception as e:
+        result_queue.put(("error", -1, worker_id, [], None, _format_exc(e)))
+        return
+    while True:
+        try:
+            job = index_queue.get()
+        except (EOFError, OSError):
+            return
+        if job is None:
+            return
+        batch_idx, indices = job
+        try:
+            result_queue.put(_fetch_one(dataset, collate_fn, batch_idx,
+                                        worker_id, indices, quarantine))
+        except (EOFError, OSError, BrokenPipeError):
+            return  # parent gone: nothing left to report to
 
 
 class _PrefetchIterator:
-    def __init__(self, loader: "DataLoader"):
+    def __init__(self, loader: "DataLoader", skip_batches: int = 0):
         self._loader = loader
-        self._index_iter = iter(loader.batch_sampler) \
-            if loader.batch_sampler is not None else None
+        bs = loader.batch_sampler
+        # sampler state snapshot BEFORE iter() (which advances the
+        # sampler's epoch) — this is what state_dict() hands a resume
+        self._sampler_state = bs.state_dict() \
+            if bs is not None and hasattr(bs, "state_dict") else {}
+        self._index_iter = iter(bs) if bs is not None else None
+        self._skip = int(skip_batches)
+        self._cursor = self._skip  # index batches consumed (consumer view)
+        if self._skip and self._index_iter is not None:
+            # mid-epoch resume: fast-forward at the INDEX level — no
+            # sample fetch, no collation, just the sampler replaying
+            for _ in range(self._skip):
+                if next(self._index_iter, None) is None:
+                    break
         self._queue: "queue.Queue" = queue.Queue(
             maxsize=max(2, loader.prefetch_factor))
         self._done = object()
         self._err = None
         self._stopped = False
+        self._closed = False
+        self._exhausted = False
+        self.quarantined: list = []
+        # ------------------------------------------- supervised pool
         self._pool = None
+        self._ctx = None
+        self._workers: list = []
+        self._index_queues: list = []
+        self._result_queue = None
+        self._in_flight: dict = {}  # batch_idx -> (wid, indices)
+        # wid -> monotonic time the worker last made progress while
+        # holding in-flight work (dispatch into an idle worker, or its
+        # most recent result): the per-fetch deadline is measured from
+        # here, so queueing behind other batches never counts against it
+        self._busy_since: dict = {}
+        self._respawns_left = int(loader.worker_respawn_limit)
+        self._fetch_timeout = loader._fetch_timeout()
         if loader.num_workers > 0 and self._index_iter is not None:
             # fork on the CONSUMER thread, before the producer thread
             # exists and before this iterator touches the device —
             # forking from a helper thread while JAX dispatch threads
             # hold locks is how the classic post-fork deadlock happens
-            ctx = mp.get_context("fork")
-            counter = ctx.Value("i", 0)
-            self._pool = ctx.Pool(
-                loader.num_workers, initializer=_worker_init,
-                initargs=(loader.dataset, loader.collate_fn,
-                          loader.worker_init_fn, counter,
-                          loader.num_workers))
+            self._spawn_pool()
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
-    def _fetch_batch(self, indices):
-        ds = self._loader.dataset
-        samples = [ds[i] for i in indices]
-        return self._loader.collate_fn(samples)
+    # ------------------------------------------------------- pool plumbing
+    def _spawn_pool(self):
+        loader = self._loader
+        ctx = mp.get_context("fork")
+        self._ctx = ctx
+        self._result_queue = ctx.Queue()
+        from ..core import flags as _flags
+        self._base_seed = int(_flags.get_flag("seed") or 0)
+        for wid in range(loader.num_workers):
+            self._start_worker(wid)
+        # the live-pool handle close() nulls out (and tests assert on)
+        self._pool = self._workers
 
+    def _start_worker(self, wid: int):
+        loader = self._loader
+        q = self._ctx.Queue()
+        p = self._ctx.Process(
+            target=_worker_loop,
+            args=(loader.dataset, loader.collate_fn, loader.worker_init_fn,
+                  wid, loader.num_workers, q, self._result_queue,
+                  loader.skip_bad_samples, self._base_seed),
+            daemon=True)
+        p.start()
+        if wid < len(self._workers):
+            old_q = self._index_queues[wid]
+            self._workers[wid] = p
+            self._index_queues[wid] = q
+            try:  # the dead worker's queue: nothing reads it anymore
+                old_q.cancel_join_thread()
+                old_q.close()
+            except (OSError, ValueError):
+                pass
+        else:
+            self._workers.append(p)
+            self._index_queues.append(q)
+
+    def _dispatch(self, batch_idx: int, wid: int, indices):
+        self._in_flight[batch_idx] = (wid, list(indices))
+        self._busy_since.setdefault(wid, time.monotonic())
+        self._index_queues[wid].put((batch_idx, list(indices)))
+
+    def _note_progress(self, wid):
+        """A result arrived from ``wid``: restart its fetch clock (or
+        clear it when the worker went idle)."""
+        if wid is None:
+            return
+        if any(f[0] == wid for f in self._in_flight.values()):
+            self._busy_since[wid] = time.monotonic()
+        else:
+            self._busy_since.pop(wid, None)
+
+    def _check_workers(self):
+        """Liveness + per-fetch deadline sweep, run whenever the result
+        wait comes up empty. Dead worker -> respawn (bounded) and
+        re-dispatch its in-flight batches; wedged worker (no progress on
+        its current fetch past the deadline) -> stack dump +
+        WatchdogTimeout, the hang surfaced instead of stalling the pod."""
+        for wid, p in enumerate(self._workers):
+            if p is None or p.is_alive():
+                continue
+            monitor.record_worker_death(wid)
+            if self._respawns_left <= 0:
+                raise DataLoaderWorkerError(
+                    f"DataLoader worker {wid} died (exitcode "
+                    f"{p.exitcode}) and the respawn budget is exhausted",
+                    worker_id=wid)
+            self._respawns_left -= 1
+            lost = sorted((b, f) for b, f in self._in_flight.items()
+                          if f[0] == wid)
+            # fork happens on the supervisor thread here — acceptable
+            # because workers only run dataset/collate code, never the
+            # jax dispatch machinery whose locks make forking from
+            # threads dangerous
+            self._start_worker(wid)
+            monitor.record_worker_respawn(wid)
+            self._busy_since.pop(wid, None)
+            for b, (_, idxs) in lost:
+                self._dispatch(b, wid, idxs)
+        if not self._fetch_timeout:
+            return
+        now = time.monotonic()
+        for wid, t0 in list(self._busy_since.items()):
+            if now - t0 <= self._fetch_timeout:
+                continue
+            # the worker has held in-flight work without producing a
+            # single result for a full deadline: wedged (a healthy
+            # worker finishes each fetch well inside it; batches merely
+            # QUEUED behind others never start this clock)
+            owned = sorted(b for b, f in self._in_flight.items()
+                           if f[0] == wid)
+            idxs = self._in_flight[owned[0]][1] if owned else []
+            from ..distributed import resilience
+            resilience.dump_stacks("io.fetch", self._fetch_timeout)
+            monitor.record_watchdog_timeout("io.fetch")
+            raise resilience.WatchdogTimeout(
+                f"DataLoader fetch of batch "
+                f"{owned[0] if owned else '?'} (worker {wid}, samples "
+                f"{idxs[:8]}{'...' if len(idxs) > 8 else ''}) exceeded "
+                f"{self._fetch_timeout:.1f}s — worker wedged")
+
+    def _note_quarantined(self, dropped):
+        if not dropped:
+            return
+        self.quarantined.extend(dropped)
+        # mirrored on the loader so the record outlives the iterator
+        self._loader._quarantined.extend(dropped)
+        monitor.record_sample_quarantined(len(dropped))
+
+    # ------------------------------------------------------------- produce
     def _produce(self):
         try:
             if isinstance(self._loader.dataset, IterableDataset):
-                batch = []
-                for item in self._loader.dataset:
-                    batch.append(item)
-                    if len(batch) == self._loader.batch_size:
-                        self._queue.put(self._to_device(
-                            self._loader.collate_fn(batch)))
-                        batch = []
-                if batch and not self._loader.drop_last:
-                    self._queue.put(self._to_device(
-                        self._loader.collate_fn(batch)))
-            elif self._pool is not None:
-                # imap preserves batch order across workers
-                for batch in self._pool.imap(_worker_fetch,
-                                             self._index_iter):
-                    if not self._put(self._to_device(batch)):
-                        return  # consumer abandoned the iterator
+                self._produce_iterable()
+            elif self._workers:
+                self._produce_mp()
             else:
-                for indices in self._index_iter:
-                    if not self._put(self._to_device(
-                            self._fetch_batch(indices))):
-                        return
+                self._produce_sp()
         except Exception as e:  # surface in consumer thread
             self._err = e
         finally:
             self._put(self._done)
             self._shutdown_pool()
+
+    def _produce_iterable(self):
+        loader = self._loader
+        batch, batch_idx, pos = [], 0, -1
+        quarantine = loader.skip_bad_samples
+
+        def emit(b, idx):
+            if idx < self._skip:
+                return True  # resume fast-forward: count, don't collate
+            return self._put((idx, self._to_device(loader.collate_fn(b))))
+
+        for item in loader.dataset:
+            pos += 1
+            if quarantine and not _sample_finite(item):
+                self._note_quarantined([(pos, "non-finite sample")])
+                continue
+            batch.append(item)
+            if len(batch) == loader.batch_size:
+                if not emit(batch, batch_idx):
+                    return
+                batch_idx += 1
+                batch = []
+        if batch and not loader.drop_last:
+            emit(batch, batch_idx)
+
+    def _produce_sp(self):
+        loader = self._loader
+        batch_idx = self._skip
+        for indices in self._index_iter:
+            env = _fetch_one(loader.dataset, loader.collate_fn, batch_idx,
+                             None, indices, loader.skip_bad_samples)
+            if env[0] == "error":
+                _, _, _, idxs, sample_i, tb = env
+                raise DataLoaderWorkerError(
+                    f"DataLoader sample fetch failed"
+                    + (f" at sample index {sample_i}"
+                       if sample_i is not None else "")
+                    + f":\n{tb}", sample_index=sample_i,
+                    batch_indices=idxs)
+            _, _, _, batch, dropped = env
+            self._note_quarantined(dropped)
+            if batch is not None:
+                if not self._put((batch_idx, self._to_device(batch))):
+                    return
+            batch_idx += 1
+
+    def _produce_mp(self):
+        loader = self._loader
+        max_outstanding = max(2, loader.prefetch_factor) * loader.num_workers
+        buffer: dict = {}
+        next_emit = self._skip
+        next_dispatch = self._skip
+        exhausted = False
+        rr = 0
+        while not self._stopped:
+            while not exhausted and len(self._in_flight) < max_outstanding:
+                indices = next(self._index_iter, None)
+                if indices is None:
+                    exhausted = True
+                    break
+                self._dispatch(next_dispatch, rr % loader.num_workers,
+                               indices)
+                next_dispatch += 1
+                rr += 1
+            if exhausted and not self._in_flight:
+                return
+            try:
+                env = self._result_queue.get(timeout=0.1)
+            except queue.Empty:
+                self._check_workers()
+                continue
+            if env[0] == "error":
+                _, _, wid, idxs, sample_i, tb = env
+                raise DataLoaderWorkerError(
+                    f"DataLoader worker {wid} failed"
+                    + (f" at sample index {sample_i}"
+                       if sample_i is not None else "")
+                    + f":\n{tb}", worker_id=wid, sample_index=sample_i,
+                    batch_indices=idxs)
+            _, batch_idx, wid, batch, dropped = env
+            if batch_idx not in self._in_flight:
+                self._note_progress(wid)
+                continue  # duplicate after a respawn re-dispatch
+            del self._in_flight[batch_idx]
+            self._note_progress(wid)
+            self._note_quarantined(dropped)
+            buffer[batch_idx] = batch
+            # order-preserving release (imap semantics, but index-driven
+            # so a respawned worker's re-computed batch slots back in)
+            while next_emit in buffer:
+                b = buffer.pop(next_emit)
+                if b is not None:
+                    if not self._put((next_emit, self._to_device(b))):
+                        return
+                next_emit += 1
 
     def _put(self, item) -> bool:
         """Bounded put that gives up when the consumer closed us, so an
@@ -164,22 +472,63 @@ class _PrefetchIterator:
                 continue
         return False
 
+    # ------------------------------------------------------------ teardown
     def _shutdown_pool(self):
-        pool, self._pool = self._pool, None
-        if pool is not None:
-            pool.terminate()
-            pool.join()
+        workers, self._workers = self._workers, []
+        index_queues, self._index_queues = self._index_queues, []
+        self._pool = None
+        self._in_flight.clear()
+        if not workers:
+            return
+        for q in index_queues:
+            try:
+                q.put_nowait(None)  # graceful-exit sentinel
+            except (OSError, ValueError, queue.Full):
+                pass
+        for p in workers:
+            if p is not None:
+                p.join(timeout=0.5)
+        for p in workers:
+            if p is not None and p.is_alive():
+                # SIGKILL, not SIGTERM: a wedged (SIGSTOPped) worker
+                # never handles SIGTERM, and KILL works on stopped
+                # processes too
+                p.kill()
+                p.join(timeout=5.0)
+        for q in index_queues + [self._result_queue]:
+            if q is None:
+                continue
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except (OSError, ValueError):
+                pass
+        self._result_queue = None
 
     def close(self):
-        """Stop the producer and reap worker processes."""
+        """Stop the producer and reap worker processes. Idempotent, and
+        called automatically on every consumer-side exit path
+        (StopIteration, propagated worker error, __del__), so an aborted
+        epoch can never leak the pool."""
+        if self._closed:
+            return
+        self._closed = True
         self._stopped = True
         try:  # unblock a producer stuck in put()
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5.0)
+        thread = getattr(self, "_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
         self._shutdown_pool()
+        try:
+            # wake a consumer blocked in __next__ on another thread so
+            # a cross-thread close can never strand it
+            self._queue.put_nowait(self._done)
+        except queue.Full:
+            pass
 
     def __del__(self):
         try:
@@ -187,6 +536,7 @@ class _PrefetchIterator:
         except Exception:
             pass
 
+    # ------------------------------------------------------------- consume
     def _to_device(self, batch):
         # async host->device: device_put returns immediately, transfer
         # overlaps with compute on the prior batch
@@ -202,17 +552,50 @@ class _PrefetchIterator:
         return jax.tree_util.tree_map(put, batch)
 
     def __next__(self):
-        item = self._queue.get()
-        if item is self._done:
+        if self._exhausted:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        if self._closed:
+            # closed without being consumed to the end — most likely a
+            # second iter() on the same DataLoader invalidated this one
+            # (one active iterator per loader); fail loudly rather than
+            # block forever on a queue nothing fills
+            raise RuntimeError(
+                "DataLoader iterator is closed (creating a new iterator "
+                "from the same DataLoader closes the previous one)")
+        item = self._queue.get()
+        if item is self._done:
+            self._exhausted = True
+            if self._err is not None:
+                self.close()  # error path must reap the pool too
+                raise self._err
+            self.close()
+            raise StopIteration
+        batch_idx, batch = item
+        self._cursor = batch_idx + 1
         if monitor.enabled:
-            monitor.record_dataloader_batch(*_batch_stats(item))
-        return item
+            monitor.record_dataloader_batch(*_batch_stats(batch))
+        return batch
 
     def __iter__(self):
         return self
+
+
+def _state_scalar(v):
+    """Coerce a checkpoint-restored leaf (Tensor / 0-d array / scalar)
+    back to the plain python number sampler state is made of."""
+    v = getattr(v, "data", v)
+    arr = np.asarray(v)
+    return arr.item() if arr.shape == () else arr.tolist()
+
+
+def _coerce_state(node):
+    if isinstance(node, dict):
+        return {k: _coerce_state(v) for k, v in node.items()}
+    if node is None:
+        return None
+    return _state_scalar(node)
 
 
 class DataLoader:
@@ -222,7 +605,9 @@ class DataLoader:
                  collate_fn: Optional[Callable] = None, num_workers: int = 0,
                  use_buffer_reader: bool = True, prefetch_factor: int = 2,
                  use_shared_memory: bool = False, timeout=0,
-                 worker_init_fn=None, keep_int64: bool = True):
+                 worker_init_fn=None, keep_int64: bool = True,
+                 worker_respawn_limit: int = 3,
+                 skip_bad_samples: bool = False):
         self.dataset = dataset
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -231,6 +616,15 @@ class DataLoader:
         self.keep_int64 = keep_int64
         self.num_workers = int(num_workers)
         self.worker_init_fn = worker_init_fn
+        # per-fetch deadline (seconds; 0 = PADDLE_WATCHDOG_DATALOADER_S
+        # env, unset = no deadline) — a wedged worker surfaces as
+        # WatchdogTimeout instead of stalling the whole pod
+        self.timeout = float(timeout or 0)
+        self.worker_respawn_limit = int(worker_respawn_limit)
+        self.skip_bad_samples = bool(skip_bad_samples)
+        self._latest_iter = None
+        self._resume_state: Optional[dict] = None
+        self._quarantined: list = []
         if self.num_workers > 0 and isinstance(dataset, IterableDataset):
             raise ValueError(
                 "num_workers > 0 requires a map-style Dataset "
@@ -249,13 +643,80 @@ class DataLoader:
                 dataset, shuffle=shuffle, batch_size=batch_size,
                 drop_last=drop_last)
 
+    def _fetch_timeout(self) -> Optional[float]:
+        if self.timeout > 0:
+            return self.timeout
+        from ..distributed.resilience import env_timeout
+        return env_timeout("PADDLE_WATCHDOG_DATALOADER_S")
+
     def __iter__(self):
-        return _PrefetchIterator(self)
+        # an abandoned previous epoch (break mid-iteration) must not
+        # keep its worker pool alive behind the new one
+        prev = self._active_iter()
+        if prev is not None and not prev._closed:
+            prev.close()
+        resume, self._resume_state = self._resume_state, None
+        skip = 0
+        if resume:
+            skip = int(resume.get("cursor") or 0)
+            sampler_state = resume.get("sampler")
+            if sampler_state and self.batch_sampler is not None and \
+                    hasattr(self.batch_sampler, "load_state_dict"):
+                self.batch_sampler.load_state_dict(sampler_state)
+        self._quarantined = []
+        it = _PrefetchIterator(self, skip_batches=skip)
+        self._latest_iter = weakref.ref(it)
+        return it
 
     def __len__(self):
         if self.batch_sampler is None:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
+
+    # ------------------------------------------------------------- resume
+    def _active_iter(self) -> Optional[_PrefetchIterator]:
+        return self._latest_iter() if self._latest_iter is not None else None
+
+    @property
+    def quarantined(self) -> list:
+        """[(sample_index, reason), ...] quarantined by the most recent
+        iteration (skip_bad_samples mode)."""
+        return list(self._quarantined)
+
+    def state_dict(self) -> dict:
+        """Checkpointable position: ``cursor`` (index batches already
+        consumed this epoch) + the sampler's epoch/RNG state as of this
+        epoch's start. Mid-iteration this captures the ACTIVE iterator,
+        so an emergency save at a step boundary loses nothing."""
+        it = self._active_iter()
+        if it is not None and not it._exhausted:
+            return {"cursor": int(it._cursor),
+                    "sampler": dict(it._sampler_state)}
+        if self._resume_state is not None:  # loaded, not yet iterated
+            return {"cursor": int(self._resume_state.get("cursor") or 0),
+                    "sampler": dict(self._resume_state.get("sampler") or {})}
+        bs = self.batch_sampler
+        sampler = bs.state_dict() \
+            if bs is not None and hasattr(bs, "state_dict") else {}
+        return {"cursor": 0, "sampler": sampler}
+
+    def load_state_dict(self, state: dict) -> int:
+        """Arm the next ``__iter__`` to resume: restore the sampler
+        state, then fast-forward ``cursor`` index batches. Leaves may be
+        checkpoint-restored Tensors/0-d arrays — coerced here. Returns
+        the cursor."""
+        state = _coerce_state(dict(state or {}))
+        cursor = int(state.get("cursor") or 0)
+        self._resume_state = {"cursor": cursor,
+                              "sampler": state.get("sampler") or {}}
+        return cursor
+
+    @property
+    def resumed_mid_epoch(self) -> bool:
+        """True while a loaded, not-yet-replayed resume state points
+        into the middle of an epoch (cursor > 0)."""
+        return bool(self._resume_state
+                    and self._resume_state.get("cursor", 0) > 0)
 
 
 class WorkerInfo:

@@ -1,12 +1,44 @@
 """Samplers incl. DistributedBatchSampler (≈ python/paddle/io/
 BatchSampler, python/paddle/fluid/dataloader/batch_sampler.py:
-DistributedBatchSampler — rank-sharded indices with padding)."""
+DistributedBatchSampler — rank-sharded indices with padding).
+
+Checkpointable (the t5x/Grain deterministic-input contract): every
+sampler exposes ``state_dict()/load_state_dict()``, and the shuffling
+samplers derive each epoch's permutation from a STORED (seed, epoch)
+pair via ``np.random.SeedSequence`` — never from the global RNG — so a
+resumed job replays the exact same index stream. The base seed is drawn
+once at construction (from the global RNG, so ``paddle.seed`` still
+makes whole runs reproducible) and checkpointed with the epoch.
+"""
 from __future__ import annotations
 
 import math
 from typing import Iterator, List, Optional
 
 import numpy as np
+
+
+def _draw_base_seed(generator) -> int:
+    """Resolve a sampler's stored base seed: an explicit int, a
+    np.random.Generator to draw from, or None -> one draw from the
+    global RNG (the only global-RNG touch; everything after is derived
+    from the stored value)."""
+    if generator is None:
+        return int(np.random.randint(0, 2 ** 31 - 1))
+    if isinstance(generator, (int, np.integer)):
+        return int(generator)
+    if isinstance(generator, np.random.Generator):
+        return int(generator.integers(0, 2 ** 31 - 1))
+    raise TypeError(
+        f"generator must be None, an int seed, or np.random.Generator; "
+        f"got {type(generator)}")
+
+
+def _epoch_rng(seed: int, epoch: int) -> np.random.Generator:
+    """The per-epoch generator: seed and epoch folded through a
+    SeedSequence, so epochs are decorrelated and replayable."""
+    return np.random.Generator(
+        np.random.PCG64(np.random.SeedSequence([int(seed), int(epoch)])))
 
 
 class Sampler:
@@ -19,6 +51,13 @@ class Sampler:
     def __len__(self):
         raise NotImplementedError
 
+    # stateless by default; stateful subclasses override both
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, state: dict) -> None:
+        pass
+
 
 class SequenceSampler(Sampler):
     def __iter__(self):
@@ -29,41 +68,78 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """Shuffling sampler with a stored per-epoch seed schedule: each
+    ``__iter__`` draws the CURRENT epoch's permutation then advances the
+    epoch, so consecutive epochs shuffle differently while
+    ``state_dict()`` -> ``load_state_dict()`` replays any epoch
+    exactly."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self._seed = _draw_base_seed(generator)
+        self._epoch = 0
 
     @property
     def num_samples(self):
         return self._num_samples or len(self.data_source)
 
+    def set_epoch(self, epoch: int):
+        self._epoch = int(epoch)
+
     def __iter__(self):
         n = len(self.data_source)
+        rng = _epoch_rng(self._seed, self._epoch)
+        self._epoch += 1
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[:self.num_samples].tolist())
-
-    def __len__(self):
-        return self.num_samples
-
-
-class WeightedRandomSampler(Sampler):
-    def __init__(self, weights, num_samples, replacement=True):
-        super().__init__(None)
-        self.weights = np.asarray(weights, np.float64)
-        self.num_samples = num_samples
-        self.replacement = replacement
-
-    def __iter__(self):
-        p = self.weights / self.weights.sum()
-        idx = np.random.choice(len(self.weights), self.num_samples,
-                               replace=self.replacement, p=p)
+            idx = rng.integers(0, n, self.num_samples)
+        else:
+            idx = rng.permutation(n)[:self.num_samples]
         return iter(idx.tolist())
 
     def __len__(self):
         return self.num_samples
+
+    def state_dict(self) -> dict:
+        return {"seed": int(self._seed), "epoch": int(self._epoch)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._seed = int(state.get("seed", self._seed))
+        self._epoch = int(state.get("epoch", self._epoch))
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True,
+                 generator=None):
+        super().__init__(None)
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+        self._seed = _draw_base_seed(generator)
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self._epoch = int(epoch)
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        rng = _epoch_rng(self._seed, self._epoch)
+        self._epoch += 1
+        idx = rng.choice(len(self.weights), self.num_samples,
+                         replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+    def state_dict(self) -> dict:
+        return {"seed": int(self._seed), "epoch": int(self._epoch)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._seed = int(state.get("seed", self._seed))
+        self._epoch = int(state.get("epoch", self._epoch))
 
 
 class BatchSampler(Sampler):
@@ -95,12 +171,25 @@ class BatchSampler(Sampler):
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
+    # position/RNG state lives in the wrapped sampler
+    def state_dict(self) -> dict:
+        return self.sampler.state_dict() \
+            if hasattr(self.sampler, "state_dict") else {}
+
+    def load_state_dict(self, state: dict) -> None:
+        if hasattr(self.sampler, "load_state_dict"):
+            self.sampler.load_state_dict(state)
+
 
 class DistributedBatchSampler(BatchSampler):
     """Shards the index space across data-parallel ranks with padding so
     every rank sees the same number of batches (required for lockstep SPMD
     execution — same reason the reference pads:
-    fluid/dataloader/batch_sampler.py DistributedBatchSampler)."""
+    fluid/dataloader/batch_sampler.py DistributedBatchSampler).
+
+    The shuffle permutation is seeded by the epoch alone (reference
+    contract: ``set_epoch`` on every rank keeps the ranks' shards
+    aligned), so ``state_dict()`` only needs the epoch."""
 
     def __init__(self, dataset, batch_size, num_replicas: Optional[int] = None,
                  rank: Optional[int] = None, shuffle=False, drop_last=False):
@@ -144,3 +233,9 @@ class DistributedBatchSampler(BatchSampler):
         if self.drop_last:
             return self.num_samples // self.batch_size
         return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def state_dict(self) -> dict:
+        return {"epoch": int(self.epoch)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state.get("epoch", self.epoch))

@@ -17,8 +17,14 @@ cost on v5e (layer1/2 run at the HBM roof).
 Numerics: the matmul accumulates in fp32 on the MXU; statistics are
 computed from the bf16-rounded stored output, so they match what the
 unfused two-pass path computes from the materialized conv output.
-Variance uses the one-pass E[y^2] - E[y]^2 form in fp32 (what the
-reference's cuDNN path uses as well).
+Variance stays one-pass but SHIFTED: the first grid block's channel
+means become a per-channel anchor k, and the kernels accumulate
+sum(y - k) / sum((y - k)^2), so var = E[(y-k)^2] - E[y-k]^2 never
+cancels catastrophically. The naive E[y^2] - E[y]^2 form loses all
+significance exactly where ResNet needs it most (late stages: few
+rows per channel, |mean| >> std) — measured up to 7% relative error
+in bn-weight gradients at layer4 in fp32, which the shift removes
+while keeping the single HBM pass.
 """
 from __future__ import annotations
 
@@ -44,8 +50,10 @@ def _pick_block(n: int, preferred: int) -> int:
     return max(block, 1)
 
 
-def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref):
-    """y = x @ w; epilogue accumulates per-channel sum / sumsq of y."""
+def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref, k_ref):
+    """y = x @ w; epilogue accumulates per-channel shifted sum / sumsq
+    of y (anchor k = block 0's channel means, held in k_ref across the
+    grid — the shifted one-pass variance form)."""
     i = pl.program_id(0)
     y = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
     yr = y.astype(y_ref.dtype)
@@ -54,15 +62,17 @@ def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref):
 
     @pl.when(i == 0)
     def _init():
+        k_ref[:] = jnp.mean(yf, axis=0, keepdims=True)
         s_ref[:] = jnp.zeros_like(s_ref)
         q_ref[:] = jnp.zeros_like(q_ref)
 
-    s_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
-    q_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+    d = yf - k_ref[:]
+    s_ref[:] += jnp.sum(d, axis=0, keepdims=True)
+    q_ref[:] += jnp.sum(d * d, axis=0, keepdims=True)
 
 
 def _bn_relu_mm_stats_kernel(x_ref, scale_ref, shift_ref, w_ref,
-                             y_ref, s_ref, q_ref):
+                             y_ref, s_ref, q_ref, k_ref):
     """a = relu(x * scale + shift) (bf16, on the fly); y = a @ w; stats
     epilogue as above. scale/shift are the folded BN affine of the
     PREVIOUS conv's statistics."""
@@ -77,11 +87,13 @@ def _bn_relu_mm_stats_kernel(x_ref, scale_ref, shift_ref, w_ref,
 
     @pl.when(i == 0)
     def _init():
+        k_ref[:] = jnp.mean(yf, axis=0, keepdims=True)
         s_ref[:] = jnp.zeros_like(s_ref)
         q_ref[:] = jnp.zeros_like(q_ref)
 
-    s_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
-    q_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+    d = yf - k_ref[:]
+    s_ref[:] += jnp.sum(d, axis=0, keepdims=True)
+    q_ref[:] += jnp.sum(d * d, axis=0, keepdims=True)
 
 
 def _vmem_bm(k, n, m, es, extra_f32_cols=0):
@@ -122,12 +134,17 @@ def _itemsize(x):
 
 
 def _mm_stats_bwd_kernel(dy_ref, y_ref, x_ref, wt_ref, perch_ref, dvar2_ref,
-                         dx_ref, dw_ref):
+                         mean_ref, dx_ref, dw_ref):
     """One-pass dx + dw with the (mean, var) cotangents folded into the
-    effective output gradient: dy_eff = dy + perch + dvar2 * y."""
+    effective output gradient: dy_eff = dy + perch + dvar2 * (y - mean).
+    The variance term multiplies the CENTERED output — folding the mean
+    into perch instead (dy + [perch - dvar2*mean] + dvar2*y) cancels
+    catastrophically when |mean| >> std, the same failure mode the
+    forward's shifted stats avoid."""
     i = pl.program_id(0)
     dy_eff = (dy_ref[:].astype(jnp.float32) + perch_ref[:]
-              + dvar2_ref[:] * y_ref[:].astype(jnp.float32))
+              + dvar2_ref[:] * (y_ref[:].astype(jnp.float32)
+                                - mean_ref[:]))
     dy_bf = dy_eff.astype(dy_ref.dtype)
     dx_ref[:] = jnp.dot(dy_bf, wt_ref[:],
                         preferred_element_type=jnp.float32
@@ -143,13 +160,14 @@ def _mm_stats_bwd_kernel(dy_ref, y_ref, x_ref, wt_ref, perch_ref, dvar2_ref,
 
 
 def _bn_relu_mm_stats_bwd_kernel(dy_ref, y_ref, x_ref, scale_ref, shift_ref,
-                                 wt_ref, perch_ref, dvar2_ref,
+                                 wt_ref, perch_ref, dvar2_ref, mean_ref,
                                  dx_ref, dw_ref, dscale_ref, dshift_ref):
     """One-pass dx/dw/dscale/dshift for the prologue kernel: recomputes
     a = relu(x*scale+shift) in VMEM (never from HBM)."""
     i = pl.program_id(0)
     dy_eff = (dy_ref[:].astype(jnp.float32) + perch_ref[:]
-              + dvar2_ref[:] * y_ref[:].astype(jnp.float32))
+              + dvar2_ref[:] * (y_ref[:].astype(jnp.float32)
+                                - mean_ref[:]))
     dy_bf = dy_eff.astype(dy_ref.dtype)
     xf = x_ref[:].astype(jnp.float32)
     pre = xf * scale_ref[:] + shift_ref[:]
@@ -171,7 +189,7 @@ def _bn_relu_mm_stats_bwd_kernel(dy_ref, y_ref, x_ref, scale_ref, shift_ref,
     dshift_ref[:] += jnp.sum(gated, axis=0, keepdims=True)
 
 
-def _mm_stats_bwd_pallas(dy, y, x2, w2, perch, dvar2):
+def _mm_stats_bwd_pallas(dy, y, x2, w2, perch, dvar2, mean):
     m, k = x2.shape
     n = w2.shape[1]
     bm = _vmem_bm(k, n, m, _itemsize(x2))
@@ -188,6 +206,7 @@ def _mm_stats_bwd_pallas(dy, y, x2, w2, perch, dvar2):
             pl.BlockSpec((n, k), lambda i: (0, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((bm, k), lambda i: (i, 0)),
@@ -198,11 +217,13 @@ def _mm_stats_bwd_pallas(dy, y, x2, w2, perch, dvar2):
             jax.ShapeDtypeStruct((k, n), jnp.float32),
         ],
         interpret=_interpret(),
-    )(dy, y, x2, wt, perch.reshape(1, n), dvar2.reshape(1, n))
+    )(dy, y, x2, wt, perch.reshape(1, n), dvar2.reshape(1, n),
+      mean.astype(jnp.float32).reshape(1, n))
     return dx, dw
 
 
-def _bn_relu_mm_stats_bwd_pallas(dy, y, x2, scale, shift, w2, perch, dvar2):
+def _bn_relu_mm_stats_bwd_pallas(dy, y, x2, scale, shift, w2, perch, dvar2,
+                                 mean):
     m, k = x2.shape
     n = w2.shape[1]
     bm = _vmem_bm(k, n, m, _itemsize(x2), extra_f32_cols=2 * k)
@@ -221,6 +242,7 @@ def _bn_relu_mm_stats_bwd_pallas(dy, y, x2, scale, shift, w2, perch, dvar2):
             pl.BlockSpec((n, k), lambda i: (0, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((bm, k), lambda i: (i, 0)),
@@ -237,7 +259,8 @@ def _bn_relu_mm_stats_bwd_pallas(dy, y, x2, scale, shift, w2, perch, dvar2):
         interpret=_interpret(),
     )(dy, y, x2, scale.reshape(1, k).astype(jnp.float32),
       shift.reshape(1, k).astype(jnp.float32), wt,
-      perch.reshape(1, n), dvar2.reshape(1, n))
+      perch.reshape(1, n), dvar2.reshape(1, n),
+      mean.astype(jnp.float32).reshape(1, n))
     return dx, dw, dscale[0], dshift[0]
 
 
@@ -247,7 +270,7 @@ def _mm_stats_pallas(x2, w2):
     bm = _vmem_fwd_bm(k, n, m, _itemsize(x2))
     if not bm:
         return None
-    y, s, q = pl.pallas_call(
+    y, s, q, kk = pl.pallas_call(
         _mm_stats_kernel,
         grid=(m // bm,),
         in_specs=[
@@ -258,15 +281,17 @@ def _mm_stats_pallas(x2, w2):
             pl.BlockSpec((bm, n), lambda i: (i, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((m, n), x2.dtype),
             jax.ShapeDtypeStruct((1, n), jnp.float32),
             jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
         ],
         interpret=_interpret(),
     )(x2, w2)
-    return y, s[0], q[0]
+    return y, s[0], q[0], kk[0]
 
 
 def _bn_relu_mm_stats_pallas(x2, scale, shift, w2):
@@ -275,7 +300,7 @@ def _bn_relu_mm_stats_pallas(x2, scale, shift, w2):
     bm = _vmem_fwd_bm(k, n, m, _itemsize(x2))
     if not bm:
         return None
-    y, s, q = pl.pallas_call(
+    y, s, q, kk = pl.pallas_call(
         _bn_relu_mm_stats_kernel,
         grid=(m // bm,),
         in_specs=[
@@ -288,38 +313,52 @@ def _bn_relu_mm_stats_pallas(x2, scale, shift, w2):
             pl.BlockSpec((bm, n), lambda i: (i, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((m, n), x2.dtype),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
             jax.ShapeDtypeStruct((1, n), jnp.float32),
             jax.ShapeDtypeStruct((1, n), jnp.float32),
         ],
         interpret=_interpret(),
     )(x2, scale.reshape(1, k).astype(jnp.float32),
       shift.reshape(1, k).astype(jnp.float32), w2)
-    return y, s[0], q[0]
+    return y, s[0], q[0], kk[0]
 
 
 # ---------------------------------------------------------------------------
 # custom-vjp wrappers (flattened [M, C] form)
 # ---------------------------------------------------------------------------
 
+def _finish_shifted_stats(s, q, k, rows):
+    """(mean, var) from shifted sums: s = sum(y-k), q = sum((y-k)^2).
+    Mathematically mean = k + E[y-k] and var = E[(y-k)^2] - E[y-k]^2
+    for ANY k; numerically k ≈ mean keeps both subtractions benign.
+    Round-off can still leave var a hair negative — clamp, BN folds it
+    through rsqrt(var + eps)."""
+    ds = s / rows
+    mean = k + ds
+    var = jnp.maximum(q / rows - ds * ds, 0.0)
+    return mean, var
+
+
 @jax.custom_vjp
 def matmul_bn_stats(x2, w2):
     """y = x2 @ w2 plus the BN batch statistics of y in one HBM pass.
 
     Returns (y [M,N], mean [N] fp32, var [N] fp32)."""
+    m = x2.shape[0]
     out = _mm_stats_pallas(x2, w2)
-    if out is None:  # VMEM-bounded: plain XLA two-pass
+    if out is None:  # VMEM-bounded: plain XLA (two-pass stats for free)
         y = jnp.dot(x2, w2,
                     preferred_element_type=jnp.float32).astype(x2.dtype)
         yf = y.astype(jnp.float32)
-        s, q = jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
-    else:
-        y, s, q = out
-    m = x2.shape[0]
-    mean = s / m
-    var = q / m - mean * mean
+        mean = jnp.mean(yf, axis=0)
+        var = jnp.mean((yf - mean) ** 2, axis=0)
+        return y, mean, var
+    y, s, q, k = out
+    mean, var = _finish_shifted_stats(s, q, k, m)
     return y, mean, var
 
 
@@ -330,16 +369,19 @@ def _matmul_bn_stats_fwd(x2, w2):
 
 def _dy_effective(dy, dmean, dvar, y, mean, rows):
     """Cotangent of y through (y, mean, var) outputs: mean = sum(y)/M,
-    var = sum(y^2)/M - mean^2."""
+    var = E[(y-mean)^2]. The dvar term multiplies the CENTERED output —
+    expanding it as dvar2*y - dvar2*mean cancels catastrophically when
+    |mean| >> std."""
     dyf = dy.astype(jnp.float32)
     yf = y.astype(jnp.float32)
-    per_ch = (dmean - 2.0 * dvar * mean) / rows
-    return dyf + per_ch[None, :] + (2.0 / rows) * dvar[None, :] * yf
+    return dyf + (dmean / rows)[None, :] \
+        + (2.0 / rows) * dvar[None, :] * (yf - mean[None, :])
 
 
-def _stats_cotangent_coeffs(dmean, dvar, mean, rows):
-    """Per-channel coefficients of dy_eff = dy + perch + dvar2 * y."""
-    perch = (dmean - 2.0 * dvar * mean) / rows
+def _stats_cotangent_coeffs(dmean, dvar, rows):
+    """Per-channel coefficients of
+    dy_eff = dy + perch + dvar2 * (y - mean)."""
+    perch = dmean / rows
     dvar2 = (2.0 / rows) * dvar
     return perch.astype(jnp.float32), dvar2.astype(jnp.float32)
 
@@ -348,8 +390,9 @@ def _matmul_bn_stats_bwd(res, cts):
     x2, w2, y, mean = res
     dy, dmean, dvar = cts
     rows = x2.shape[0]
-    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
-    out = _mm_stats_bwd_pallas(dy.astype(x2.dtype), y, x2, w2, perch, dvar2)
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, rows)
+    out = _mm_stats_bwd_pallas(dy.astype(x2.dtype), y, x2, w2, perch,
+                               dvar2, mean)
     if out is not None:
         dx, dw = out
         return dx, dw.astype(w2.dtype)
@@ -370,19 +413,19 @@ def bn_relu_matmul_bn_stats(x2, scale, shift, w2):
     The scale/shift prologue is the folded affine of the previous BN
     (gamma * rsqrt(var+eps), beta - mean * that), so the normalized
     activation `a` is never written to HBM. Returns (y, mean, var)."""
+    m = x2.shape[0]
     out = _bn_relu_mm_stats_pallas(x2, scale, shift, w2)
-    if out is None:  # VMEM-bounded: plain XLA two-pass
+    if out is None:  # VMEM-bounded: plain XLA (two-pass stats for free)
         a = jnp.maximum(x2.astype(jnp.float32) * scale[None, :]
                         + shift[None, :], 0.0).astype(x2.dtype)
         y = jnp.dot(a, w2,
                     preferred_element_type=jnp.float32).astype(x2.dtype)
         yf = y.astype(jnp.float32)
-        s, q = jnp.sum(yf, axis=0), jnp.sum(yf * yf, axis=0)
-    else:
-        y, s, q = out
-    m = x2.shape[0]
-    mean = s / m
-    var = q / m - mean * mean
+        mean = jnp.mean(yf, axis=0)
+        var = jnp.mean((yf - mean) ** 2, axis=0)
+        return y, mean, var
+    y, s, q, k = out
+    mean, var = _finish_shifted_stats(s, q, k, m)
     return y, mean, var
 
 
@@ -395,9 +438,9 @@ def _bn_relu_matmul_bn_stats_bwd(res, cts):
     x2, scale, shift, w2, y, mean = res
     dy, dmean, dvar = cts
     rows = x2.shape[0]
-    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, rows)
     out = _bn_relu_mm_stats_bwd_pallas(dy.astype(x2.dtype), y, x2, scale,
-                                       shift, w2, perch, dvar2)
+                                       shift, w2, perch, dvar2, mean)
     if out is not None:
         dx, dw, dscale, dshift = out
         return dx, dscale, dshift, dw.astype(w2.dtype)
@@ -435,8 +478,13 @@ bn_relu_matmul_bn_stats.defvjp(_bn_relu_matmul_bn_stats_fwd,
 
 
 def _conv3x3_fwd_kernel(x_ref, scale_ref, shift_ref, w_ref,
-                        y_ref, s_ref, q_ref, awin, *, hh, ww, cc, oo):
+                        y_ref, s_ref, q_ref, k_ref, awin, *, hh, ww, cc, oo):
     n = pl.program_id(0)
+
+    raw = x_ref[0]
+    sc = scale_ref[:].reshape(1, 1, cc)
+    sh = shift_ref[:].reshape(1, 1, cc)
+    act = jnp.maximum(raw.astype(jnp.float32) * sc + sh, 0.0)
 
     @pl.when(n == 0)
     def _init():
@@ -444,10 +492,6 @@ def _conv3x3_fwd_kernel(x_ref, scale_ref, shift_ref, w_ref,
         s_ref[:] = jnp.zeros_like(s_ref)
         q_ref[:] = jnp.zeros_like(q_ref)
 
-    raw = x_ref[0]
-    sc = scale_ref[:].reshape(1, 1, cc)
-    sh = shift_ref[:].reshape(1, 1, cc)
-    act = jnp.maximum(raw.astype(jnp.float32) * sc + sh, 0.0)
     awin[pl.ds(1, hh), pl.ds(1, ww), :] = act.astype(awin.dtype)
 
     acc = jnp.zeros((hh * ww, oo), jnp.float32)
@@ -460,17 +504,26 @@ def _conv3x3_fwd_kernel(x_ref, scale_ref, shift_ref, w_ref,
     y = acc.astype(y_ref.dtype)
     y_ref[...] = y.reshape(1, hh, ww, oo)
     yf = y.astype(jnp.float32)
-    s_ref[:] += jnp.sum(yf, axis=0, keepdims=True)
-    q_ref[:] += jnp.sum(yf * yf, axis=0, keepdims=True)
+
+    # shifted stats: anchor k = image 0's channel means (held in k_ref
+    # across the grid) keeps the one-pass variance cancellation-free
+    @pl.when(n == 0)
+    def _anchor():
+        k_ref[:] = jnp.mean(yf, axis=0, keepdims=True)
+
+    d = yf - k_ref[:]
+    s_ref[:] += jnp.sum(d, axis=0, keepdims=True)
+    q_ref[:] += jnp.sum(d * d, axis=0, keepdims=True)
 
 
 def _conv3x3_bwd_kernel(dy_ref, y_ref, x_ref, scale_ref, shift_ref,
-                        wf_ref, perch_ref, dvar2_ref,
+                        wf_ref, perch_ref, dvar2_ref, mean_ref,
                         dx_ref, dw_ref, ds_ref, dt_ref,
                         ewin, xwin, *, hh, ww, cc, oo):
     """One pass per image: dx (with relu gating + scale), dw (9 taps,
     fp32 accumulated), dscale/dshift — dy_eff (stats cotangents folded)
-    and the recomputed activation window exist only in VMEM."""
+    and the recomputed activation window exist only in VMEM. The dvar
+    term multiplies the CENTERED output (see _mm_stats_bwd_kernel)."""
     n = pl.program_id(0)
 
     @pl.when(n == 0)
@@ -484,7 +537,7 @@ def _conv3x3_bwd_kernel(dy_ref, y_ref, x_ref, scale_ref, shift_ref,
     dyf = dy_ref[0].astype(jnp.float32)
     yf = y_ref[0].astype(jnp.float32)
     e = dyf + perch_ref[:].reshape(1, 1, oo) \
-        + dvar2_ref[:].reshape(1, 1, oo) * yf
+        + dvar2_ref[:].reshape(1, 1, oo) * (yf - mean_ref[:].reshape(1, 1, oo))
     e_bf = e.astype(ewin.dtype)
     ewin[pl.ds(1, hh), pl.ds(1, ww), :] = e_bf
 
@@ -542,7 +595,7 @@ def conv3x3_vmem_ok(h, w, c, o, itemsize=2, budget=14 * 2 ** 20):
 def _conv3x3_fwd_pallas(x, scale, shift, w9, interpret=False):
     n, h, wd, c = x.shape
     o = w9.shape[1]
-    y, s, q = pl.pallas_call(
+    y, s, q, kk = pl.pallas_call(
         functools.partial(_conv3x3_fwd_kernel, hh=h, ww=wd, cc=c, oo=o),
         grid=(n,),
         in_specs=[
@@ -555,9 +608,11 @@ def _conv3x3_fwd_pallas(x, scale, shift, w9, interpret=False):
             pl.BlockSpec((1, h, wd, o), lambda i: (i, 0, 0, 0)),
             pl.BlockSpec((1, o), lambda i: (0, 0)),
             pl.BlockSpec((1, o), lambda i: (0, 0)),
+            pl.BlockSpec((1, o), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, h, wd, o), x.dtype),
+            jax.ShapeDtypeStruct((1, o), jnp.float32),
             jax.ShapeDtypeStruct((1, o), jnp.float32),
             jax.ShapeDtypeStruct((1, o), jnp.float32),
         ],
@@ -567,11 +622,11 @@ def _conv3x3_fwd_pallas(x, scale, shift, w9, interpret=False):
         interpret=interpret,
     )(x, scale.reshape(1, c).astype(jnp.float32),
       shift.reshape(1, c).astype(jnp.float32), w9)
-    return y, s[0], q[0]
+    return y, s[0], q[0], kk[0]
 
 
 def _conv3x3_bwd_pallas(dy, y, x, scale, shift, w9, wf9, perch, dvar2,
-                        interpret=False):
+                        mean, interpret=False):
     n, h, wd, c = x.shape
     o = w9.shape[1]
     dx, dw, ds, dt = pl.pallas_call(
@@ -584,6 +639,7 @@ def _conv3x3_bwd_pallas(dy, y, x, scale, shift, w9, wf9, perch, dvar2,
             pl.BlockSpec((1, c), lambda i: (0, 0)),
             pl.BlockSpec((1, c), lambda i: (0, 0)),
             pl.BlockSpec((9 * o, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, o), lambda i: (0, 0)),
             pl.BlockSpec((1, o), lambda i: (0, 0)),
             pl.BlockSpec((1, o), lambda i: (0, 0)),
         ],
@@ -606,12 +662,15 @@ def _conv3x3_bwd_pallas(dy, y, x, scale, shift, w9, wf9, perch, dvar2,
         interpret=interpret,
     )(dy, y, x, scale.reshape(1, c).astype(jnp.float32),
       shift.reshape(1, c).astype(jnp.float32), wf9,
-      perch.reshape(1, o), dvar2.reshape(1, o))
+      perch.reshape(1, o), dvar2.reshape(1, o),
+      mean.astype(jnp.float32).reshape(1, o))
     return dx, dw, ds[0], dt[0]
 
 
 def _conv3x3_ref_fwd(x, scale, shift, w9):
-    """jnp mirror of the fused 3x3 kernel (CPU path + oracle)."""
+    """jnp mirror of the fused 3x3 kernel (CPU path + oracle) — shifted
+    stats with the same image-0 anchor so (s, q, k) match the kernel's
+    bit for bit up to reduction order."""
     c = x.shape[-1]
     o = w9.shape[1]
     a = jnp.maximum(x.astype(jnp.float32) * scale + shift, 0.0
@@ -622,9 +681,11 @@ def _conv3x3_ref_fwd(x, scale, shift, w9):
         preferred_element_type=jnp.float32)
     yb = y.astype(x.dtype)
     yf = yb.astype(jnp.float32)
-    s = jnp.sum(yf, axis=(0, 1, 2))
-    q = jnp.sum(yf * yf, axis=(0, 1, 2))
-    return yb, s, q
+    k = jnp.mean(yf[0], axis=(0, 1))
+    d = yf - k
+    s = jnp.sum(d, axis=(0, 1, 2))
+    q = jnp.sum(d * d, axis=(0, 1, 2))
+    return yb, s, q, k
 
 
 @jax.custom_vjp
@@ -637,10 +698,9 @@ def conv3x3_bn_act_stats(x, scale, shift, w9):
     # off-TPU the same Pallas kernel runs in interpret mode, so the
     # CPU test suite exercises the real kernel logic (the jnp mirror
     # _conv3x3_ref_fwd is the oracle in tests/test_fused_resnet.py)
-    y, s, q = _conv3x3_fwd_pallas(x, scale, shift, w9,
-                                  interpret=_interpret())
-    mean = s / rows
-    var = q / rows - mean * mean
+    y, s, q, k = _conv3x3_fwd_pallas(x, scale, shift, w9,
+                                     interpret=_interpret())
+    mean, var = _finish_shifted_stats(s, q, k, rows)
     return y, mean, var
 
 
@@ -664,20 +724,20 @@ def _conv3x3_bwd(res, cts):
     n, h, wd, c = x.shape
     o = w9.shape[1]
     rows = n * h * wd
-    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, rows)
     wf9 = _conv3x3_flip(w9, c, o)
     dx, dw, ds, dt = _conv3x3_bwd_pallas(
         dy.astype(x.dtype), y, x, scale, shift, w9, wf9, perch, dvar2,
-        interpret=_interpret())
+        mean, interpret=_interpret())
     return dx, ds, dt, dw.astype(w9.dtype)
 
 
-def _conv3x3_ref_bwd(dy, y, x, scale, shift, w9, perch, dvar2):
+def _conv3x3_ref_bwd(dy, y, x, scale, shift, w9, perch, dvar2, mean):
     """jnp mirror of the fused 3x3 backward kernel (test oracle)."""
     c = x.shape[-1]
     o = w9.shape[1]
-    e = (dy.astype(jnp.float32) + perch + dvar2 * y.astype(jnp.float32)
-         ).astype(x.dtype)
+    e = (dy.astype(jnp.float32) + perch
+         + dvar2 * (y.astype(jnp.float32) - mean)).astype(x.dtype)
     xf = x.astype(jnp.float32)
     pre = xf * scale + shift
     a = jnp.maximum(pre, 0.0).astype(x.dtype)
@@ -799,6 +859,73 @@ def _bn_apply_bwd(res, dout):
 bn_apply.defvjp(_bn_apply_fwd, _bn_apply_bwd)
 
 
+# ---------------------------------------------------------------------------
+# CENTERED epilogue applies. The folded form above (bn_fold then
+# bn_apply*) autodiffs gamma as rsqrt(var+eps) * (dscale - mean*dshift):
+# when |mean| >> std (late ResNet stages, few rows per channel) the two
+# sums are each ~mean*sum(g) and their fp32 difference cancels to noise
+# — measured ~3% relative error in layer4 bn gradients. These variants
+# take the batch mean explicitly, apply (y - mean) * scale + beta, and
+# compute dscale against the fp32-CENTERED output, so the gamma path is
+# rsqrt * dscale with no cancelling subtraction anywhere.
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def bn_center_apply_relu_add(y, mean, scale, beta, identity):
+    """relu(bf16((y - mean) * scale + beta) + identity) — the
+    bottleneck's closing apply in centered form (scale is
+    gamma * rsqrt(var + eps), see bn_fold's first output)."""
+    pre = ((y.astype(jnp.float32) - mean) * scale + beta).astype(y.dtype)
+    return jnp.maximum(pre + identity, jnp.zeros((), y.dtype))
+
+
+def _bn_center_apply_relu_add_fwd(y, mean, scale, beta, identity):
+    out = bn_center_apply_relu_add(y, mean, scale, beta, identity)
+    return out, (y, mean, scale, out)
+
+
+def _bn_center_apply_relu_add_bwd(res, dout):
+    y, mean, scale, out = res
+    g = jnp.where(out > 0, dout, jnp.zeros((), dout.dtype))
+    gf = g.astype(jnp.float32)
+    axes = tuple(range(y.ndim - 1))
+    dy = (gf * scale).astype(y.dtype)
+    dbeta = jnp.sum(gf, axis=axes)
+    dmean = -dbeta * scale
+    dscale = jnp.sum(gf * (y.astype(jnp.float32) - mean), axis=axes)
+    return dy, dmean, dscale, dbeta, g.astype(dout.dtype)
+
+
+bn_center_apply_relu_add.defvjp(_bn_center_apply_relu_add_fwd,
+                                _bn_center_apply_relu_add_bwd)
+
+
+@jax.custom_vjp
+def bn_center_apply(y, mean, scale, beta):
+    """bf16((y - mean) * scale + beta) — the downsample-branch apply
+    (no relu) in centered form."""
+    return ((y.astype(jnp.float32) - mean) * scale + beta).astype(y.dtype)
+
+
+def _bn_center_apply_fwd(y, mean, scale, beta):
+    return bn_center_apply(y, mean, scale, beta), (y, mean, scale)
+
+
+def _bn_center_apply_bwd(res, dout):
+    y, mean, scale = res
+    df = dout.astype(jnp.float32)
+    axes = tuple(range(y.ndim - 1))
+    dy = (df * scale).astype(y.dtype)
+    dbeta = jnp.sum(df, axis=axes)
+    dmean = -dbeta * scale
+    dscale = jnp.sum(df * (y.astype(jnp.float32) - mean), axis=axes)
+    return dy, dmean, dscale, dbeta
+
+
+bn_center_apply.defvjp(_bn_center_apply_fwd, _bn_center_apply_bwd)
+
+
 @jax.custom_vjp
 def bn_moments(y):
     """Channel-last batch moments (fp32 mean/var) with a residual-lean
@@ -807,7 +934,9 @@ def bn_moments(y):
     yf = y.astype(jnp.float32)
     axes = tuple(range(y.ndim - 1))
     mean = jnp.mean(yf, axis=axes)
-    var = jnp.mean(yf * yf, axis=axes) - mean * mean
+    # two-pass variance (y is materialized anyway): E[y^2]-E[y]^2
+    # cancels catastrophically when |mean| >> std
+    var = jnp.mean((yf - mean) ** 2, axis=axes)
     return mean, var
 
 
@@ -820,8 +949,8 @@ def _bn_moments_bwd(res, cts):
     y, mean = res
     dmean, dvar = cts
     rows = math.prod(y.shape[:-1])
-    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, mean, rows)
-    dy = perch + dvar2 * y.astype(jnp.float32)
+    perch, dvar2 = _stats_cotangent_coeffs(dmean, dvar, rows)
+    dy = perch + dvar2 * (y.astype(jnp.float32) - mean)
     return (dy.astype(y.dtype),)
 
 

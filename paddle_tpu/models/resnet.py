@@ -90,8 +90,10 @@ class BottleneckBlock(Layer):
         fusion (see kernels/fused_resnet.py for the roofline argument).
         Numerics match the unfused path within bf16 rounding; running
         stats update identically."""
-        from ..nn.functional.fused_conv import (bn_apply, bn_apply_relu,
-                                                bn_apply_relu_add, bn_fold,
+        from ..nn.functional.fused_conv import (bn_apply_relu,
+                                                bn_center_apply,
+                                                bn_center_apply_relu_add,
+                                                bn_fold,
                                                 bn_moments, conv1x1_bn_stats,
                                                 bn_relu_conv1x1_bn_stats,
                                                 bn_relu_conv3x3_bn_stats)
@@ -121,18 +123,22 @@ class BottleneckBlock(Layer):
                          self.bn2.epsilon)
         y3, m3, v3 = bn_relu_conv1x1_bn_stats(y2, s2, t2, self.conv3.weight)
         self.bn3._update_running(m3, v3)
-        s3, t3 = bn_fold(self.bn3.weight, self.bn3.bias, m3, v3,
-                         self.bn3.epsilon)
+        # epilogue applies run CENTERED (mean passed explicitly, beta
+        # raw): only bn_fold's scale output is consumed, so the gamma
+        # gradient is rsqrt(var+eps) * dscale with no cancelling
+        # dscale - mean*dshift subtraction (see bn_center_apply*)
+        s3, _ = bn_fold(self.bn3.weight, self.bn3.bias, m3, v3,
+                        self.bn3.epsilon)
         if self.downsample is not None:
             dsconv, dsbn = self.downsample[0], self.downsample[1]
             yd, md, vd = conv1x1_bn_stats(x, dsconv.weight,
                                           stride=_stride0(dsconv))
             dsbn._update_running(md, vd)
-            sd, td = bn_fold(dsbn.weight, dsbn.bias, md, vd, dsbn.epsilon)
-            identity = bn_apply(yd, sd, td)
+            sd, _ = bn_fold(dsbn.weight, dsbn.bias, md, vd, dsbn.epsilon)
+            identity = bn_center_apply(yd, md, sd, dsbn.bias)
         else:
             identity = x
-        return bn_apply_relu_add(y3, s3, t3, identity)
+        return bn_center_apply_relu_add(y3, m3, s3, self.bn3.bias, identity)
 
 
 class ResNet(Layer):

@@ -62,6 +62,23 @@ def bn_apply(y, scale, shift):
     return _impl(y, scale, shift)
 
 
+@op("bn_center_apply_relu_add")
+def bn_center_apply_relu_add(y, mean, scale, beta, identity):
+    """relu(bf16((y-mean)*scale + beta) + identity) — the epilogue
+    apply in CENTERED form (scale = gamma*rsqrt(var+eps)): its vjp
+    computes dscale against the fp32-centered output, avoiding the
+    dscale vs mean*dshift cancellation of the folded form."""
+    from ...kernels.fused_resnet import bn_center_apply_relu_add as _impl
+    return _impl(y, mean, scale, beta, identity)
+
+
+@op("bn_center_apply")
+def bn_center_apply(y, mean, scale, beta):
+    """bf16((y-mean)*scale + beta) — centered apply, no relu."""
+    from ...kernels.fused_resnet import bn_center_apply as _impl
+    return _impl(y, mean, scale, beta)
+
+
 @op("bn_moments")
 def bn_moments(y):
     """Channel-last batch mean/var (fp32) with a residual-lean vjp."""

@@ -24,6 +24,14 @@ Injectable faults:
                                   under jit the call count is a
                                   trace-time constant — use
                                   poison_batch there).
+- ``kill_worker(...)``          — SIGKILL one of a DataLoader's worker
+                                  processes (crashed/OOM-killed worker;
+                                  drives the supervised respawn path).
+- ``suspend_worker(...)``       — SIGSTOP a worker (wedged worker; the
+                                  per-fetch deadline must fire).
+- ``FlakySamples(ds, ...)``     — dataset wrapper raising / returning
+                                  NaN samples at exact indices (drives
+                                  error attribution and quarantine).
 """
 from __future__ import annotations
 
@@ -33,12 +41,17 @@ import time
 from typing import Iterable, Optional, Sequence
 
 __all__ = [
+    "FlakySamples",
     "KillAfter",
     "NaNLoss",
     "StoreFaults",
     "checkpoint_data_files",
+    "dataloader_workers",
+    "kill_worker",
     "poison_batch",
     "remove_commit_marker",
+    "resume_worker",
+    "suspend_worker",
     "truncate_checkpoint",
 ]
 
@@ -213,6 +226,80 @@ def poison_batch(batch):
         return poison(node)
 
     return walk(batch)
+
+
+# ------------------------------------------------- dataloader faults
+
+def dataloader_workers(loader_or_iter) -> list:
+    """The live worker processes of a DataLoader (its active iterator)
+    or of a ``_PrefetchIterator`` directly. Deterministic handle for
+    the kill/suspend injections below."""
+    it = loader_or_iter
+    active = getattr(it, "_active_iter", None)
+    if callable(active):  # a DataLoader: reach through to the iterator
+        it = active()
+    if it is None:
+        raise RuntimeError("DataLoader has no active iterator")
+    workers = [w for w in getattr(it, "_workers", []) if w is not None]
+    if not workers:
+        raise RuntimeError("no worker processes (num_workers=0?)")
+    return workers
+
+
+def kill_worker(loader_or_iter, worker_id: int = 0,
+                sig: int = signal.SIGKILL) -> int:
+    """Deliver ``sig`` (default SIGKILL — a crash/OOM-kill) to one
+    DataLoader worker. The supervisor must respawn it and re-dispatch
+    its in-flight batches with no change to the batch stream. Returns
+    the killed pid."""
+    p = dataloader_workers(loader_or_iter)[worker_id]
+    os.kill(p.pid, sig)
+    return p.pid
+
+
+def suspend_worker(loader_or_iter, worker_id: int = 0) -> int:
+    """SIGSTOP a worker — the deterministic 'wedged worker' fault: the
+    process stays alive (liveness checks pass) but never produces, so
+    the per-fetch deadline must surface a WatchdogTimeout. Returns the
+    pid (pass to ``resume_worker`` for cleanup, or let the iterator's
+    teardown SIGKILL it)."""
+    p = dataloader_workers(loader_or_iter)[worker_id]
+    os.kill(p.pid, signal.SIGSTOP)
+    return p.pid
+
+
+def resume_worker(pid: int) -> None:
+    """SIGCONT a worker suspended by ``suspend_worker``."""
+    try:
+        os.kill(pid, signal.SIGCONT)
+    except ProcessLookupError:
+        pass  # teardown already reaped it
+
+
+class FlakySamples:
+    """Map-style dataset wrapper that fails on exact sample indices:
+    ``raise_at`` indices raise ValueError, ``nan_at`` indices return
+    the sample with every float leaf NaN-filled. Drives the
+    DataLoader's error-attribution and quarantine paths without
+    touching the wrapped dataset."""
+
+    def __init__(self, dataset, raise_at: Iterable[int] = (),
+                 nan_at: Iterable[int] = ()):
+        self.dataset = dataset
+        self.raise_at = frozenset(int(i) for i in raise_at)
+        self.nan_at = frozenset(int(i) for i in nan_at)
+
+    def __len__(self):
+        return len(self.dataset)
+
+    def __getitem__(self, idx):
+        if int(idx) in self.raise_at:
+            raise ValueError(f"FlakySamples: injected failure at "
+                             f"sample {int(idx)}")
+        sample = self.dataset[idx]
+        if int(idx) in self.nan_at:
+            return poison_batch(sample)
+        return sample
 
 
 class NaNLoss:

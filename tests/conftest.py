@@ -28,6 +28,31 @@ def pytest_configure(config):
         "each — tier-1)")
 
 
+def pytest_collection_modifyitems(config, items):
+    """Chaos-marker guard: any test in a module that imports the
+    fault-injection harness at module level MUST carry the ``chaos``
+    marker (so ``pytest -m chaos`` really runs the whole chaos tier and
+    ``-m 'not chaos'`` really excludes it). Fails collection otherwise."""
+    import types
+    unmarked = []
+    for item in items:
+        mod = getattr(item, "module", None)
+        if mod is None:
+            continue
+        uses_fi = any(
+            isinstance(v, types.ModuleType)
+            and getattr(v, "__name__", "")
+            == "paddle_tpu.utils.fault_injection"
+            for v in vars(mod).values())
+        if uses_fi and item.get_closest_marker("chaos") is None:
+            unmarked.append(item.nodeid)
+    if unmarked:
+        raise pytest.UsageError(
+            "tests built on paddle_tpu.utils.fault_injection must be "
+            "@pytest.mark.chaos (or mark the module: pytestmark = "
+            "pytest.mark.chaos):\n  " + "\n  ".join(sorted(unmarked)))
+
+
 @pytest.fixture(autouse=True)
 def _seed_rng():
     import paddle_tpu

@@ -1,0 +1,356 @@
+"""Chaos tests for the supervised data pipeline (io/dataloader.py),
+driven by the deterministic fault-injection harness: a dead worker is
+respawned with an identical batch stream, a wedged worker surfaces as
+WatchdogTimeout (stack dump included) instead of stalling, bad samples
+are quarantined and counted, and a preemption mid-epoch resumes from
+the per-step checkpoint replaying the exact remaining batches."""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import auto_checkpoint as ac
+from paddle_tpu.distributed import resilience
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.elastic import ELASTIC_EXIT_CODE
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import DataLoader, DataLoaderWorkerError
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.io.sampler import BatchSampler, RandomSampler
+from paddle_tpu.profiler import metrics
+from paddle_tpu.utils import fault_injection as fi
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Emergency savers are process-global; never leak between tests."""
+    yield
+    resilience._EMERGENCY.clear()
+    resilience._ACTIVE.clear()
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    was = metrics.is_enabled()
+    metrics.enable()
+    yield
+    if not was:
+        metrics.disable()
+
+
+def _counter(name):
+    snap = metrics.snapshot().get(name)
+    return int(snap["value"]) if snap else 0
+
+
+class ArangeDataset(Dataset):
+    """dataset[i] = (f(i) vector, i) — every batch's content names its
+    sample indices, so stream comparisons are bitwise-meaningful.
+    ``delay`` throttles each fetch so the prefetch pipeline is still in
+    flight when a test injects its fault (samples are tiny; without it
+    the whole epoch is produced before the fault lands)."""
+
+    def __init__(self, n, delay=0.0):
+        self.n = n
+        self.delay = delay
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if self.delay:
+            time.sleep(self.delay)
+        return (np.arange(4, dtype=np.float32) + 10.0 * i, np.int64(i))
+
+
+def _arrs(batch):
+    return np.asarray(batch[0].numpy())
+
+
+# -------------------------------------------- worker death -> respawn
+
+def test_worker_sigkill_respawns_and_stream_identical():
+    ds = ArangeDataset(40, delay=0.02)
+    ref = [_arrs(b) for b in DataLoader(ds, batch_size=4, shuffle=False,
+                                        num_workers=0)]
+    dl = DataLoader(ds, batch_size=4, shuffle=False, num_workers=2)
+    before = _counter("io.worker.respawns")
+    it = iter(dl)
+    got = [_arrs(next(it))]
+    time.sleep(0.2)  # let the pipeline fill so a batch is in flight
+    fi.kill_worker(dl, worker_id=0)
+    got += [_arrs(b) for b in it]
+    assert len(got) == len(ref)
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(a, b)
+    assert _counter("io.worker.respawns") > before
+    assert _counter("io.worker.deaths") >= 1
+
+
+def test_worker_death_past_respawn_budget_raises():
+    ds = ArangeDataset(400, delay=0.01)
+    dl = DataLoader(ds, batch_size=2, shuffle=False, num_workers=2,
+                    worker_respawn_limit=0)
+    it = iter(dl)
+    next(it)
+    fi.kill_worker(dl, worker_id=0)
+    with pytest.raises(DataLoaderWorkerError, match="respawn budget"):
+        list(it)
+    assert it._pool is None  # error path reaped the pool
+
+
+# ------------------------------------------------ wedged -> watchdog
+
+def test_wedged_worker_surfaces_watchdog_timeout(capfd):
+    ds = ArangeDataset(40, delay=0.02)
+    dl = DataLoader(ds, batch_size=4, shuffle=False, num_workers=2,
+                    timeout=1.0)
+    before = _counter("resilience.watchdog.timeouts{label=io.fetch}")
+    it = iter(dl)
+    next(it)
+    time.sleep(0.2)
+    pid = fi.suspend_worker(dl, worker_id=1)
+    t0 = time.monotonic()
+    with pytest.raises(resilience.WatchdogTimeout, match="wedged"):
+        list(it)
+    assert time.monotonic() - t0 < 10.0  # surfaced, not stalled
+    err = capfd.readouterr().err
+    assert "Watchdog 'io.fetch' expired" in err
+    assert "thread" in err  # the stack dump
+    assert _counter(
+        "resilience.watchdog.timeouts{label=io.fetch}") == before + 1
+    it.close()  # reaps the SIGSTOPped worker via SIGKILL
+    assert it._pool is None
+    fi.resume_worker(pid)  # no-op: already reaped
+
+
+# ------------------------------------------- bad sample -> quarantine
+
+@pytest.mark.parametrize("num_workers", [0, 2])
+def test_bad_samples_quarantined_with_metric(num_workers):
+    ds = fi.FlakySamples(ArangeDataset(16), raise_at={5}, nan_at={9})
+    before = _counter("io.sample.quarantined")
+    dl = DataLoader(ds, batch_size=4, shuffle=False,
+                    num_workers=num_workers, skip_bad_samples=True)
+    batches = list(dl)
+    total = sum(int(_arrs(b).shape[0]) for b in batches)
+    assert total == 14  # two samples dropped, batches stay in order
+    assert sorted(i for i, _ in dl.quarantined) == [5, 9]
+    reasons = dict(dl.quarantined)
+    assert "ValueError" in reasons[5]
+    assert "non-finite" in reasons[9]
+    assert _counter("io.sample.quarantined") == before + 2
+
+
+@pytest.mark.parametrize("num_workers", [0, 2])
+def test_bad_sample_error_attribution_without_quarantine(num_workers):
+    ds = fi.FlakySamples(ArangeDataset(16), raise_at={5})
+    dl = DataLoader(ds, batch_size=4, shuffle=False,
+                    num_workers=num_workers)
+    it = iter(dl)
+    with pytest.raises(DataLoaderWorkerError) as ei:
+        list(it)
+    assert ei.value.sample_index == 5
+    assert 5 in ei.value.batch_indices
+    assert "FlakySamples" in str(ei.value)  # worker traceback included
+    assert it._pool is None
+    it.close()  # idempotent on an already-closed iterator
+    it.close()
+
+
+# ---------------------------- acceptance e2e: kill + preempt + resume
+
+def test_kill_then_preempt_resume_replays_exact_batches(tmp_path):
+    """The ISSUE acceptance path: a worker is SIGKILLed at a fixed step
+    (respawn keeps the stream identical), the job is preempted (SIGTERM)
+    two steps later, the per-step emergency checkpoint carries the
+    loader state, and the relaunched job replays the exact remaining
+    batch sequence — bitwise equal, <=1 step lost — with
+    io.worker.respawns and io.sample.quarantined recorded."""
+    base = fi.FlakySamples(ArangeDataset(48, delay=0.01), nan_at={7})
+
+    def make_loader():
+        sampler = RandomSampler(base, generator=123)
+        bs = BatchSampler(base, sampler=sampler, batch_size=4)
+        return DataLoader(base, batch_sampler=bs, num_workers=2,
+                          skip_bad_samples=True, worker_respawn_limit=2)
+
+    # uninterrupted reference stream (same seed -> same permutation)
+    ref = [_arrs(b) for b in make_loader()]
+    assert len(ref) == 12
+
+    respawns0 = _counter("io.worker.respawns")
+    quarantined0 = _counter("io.sample.quarantined")
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    loader = make_loader()
+    step_box = {"step": -1}
+    mgr.save_on_preemption(
+        lambda: {"step": step_box["step"], "loader": loader.state_dict()})
+    kill = fi.KillAfter(6, signal.SIGTERM)  # SIGTERM lands on step 5
+    seen = []
+    with pytest.raises(SystemExit) as exc:
+        with resilience.GracefulShutdown():
+            for step, batch in enumerate(loader):
+                seen.append(_arrs(batch))
+                step_box["step"] = step
+                if step == 3:
+                    fi.kill_worker(loader, worker_id=0)
+                kill.step()
+                resilience.poll(step)  # step 5: emergency save + exit
+    assert exc.value.code == ELASTIC_EXIT_CODE
+    assert len(seen) == 6  # steps 0..5 completed
+    # the mid-stream worker kill changed nothing
+    for a, b in zip(seen, ref):
+        np.testing.assert_array_equal(a, b)
+    assert _counter("io.worker.respawns") > respawns0
+    assert _counter("io.sample.quarantined") > quarantined0
+
+    # ------------------------------------------------ "relaunch"
+    resilience._EMERGENCY.clear()
+    loader2 = make_loader()
+    state = mgr.restore()
+    assert int(np.asarray(getattr(state["step"], "data",
+                                  state["step"]))) == 5
+    loader2.load_state_dict(state["loader"])
+    remaining = [_arrs(b) for b in loader2]
+    # <=1 step lost: everything after the 6 consumed batches replays
+    assert len(remaining) == len(ref) - 6
+    for a, b in zip(remaining, ref[6:]):
+        np.testing.assert_array_equal(a, b)
+    mgr.close()
+
+
+# --------------------------- train_epoch_range mid-epoch loader resume
+
+def _env(tmp_path, monkeypatch, job, interval="1"):
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_JOB_ID", job)
+    monkeypatch.setenv("PADDLE_EDL_HDFS_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("PADDLE_EDL_SAVE_CHECKPOINT_INTER", interval)
+
+
+def test_train_epoch_range_midepoch_step_resume(tmp_path, monkeypatch):
+    """Preempt INSIDE an epoch (per-step resilience.poll): the emergency
+    checkpoint carries the loader cursor, and the relaunched range
+    re-enters the interrupted epoch with only its remaining batches —
+    interrupted-run + resumed-run batches == the uninterrupted stream."""
+    _env(tmp_path, monkeypatch, "chaos-io-mid")
+    ds = ArangeDataset(40)  # 10 batches/epoch
+
+    def make_loader():
+        sampler = RandomSampler(ds, generator=7)
+        bs = BatchSampler(ds, sampler=sampler, batch_size=4)
+        return DataLoader(ds, batch_sampler=bs, num_workers=0)
+
+    ref = []
+    ref_loader = make_loader()
+    for _ in range(3):
+        ref += [_arrs(b) for b in ref_loader]
+
+    loader = make_loader()
+    status = ac.ExeTrainStatus()
+    kill = fi.KillAfter(6, signal.SIGTERM)  # fires at epoch 0, step 5
+    consumed = []
+    with pytest.raises(SystemExit) as exc:
+        for epoch in ac.train_epoch_range(3, status=status, loader=loader):
+            for step, batch in enumerate(loader):
+                consumed.append(_arrs(batch))
+                kill.step()
+                resilience.poll(step)  # per-STEP preemption boundary
+    assert exc.value.code == ELASTIC_EXIT_CODE
+    assert len(consumed) == 6
+
+    # relaunch: fresh loader + status, same env
+    resilience._EMERGENCY.clear()
+    loader2 = make_loader()
+    status2 = ac.ExeTrainStatus()
+    epochs2 = []
+    for epoch in ac.train_epoch_range(3, status=status2, loader=loader2):
+        epochs2.append(epoch)
+        for batch in loader2:
+            consumed.append(_arrs(batch))
+    assert epochs2 == [0, 1, 2]  # re-entered the interrupted epoch
+    assert len(consumed) == len(ref)
+    for a, b in zip(consumed, ref):
+        np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------- hapi fit mid-epoch resume
+
+def test_fit_preemption_resumes_mid_epoch(tmp_path):
+    """Model.fit preempted mid-epoch writes emergency.pdstate (epoch,
+    step, loader cursor); fit(resume=True) re-enters the interrupted
+    epoch and trains only its remaining batches."""
+    class XYDataset(Dataset):
+        def __init__(self, n=32):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(n, 4).astype(np.float32)
+            self.y = rng.randint(0, 2, (n,)).astype(np.int64)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def make(seed):
+        paddle.seed(seed)
+        net = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        m = Model(net)
+        m.prepare(optimizer.SGD(learning_rate=0.01,
+                                parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        return m
+
+    def make_loader():
+        ds = XYDataset()
+        bs = BatchSampler(ds, sampler=RandomSampler(ds, generator=11),
+                          batch_size=4)
+        return DataLoader(ds, batch_sampler=bs)  # 8 batches/epoch
+
+    from paddle_tpu.hapi.callbacks import Callback
+
+    kill = fi.KillAfter(4, signal.SIGTERM)  # fires on batch index 3
+
+    class Chaos(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            kill.step()
+
+    save_dir = str(tmp_path / "ckpts")
+    m = make(0)
+    with pytest.raises(SystemExit) as exc:
+        with resilience.GracefulShutdown():
+            m.fit(train_data=make_loader(), epochs=2, save_dir=save_dir,
+                  verbose=0, callbacks=[Chaos()])
+    assert exc.value.code == ELASTIC_EXIT_CODE
+    from paddle_tpu import framework_io
+    state = framework_io.load(os.path.join(save_dir,
+                                           "emergency.pdstate"))
+    assert state["epoch"] == 0 and state["step"] == 4
+    assert state["loader"]["cursor"] == 4
+
+    # relaunch: fresh model + loader; resume=True picks up the state
+    resilience._EMERGENCY.clear()
+    m2 = make(1)
+
+    class CountSteps(Callback):
+        per_epoch = {}
+
+        def on_epoch_begin(self, epoch, logs=None):
+            self._epoch = epoch
+            self.per_epoch[epoch] = 0
+
+        def on_train_batch_end(self, step, logs=None):
+            self.per_epoch[self._epoch] += 1
+
+    m2.fit(train_data=make_loader(), epochs=2, save_dir=save_dir,
+           verbose=0, callbacks=[CountSteps()], resume=True)
+    # epoch 0 replays only its 4 remaining batches; epoch 1 runs all 8
+    assert CountSteps.per_epoch == {0: 4, 1: 8}

@@ -519,6 +519,16 @@ MANUAL_SPECS = {
     "bn_apply": ([rng.randn(2, 4, 4, 16).astype(np.float32),
                   (np.abs(rng.randn(16)) + 0.5).astype(np.float32),
                   (rng.randn(16) * 0.1).astype(np.float32)], {}),
+    "bn_center_apply_relu_add": (
+        [rng.randn(2, 4, 4, 16).astype(np.float32),
+         rng.randn(16).astype(np.float32),
+         (np.abs(rng.randn(16)) + 0.5).astype(np.float32),
+         (rng.randn(16) * 0.1).astype(np.float32),
+         rng.randn(2, 4, 4, 16).astype(np.float32)], {}),
+    "bn_center_apply": ([rng.randn(2, 4, 4, 16).astype(np.float32),
+                         rng.randn(16).astype(np.float32),
+                         (np.abs(rng.randn(16)) + 0.5).astype(np.float32),
+                         (rng.randn(16) * 0.1).astype(np.float32)], {}),
     "bn_moments": ([rng.randn(2, 4, 4, 16).astype(np.float32)], {}),
     "bn_fold": ([(np.abs(rng.randn(8)) + 0.5).astype(np.float32),
                  rng.randn(8).astype(np.float32),
