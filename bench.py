@@ -58,17 +58,36 @@ def _setup():
     return dev, dev.platform == "tpu"
 
 
-def _time_steps(step, x, y, iters):
+def _time_steps(step, x, y, iters, profile_dir=None):
     # warmup (compile). Sync via host transfer of the loss: on the axon
     # remote tunnel block_until_ready can acknowledge before execution
     # completes, and donated param buffers alias inputs — float() is the
     # only reliable fence.
     loss = step(x, y)
     float(loss)
+    prof = None
+    if profile_dir:
+        # BENCH_PROFILE=1: drop ONE Perfetto trace of a few mid-run
+        # steps so host/device overlap is visually auditable (host spans
+        # + metric counter tracks; open in ui.perfetto.dev). The
+        # recording window adds host overhead — the tokens/sec printed
+        # from a profiled run is NOT a benchmark number.
+        from paddle_tpu import profiler as _profiler
+        prof = _profiler.Profiler(
+            scheduler=(1, min(1 + 4, iters)),
+            on_trace_ready=_profiler.export_chrome_tracing(
+                profile_dir, "bench"))
+        prof.start()
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(x, y)
+        if prof is not None:
+            prof.step()
     final = float(loss)
+    if prof is not None:
+        prof.stop()
+        print(f"BENCH_PROFILE: Perfetto trace in {profile_dir}/",
+              file=sys.stderr)
     return time.perf_counter() - t0, final
 
 
@@ -123,7 +142,9 @@ def bench_gpt2(dev, on_tpu):
     y = paddle.to_tensor(ids.astype(np.int64))
 
     iters = 20 if on_tpu else 3
-    dt, loss = _time_steps(step, x, y, iters)
+    profile_dir = "bench_trace" \
+        if os.environ.get("BENCH_PROFILE", "") == "1" else None
+    dt, loss = _time_steps(step, x, y, iters, profile_dir=profile_dir)
 
     tokens_per_sec = batch * seq * iters / dt
     mfu = tokens_per_sec * model.flops_per_token(seq) / peak_flops(dev)

@@ -21,6 +21,11 @@ Metric name scheme (what the summary views group by):
     io.batches / io.samples / io.bytes    dataloader throughput
     io.worker.deaths / io.worker.respawns{worker=...}   pool supervision
     io.sample.quarantined       bad/non-finite samples skipped
+    io.host2device.placed / .skipped / .bytes   device placements (a
+                                skip = the leaf already sat on the
+                                target sharding, placement idempotent)
+    train.loss_fetches          loss scalars read back by the async loop
+    train.host_syncs            the subset that BLOCKED (device not done)
     amp.scaler.steps / amp.scaler.skipped / amp.loss_scale
     device.memory.allocated / device.memory.reserved   gauges (bytes)
     resilience.preemptions / resilience.emergency_saves
@@ -124,6 +129,20 @@ def record_sample_quarantined(n: int = 1):
     metrics.counter("io.sample.quarantined").inc(int(n))
 
 
+def record_host2device(placed: int, skipped: int = 0, nbytes: int = 0):
+    """Host->device batch placements: ``placed`` leaves transferred,
+    ``skipped`` leaves already resident on their target sharding (the
+    idempotent-placement fast path)."""
+    if not enabled:
+        return
+    if placed:
+        metrics.counter("io.host2device.placed").inc(int(placed))
+    if skipped:
+        metrics.counter("io.host2device.skipped").inc(int(skipped))
+    if nbytes:
+        metrics.counter("io.host2device.bytes").inc(int(nbytes))
+
+
 # ------------------------------------------------------------- amp layer
 
 def record_scaler_step(skipped: bool, scale: float):
@@ -163,6 +182,18 @@ def record_ckpt_fallback(step):
         return
     metrics.counter("resilience.ckpt.fallback").inc()
     metrics.gauge("resilience.ckpt.last_skipped_step").set(float(step))
+
+
+def record_loss_fetch(blocking: bool):
+    """One loss scalar read back by the async train loop; ``blocking``
+    means the device had not finished the step when the host asked (a
+    true pipeline stall, counted in ``train.host_syncs`` — the number
+    the host-sync regression gate bounds)."""
+    if not enabled:
+        return
+    metrics.counter("train.loss_fetches").inc()
+    if blocking:
+        metrics.counter("train.host_syncs").inc()
 
 
 def record_anomaly():

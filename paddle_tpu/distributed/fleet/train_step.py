@@ -69,7 +69,8 @@ class DistributedTrainStep:
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  mesh: Optional[Mesh] = None, donate: bool = True,
-                 accumulate_steps: int = 1, abstract: bool = False):
+                 accumulate_steps: int = 1, abstract: bool = False,
+                 recompute=None):
         """abstract=True skips placing parameters on the mesh (and
         lower_abstract() skips optimizer/batch buffers too): the step
         can then only be LOWERED, not executed — compile-planning a
@@ -85,6 +86,14 @@ class DistributedTrainStep:
             optimizer, "_sharding_strategy", ShardingStrategy(stage=0))
         self.accumulate_steps = accumulate_steps
         self.abstract = abstract
+        # recompute: fleet.utils.RecomputeConfig (or policy name) —
+        # wraps the whole per-microbatch forward in jax.checkpoint so
+        # long-context configs trade backward FLOPs for activation HBM
+        # (and with it, batch size) without editing the model
+        if recompute is not None:
+            from .utils.recompute import _as_config
+            recompute = _as_config(recompute)
+        self._recompute = recompute
 
         if not abstract:
             shard_model(model, self.mesh, self.strategy)
@@ -119,6 +128,9 @@ class DistributedTrainStep:
                                                   else b for b in batch[:-1]])
             loss = loss_fn(out, jax.tree_util.tree_map(_wrap, batch[-1]))
             return _unwrap(loss)
+
+        if self._recompute is not None and self._recompute.enabled:
+            loss_of = self._recompute.wrap(loss_of)
 
         def grads_of(pvals, *batch):
             loss, grads = jax.value_and_grad(loss_of)(list(pvals), *batch)
@@ -160,9 +172,32 @@ class DistributedTrainStep:
                            self._param_shardings, None))
 
     # ------------------------------------------------------------------ call
+    def batch_sharding_for(self, leaf) -> NamedSharding:
+        """Target input sharding for one batch leaf (rank-determined:
+        the leading data dim shards over the dp+sharding axes). This is
+        the contract the sharded device prefetcher
+        (``io.device_prefetch.prefetch_to_device(loader, step)``)
+        places against, so batches arrive committed on exactly the
+        shardings ``_shard_batch`` would apply — which then skips."""
+        nd = getattr(leaf, "ndim", None)
+        if nd is None:
+            nd = np.ndim(leaf)
+        return NamedSharding(self.mesh, self._batch_leaf_spec(int(nd)))
+
+    @property
+    def batch_shardings(self):
+        """Callable ``leaf -> NamedSharding`` (alias of
+        batch_sharding_for) for prefetchers/loaders."""
+        return self.batch_sharding_for
+
     def _shard_batch(self, arr):
-        return jax.device_put(
-            arr, NamedSharding(self.mesh, self._batch_leaf_spec(arr.ndim)))
+        # the ONE idempotent-placement implementation (skip test +
+        # io.host2device counting) lives in io.device_prefetch; lazy
+        # import keeps fleet importable without the io package loaded
+        from ...io.device_prefetch import place_batch
+        sh = NamedSharding(self.mesh, self._batch_leaf_spec(arr.ndim))
+        out = place_batch(arr, sh)
+        return out._data if isinstance(out, Tensor) else out
 
     def _ensure_opt_state(self):
         """Seed (or re-load from a restored optimizer) the sharded

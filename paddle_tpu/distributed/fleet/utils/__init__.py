@@ -1,5 +1,7 @@
 """Fleet utilities (reference: python/paddle/distributed/fleet/utils/
-— the FS client family used by checkpoint/elastic paths)."""
+— the FS client family used by checkpoint/elastic paths, plus the
+``recompute`` activation-checkpointing entry)."""
 from .fs import FS, LocalFS, HDFSClient  # noqa: F401
+from .recompute import RecomputeConfig, recompute  # noqa: F401
 
-__all__ = ["FS", "LocalFS", "HDFSClient"]
+__all__ = ["FS", "LocalFS", "HDFSClient", "RecomputeConfig", "recompute"]

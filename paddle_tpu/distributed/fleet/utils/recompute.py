@@ -1,0 +1,120 @@
+"""Paddle-parity activation recompute: ``fleet.utils.recompute``.
+
+Reference analog: python/paddle/distributed/fleet/utils re-exports
+``recompute`` (fleet/recompute/recompute.py:386 — a PyLayer re-running
+the forward with RNG state restore). TPU-native the whole mechanism is
+``jax.checkpoint``: XLA re-emits the forward inside the backward pass,
+RNG is functional so nothing needs restoring, and the *policy* decides
+which intermediates are worth keeping.
+
+``RecomputeConfig`` names the policies with their jax names so a config
+file can dial the memory/FLOPs trade per run:
+
+    ============================  =========================================
+    policy                        saves
+    ============================  =========================================
+    ``None``                      everything (recompute OFF)
+    ``"full"``                    nothing — max HBM relief, ~1.3x trunk
+                                  FLOPs (alias ``"nothing_saveable"``,
+                                  the literal jax name)
+    ``"dots_saveable"``           matmul/einsum outputs — cheap backward,
+                                  moderate memory (the reference's
+                                  ``core_attn`` granularity)
+    ``"dots_with_no_batch_dims_saveable"``  matmuls without batch dims —
+                                  the default "selective" granularity
+    ============================  =========================================
+
+Long-context configs trade recompute for batch size: at s4096+ the
+activations dominate HBM, and ``RecomputeConfig("full")`` buys back
+enough to double the per-chip batch (see BASELINE.md sweeps).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+
+from ...parallel.recompute import recompute as _parallel_recompute
+
+#: policy name -> jax.checkpoint policy (None = save nothing)
+_JAX_POLICIES = {
+    "full": None,
+    "nothing_saveable": None,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims_saveable":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    # reference-granularity aliases (models/gpt.py vocabulary)
+    "selective": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "core_attn": jax.checkpoint_policies.dots_saveable,
+}
+
+
+@dataclass(frozen=True)
+class RecomputeConfig:
+    """Declarative remat knob carried by train steps and model configs.
+
+    ``policy=None`` disables recompute entirely (``wrap`` is the
+    identity); any named policy wraps a function in ``jax.checkpoint``
+    with the corresponding saveable-intermediates rule.
+    """
+
+    #: a name from _JAX_POLICIES, a raw ``jax.checkpoint_policies``
+    #: callable, or None (recompute OFF)
+    policy: Optional[object] = "full"
+
+    def __post_init__(self):
+        if self.policy is not None and not callable(self.policy) \
+                and self.policy not in _JAX_POLICIES:
+            raise ValueError(
+                f"unknown recompute policy {self.policy!r}; one of "
+                f"{sorted(set(_JAX_POLICIES))}, a jax.checkpoint_policies "
+                f"callable, or None")
+
+    @property
+    def enabled(self) -> bool:
+        return self.policy is not None
+
+    def jax_policy(self):
+        """The jax.checkpoint ``policy=`` value (None = save nothing)."""
+        if callable(self.policy):
+            return self.policy
+        return _JAX_POLICIES.get(self.policy)
+
+    def wrap(self, fn: Callable) -> Callable:
+        """``jax.checkpoint(fn, policy=...)`` under this config; ``fn``
+        unchanged when disabled."""
+        if not self.enabled:
+            return fn
+        return jax.checkpoint(fn, policy=self.jax_policy())
+
+
+def _as_config(policy) -> Optional[RecomputeConfig]:
+    if policy is None or isinstance(policy, RecomputeConfig):
+        return policy
+    return RecomputeConfig(policy=policy)
+
+
+def recompute(function: Callable, *args, **kwargs):
+    """≈ ``paddle.distributed.fleet.utils.recompute(function, *args)``:
+    run ``function`` now, recompute its intermediates in backward.
+
+    Accepts the reference's ``use_reentrant``/``preserve_rng_state``
+    kwargs (both meaningless under jax — remat re-traces, RNG is
+    functional) and a ``policy=`` extension: a name from
+    :class:`RecomputeConfig` or a raw ``jax.checkpoint_policies``
+    callable. Layers become functional remat regions (their parameters
+    turn into explicit tape inputs), plain callables are wrapped
+    directly."""
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    # policy=None here means "full" (calling recompute() at all asks
+    # for remat — Paddle's recompute has no policy knob, it always
+    # recomputes everything); pass RecomputeConfig(None) to run the
+    # function plainly with recompute OFF.
+    policy = kwargs.pop("policy", "full")
+    cfg = _as_config("full" if policy is None else policy)
+    if not cfg.enabled:
+        return function(*args, **kwargs)
+    return _parallel_recompute(function, *args, policy=cfg.jax_policy(),
+                               **kwargs)

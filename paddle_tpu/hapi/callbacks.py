@@ -409,6 +409,7 @@ class MetricsCallback(Callback):
         self._steps = 0
         self._samples0 = self._counter("io.samples")
         self._retraces0 = self._counter("jit.compile.total")
+        self._syncs0 = self._counter("train.host_syncs")
         try:
             device.reset_peak_memory_stats()
             # per-batch polling advances the tracked high-water, but
@@ -434,6 +435,10 @@ class MetricsCallback(Callback):
             "steps_per_sec": self._steps / dt,
             "retraces": self._counter("jit.compile.total")
             - self._retraces0,
+            # blocking loss read-backs this interval — the async loop's
+            # contract is ≤1 (the epoch-end drain barrier)
+            "host_syncs": self._counter("train.host_syncs")
+            - self._syncs0,
         }
         samples = self._counter("io.samples") - self._samples0
         if samples:

@@ -7,13 +7,16 @@ TrainStep, eval/predict through a jitted forward).
 """
 from __future__ import annotations
 
+import collections
 import os
 import pickle
 from typing import Callable, List, Optional
 
+import jax
 import numpy as np
 
 from .. import framework_io
+from ..core import monitor
 from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
 from ..io.dataset import Dataset
@@ -34,6 +37,93 @@ def _as_list(x):
     if x is None:
         return []
     return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class AsyncScalarFetcher:
+    """Bounded lag window between device-side scalar production and
+    host-side consumption — the non-blocking train loop's core.
+
+    ``float(loss)`` after every step drains the device dispatch queue:
+    the host stalls until step N finishes before it can even *launch*
+    step N+1, so H2D transfer, host-side batching and device compute
+    never overlap. Instead ``push(step, loss)`` enqueues the on-device
+    scalar and returns the values that have matured out of a ``lag``-
+    step window (default 2, ``PADDLE_ASYNC_STEPS``; 0 restores fully
+    synchronous reads). By the time a value is popped the device has
+    had ``lag`` steps of runway, so the transfer is almost always a
+    ready-buffer copy, not a stall — ``train.loss_fetches`` counts
+    every read-back and ``train.host_syncs`` counts the subset that
+    actually blocked, which the host-sync regression gate bounds.
+
+    ``drain()`` flushes the window in order (epoch end: no value is
+    dropped or reordered, it is only observed up to ``lag`` steps
+    late); ``sync()`` blocks until every in-flight value is computed
+    WITHOUT consuming it (the emergency-save barrier: a checkpoint
+    taken after ``sync()`` reflects fully-executed steps, never a
+    half-dispatched one)."""
+
+    def __init__(self, lag: Optional[int] = None, record: bool = True):
+        if lag is None:
+            env = os.environ.get("PADDLE_ASYNC_STEPS", "").strip()
+            try:
+                lag = int(env) if env else 2
+            except ValueError:
+                lag = 2
+        self.lag = max(0, int(lag))
+        # record=False: don't touch the train.loss_fetches/host_syncs
+        # counters — those name the TRAIN loop's pipeline contract; the
+        # eval loop reuses the window mechanics but must not pollute
+        # the gated metric
+        self.record = bool(record)
+        self._window: collections.deque = collections.deque()
+
+    def __len__(self):
+        return len(self._window)
+
+    @staticmethod
+    def _ready(value) -> bool:
+        arr = getattr(value, "_data", value)
+        try:
+            return bool(arr.is_ready())
+        except AttributeError:
+            return True  # plain host scalar: nothing to wait for
+
+    def push(self, step: int, value):
+        """Enqueue step's on-device scalar; return the [(step, float)]
+        that matured out of the lag window (possibly empty)."""
+        self._window.append((step, value))
+        out = []
+        while len(self._window) > self.lag:
+            s, v = self._window.popleft()
+            if self.record and monitor.enabled:
+                monitor.record_loss_fetch(not self._ready(v))
+            out.append((s, float(v)))
+        return out
+
+    def drain(self):
+        """Flush the whole window in push order. One drain is ONE sync
+        barrier: at most one blocking read-back is charged to
+        ``train.host_syncs`` however many values are pending."""
+        out = []
+        blocked = False
+        while self._window:
+            s, v = self._window.popleft()
+            if self.record and monitor.enabled:
+                b = not self._ready(v)
+                monitor.record_loss_fetch(b and not blocked)
+                blocked = blocked or b
+            out.append((s, float(v)))
+        return out
+
+    def sync(self):
+        """Block until every pending value is computed, without
+        consuming any — the device has caught up with the host."""
+        for _, v in self._window:
+            arr = getattr(v, "_data", v)
+            try:
+                arr.block_until_ready()
+            except AttributeError:
+                pass
 
 
 class Model:
@@ -65,25 +155,92 @@ class Model:
             self._train_step = TrainStep(self.network, optimizer,
                                          self._loss)
         self._eval_fn = to_static(self.network)
+        self._eval_step_jit = None  # lazily-built jitted (out, loss) step
+        self._eval_loss_eager = False  # loss not jax-traceable: eager path
         return self
 
     # ------------------------------------------------------- batch methods
     def train_batch(self, inputs, labels):
+        """Run one fused train step and return the ON-DEVICE loss (a
+        scalar Tensor). The call does not wait for the step to finish —
+        ``float(loss)`` forces the host transfer when the value is
+        actually needed. fit() reads losses through a lagged
+        AsyncScalarFetcher so the device queue stays full."""
         if self._train_step is None:
             raise RuntimeError("call prepare(optimizer, loss) first")
         self.network.train()
         inputs = [_to_tensor(x) for x in _as_list(inputs)]
         labels = [_to_tensor(x) for x in _as_list(labels)]
-        loss = self._train_step(*inputs, *labels)
-        return float(loss)
+        return self._train_step(*inputs, *labels)
+
+    def _build_eval_step(self):
+        """Jit ONE program computing (outputs, loss): the loss no longer
+        runs eagerly outside the compiled eval fn, and the returned loss
+        is an on-device scalar read back asynchronously (same contract
+        as train_batch). Parameters are passed as operands re-read every
+        call, so optimizer updates between evals are seen without a
+        retrace."""
+        import jax
+        from ..jit.api import _RetraceTracker, _unwrap, _wrap, \
+            functional_call
+        net, loss_fn = self.network, self._loss
+
+        @jax.jit
+        def jitted(state_vals, arg_vals, label_val):
+            names = jitted._state_names
+            out = functional_call(net, dict(zip(names, state_vals)),
+                                  *arg_vals)
+            loss = loss_fn(out, jax.tree_util.tree_map(_wrap, label_val))
+            unw = jax.tree_util.tree_map(
+                _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+            return unw, _unwrap(loss)
+
+        # state walked ONCE here, not per eval batch (the TrainStep
+        # _params_cache fix, applied to eval): Tensor objects are
+        # mutated in place by optimizer/set_state_dict, so re-reading
+        # ._data each call sees fresh values without a re-walk
+        state = net.state_dict()
+        jitted._state_names = list(state.keys())
+        self._eval_state_cache = list(state.values())
+        self._eval_step_jit = jitted
+        self._eval_tracker = _RetraceTracker()
+
+    def _eval_batch_eager(self, inputs, labels):
+        """Pre-pipeline eval path: compiled forward, loss computed
+        eagerly on its outputs — the fallback for user losses that are
+        not jax-traceable (host-side ``.numpy()``/``float()``)."""
+        out = self._eval_fn(*inputs)
+        return out, self._loss(out, labels[0])
 
     def eval_batch(self, inputs, labels):
         self.network.eval()
         inputs = [_to_tensor(x) for x in _as_list(inputs)]
         labels = [_to_tensor(x) for x in _as_list(labels)]
-        out = self._eval_fn(*inputs)
-        loss = self._loss(out, labels[0]) if self._loss else None
-        return out, (float(loss) if loss is not None else None)
+        if self._loss is None:
+            return self._eval_fn(*inputs), None
+        if getattr(self, "_eval_loss_eager", False):
+            return self._eval_batch_eager(inputs, labels)
+        if getattr(self, "_eval_step_jit", None) is None:
+            self._build_eval_step()
+        from ..jit.api import _wrap
+        jitted = self._eval_step_jit
+        state_vals = tuple(t._data for t in self._eval_state_cache)
+        arg_vals = tuple(t._data for t in inputs)
+        label_val = labels[0]._data
+        pre = self._eval_tracker.pre(jitted)
+        try:
+            out, loss = jitted(state_vals, arg_vals, label_val)
+        except (jax.errors.JAXTypeError, TypeError):
+            # the user's loss callable does host-side work on tracers
+            # (eval-only Models could always do that: the loss used to
+            # run eagerly outside the compiled fn) — permanently fall
+            # back to the eager path for this Model
+            self._eval_loss_eager = True
+            self._eval_step_jit = None
+            return self._eval_batch_eager(inputs, labels)
+        self._eval_tracker.observe(jitted, (state_vals, arg_vals,
+                                            label_val), pre)
+        return jax.tree_util.tree_map(_wrap, out), Tensor(loss)
 
     def predict_batch(self, inputs):
         self.network.eval()
@@ -106,6 +263,16 @@ class Model:
             anomaly_guard=None, resume=None):
         """≈ hapi model.py:1149 — epochs over train_data with optional
         periodic eval, checkpointing, logging, early stopping.
+
+        The loop is NON-BLOCKING: train_batch returns the on-device
+        loss and a bounded AsyncScalarFetcher reads values back with a
+        lag of ``PADDLE_ASYNC_STEPS`` steps (default 2, 0 = fully
+        synchronous), so the host keeps the device dispatch queue full
+        instead of stalling on ``float(loss)`` every step. Callbacks
+        and the anomaly guard observe each loss up to that many steps
+        after its batch was launched; the window drains at epoch end
+        (and before any emergency save), so no loss is ever dropped or
+        reordered.
 
         ``anomaly_guard``: resilience.AnomalyGuard instance, True for a
         default one, or None (also enabled by PADDLE_ANOMALY_GUARD=1) —
@@ -172,14 +339,35 @@ class Model:
             raise
         return self
 
+    def _consume_loss(self, step, loss, guard, cbs, losses):
+        """Host-side handling of ONE matured loss value (float): the
+        anomaly guard and the batch-end callbacks observe losses here,
+        ``lag`` steps after the step that produced them was launched."""
+        if guard is not None and not guard.observe(loss):
+            # anomaly: loss not recorded, params were kept
+            # unchanged in-jit (skip_nonfinite TrainStep)
+            cbs.on_train_batch_end(step, {"loss": loss,
+                                          "skipped_batch": True})
+        else:
+            losses.append(loss)
+            cbs.on_train_batch_end(step, {"loss": loss})
+
     def _fit_loop(self, loader, eval_loader, epochs, eval_freq, cbs,
                   guard, resilience, start_epoch=0):
         stop = False
         global_step = 0
+        # the lagged loss window: train_batch returns the on-device
+        # scalar, the fetcher reads it back K steps later so the host
+        # never drains the device dispatch queue mid-epoch
+        fetcher = AsyncScalarFetcher()
         # live progress the emergency saver (ModelCheckpoint) snapshots:
         # epoch, step, and the loader whose state_dict pins the batch
-        # cursor — together the exact mid-epoch resume point
-        progress = {"epoch": start_epoch, "step": 0, "loader": loader}
+        # cursor — together the exact mid-epoch resume point. The
+        # fetcher rides along so _train_state can sync the in-flight
+        # window before an emergency save (the saved step is always a
+        # fully-executed one).
+        progress = {"epoch": start_epoch, "step": 0, "loader": loader,
+                    "fetcher": fetcher}
         self._fit_progress = progress
         for epoch in range(start_epoch, epochs):
             progress["epoch"] = epoch
@@ -191,20 +379,21 @@ class Model:
                 loss = self.train_batch(inputs, labels)
                 global_step += 1
                 progress["step"] = global_step
-                if guard is not None and not guard.observe(loss):
-                    # anomaly: loss not recorded, params were kept
-                    # unchanged in-jit (skip_nonfinite TrainStep)
-                    cbs.on_train_batch_end(step, {"loss": loss,
-                                                  "skipped_batch": True})
-                else:
-                    losses.append(loss)
-                    cbs.on_train_batch_end(step, {"loss": loss})
+                for s, val in fetcher.push(step, loss):
+                    self._consume_loss(s, val, guard, cbs, losses)
                 # preemption lands here: emergency save + exit(101)
                 resilience.poll(global_step)
                 if any(getattr(cb, "stopped", False)
                        for cb in cbs.callbacks):
                     stop = True  # e.g. TerminateOnNaN
                     break
+            # epoch end drains the lag window: every loss is observed,
+            # in order, before epoch logs / checkpoints / eval run
+            for s, val in fetcher.drain():
+                self._consume_loss(s, val, guard, cbs, losses)
+            if not stop and any(getattr(cb, "stopped", False)
+                                for cb in cbs.callbacks):
+                stop = True  # a drained tail loss tripped a callback
             if stop:
                 # a mid-epoch stop (NaN loss) skips the epoch tail:
                 # no checkpoint of poisoned weights, no wasted eval
@@ -227,18 +416,31 @@ class Model:
         for m in self._metrics:
             m.reset()
         losses = []
+        # same lag-window contract as the train loop: eval_batch
+        # returns the on-device scalar, callbacks observe each loss as
+        # a FLOAT up to K steps late, and the window drains (one
+        # barrier) at eval end — never a per-batch blocking read-back.
+        # record=False: train.loss_fetches/host_syncs stay a pure
+        # train-loop contract
+        fetcher = AsyncScalarFetcher(record=False)
         for step, batch in enumerate(loader):
             cbs.on_eval_batch_begin(step)
             inputs, labels = self._split_batch(batch)
             out, loss = self.eval_batch(inputs, labels)
-            if loss is not None:
-                losses.append(loss)
             for m in self._metrics:
                 if hasattr(m, "compute"):
                     m.update(m.compute(out, _as_list(labels)[0]))
                 else:
                     m.update(out, _as_list(labels)[0])
-            cbs.on_eval_batch_end(step, {"loss": loss})
+            if loss is None:
+                cbs.on_eval_batch_end(step, {"loss": None})
+                continue
+            for s, val in fetcher.push(step, loss):
+                losses.append(val)
+                cbs.on_eval_batch_end(s, {"loss": val})
+        for s, val in fetcher.drain():
+            losses.append(val)
+            cbs.on_eval_batch_end(s, {"loss": val})
         logs = {}
         if losses:
             logs["loss"] = float(np.mean(losses))
@@ -333,6 +535,13 @@ class Model:
         p = self._fit_progress
         if p is None:
             return None
+        fetcher = p.get("fetcher")
+        if fetcher is not None:
+            # barrier: every launched step has finished on device, so
+            # the saved (epoch, step, loader cursor) names a fully-
+            # executed step — an emergency save never checkpoints
+            # params mid-dispatch or a stale loss window
+            fetcher.sync()
         st = {"epoch": int(p["epoch"]), "step": int(p["step"])}
         ld = p.get("loader")
         if ld is not None and hasattr(ld, "state_dict"):

@@ -1,7 +1,20 @@
 """paddle.incubate.distributed.models.moe analog (reference:
 python/paddle/incubate/distributed/models/moe/). The modern MoE layer
 lives in paddle_tpu.distributed.parallel.moe and is re-exported here
-under the reference's import path."""
+under the reference's import path.
+
+NOTE — constructor signature differs from the reference. The reference
+``MoELayer(d_model, experts: LayerList, gate: dict | Gate,
+moe_group=..., mp_group=..., recompute_interval=...)`` wraps
+user-built expert Layers; here ``MoELayer`` is :class:`MoEMLP`, which
+OWNS its stacked expert weights and takes ``(d_model, d_hidden,
+num_experts, gate: str, top_k=, capacity_factor=)`` — process groups
+are implicit in the 'ep' mesh axis and recompute is a train-step
+concern (``fleet.utils.RecomputeConfig``). Migrating call sites must
+switch construction to the MoEMLP form; only the *forward* contract
+(tokens in, combined expert outputs + ``l_aux`` set per call) is
+drop-in.
+"""
 from paddle_tpu.distributed.parallel.moe import (  # noqa: F401
     MoEMLP as MoELayer)
 from .grad_clip import (ClipGradForMOEByGlobalNorm,  # noqa: F401

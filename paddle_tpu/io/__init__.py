@@ -1,5 +1,7 @@
 from .dataloader import (DataLoader, DataLoaderWorkerError,  # noqa: F401
                          WorkerInfo, get_worker_info)
+from .device_prefetch import (DevicePrefetcher, place_batch,  # noqa: F401
+                              prefetch_to_device)
 from .token_loader import TokenLoader  # noqa: F401
 from .dataset import (ChainDataset, ComposeDataset, Dataset,  # noqa: F401
                       IterableDataset, Subset, TensorDataset, random_split)

@@ -539,17 +539,27 @@ class _PrefetchIterator:
     # ------------------------------------------------------------- consume
     def _to_device(self, batch):
         # async host->device: device_put returns immediately, transfer
-        # overlaps with compute on the prior batch
-        def put(x):
+        # overlaps with compute on the prior batch. With batch
+        # shardings installed (DataLoader.set_batch_shardings, usually
+        # the train step's batch_sharding_for) each leaf is placed
+        # COMMITTED on its target sharding, so the consumer's own
+        # _shard_batch re-placement becomes a counted no-op; leaves
+        # already resident on their target are never re-placed
+        # (idempotent — io.host2device.{placed,skipped,bytes}).
+        from .device_prefetch import place_batch
+        loader = self._loader
+
+        def convert(x):
             if isinstance(x, np.ndarray):
                 if x.dtype == np.float64:
                     x = x.astype(np.float32)
-                if x.dtype == np.int64 and self._loader.keep_int64 is False:
+                if x.dtype == np.int64 and loader.keep_int64 is False:
                     x = x.astype(np.int32)
-                return Tensor(jax.device_put(x))
             return x
 
-        return jax.tree_util.tree_map(put, batch)
+        batch = jax.tree_util.tree_map(
+            convert, batch, is_leaf=lambda t: isinstance(t, Tensor))
+        return place_batch(batch, loader._batch_shardings)
 
     def __next__(self):
         if self._exhausted:
@@ -607,7 +617,8 @@ class DataLoader:
                  use_shared_memory: bool = False, timeout=0,
                  worker_init_fn=None, keep_int64: bool = True,
                  worker_respawn_limit: int = 3,
-                 skip_bad_samples: bool = False):
+                 skip_bad_samples: bool = False,
+                 batch_shardings=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -622,6 +633,7 @@ class DataLoader:
         self.timeout = float(timeout or 0)
         self.worker_respawn_limit = int(worker_respawn_limit)
         self.skip_bad_samples = bool(skip_bad_samples)
+        self._batch_shardings = batch_shardings
         self._latest_iter = None
         self._resume_state: Optional[dict] = None
         self._quarantined: list = []
@@ -642,6 +654,16 @@ class DataLoader:
             self.batch_sampler = BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size,
                 drop_last=drop_last)
+
+    def set_batch_shardings(self, shardings) -> "DataLoader":
+        """Install per-leaf device placement targets for the prefetch
+        thread: ``None`` (default device, uncommitted), one Sharding
+        for every leaf, or a callable ``leaf -> Sharding`` — typically
+        the train step's ``batch_sharding_for``, so batches arrive
+        already committed on the step's input shardings and its own
+        ``_shard_batch`` becomes a counted no-op."""
+        self._batch_shardings = shardings
+        return self
 
     def _fetch_timeout(self) -> Optional[float]:
         if self.timeout > 0:

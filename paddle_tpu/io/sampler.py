@@ -22,8 +22,18 @@ def _draw_base_seed(generator) -> int:
     """Resolve a sampler's stored base seed: an explicit int, a
     np.random.Generator to draw from, or None -> one draw from the
     global RNG (the only global-RNG touch; everything after is derived
-    from the stored value)."""
+    from the stored value).
+
+    The None draw prefers the framework RNG (``paddle.seed``) so a
+    seeded program gets a reproducible shuffle order across fresh
+    processes; NumPy's global RNG (process entropy unless the user
+    seeded it) is only the fallback when paddle.seed was never called."""
     if generator is None:
+        from ..core import random as _random
+        if _random.get_seed() is not None:
+            import jax
+            return int(jax.random.randint(
+                _random.next_key(), (), 0, 2 ** 31 - 1))
         return int(np.random.randint(0, 2 ** 31 - 1))
     if isinstance(generator, (int, np.integer)):
         return int(generator)

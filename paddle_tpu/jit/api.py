@@ -281,11 +281,19 @@ class TrainStep:
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
                  donate: bool = True, sharding=None,
                  offload_opt_state: bool = False,
-                 skip_nonfinite: bool = False):
+                 skip_nonfinite: bool = False, recompute=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self._sharding = sharding
+        # recompute: a fleet.utils.RecomputeConfig (or policy name) —
+        # the whole forward becomes a jax.checkpoint region under the
+        # config's policy, trading backward FLOPs for activation HBM
+        # without touching the model definition
+        if recompute is not None:
+            from ..distributed.fleet.utils.recompute import _as_config
+            recompute = _as_config(recompute)
+        self._recompute = recompute
         # skip_nonfinite: the in-jit half of the resilience layer's
         # anomaly guard — a non-finite loss keeps params/opt state
         # unchanged (the jnp.where select fuses away; same pattern as
@@ -301,6 +309,9 @@ class TrainStep:
         self._host_shardings = None
 
         self._param_names = [n for n, _ in model.named_parameters()]
+        # the Parameter objects themselves: cached so the hot loop does
+        # not re-walk the module tree (names + containers) every step
+        self._params_cache = [p for _, p in model.named_parameters()]
         self._opt_state_tree = None
 
         def step_fn(param_vals, opt_state, lr, step_no, *batch):
@@ -313,6 +324,8 @@ class TrainStep:
                     out, jax.tree_util.tree_map(_wrap, batch[-1]))
                 return _unwrap(loss)
 
+            if self._recompute is not None and self._recompute.enabled:
+                loss_of = self._recompute.wrap(loss_of)
             loss, grads = jax.value_and_grad(loss_of)(list(param_vals))
             new_params, new_state = self.optimizer.apply_gradients(
                 list(param_vals), grads, opt_state, lr=lr, step=step_no)
@@ -372,7 +385,7 @@ class TrainStep:
             self._offload = False
 
     def __call__(self, *batch):
-        params = [p for _, p in self.model.named_parameters()]
+        params = self._params_cache
         if self._opt_state_tree is None:
             # seed from the optimizer's own state when present (e.g. a
             # restored checkpoint via opt.set_state_dict) so resume works
@@ -413,7 +426,7 @@ class TrainStep:
         instead of hand-maintained per-model formulas (the reference's
         op cost-model table, cost_model/static_op_benchmark.json, is a
         measured equivalent)."""
-        params = [p for _, p in self.model.named_parameters()]
+        params = self._params_cache
         if self._opt_state_tree is None:
             self._opt_state_tree = [
                 self.optimizer._state.get(_opt_key(p))

@@ -44,6 +44,10 @@ class ErnieConfig:
     layer_norm_epsilon: float = 1e-12
     initializer_range: float = 0.02
     use_recompute: bool = False
+    #: remat policy when use_recompute — same vocabulary as GPTConfig:
+    #: the reference granularities (selective/core_attn/full) plus the
+    #: fleet.utils.RecomputeConfig policy names (dots_saveable/...)
+    recompute_granularity: str = "core_attn"
     # ERNIE pretrains with sentence-order prediction (SOP); BERT-style
     # next-sentence prediction is the same 2-way head with other labels.
     with_pooler: bool = True
@@ -178,9 +182,14 @@ class ErnieModel(Layer):
             add = (1.0 - m.astype("float32")) * -1e9
             attn_mask = Tensor(add[:, None, None, :])
         x = self.embeddings(input_ids, token_type_ids)
+        if self.cfg.use_recompute and self.training:
+            from .gpt import _remat_policy
+            policy = _remat_policy(self.cfg.recompute_granularity)
+        else:
+            policy = None
         for layer in self.layers:
-            if self.cfg.use_recompute and self.training:
-                x = recompute(layer, x, attn_mask, policy="save_dots")
+            if policy is not None:
+                x = recompute(layer, x, attn_mask, policy=policy)
             else:
                 x = layer(x, attn_mask)
         pooled = self.pooler(x) if self.pooler is not None else None

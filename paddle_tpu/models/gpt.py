@@ -41,7 +41,9 @@ class GPTConfig:
     #: remat policy when use_recompute: "selective" saves matmul
     #: outputs (save_dots_no_batch — cheap backward, moderate memory),
     #: "full" saves nothing (max memory relief, ~1.3x trunk FLOPs).
-    #: ≈ the reference's recompute_granularity (full/core_attn)
+    #: ≈ the reference's recompute_granularity (full/core_attn); also
+    #: accepts the fleet.utils.RecomputeConfig policy names
+    #: (dots_saveable / nothing_saveable / dots_with_no_batch_dims_saveable)
     recompute_granularity: str = "selective"
     #: fuse the LM head into the loss, scanned over sequence chunks so
     #: the [B, S, vocab] logits are never materialized — the dominant
@@ -71,6 +73,31 @@ class GPTConfig:
 
 
 from ._common import spec_linear as _linear
+
+#: recompute_granularity -> distributed.parallel.recompute policy name.
+#: Keys cover both the reference's granularities (selective/core_attn/
+#: full) and fleet.utils.RecomputeConfig's jax-named policies, so one
+#: vocabulary works across model configs and train-step configs.
+_REMAT_POLICY = {
+    "selective": "save_dots_no_batch",
+    "dots_with_no_batch_dims_saveable": "save_dots_no_batch",
+    "core_attn": "save_dots",
+    "dots_saveable": "save_dots",
+    "full": "full",
+    "nothing_saveable": "full",
+}
+
+
+def _remat_policy(granularity: str) -> str:
+    """Resolve a recompute_granularity to the parallel.recompute policy
+    name; a typo'd granularity ERRORS (silently training with a default
+    policy would quietly ignore the user's memory/FLOPs intent)."""
+    try:
+        return _REMAT_POLICY[granularity]
+    except KeyError:
+        raise ValueError(
+            f"unknown recompute_granularity {granularity!r}; one of "
+            f"{sorted(_REMAT_POLICY)}") from None
 
 
 class GPTAttention(Layer):
@@ -231,10 +258,7 @@ class GPTModel(Layer):
         self._moe_aux = None
         moe = self.cfg.moe_num_experts > 0
         if self.cfg.use_recompute and self.training:
-            policy = {"selective": "save_dots_no_batch",
-                      "core_attn": "save_dots",
-                      "full": "full"}.get(
-                self.cfg.recompute_granularity, "save_dots_no_batch")
+            policy = _remat_policy(self.cfg.recompute_granularity)
             aux_total = None
             for i, block in enumerate(self.blocks):
                 if moe:

@@ -216,7 +216,15 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
                               dl)
     n_out = len(out_idx)
     skeys, korder = _sorted_index(in_idx, in_dims)
-    neg = jnp.asarray(-jnp.inf, vals.dtype)
+    # identity element per dtype: -inf only exists for floats; integer
+    # values would silently cast (or raise) against a float fill
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        neg = jnp.asarray(-jnp.inf, vals.dtype)
+    elif jnp.issubdtype(vals.dtype, jnp.integer):
+        neg = jnp.asarray(jnp.iinfo(vals.dtype).min, vals.dtype)
+    else:
+        raise ValueError(
+            f"sparse max_pool3d: unsupported values dtype {vals.dtype}")
     acc = jnp.full((n_out, C), neg)
     for kd in range(ks[0]):
         for kh in range(ks[1]):

@@ -62,8 +62,52 @@ class TestModelFit:
         paddle.seed(0)
         m = _model()
         ds = ToyDataset()
+        # train_batch returns the ON-DEVICE scalar loss (non-blocking);
+        # float() is the explicit host read-back
         loss = m.train_batch(ds.x[:8], ds.y[:8])
-        assert np.isfinite(loss)
+        assert loss.shape == [] or tuple(loss.shape) == ()
+        assert np.isfinite(float(loss))
+
+    def test_eval_batch_compiled_and_async(self):
+        """eval loss is computed INSIDE the jitted eval step (one
+        compile across batches) and returned as an on-device scalar,
+        same contract as train_batch."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.profiler import metrics
+        paddle.seed(0)
+        m = _model()
+        ds = ToyDataset()
+        metrics.reset()
+        metrics.enable()
+        try:
+            _, l1 = m.eval_batch(ds.x[:8], ds.y[:8])
+            _, l2 = m.eval_batch(ds.x[8:16], ds.y[8:16])
+            snap = metrics.snapshot()
+        finally:
+            metrics.disable()
+        assert isinstance(l1, Tensor)  # read back only on float()
+        assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+        compiles = snap.get("jit.compile.total", {}).get("value", 0)
+        assert compiles == 1, f"eval step retraced: {compiles} compiles"
+
+    def test_eval_callbacks_observe_float_losses(self):
+        """on_eval_batch_end keeps the float contract (lagged, like
+        train): every batch observed exactly once, in order."""
+        from paddle_tpu.hapi.callbacks import Callback
+        paddle.seed(0)
+        m = _model()
+
+        class Rec(Callback):
+            seen = []
+
+            def on_eval_batch_end(self, step, logs=None):
+                Rec.seen.append((step, logs["loss"]))
+
+        m.evaluate(ToyDataset(n=32), batch_size=8, verbose=0,
+                   callbacks=[Rec()])
+        assert [s for s, _ in Rec.seen] == [0, 1, 2, 3]
+        assert all(isinstance(l, float) and np.isfinite(l)
+                   for _, l in Rec.seen)
 
     def test_save_load_roundtrip(self, tmp_path):
         paddle.seed(0)

@@ -317,7 +317,11 @@ def test_fit_preemption_resumes_mid_epoch(tmp_path):
 
     from paddle_tpu.hapi.callbacks import Callback
 
-    kill = fi.KillAfter(4, signal.SIGTERM)  # fires on batch index 3
+    # on_train_batch_end observes the lagged loss: with the default
+    # async window (PADDLE_ASYNC_STEPS=2) the 4th callback fires while
+    # batch index 5 is in flight, so the emergency save records the
+    # last fully-executed step: 6 steps launched+synced, cursor 6
+    kill = fi.KillAfter(4, signal.SIGTERM)
 
     class Chaos(Callback):
         def on_train_batch_end(self, step, logs=None):
@@ -333,8 +337,8 @@ def test_fit_preemption_resumes_mid_epoch(tmp_path):
     from paddle_tpu import framework_io
     state = framework_io.load(os.path.join(save_dir,
                                            "emergency.pdstate"))
-    assert state["epoch"] == 0 and state["step"] == 4
-    assert state["loader"]["cursor"] == 4
+    assert state["epoch"] == 0 and state["step"] == 6
+    assert state["loader"]["cursor"] == 6
 
     # relaunch: fresh model + loader; resume=True picks up the state
     resilience._EMERGENCY.clear()
@@ -352,5 +356,7 @@ def test_fit_preemption_resumes_mid_epoch(tmp_path):
 
     m2.fit(train_data=make_loader(), epochs=2, save_dir=save_dir,
            verbose=0, callbacks=[CountSteps()], resume=True)
-    # epoch 0 replays only its 4 remaining batches; epoch 1 runs all 8
-    assert CountSteps.per_epoch == {0: 4, 1: 8}
+    # epoch 0 replays only its 2 remaining batches; epoch 1 runs all 8
+    # (the epoch-end drain flushes the lag window, so every replayed
+    # batch still gets its on_train_batch_end)
+    assert CountSteps.per_epoch == {0: 2, 1: 8}

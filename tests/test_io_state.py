@@ -57,12 +57,16 @@ def test_random_sampler_state_roundtrip_and_set_epoch():
 
 
 def test_random_sampler_base_seed_follows_global_seed():
-    # generator=None draws the base seed ONCE from the global RNG, so
-    # paddle.seed still makes whole runs reproducible
+    # generator=None draws the base seed ONCE from the global RNG —
+    # the FRAMEWORK one (paddle.seed), so seeded runs reproduce across
+    # fresh processes (np.random's global is only the fallback when
+    # paddle.seed was never called, which pytest's autouse seed fixture
+    # makes unreachable here)
+    import paddle_tpu
     ds = ArangeDataset(16)
-    np.random.seed(123)
+    paddle_tpu.seed(123)
     a = list(RandomSampler(ds))
-    np.random.seed(123)
+    paddle_tpu.seed(123)
     b = list(RandomSampler(ds))
     assert a == b
 
@@ -181,3 +185,25 @@ def test_fresh_loader_state_dict_shape():
     ds = ArangeDataset(8)
     st = DataLoader(ds, batch_size=4, shuffle=False).state_dict()
     assert st == {"cursor": 0, "sampler": {}}
+
+
+def test_paddle_seed_makes_shuffle_reproducible():
+    """paddle.seed(S) pins the shuffle order drawn by a generator-less
+    RandomSampler — the base seed comes from the framework RNG, not
+    NumPy's global (process-entropy) state."""
+    import paddle_tpu
+
+    def order():
+        paddle_tpu.seed(77)
+        ds = ArangeDataset(16)
+        return [_arrs(b) for b in DataLoader(ds, batch_size=4,
+                                             shuffle=True)]
+
+    a, b = order(), order()
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    # different seed -> different permutation (overwhelmingly likely)
+    paddle_tpu.seed(78)
+    ds = ArangeDataset(16)
+    c = [_arrs(b) for b in DataLoader(ds, batch_size=4, shuffle=True)]
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))

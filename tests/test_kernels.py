@@ -143,6 +143,42 @@ class TestFlashAttention:
                                        atol=2e-4, rtol=2e-4)
 
     @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_multiblock_online_softmax_path(self, causal, monkeypatch):
+        """The multi-block online-softmax forward (_fwd_kernel) serves
+        sk > _WHOLE_K_MAX_SK in production (s8192+), where every suite
+        shape would otherwise take the whole-K override — force the
+        gate to 0 so the online-rescale math (base-2 exp2, scale folded
+        into q) keeps parity coverage."""
+        import importlib
+        fa_mod = importlib.import_module(
+            "paddle_tpu.kernels.flash_attention")
+        monkeypatch.setattr(fa_mod, "_WHOLE_K_MAX_SK", 0)
+        b, s, h, d = 1, 512, 2, 64
+        q, k, v = (_rand(b, s, h, d, seed=i) for i in range(3))
+        # nk > 1 so the multi-block kernel (not the single-block fast
+        # path) actually runs
+        fwd = flash_attention(q, k, v, causal=causal,
+                              block_q=128, block_k=128)
+        ref = _sdpa_xla(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(fwd), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=causal,
+                                block_q=128, block_k=128)
+            return jnp.sum(o * o)
+
+        def loss_ref(q, k, v):
+            o = _sdpa_xla(q, k, v, is_causal=causal)
+            return jnp.sum(o * o)
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
     def test_grads_tiled_dispatch_recursion(self, causal, monkeypatch):
         """Past the dq-accumulator cap the tiled dispatch halves the q
         range recursively (causal low halves drop their masked high
@@ -253,7 +289,10 @@ class TestFlashAttention:
         # variant (the r5 whole-K kernel initially shipped mean(v)
         # there — caught in review because only the grads were checked)
         for blocks in [dict(block_q=64, block_k=64),
-                       dict(block_q=64, block_k=128)]:  # multi/whole-K
+                       dict(block_q=64, block_k=128)]:  # both whole-K
+            # (sk 256 <= _WHOLE_K_MAX_SK: the whole-K override serves
+            # nk > 1 too; the multi-block kernel is gate-forced in
+            # test_fwd_multiblock_online_softmax_path)
             fwd = flash_attention(q, k, v, causal=True, **blocks)
             assert np.all(np.asarray(fwd)[:, :128] == 0.0), \
                 f"masked-row forward not zero under {blocks}"

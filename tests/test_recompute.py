@@ -1,0 +1,233 @@
+"""`fleet.utils.recompute` / RecomputeConfig policy parity.
+
+Recompute must change HBM/FLOPs, never numerics: loss AND grads of a
+2-block GPT under `full` vs `dots_saveable` vs no-remat agree to fp32
+tolerance, and wrapping the loss in `jax.checkpoint` costs exactly one
+compile — the jit retrace tracker reports zero extra retraces across
+repeated steps (≈ the reference's test_recompute.py asserting
+recompute == no-recompute grads, plus our retrace gate)."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.utils import RecomputeConfig, recompute
+from paddle_tpu.jit.api import TrainStep, functional_call, _unwrap, _wrap
+from paddle_tpu.models.gpt import gpt
+from paddle_tpu.profiler import metrics
+
+
+def _gpt2block():
+    paddle.seed(0)
+    return gpt("test-tiny")  # test-tiny is the 2-block config
+
+
+def _loss_and_grads(policy):
+    """Loss + per-param grads of one forward/backward, the whole loss
+    function wrapped per ``policy`` (None = no remat)."""
+    model = _gpt2block()
+    names = [n for n, _ in model.named_parameters()]
+    pvals = [p._data for _, p in model.named_parameters()]
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 512, (2, 16)).astype(np.int32)
+    labels = ids.astype(np.int64)
+
+    def loss_of(params):
+        out = functional_call(model, dict(zip(names, params)),
+                              _wrap(ids))
+        return _unwrap(model.loss(out, _wrap(labels)))
+
+    cfg = RecomputeConfig(policy) if policy is not None else None
+    fn = cfg.wrap(loss_of) if cfg is not None else loss_of
+    loss, grads = jax.jit(jax.value_and_grad(fn))(pvals)
+    return float(loss), [np.asarray(g) for g in grads]
+
+
+class TestPolicyParity:
+    @pytest.mark.parametrize("policy", ["full", "dots_saveable",
+                                        "dots_with_no_batch_dims_saveable"])
+    def test_loss_and_grads_match_no_remat(self, policy):
+        ref_loss, ref_grads = _loss_and_grads(None)
+        loss, grads = _loss_and_grads(policy)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-6, atol=1e-7)
+        assert len(grads) == len(ref_grads)
+        for g, r in zip(grads, ref_grads):
+            np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+
+    def test_trainstep_recompute_param_parity(self):
+        """One fused TrainStep under recompute updates params exactly
+        like the un-rematted step (same seed, same batch)."""
+
+        def one_step(recompute_cfg):
+            model = _gpt2block()
+            # SGD: the update is LINEAR in the grad, so param parity
+            # inherits the grad tolerance (Adam's sign-like step blows
+            # roundoff in near-zero grads up to the full ±lr)
+            opt = optimizer.SGD(learning_rate=1e-2,
+                                parameters=model.parameters())
+            step = TrainStep(model, opt,
+                             lambda out, lbl: model.loss(out, lbl),
+                             recompute=recompute_cfg)
+            rng = np.random.RandomState(0)
+            ids = rng.randint(0, 512, (2, 16)).astype(np.int32)
+            loss = step(paddle.to_tensor(ids),
+                        paddle.to_tensor(ids.astype(np.int64)))
+            return float(loss), {n: p.numpy() for n, p in
+                                 model.named_parameters()}
+
+        ref_loss, ref_params = one_step(None)
+        for cfg in ("full", RecomputeConfig("dots_saveable")):
+            loss, params = one_step(cfg)
+            np.testing.assert_allclose(loss, ref_loss, rtol=1e-6,
+                                       atol=1e-7)
+            for n in ref_params:
+                np.testing.assert_allclose(params[n], ref_params[n],
+                                           rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+class TestRetraceGate:
+    def test_recompute_costs_exactly_one_compile(self):
+        """3 steps under recompute: jit.compile.total grows by exactly
+        one (the first trace) — the checkpoint wrapper must not perturb
+        the jit cache key step-to-step."""
+        model = _gpt2block()
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, opt,
+                         lambda out, lbl: model.loss(out, lbl),
+                         recompute="dots_saveable")
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 512, (2, 16)).astype(np.int32)
+        x = paddle.to_tensor(ids)
+        y = paddle.to_tensor(ids.astype(np.int64))
+        metrics.reset()
+        metrics.enable()
+        try:
+            for _ in range(3):
+                float(step(x, y))
+            snap = metrics.snapshot()
+        finally:
+            metrics.disable()
+        total = snap.get("jit.compile.total", {}).get("value", 0)
+        assert total == 1, f"expected 1 compile, tracker saw {total}"
+
+
+class TestRecomputeConfig:
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown recompute policy"):
+            RecomputeConfig("save_everything_twice")
+
+    def test_none_policy_is_identity(self):
+        cfg = RecomputeConfig(None)
+        assert not cfg.enabled
+        fn = lambda x: x + 1
+        assert cfg.wrap(fn) is fn
+
+    def test_raw_jax_callable_policy_accepted(self):
+        """The docstring promises raw jax.checkpoint_policies callables
+        work everywhere a policy name does."""
+        raw = jax.checkpoint_policies.dots_saveable
+        cfg = RecomputeConfig(raw)
+        assert cfg.enabled and cfg.jax_policy() is raw
+        paddle.seed(3)
+        layer = nn.Linear(8, 8)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        out = recompute(layer, x, policy=raw)
+        np.testing.assert_allclose(out.numpy(), layer(x).numpy(),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_recompute_policy_none_means_full(self):
+        """recompute(fn, policy=None) remats under the default 'full'
+        policy (Paddle's recompute always recomputes); only
+        RecomputeConfig(None) spells recompute OFF."""
+        paddle.seed(3)
+        layer = nn.Linear(8, 8)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        out = recompute(layer, x, policy=None)
+        np.testing.assert_allclose(out.numpy(), layer(x).numpy(),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_alias_policies_share_jax_policy(self):
+        assert RecomputeConfig("full").jax_policy() is \
+            RecomputeConfig("nothing_saveable").jax_policy() is None
+        assert RecomputeConfig("core_attn").jax_policy() is \
+            RecomputeConfig("dots_saveable").jax_policy()
+
+
+class TestPaddleParityEntry:
+    def test_recompute_matches_direct_call(self):
+        paddle.seed(3)
+        layer = nn.Linear(8, 8)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        # the reference's kwargs are accepted and ignored
+        out = recompute(layer, x, use_reentrant=False,
+                        preserve_rng_state=True)
+        np.testing.assert_allclose(out.numpy(), layer(x).numpy(),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_recompute_grads_flow(self):
+        paddle.seed(3)
+        layer = nn.Linear(8, 4)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        out = recompute(layer, x, policy="dots_saveable")
+        out.mean().backward()
+        assert layer.weight.grad is not None
+        assert np.abs(layer.weight.grad.numpy()).sum() > 0
+
+
+class TestGranularityMapping:
+    def test_typo_granularity_raises_not_falls_back(self):
+        """A typo'd recompute_granularity must error, not silently
+        train under a default policy — and GPT/ERNIE agree on that."""
+        from paddle_tpu.models.ernie import ernie
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, 512, (2, 16)).astype(np.int32))
+        paddle.seed(0)
+        g = gpt("test-tiny", use_recompute=True,
+                recompute_granularity="core-attn")  # hyphen typo
+        g.train()
+        with pytest.raises(ValueError, match="recompute_granularity"):
+            g(ids)
+        paddle.seed(0)
+        e = ernie("test-tiny", use_recompute=True,
+                  recompute_granularity="core-attn")
+        e.train()
+        with pytest.raises(ValueError, match="recompute_granularity"):
+            e(ids)
+
+
+@pytest.fixture
+def mesh_dp8():
+    hcg = fleet.init(strategy=fleet.DistributedStrategy(
+        hybrid_configs={"dp_degree": 8}))
+    yield hcg
+    dist.set_hybrid_communicate_group(None)
+
+
+def test_fleet_step_recompute_loss_parity(mesh_dp8):
+    """DistributedTrainStep(recompute=...) must not move the loss."""
+
+    def one(recompute_cfg):
+        paddle.seed(11)
+        m = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=m.parameters())
+        m = fleet.distributed_model(m)
+        opt = fleet.distributed_optimizer(opt)
+        step = fleet.DistributedTrainStep(
+            m, opt, nn.functional.cross_entropy, recompute=recompute_cfg)
+        rng = np.random.RandomState(0)
+        xs = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        ys = paddle.to_tensor(rng.randint(0, 4, 16))
+        return [float(step(xs, ys)) for _ in range(3)]
+
+    np.testing.assert_allclose(one("full"), one(None), rtol=1e-6,
+                               atol=1e-7)

@@ -271,6 +271,24 @@ def test_sparse_max_pool3d_matches_dense_oracle():
                                    rtol=1e-6)
 
 
+def test_sparse_max_pool3d_integer_values():
+    """Integer-valued sparse tensors pool with the dtype's own minimum
+    as the identity — no float(-inf) fill leaking into an int lattice."""
+    rs = np.random.RandomState(7)
+    idx = np.array([[0, 0, 0, 0], [0, 0, 0, 1],
+                    [0, 1, 1, 1], [1, 2, 3, 3]], np.int32).T
+    vals = rs.randint(-50, 50, (4, 3)).astype(np.int32)
+    x = sparse.sparse_coo_tensor(idx, vals, shape=[2, 4, 4, 4, 3])
+    out = sparse.nn.functional.max_pool3d(x, kernel_size=2, stride=2)
+    got = out.to_dense().numpy()
+    assert got.dtype == np.int32
+    # sites (0,0,0,0), (0,0,0,1) and (0,1,1,1) all fall in output
+    # window (0,0,0,0): elementwise max of their value rows
+    np.testing.assert_array_equal(
+        got[0, 0, 0, 0], np.maximum.reduce(vals[:3]))
+    np.testing.assert_array_equal(got[1, 1, 1, 1], vals[3])
+
+
 def test_sparse_batchnorm_layers_and_conv_layers():
     """Layer wrappers: BatchNorm normalizes value rows (matches dense
     BatchNorm1D on the values), Conv3D/SubmConv3D/MaxPool3D run
