@@ -402,8 +402,73 @@ def bench_moe_block(dev, on_tpu):
     }
 
 
+def bench_decode(dev, on_tpu):
+    """Serving-trajectory bench: prefill 512 + decode 128 on test-tiny
+    GPT (ISSUE-6 decode mode). Reports decode tokens/sec (pipelined
+    host loop, no per-token sync) plus p50/p95 per-token latency from a
+    second, per-step-synced pass. vs_baseline is 1.0 by definition —
+    this row DEFINES the decode baseline from this revision on."""
+    import os
+    import paddle_tpu as paddle
+    from paddle_tpu.generation import GenerationConfig, GenerationSession
+    from paddle_tpu.generation.api import _round_up
+    from paddle_tpu.models.gpt import gpt
+    import jax
+    import jax.numpy as jnp
+
+    prefill_len, new_tokens = 512, 128
+    b = int(os.environ.get("BENCH_DECODE_BATCH", 8 if on_tpu else 2))
+    paddle.seed(0)
+    model = gpt("test-tiny", max_position_embeddings=1024)
+    model.bfloat16() if on_tpu else None
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, model.cfg.vocab_size,
+                      (b, prefill_len)).astype(np.int32)
+
+    cfg = GenerationConfig()
+    cache_len = _round_up(prefill_len + new_tokens)
+    sess = GenerationSession(model)
+    state = sess.state_values()
+    key = jax.random.PRNGKey(0)
+    plen = jnp.full((b,), prefill_len, jnp.int32)
+
+    def run(sync_each_step):
+        tok, cache, k, fin = sess.prefill(state, jnp.asarray(ids), plen,
+                                          key, cfg, cache_len)
+        tok.block_until_ready()  # decode timer must NOT include the
+        #                          async prefill-512 device time
+        times = []
+        t0 = time.perf_counter()
+        for _ in range(new_tokens - 1):
+            s0 = time.perf_counter()
+            tok, _, cache, k, fin = sess.decode(state, tok, cache, k,
+                                                fin, cfg)
+            if sync_each_step:
+                tok.block_until_ready()
+                times.append(time.perf_counter() - s0)
+        tok.block_until_ready()
+        return time.perf_counter() - t0, times
+
+    run(False)  # warmup: compiles prefill + decode
+    dt, _ = run(False)                          # throughput pass
+    _, per_step = run(True)                     # latency pass
+    decode_tps = b * (new_tokens - 1) / dt
+    p50 = float(np.percentile(per_step, 50) * 1e3)
+    p95 = float(np.percentile(per_step, 95) * 1e3)
+    return {
+        "metric": f"test-tiny decode tokens/sec/chip (b{b} "
+                  f"prefill{prefill_len}+decode{new_tokens}, "
+                  f"p50={p50:.2f}ms, p95={p95:.2f}ms per token, "
+                  f"device={dev.device_kind})",
+        "value": round(decode_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+    }
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
+    "decode": bench_decode,
     "moe-block": bench_moe_block,
     "resnet50": bench_resnet50,
     "ernie-base": bench_ernie_base,

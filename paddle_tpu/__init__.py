@@ -94,6 +94,7 @@ dtype = _dtype_mod.convert_dtype  # paddle.dtype('float32') parity
 from . import amp  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import generation  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402

@@ -33,6 +33,8 @@ Metric name scheme (what the summary views group by):
     resilience.ckpt.fallback    corrupt checkpoint steps skipped on restore
     train.anomalies / train.anomaly_restores  non-finite-loss guard
     errors.swallowed{where=...} deliberately swallowed exceptions
+    gen.tokens / gen.prefill_steps / gen.decode_steps   generation loop
+    gen.cache_occupancy         gauge: KV cache fraction in use
 """
 from __future__ import annotations
 
@@ -218,6 +220,31 @@ def record_swallowed(where: str, exc: BaseException):
     if not enabled:
         return
     metrics.counter("errors.swallowed", where=where).inc()
+
+
+# ------------------------------------------------------ generation layer
+
+def record_generation(prefill_steps: int = 0, decode_steps: int = 0,
+                      tokens: int = 0):
+    """Generation loop progress: one prefill dispatch / decode dispatch
+    (= one token per row) and the tokens it produced. MetricsCallback
+    surfaces gen.tokens deltas as tokens/sec."""
+    if not enabled:
+        return
+    if prefill_steps:
+        metrics.counter("gen.prefill_steps").inc(int(prefill_steps))
+    if decode_steps:
+        metrics.counter("gen.decode_steps").inc(int(decode_steps))
+    if tokens:
+        metrics.counter("gen.tokens").inc(int(tokens))
+
+
+def record_cache_occupancy(frac: float):
+    """Fraction of the KV cache in use at the end of a generate() call
+    (max over batch rows) — headroom before the ring would wrap."""
+    if not enabled:
+        return
+    metrics.gauge("gen.cache_occupancy").set(float(frac))
 
 
 # ---------------------------------------------------------- device layer

@@ -1,0 +1,15 @@
+"""Autoregressive generation subsystem: ring KV cache, decode-shaped
+flash attention, sampling, and the jitted (prefill, decode) pair behind
+``Model.generate()`` / ``inference.Predictor``'s generation mode.
+
+See docs/architecture.md "Generation & KV cache".
+"""
+from .api import GenerationConfig, GenerationSession, generate  # noqa: F401
+from .kv_cache import KVCache  # noqa: F401
+from .sampling import (apply_temperature, apply_top_k,  # noqa: F401
+                       apply_top_p, sample)
+
+__all__ = [
+    "GenerationConfig", "GenerationSession", "generate", "KVCache",
+    "sample", "apply_temperature", "apply_top_k", "apply_top_p",
+]

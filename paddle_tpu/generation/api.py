@@ -1,0 +1,376 @@
+"""Autoregressive generation: jitted (prefill, decode) pair + host loop.
+
+The contract that makes serving-grade decoding possible on TPU:
+
+- exactly TWO compiled programs per (shape, config): one prefill over
+  the padded prompt, one single-token decode step. The host loop then
+  issues ONE device dispatch per generated token with no per-token
+  retrace (gated by ``jit.retraces{cause=new_shape}`` ≈ 0) and no
+  per-token host sync — tokens accumulate on device and transfer once
+  at the end (eos polling, when enabled, reads one tiny bool every
+  ``_EOS_CHECK_EVERY`` steps).
+- sampling (greedy/temperature/top-k/top-p) runs INSIDE the decode
+  program; the ``GenerationConfig`` is a static jit argument, so the
+  sampler never branches on device.
+- the KV cache is donated to the decode step on TPU, so each token's
+  cache update is an in-place HBM write, not a copy of
+  [layers, batch, max_len, heads, head_dim].
+
+Networks plug in via the cache protocol (models/gpt.py wiring):
+``forward(input_ids, use_cache=True, prompt_len=..., cache_max_len=N)``
+returns (next-token logits, filled cache) for prefill, and
+``forward(input_ids, cache=cache)`` returns (logits, cache) for decode.
+
+Reference analog: the reference ships this layer as
+paddle/fluid/inference + the fused-multi-transformer decode ops (~90k
+LoC); AOT-compiled jax executables + donated buffers make it this file.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import monitor
+from ..core.tensor import Tensor
+from .kv_cache import KVCache
+from .sampling import sample
+
+__all__ = ["GenerationConfig", "GenerationSession", "generate"]
+
+_EOS_CHECK_EVERY = 16  # decode steps between host reads of `finished`
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Static sampling/stopping configuration (hashable: it is a jit
+    static argument — a new config compiles a new decode program)."""
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    pad_token_id: Optional[int] = None
+
+    @property
+    def pad_value(self) -> int:
+        if self.pad_token_id is not None:
+            return int(self.pad_token_id)
+        return int(self.eos_token_id) if self.eos_token_id is not None \
+            else 0
+
+
+def _round_up(n: int, mult: int = 128) -> int:
+    return -(-int(n) // mult) * mult
+
+
+def _sample_cfg(cfg: GenerationConfig) -> dict:
+    return dict(do_sample=cfg.do_sample, temperature=cfg.temperature,
+                top_k=cfg.top_k, top_p=cfg.top_p)
+
+
+def _expect_logits_cache(out):
+    """The cache protocol returns exactly (logits, cache). Fail with a
+    readable error instead of an opaque unpack inside the trace —
+    encoder-style cached forwards (e.g. ErnieModel's incremental
+    encoding, which returns (seq, pooled, cache)) are not generative
+    LMs."""
+    if not (isinstance(out, (tuple, list)) and len(out) == 2):
+        got = (f"a {len(out)}-tuple" if isinstance(out, (tuple, list))
+               else type(out).__name__)
+        raise TypeError(
+            "generate(): the network's cached forward must return "
+            f"(logits, cache), got {got}; use a generative LM head "
+            "(e.g. models.gpt.GPTForCausalLM — ErnieModel's "
+            "incremental encoding is an encoder protocol, not "
+            "a decoder)")
+    return out
+
+
+class GenerationSession:
+    """The jitted (prefill, decode) pair for one network.
+
+    Built once per network and reused across ``generate()`` calls, so
+    jax's jit cache carries warm executables between requests.
+    ``aot_compile`` additionally stores ahead-of-time compiled
+    executables for fixed shapes (the Predictor's serving mode)."""
+
+    def __init__(self, network):
+        from ..jit.api import _RetraceTracker, _unwrap, functional_call
+        network.eval()
+        self.network = network
+        self._names = list(network.state_dict().keys())
+        # one tracker per jitted fn: prefill and decode each classify
+        # their first compile as cause=first, and any later miss on the
+        # same fn as the true cause (the gate: new_shape stays 0)
+        self._prefill_tracker = _RetraceTracker()
+        self._decode_tracker = _RetraceTracker()
+        self._compiled = {}  # (kind, shape key) -> AOT executable
+        names = self._names
+
+        def prefill_fn(state_vals, ids, prompt_len, key, cfg, cache_len):
+            out = functional_call(
+                network, dict(zip(names, state_vals)), Tensor(ids),
+                use_cache=True, prompt_len=prompt_len,
+                cache_max_len=cache_len)
+            logits, cache = _expect_logits_cache(out)
+            logits = _unwrap(logits)[:, -1].astype(jnp.float32)  # [B, V]
+            k0, k1 = jax.random.split(key)
+            tok = sample(logits, k0, **_sample_cfg(cfg))
+            if cfg.eos_token_id is not None:
+                finished = tok == cfg.eos_token_id
+            else:
+                finished = jnp.zeros(tok.shape, bool)
+            return tok, cache, k1, finished
+
+        def decode_fn(state_vals, tok, cache, key, finished, cfg):
+            out = functional_call(
+                network, dict(zip(names, state_vals)), Tensor(tok[:, None]),
+                cache=cache)
+            logits, cache = _expect_logits_cache(out)
+            logits = _unwrap(logits)[:, -1].astype(jnp.float32)
+            k0, k1 = jax.random.split(key)
+            nxt = sample(logits, k0, **_sample_cfg(cfg))
+            # rows that finished on an earlier step emit padding
+            emitted = jnp.where(finished, jnp.int32(cfg.pad_value), nxt)
+            if cfg.eos_token_id is not None:
+                finished = finished | (nxt == cfg.eos_token_id)
+            return nxt, emitted, cache, k1, finished
+
+        # donate the cache on TPU only: CPU/GPU donation is a no-op
+        # that warns once per program
+        donate = (2,) if jax.default_backend() == "tpu" else ()
+        self._prefill_fn = prefill_fn
+        self._decode_fn = decode_fn
+        self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
+        self._decode_jit = jax.jit(decode_fn, static_argnums=(5,),
+                                   donate_argnums=donate)
+
+    # ------------------------------------------------------------- state
+    def state_values(self):
+        """Fresh parameter/buffer arrays (Tensors are mutated in place
+        by optimizers, so ._data is re-read per call; a changed key SET
+        means the session must be rebuilt)."""
+        state = self.network.state_dict()
+        if list(state.keys()) != self._names:
+            raise RuntimeError("network structure changed under the "
+                               "generation session; rebuild it")
+        return tuple(t._data for t in state.values())
+
+    # ----------------------------------------------------------- calling
+    def _ensure_eval(self):
+        # a fit() loop flips the network back to train mode every batch;
+        # a retrace here (new shape) would then BAKE active dropout into
+        # the prefill/decode program — force eval before every dispatch
+        # (attribute check only on the hot path)
+        if self.network.training:
+            self.network.eval()
+
+    def prefill(self, state_vals, ids, prompt_len, key, cfg, cache_len):
+        self._ensure_eval()
+        exe = self._compiled.get(("prefill", ids.shape, cache_len, cfg))
+        if exe is not None:
+            return exe(state_vals, ids, prompt_len, key)
+        pre = self._prefill_tracker.pre(self._prefill_jit)
+        out = self._prefill_jit(state_vals, ids, prompt_len, key, cfg,
+                                cache_len)
+        self._prefill_tracker.observe(self._prefill_jit,
+                                      (ids.shape, cache_len, str(cfg)),
+                                      pre)
+        return out
+
+    def decode(self, state_vals, tok, cache, key, finished, cfg):
+        self._ensure_eval()
+        exe = self._compiled.get(
+            ("decode", tok.shape, cache.max_len, cfg))
+        if exe is not None:
+            return exe(state_vals, tok, cache, key, finished)
+        pre = self._decode_tracker.pre(self._decode_jit)
+        out = self._decode_jit(state_vals, tok, cache, key, finished, cfg)
+        self._decode_tracker.observe(self._decode_jit,
+                                     (tok.shape, cache.max_len,
+                                      str(cfg)), pre)
+        return out
+
+    # --------------------------------------------------------------- aot
+    def aot_compile(self, batch: int, prompt_len: int, cache_len: int,
+                    cfg: GenerationConfig):
+        """Ahead-of-time compile the (prefill, decode) pair for one
+        fixed padded shape (serving: compile at startup, zero retraces
+        under live traffic). Compiled executables are called WITHOUT
+        the static args — they are baked in."""
+        sds = jax.ShapeDtypeStruct
+        state = tuple(sds(v.shape, v.dtype) for v in self.state_values())
+        ids = sds((batch, prompt_len), jnp.int32)
+        plen = sds((batch,), jnp.int32)
+        key = sds((2,), jnp.uint32)
+        pexe = self._prefill_jit.lower(
+            state, ids, plen, key, cfg, cache_len).compile()
+        self._compiled[("prefill", (batch, prompt_len), cache_len,
+                        cfg)] = pexe
+        # decode avals come from the prefill's own outputs
+        _, cache_aval, _, fin = jax.eval_shape(
+            lambda s, i, p, k: self._prefill_fn(s, i, p, k, cfg,
+                                                cache_len),
+            state, ids, plen, key)
+        tok = sds((batch,), jnp.int32)
+        dexe = self._decode_jit.lower(
+            state, tok, cache_aval, key, fin, cfg).compile()
+        self._compiled[("decode", (batch,), cache_len, cfg)] = dexe
+        return pexe, dexe
+
+
+def _as_int_ids(input_ids) -> np.ndarray:
+    ids = input_ids
+    if isinstance(ids, Tensor):
+        ids = np.asarray(ids._data)
+    ids = np.asarray(ids)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    if ids.ndim != 2:
+        raise ValueError(f"input_ids must be [batch, seq], got "
+                         f"shape {ids.shape}")
+    return ids.astype(np.int32)
+
+
+def _session_for(network) -> GenerationSession:
+    sess = getattr(network, "_generation_session", None)
+    if sess is None or sess.network is not network or \
+            list(network.state_dict().keys()) != sess._names:
+        sess = GenerationSession(network)
+        object.__setattr__(network, "_generation_session", sess)
+    return sess
+
+
+def generate(network, input_ids, max_new_tokens: int = 32, *,
+             do_sample: bool = False, temperature: float = 1.0,
+             top_k: int = 0, top_p: float = 1.0,
+             eos_token_id: Optional[int] = None,
+             pad_token_id: Optional[int] = None,
+             prompt_len=None, cache_max_len: Optional[int] = None,
+             seed: Optional[int] = None,
+             session: Optional[GenerationSession] = None,
+             live_rows: Optional[int] = None) -> Tensor:
+    """Generate ``max_new_tokens`` tokens after ``input_ids``.
+
+    input_ids: [batch, seq] int prompt (right-padded for ragged
+    batches; pass per-row true lengths via ``prompt_len``). Returns the
+    GENERATED ids only, [batch, max_new_tokens] int32; with
+    ``eos_token_id`` set, positions after a row's first eos hold
+    ``pad_token_id`` (default: the eos id).
+
+    Exactly one prefill dispatch plus one decode dispatch per token;
+    two compiles per (shape, sampling config). Raises up front when
+    prompt + new tokens would exceed the model's
+    ``max_position_embeddings`` (a wrapped/clipped position gather
+    would silently corrupt the distribution otherwise).
+
+    ``seed=None`` with ``do_sample=True`` draws fresh entropy from the
+    framework RNG (``paddle.seed`` pins it) — repeated calls sample
+    DIFFERENT continuations; pass an explicit ``seed`` for a
+    reproducible draw. ``live_rows`` marks how many leading batch rows
+    are real requests (the Predictor's fixed-batch padding rows are
+    not) — the ``gen.tokens`` metric counts only live rows, and only
+    up to each row's first eos.
+    """
+    ids = _as_int_ids(input_ids)
+    b, s = ids.shape
+    max_new_tokens = int(max_new_tokens)
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
+    if prompt_len is None:
+        plen = np.full((b,), s, np.int32)
+    else:
+        plen = np.asarray(
+            prompt_len._data if isinstance(prompt_len, Tensor)
+            else prompt_len).astype(np.int32).reshape(-1)
+        if plen.shape != (b,):
+            raise ValueError(f"prompt_len must be [batch]={b}, got "
+                             f"shape {plen.shape}")
+        if (plen < 1).any() or (plen > s).any():
+            raise ValueError("prompt_len entries must be in [1, "
+                             f"{s}], got {plen.tolist()}")
+
+    # out-of-range decode positions fail HERE, not as a silent clipped
+    # position-embedding gather deep in the model
+    cfg_obj = getattr(network, "cfg", None)
+    max_pos = getattr(cfg_obj, "max_position_embeddings", None)
+    total = int(plen.max()) + max_new_tokens
+    if max_pos is not None and total > int(max_pos):
+        raise ValueError(
+            f"generate(): prompt ({int(plen.max())} tokens) + "
+            f"max_new_tokens ({max_new_tokens}) = {total} exceeds the "
+            f"model's max_position_embeddings ({int(max_pos)}); shorten "
+            "the prompt, lower max_new_tokens, or build the model with "
+            "a larger max_position_embeddings")
+
+    cache_len = int(cache_max_len) if cache_max_len is not None \
+        else _round_up(s + max_new_tokens)
+    if cache_len < s + max_new_tokens:
+        raise ValueError(
+            f"cache_max_len {cache_len} < prompt {s} + max_new_tokens "
+            f"{max_new_tokens}; the ring cache would wrap and overwrite "
+            "the oldest context")
+
+    cfg = GenerationConfig(do_sample=do_sample, temperature=temperature,
+                           top_k=top_k, top_p=top_p,
+                           eos_token_id=eos_token_id,
+                           pad_token_id=pad_token_id)
+    sess = session if session is not None else _session_for(network)
+    state_vals = sess.state_values()
+    if seed is not None:
+        key = jax.random.PRNGKey(int(seed))
+    elif cfg.do_sample:
+        # fresh entropy per call: repeated unseeded sampling must not
+        # replay one fixed key stream (paddle.seed pins the source)
+        from ..core import random as _random
+        key = _random.next_key()
+    else:
+        key = jax.random.PRNGKey(0)  # greedy: key is never consumed
+
+    tok, cache, key, finished = sess.prefill(
+        state_vals, jnp.asarray(ids), jnp.asarray(plen), key, cfg,
+        cache_len)
+    if monitor.enabled:
+        monitor.record_generation(prefill_steps=1)
+    outs = [tok]
+    n_done = 1
+    for i in range(max_new_tokens - 1):
+        tok, emitted, cache, key, finished = sess.decode(
+            state_vals, tok, cache, key, finished, cfg)
+        outs.append(emitted)
+        n_done += 1
+        if monitor.enabled:
+            monitor.record_generation(decode_steps=1)
+        # eos early-exit: one tiny host read every K steps (never per
+        # token — that would drain the dispatch queue)
+        if cfg.eos_token_id is not None and \
+                (i + 1) % _EOS_CHECK_EVERY == 0 and \
+                bool(jnp.all(finished)):
+            break
+    result = jnp.stack(outs, axis=1)                 # [B, n_done]
+    if monitor.enabled:
+        # real generated tokens only: live rows, each counted up to its
+        # first eos (padding-row and post-eos emissions are not
+        # throughput). One [live, n_done] host read at call end — the
+        # caller is about to transfer the result anyway.
+        live = b if live_rows is None else min(int(live_rows), b)
+        arr = np.asarray(result[:live])
+        if cfg.eos_token_id is not None:
+            hit = arr == cfg.eos_token_id
+            per_row = np.where(hit.any(1), hit.argmax(1) + 1, n_done)
+            tokens = int(per_row.sum())
+        else:
+            tokens = live * n_done
+        monitor.record_generation(tokens=tokens)
+        monitor.record_cache_occupancy(
+            (int(plen.max()) + n_done) / cache_len)
+    if n_done < max_new_tokens:                      # early eos exit
+        result = jnp.concatenate(
+            [result, jnp.full((b, max_new_tokens - n_done),
+                              cfg.pad_value, jnp.int32)], axis=1)
+    return Tensor(result)

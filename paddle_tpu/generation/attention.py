@@ -1,0 +1,39 @@
+"""Shared cached-attention step for model wiring.
+
+GPT and ERNIE attention layers run the identical cache choreography —
+write the fresh k/v into the ring at each row's ``kv_len``, then either
+attend the cached prefix through the decode flash kernel (decode) or
+run ordinary self-attention over the fresh window (prefill). One
+implementation here so a fix (GQA cache heads, sharded creation, mask
+semantics) can never silently diverge between models; only the
+``causal`` flag differs.
+"""
+from __future__ import annotations
+
+
+def cached_attention(q, k, v, cache, layer_idx, *, decode: bool,
+                     causal: bool, attn_mask=None):
+    """Write ``k``/``v`` ([b, s, heads, head_dim] Tensors) into
+    ``cache`` at layer ``layer_idx`` and attend. Returns (out, cache);
+    ``out`` is [b, s, heads, head_dim]. Decode reads the cached prefix
+    via ``kernels.flash_attention_decode`` with per-row ragged masking
+    at ``kv_len + s``; prefill is plain self-attention over the fresh
+    window (``causal`` per model family, ``attn_mask`` honored)."""
+    from ..core.tensor import dispatch
+    from ..nn import functional as F
+    cache = cache.update(layer_idx, k, v, cache.kv_len)
+    if decode:
+        from ..kernels.flash_attention import flash_attention_decode
+        s = q.shape[1]
+        mask_len = cache.kv_len + s  # includes the new rows
+        out = dispatch(
+            "flash_attention_decode",
+            lambda q_, kc, vc, kl: flash_attention_decode(
+                q_, kc, vc, kl),
+            (q, cache.k[layer_idx], cache.v[layer_idx], mask_len), {},
+            differentiable=False)
+    else:
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=causal,
+            dropout_p=0.0, training=False)
+    return out, cache

@@ -1,0 +1,160 @@
+"""Ring KV cache for incremental decoding.
+
+One donated on-device pytree holds every layer's cached keys/values:
+
+    k, v:   [num_layers, batch, max_len, num_heads, head_dim]
+    kv_len: [batch] int32 — valid entries per row (ragged batches)
+
+``update(layer, k, v, pos)`` is pure-functional (returns a new KVCache
+whose buffers alias the old ones under XLA donation), so the SAME code
+path jit-compiles for prefill (write the whole padded prompt at pos 0)
+and decode (write 1..8 new rows at each row's ``kv_len``). Write
+positions wrap modulo ``max_len`` (ring semantics); ``generate()``
+validates lengths up front so a live cache never actually wraps — the
+wrap exists so an out-of-contract write corrupts the oldest entries
+instead of faulting.
+
+Sharding: ``partition_spec()`` places batch on the (dp, sharding) mesh
+axes and heads on mp — the same layout the models' qkv activations
+carry under ``DistributedTrainStep`` — so hybrid-mesh models decode
+without resharding. ``shard(mesh)`` trims the spec to the axes the mesh
+actually has.
+
+Reference analog: the fused-multi-transformer decode ops' CacheKV
+tensors (paddle/fluid/operators/fused/fused_multi_transformer_op.cu);
+here the cache is a plain pytree the compiled step updates in place via
+buffer donation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _raw(x):
+    from ..core.tensor import Tensor
+    return x._data if isinstance(x, Tensor) else x
+
+
+@jax.tree_util.register_pytree_node_class
+class KVCache:
+    """Per-layer K/V ring cache with per-row valid lengths."""
+
+    __slots__ = ("k", "v", "kv_len")
+
+    def __init__(self, k, v, kv_len):
+        self.k = k
+        self.v = v
+        self.kv_len = kv_len
+
+    # ------------------------------------------------------------ pytree
+    def tree_flatten(self):
+        return (self.k, self.v, self.kv_len), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # ------------------------------------------------------------- shape
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def dtype(self):
+        return self.k.dtype
+
+    # ---------------------------------------------------------- creation
+    @classmethod
+    def create(cls, num_layers: int, batch: int, max_len: int,
+               num_heads: int, head_dim: int, dtype=jnp.float32,
+               mesh=None) -> "KVCache":
+        shape = (num_layers, batch, max_len, num_heads, head_dim)
+        cache = cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                    jnp.zeros((batch,), jnp.int32))
+        return cache.shard(mesh) if mesh is not None else cache
+
+    @staticmethod
+    def partition_spec() -> P:
+        """[layers, batch, max_len, heads, head_dim]: batch over
+        (dp, sharding), heads over mp — the models' qkv layout."""
+        return P(None, ("dp", "sharding"), None, "mp", None)
+
+    def shard(self, mesh) -> "KVCache":
+        """Place the cache on ``mesh`` (spec trimmed to the axes the
+        mesh has). Works both eagerly (device_put) and inside a trace
+        (sharding constraint)."""
+        names = set(mesh.axis_names)
+
+        def trim(axes):
+            if isinstance(axes, tuple):
+                kept = tuple(a for a in axes if a in names)
+                return kept if kept else None
+            return axes if axes in names else None
+
+        spec = P(*(trim(ax) for ax in self.partition_spec()))
+        kv_sh = NamedSharding(mesh, spec)
+        len_sh = NamedSharding(mesh, P(trim(("dp", "sharding"))))
+        place = jax.lax.with_sharding_constraint \
+            if isinstance(self.k, jax.core.Tracer) else jax.device_put
+        return KVCache(place(self.k, kv_sh), place(self.v, kv_sh),
+                       place(self.kv_len, len_sh))
+
+    # ------------------------------------------------------------ update
+    def update(self, layer: int, k_new, v_new, pos) -> "KVCache":
+        """Write ``k_new``/``v_new`` ([batch, s, heads, head_dim]) into
+        ``layer`` at per-row start position ``pos`` ([batch] int32 or a
+        scalar), wrapping modulo max_len. Does NOT advance ``kv_len`` —
+        every layer of one forward writes at the same positions; the
+        model advances the length once via ``with_kv_len``."""
+        k_new, v_new = _raw(k_new), _raw(v_new)
+        pos = jnp.asarray(_raw(pos), jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (k_new.shape[0],))
+        steps = jnp.arange(k_new.shape[1], dtype=jnp.int32)
+
+        def write(buf, new, p):  # [T, H, D], [S, H, D], scalar
+            # scatter, not dynamic_update_slice: each target slot wraps
+            # modulo max_len independently (true ring semantics; a
+            # slice write would CLAMP at the end instead)
+            idx = (p + steps) % buf.shape[0]
+            return buf.at[idx].set(new.astype(buf.dtype))
+
+        k_l = jax.vmap(write)(self.k[layer], k_new, pos)
+        v_l = jax.vmap(write)(self.v[layer], v_new, pos)
+        return KVCache(self.k.at[layer].set(k_l),
+                       self.v.at[layer].set(v_l), self.kv_len)
+
+    def positions(self, s: int):
+        """Absolute positions of ``s`` appended tokens per row
+        ([batch, s] int32: ``kv_len[r] .. kv_len[r]+s-1``) — the decode
+        position-embedding offsets."""
+        return self.kv_len[:, None] + \
+            jnp.arange(s, dtype=jnp.int32)[None, :]
+
+    def with_kv_len(self, kv_len) -> "KVCache":
+        kv_len = jnp.asarray(_raw(kv_len), jnp.int32)
+        if kv_len.ndim == 0:
+            kv_len = jnp.broadcast_to(kv_len, (self.batch,))
+        return KVCache(self.k, self.v, kv_len)
+
+    # --------------------------------------------------------- telemetry
+    def occupancy(self) -> float:
+        """Host-side fraction of the cache in use (max over rows) — the
+        gen.cache_occupancy gauge. Syncs kv_len (a [batch] int32 — a
+        few bytes) to host."""
+        import numpy as np
+        return float(np.max(np.asarray(self.kv_len))) / self.max_len
+
+    def __repr__(self):
+        return (f"KVCache(layers={self.num_layers}, batch={self.batch}, "
+                f"max_len={self.max_len}, dtype={self.k.dtype})")

@@ -1,0 +1,67 @@
+"""Token sampling for the jitted decode step.
+
+All transforms are pure jnp over [batch, vocab] fp32 logits with the
+sampling hyperparameters closed over as PYTHON values — they select the
+trace, so a `generate()` call compiles exactly one decode program per
+(shape, config) and never branches on device. Reference analog:
+PaddleNLP's TopKProcess/TopPProcess logits processors; the reference
+repo's own surface is paddle.tensor.search.top_p_sampling.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_MASKED = -1e10  # large-negative, not -inf: keeps softmax/categorical exact
+
+
+def apply_temperature(logits, temperature: float):
+    if temperature == 1.0:
+        return logits
+    return logits / max(float(temperature), 1e-6)
+
+
+def apply_top_k(logits, k: int):
+    """Keep the k highest logits per row, mask the rest."""
+    k = min(int(k), logits.shape[-1])
+    vals = jax.lax.top_k(logits, k)[0]
+    thresh = vals[..., -1:]
+    return jnp.where(logits >= thresh, logits, _MASKED)
+
+
+def apply_top_p(logits, p: float):
+    """Nucleus filtering: keep the smallest set of tokens whose
+    cumulative probability reaches ``p`` (the top token always
+    survives)."""
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    # token j is kept while the mass strictly BEFORE it is < p; pin the
+    # top token explicitly so p <= 0 degrades to greedy, not to an
+    # all-masked row (which would sample UNIFORMLY over the vocab)
+    keep = (jnp.cumsum(probs, axis=-1) - probs) < float(p)
+    keep = keep.at[..., 0].set(True)
+    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits >= thresh, logits, _MASKED)
+
+
+def sample(logits, key=None, *, do_sample: bool = False,
+           temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+    """[batch, vocab] logits -> [batch] int32 token ids.
+
+    do_sample=False (or temperature == 0) is greedy argmax; otherwise
+    temperature, then top-k (when > 0), then top-p (when < 1) filter
+    the distribution and ``jax.random.categorical`` draws from it."""
+    logits = logits.astype(jnp.float32)
+    if not do_sample or float(temperature) == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if key is None:
+        raise ValueError("sampling (do_sample=True) needs a PRNG key")
+    logits = apply_temperature(logits, temperature)
+    if top_k and top_k > 0:
+        logits = apply_top_k(logits, top_k)
+    if top_p is not None and float(top_p) < 1.0:
+        logits = apply_top_p(logits, top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

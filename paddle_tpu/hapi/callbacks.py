@@ -410,6 +410,7 @@ class MetricsCallback(Callback):
         self._samples0 = self._counter("io.samples")
         self._retraces0 = self._counter("jit.compile.total")
         self._syncs0 = self._counter("train.host_syncs")
+        self._gen_tokens0 = self._counter("gen.tokens")
         try:
             device.reset_peak_memory_stats()
             # per-batch polling advances the tracked high-water, but
@@ -446,6 +447,12 @@ class MetricsCallback(Callback):
             if self.tokens_per_sample:
                 stats["tokens_per_sec"] = \
                     samples * self.tokens_per_sample / dt
+        # generation inside the epoch (eval-time generate() calls):
+        # surface the gen.* recorder family as tokens/sec
+        gen_tokens = self._counter("gen.tokens") - \
+            getattr(self, "_gen_tokens0", 0)
+        if gen_tokens:
+            stats["gen_tokens_per_sec"] = gen_tokens / dt
         try:
             stats["peak_memory_bytes"] = device.max_memory_allocated()
         except Exception:

@@ -247,6 +247,20 @@ class Model:
         inputs = [_to_tensor(x) for x in _as_list(inputs)]
         return self._eval_fn(*inputs)
 
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        """Autoregressive decoding through the KV-cache generation
+        subsystem: one jitted prefill + one jitted decode step, one
+        device dispatch per generated token. The wrapped network must
+        implement the cache protocol (``forward(input_ids,
+        use_cache=..., cache=...)`` returning (logits, cache) — e.g.
+        ``models.gpt.GPTForCausalLM``). Sampling options
+        (do_sample/temperature/top_k/top_p/eos_token_id/seed/...) are
+        forwarded to ``paddle_tpu.generation.generate``. Returns the
+        generated ids only, [batch, max_new_tokens] int32."""
+        from ..generation.api import generate as _generate
+        return _generate(self.network, input_ids, max_new_tokens,
+                         **kwargs)
+
     # -------------------------------------------------------------- loops
     def _loader(self, data, batch_size, shuffle):
         if data is None:

@@ -35,6 +35,7 @@ class Config:
         self._int8_compute = False
         self._compile_cache_dir: Optional[str] = None
         self._math_threads = 1
+        self._generation: Optional[dict] = None
         if prog_file is not None:
             self.set_model(prog_file, params_file)
 
@@ -89,6 +90,29 @@ class Config:
 
     def set_cpu_math_library_num_threads(self, n: int):
         self._math_threads = n
+        return self
+
+    def enable_generation(self, max_new_tokens: int = 64,
+                          prefill_buckets=(64, 128, 256, 512),
+                          max_batch: int = 1, do_sample: bool = False,
+                          temperature: float = 1.0, top_k: int = 0,
+                          top_p: float = 1.0, eos_token_id=None,
+                          pad_token_id=None):
+        """Generation serving mode: the predictor AOT-compiles one
+        (prefill, decode) executable pair per prompt bucket at build
+        time and batches ``Predictor.generate()`` requests at that
+        small fixed set of right-padded prefill shapes — XLA never
+        retraces under live traffic (``jit.retraces{cause=new_shape}``
+        ≈ 0 at steady state). Requires a live layer implementing the
+        KV-cache protocol (``Config.from_layer`` with e.g.
+        ``models.gpt.GPTForCausalLM``)."""
+        self._generation = dict(
+            max_new_tokens=int(max_new_tokens),
+            prefill_buckets=tuple(sorted(int(b) for b in prefill_buckets)),
+            max_batch=int(max_batch), do_sample=bool(do_sample),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id)
         return self
 
     def set_compile_cache_dir(self, path: str):

@@ -83,6 +83,7 @@ class Predictor:
             _ensure_compile_cache(config._compile_cache_dir)
         self._feeds: Dict[str, jax.Array] = {}
         self._outputs: Dict[str, jax.Array] = {}
+        self._gen_session = None
         if config._layer is not None:
             self._build_from_layer()
         elif config._model_prefix is not None:
@@ -90,6 +91,8 @@ class Predictor:
         else:
             raise ValueError("Config names neither a saved model nor a "
                              "live layer")
+        if config._generation is not None:
+            self._build_generation()
 
     # ----------------------------------------------------------- sources
     def _build_from_artifact(self) -> None:
@@ -219,6 +222,112 @@ class Predictor:
             return jitted(vals, *cast)
 
         self._run_fn = run_fn
+
+    # -------------------------------------------------------- generation
+    def _build_generation(self) -> None:
+        """Generation serving mode (Config.enable_generation): build a
+        GenerationSession over the live layer and AOT-compile the
+        (prefill, decode) pair for every prompt bucket that fits the
+        model's position table. Requests then dispatch against warm
+        executables only. NOTE the generation path serves the layer at
+        its own parameter dtype — the Config precision casts apply to
+        the plain run() path; convert the layer (``layer.bfloat16()``)
+        for low-precision decoding."""
+        from ..generation.api import (GenerationConfig, GenerationSession,
+                                      _round_up)
+        layer = self.config._layer
+        if layer is None:
+            raise ValueError("generation mode needs a live layer: use "
+                             "Config.from_layer(...) before "
+                             "enable_generation()")
+        opts = self.config._generation
+        self._gen_opts = opts
+        self._gen_cfg = GenerationConfig(
+            do_sample=opts["do_sample"], temperature=opts["temperature"],
+            top_k=opts["top_k"], top_p=opts["top_p"],
+            eos_token_id=opts["eos_token_id"],
+            pad_token_id=opts["pad_token_id"])
+        max_new = opts["max_new_tokens"]
+        max_pos = getattr(getattr(layer, "cfg", None),
+                          "max_position_embeddings", None)
+        buckets = [b for b in opts["prefill_buckets"]
+                   if max_pos is None or b + max_new <= int(max_pos)]
+        if not buckets:
+            raise ValueError(
+                f"no prefill bucket in {opts['prefill_buckets']} fits "
+                f"max_position_embeddings={max_pos} with "
+                f"max_new_tokens={max_new}")
+        self._gen_buckets = buckets
+        self._gen_session = GenerationSession(layer)
+        for b in buckets:
+            cache_len = _round_up(b + max_new)
+            self._gen_session.aot_compile(opts["max_batch"], b,
+                                          cache_len, self._gen_cfg)
+
+    def generate(self, prompts, max_new_tokens: Optional[int] = None,
+                 seed: Optional[int] = None) -> List[np.ndarray]:
+        """Serve a batch of token-id prompts (list of sequences, or a
+        2-D array) through the AOT (prefill, decode) pair: prompts are
+        right-padded to the smallest compiled bucket, short batches are
+        padded with dummy rows to the fixed batch size, and oversized
+        request lists are chunked. Returns one 1-D int32 array of
+        generated ids per prompt (truncated before the first eos when
+        ``eos_token_id`` is configured)."""
+        if self._gen_session is None:
+            raise RuntimeError("generation mode not enabled; call "
+                               "Config.enable_generation() before "
+                               "create_predictor")
+        from ..generation.api import generate as _generate
+        opts = self._gen_opts
+        if max_new_tokens is None:
+            max_new_tokens = opts["max_new_tokens"]
+        if max_new_tokens > opts["max_new_tokens"]:
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds the compiled "
+                f"budget {opts['max_new_tokens']} (set a larger value "
+                "in enable_generation())")
+        rows = [np.asarray(p).reshape(-1).astype(np.int32)
+                for p in (prompts if not hasattr(prompts, "ndim")
+                          else list(prompts))]
+        if any(r.size < 1 for r in rows):
+            raise ValueError("empty prompt")
+        max_batch = opts["max_batch"]
+        cfg = self._gen_cfg
+        eos = cfg.eos_token_id
+        results: List[np.ndarray] = []
+        from ..generation.api import _round_up
+        for lo in range(0, len(rows), max_batch):
+            chunk = rows[lo:lo + max_batch]
+            longest = max(r.size for r in chunk)
+            bucket = next((b for b in self._gen_buckets if b >= longest),
+                          None)
+            if bucket is None:
+                raise ValueError(
+                    f"prompt of {longest} tokens exceeds the largest "
+                    f"compiled prefill bucket {self._gen_buckets[-1]}")
+            ids = np.full((max_batch, bucket), cfg.pad_value, np.int32)
+            plen = np.ones((max_batch,), np.int32)  # dummy rows: len 1
+            for i, r in enumerate(chunk):
+                ids[i, :r.size] = r
+                plen[i] = r.size
+            out = _generate(
+                self.config._layer, ids,
+                max_new_tokens=max_new_tokens, prompt_len=plen,
+                cache_max_len=_round_up(
+                    bucket + opts["max_new_tokens"]),
+                seed=seed, session=self._gen_session,
+                live_rows=len(chunk),
+                do_sample=cfg.do_sample, temperature=cfg.temperature,
+                top_k=cfg.top_k, top_p=cfg.top_p, eos_token_id=eos,
+                pad_token_id=cfg.pad_token_id)
+            out = np.asarray(out._data)[:len(chunk)]
+            for row in out:
+                if eos is not None:
+                    hits = np.nonzero(row == eos)[0]
+                    if hits.size:
+                        row = row[:hits[0]]
+                results.append(row.astype(np.int32))
+        return results
 
     # --------------------------------------------------------------- api
     def get_input_names(self) -> List[str]:

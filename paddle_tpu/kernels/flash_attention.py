@@ -815,6 +815,177 @@ def _flash_bhsd_bwd(scale, causal, block_q, block_k, kv_len, res, do):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
+# ------------------------------------------------------ decode forward
+#
+# Single-query ("decode-shaped") attention: q-len 1..8 new tokens per
+# row against a long cached K/V with a PER-ROW valid length. This is
+# the serving hot loop — one call per generated token — so the kernel
+# is forward-only (no vjp) and streams the cache through VMEM with the
+# same base-2 online softmax as the training kernels. The ragged
+# column masking generalizes `_fwd_kernel`'s scalar `kv_len` to a
+# per-row length read from SMEM, and k-blocks entirely past a row's
+# valid prefix skip their compute via `pl.when` (their DMA still runs;
+# the grid is static).
+
+_DECODE_QPAD = 8          # min fp32 sublane tile: q rows pad to this
+_DECODE_BLOCK_K = 512
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, sq, block_k, num_kblocks):
+    # q_ref holds q * (scale * log2e); scores are base-2 logits
+    ik = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kv_len = kvlen_ref[0, 0]  # this row's valid cache length (incl. the
+    #                           sq new positions, already written)
+
+    # skip k-blocks entirely past the valid prefix
+    @pl.when(ik * block_k < kv_len)
+    def _compute():
+        q = q_ref[0]                                 # [qpad, D]
+        k = k_ref[0]                                 # [bk, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [qpad, bk] base-2
+        # query row i sits at global position kv_len - sq + i: it may
+        # attend keys at cols <= kv_len - sq + i (ragged causal). Rows
+        # past sq-1 are padding; their outputs are sliced off outside.
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+            + ik * block_k
+        s = jnp.where(cols - rows <= kv_len - sq, s, _NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_kblocks - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
+                   block_k=_DECODE_BLOCK_K):
+    """q: [BH, sq<=8, D] (unscaled), caches [BH, T, D], kv_len [BH]."""
+    bh, sq, d = q.shape
+    t = k_cache.shape[1]
+    qpad = _DECODE_QPAD
+    q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    if sq < qpad:
+        q = jnp.pad(q, ((0, 0), (0, qpad - sq), (0, 0)))
+    bk = _pick_block(t, block_k)
+    nk = t // bk
+    kvlen2 = kv_len.astype(jnp.int32).reshape(bh, 1)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sq=sq, block_k=bk,
+                          num_kblocks=nk),
+        grid=(bh, nk),
+        in_specs=[
+            pl.BlockSpec((1, qpad, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, 1), lambda b, j: (b, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, qpad, d), lambda b, j: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, qpad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((qpad, _LANES), jnp.float32),
+            pltpu.VMEM((qpad, _LANES), jnp.float32),
+            pltpu.VMEM((qpad, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * qpad * t * d,
+            bytes_accessed=2 * bh * (qpad + 2 * t) * d,
+            transcendentals=bh * qpad * t),
+        interpret=_interpret(),
+    )(q, k_cache, v_cache, kvlen2)
+    return out[:, :sq]
+
+
+def _decode_xla(q, k_cache, v_cache, kv_len, scale):
+    """Fallback decode attention (CPU/interpret, or cache lengths off
+    the 128 grid): fp32 masked softmax over the [BH, sq, T] scores —
+    fine at decode sizes, never used for training shapes."""
+    bh, sq, d = q.shape
+    t = k_cache.shape[1]
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    rows = jnp.arange(sq, dtype=jnp.int32)[None, :, None]
+    cols = jnp.arange(t, dtype=jnp.int32)[None, None, :]
+    valid = cols - rows <= (kv_len.astype(jnp.int32)[:, None, None] - sq)
+    s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / jnp.where(l == 0.0, 1.0, l)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(v_cache.dtype),
+                      v_cache).astype(q.dtype)
+
+
+def flash_attention_decode(query, key_cache, value_cache, kv_len,
+                           scale=None, block_k=_DECODE_BLOCK_K):
+    """Decode-shaped attention: 1..8 new query tokens per row against a
+    cached K/V with per-row valid lengths.
+
+    query: [batch, q_len<=8, num_heads, head_dim] (framework layout).
+    key_cache/value_cache: [batch, max_len, num_kv_heads, head_dim] —
+    one layer's slice of a ``generation.KVCache`` (new tokens already
+    written). kv_len: [batch] int32 — valid entries per row INCLUDING
+    the q_len new positions; query row i attends cache columns
+    ``<= kv_len - q_len + i`` (ragged causal). GQA/MQA kv heads are
+    repeated as in ``flash_attention``.
+
+    TPU runs the Pallas kernel; other backends (and cache lengths not
+    on the 128 grid) take the XLA fallback — identical math.
+    """
+    b, sq, hq, d = query.shape
+    t, hk = key_cache.shape[1], key_cache.shape[2]
+    if sq > _DECODE_QPAD:
+        raise ValueError(
+            f"flash_attention_decode: q_len {sq} > {_DECODE_QPAD}; use "
+            "flash_attention/prefill for longer query windows")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if hk != hq:
+        assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"
+        # PERF TRAP (dormant — no shipped config uses hk < hq yet):
+        # this materializes group-size copies of both caches per call.
+        # Before enabling a GQA model, switch to head-index mapping in
+        # the [B*H] flatten (or group rows inside the kernel) so decode
+        # HBM traffic stays at the hk-sized cache.
+        key_cache = jnp.repeat(key_cache, hq // hk, axis=2)
+        value_cache = jnp.repeat(value_cache, hq // hk, axis=2)
+    qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
+    kt = jnp.swapaxes(key_cache, 1, 2).reshape(b * hq, t, d)
+    vt = jnp.swapaxes(value_cache, 1, 2).reshape(b * hq, t, d)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    kl = jnp.repeat(kv_len, hq)                       # [B*H]
+    use_pallas = (jax.default_backend() == "tpu"
+                  and t % 128 == 0 and d in (64, 128, 256))
+    if use_pallas:
+        out = _decode_pallas(qt, kt, vt, kl, float(scale), block_k)
+    else:
+        out = _decode_xla(qt, kt, vt, kl, float(scale))
+    return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
+
+
 def flash_attention(query, key, value, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Flash attention over [batch, seq, num_heads, head_dim] inputs

@@ -88,10 +88,13 @@ class ErnieEmbeddings(Layer):
                                     epsilon=cfg.layer_norm_epsilon)
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, input_ids, token_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None, pos=None):
         b, s = input_ids.shape
         from .. import ops
-        pos = ops.creation.arange(s, dtype="int32")
+        if pos is None:
+            pos = ops.creation.arange(s, dtype="int32")
+        elif not isinstance(pos, Tensor):
+            pos = Tensor(pos)  # decode: [b, s] offsets from the KV cache
         x = self.word_embeddings(input_ids) \
             + self.position_embeddings(pos)
         if token_type_ids is None:
@@ -113,12 +116,24 @@ class ErnieSelfAttention(Layer):
                                 P("mp", None), P())
         self.dropout_p = cfg.attention_dropout
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, layer_idx=0,
+                decode=False):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = sharded_constraint(qkv, P(("dp", "sharding"), None, "mp"))
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
+        if cache is not None:
+            # incremental encoding (eval): cache this layer's k/v so a
+            # growing sequence never recomputes the prefix — shared
+            # choreography in generation/attention.py; ERNIE's prefill
+            # attends bidirectionally, appended tokens attend the whole
+            # cached prefix (+ causally within their own window)
+            from ..generation.attention import cached_attention
+            out, cache = cached_attention(
+                q, k, v, cache, layer_idx, decode=decode, causal=False,
+                attn_mask=attn_mask)
+            return self.out_proj(out.reshape([b, s, h])), cache
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=False,
             dropout_p=self.dropout_p, training=self.training)
@@ -142,7 +157,16 @@ class ErnieLayer(Layer):
         self.dropout = Dropout(cfg.dropout)
         self.act = cfg.hidden_act
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, layer_idx=0,
+                decode=False):
+        if cache is not None:
+            a, cache = self.attn(x, attn_mask, cache=cache,
+                                 layer_idx=layer_idx, decode=decode)
+            x = self.ln1(x + a)
+            h = self.fc1(x)
+            h = F.gelu(h, approximate=True) if self.act == "gelu" \
+                else F.relu(h)
+            return self.ln2(x + self.fc2(h)), cache
         x = self.ln1(x + self.dropout(self.attn(x, attn_mask)))
         h = self.fc1(x)
         h = F.gelu(h, approximate=True) if self.act == "gelu" else F.relu(h)
@@ -171,8 +195,13 @@ class ErnieModel(Layer):
                                  for _ in range(cfg.num_layers)])
         self.pooler = ErniePooler(cfg) if cfg.with_pooler else None
 
-    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
-        """Returns (sequence_output, pooled_output-or-None).
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None,
+                cache=None, use_cache=False, prompt_len=None,
+                cache_max_len=None):
+        """Returns (sequence_output, pooled_output-or-None) — plus the
+        KV cache as a third element under ``use_cache``/``cache``
+        (incremental encoding: prefill fills the cache, later calls
+        append tokens without recomputing the prefix).
         attn_mask: [b, s] 1/0 padding mask, or a broadcastable additive
         [b, 1, s, s] mask; converted to additive here."""
         if attn_mask is not None and len(attn_mask.shape) == 2:
@@ -181,6 +210,10 @@ class ErnieModel(Layer):
                 else attn_mask
             add = (1.0 - m.astype("float32")) * -1e9
             attn_mask = Tensor(add[:, None, None, :])
+        if cache is not None or use_cache:
+            return self._forward_cached(input_ids, token_type_ids,
+                                        attn_mask, cache, prompt_len,
+                                        cache_max_len)
         x = self.embeddings(input_ids, token_type_ids)
         if self.cfg.use_recompute and self.training:
             from .gpt import _remat_policy
@@ -194,6 +227,44 @@ class ErnieModel(Layer):
                 x = layer(x, attn_mask)
         pooled = self.pooler(x) if self.pooler is not None else None
         return x, pooled
+
+    def _forward_cached(self, input_ids, token_type_ids, attn_mask,
+                        cache, prompt_len, cache_max_len):
+        """Incremental-encoding forward (eval only): returns
+        (sequence_output, pooled-or-None, cache); ``pooled`` is filled
+        on prefill only (decode windows don't contain CLS — it stays
+        None there). NOTE ragged prefill
+        (per-row ``prompt_len`` shorter than the padded width) is NOT
+        masked here — bidirectional attention would see the pad keys;
+        pass an explicit [b, s] attn_mask for padded prefill."""
+        from ..generation.kv_cache import KVCache
+        b, s = input_ids.shape
+        decode = cache is not None
+        if decode:
+            x = self.embeddings(input_ids, token_type_ids,
+                                pos=cache.positions(s))
+        else:
+            x = self.embeddings(input_ids, token_type_ids)
+            max_len = int(cache_max_len
+                          or self.cfg.max_position_embeddings)
+            cache = KVCache.create(
+                self.cfg.num_layers, b, max_len, self.cfg.num_heads,
+                self.cfg.hidden_size // self.cfg.num_heads,
+                dtype=x._data.dtype)
+        for i, layer in enumerate(self.layers):
+            x, cache = layer(x, attn_mask, cache=cache, layer_idx=i,
+                             decode=decode)
+        if decode:
+            cache = cache.with_kv_len(cache.kv_len + s)
+        else:
+            cache = cache.with_kv_len(
+                s if prompt_len is None else prompt_len)
+        # pooled output only on prefill: on decode x holds just the
+        # appended tokens, so x[:, 0] is NOT the CLS position — pooling
+        # it would return a silently wrong sentence embedding
+        pooled = self.pooler(x) if self.pooler is not None \
+            and not decode else None
+        return x, pooled, cache
 
 
 class ErnieMLMHead(Layer):

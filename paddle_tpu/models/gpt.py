@@ -115,13 +115,26 @@ class GPTAttention(Layer):
         self.dropout_p = cfg.dropout
         self.sequence_parallel = cfg.sequence_parallel
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, layer_idx=0,
+                decode=False):
         b, s, h = x.shape
         seq = "sp" if self.sequence_parallel else None
         qkv = self.qkv_proj(x)
         qkv = sharded_constraint(qkv, P(("dp", "sharding"), seq, "mp"))
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
+        if cache is not None:
+            # generation path (eval) — shared cache choreography in
+            # generation/attention.py; GPT attends causally on prefill
+            if self.sequence_parallel:
+                raise NotImplementedError(
+                    "KV-cache generation under sequence_parallel ring "
+                    "attention is not supported")
+            from ..generation.attention import cached_attention
+            out, cache = cached_attention(
+                q, k, v, cache, layer_idx, decode=decode, causal=True,
+                attn_mask=attn_mask)
+            return self.out_proj(out.reshape([b, s, h])), cache
         if self.sequence_parallel:
             if attn_mask is not None:
                 raise ValueError(
@@ -176,7 +189,14 @@ class GPTBlock(Layer):
         else:
             self.mlp = GPTMLP(cfg)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, layer_idx=0,
+                decode=False):
+        if cache is not None:
+            a, cache = self.attn(self.ln1(x), attn_mask, cache=cache,
+                                 layer_idx=layer_idx, decode=decode)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, cache
         x = x + self.attn(self.ln1(x), attn_mask)
         x = x + self.mlp(self.ln2(x))
         return x
@@ -200,10 +220,13 @@ class GPTEmbeddings(Layer):
         self.drop = Dropout(cfg.dropout)
         self.sequence_parallel = cfg.sequence_parallel
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, pos=None):
         b, s = input_ids.shape
         from .. import ops
-        pos = ops.creation.arange(s, dtype="int32")
+        if pos is None:
+            pos = ops.creation.arange(s, dtype="int32")
+        elif not isinstance(pos, Tensor):
+            pos = Tensor(pos)  # decode: [b, s] offsets from the KV cache
         x = self.wte(input_ids) + self.wpe(pos)
         seq = "sp" if getattr(self, "sequence_parallel", False) else None
         x = sharded_constraint(x, P(("dp", "sharding"), seq, None))
@@ -253,7 +276,11 @@ class GPTModel(Layer):
         #: trace); None when the plain path ran (read l_aux attrs then)
         self._moe_aux = None
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None,
+                use_cache=False, prompt_len=None, cache_max_len=None):
+        if cache is not None or use_cache:
+            return self._forward_cached(input_ids, attn_mask, cache,
+                                        prompt_len, cache_max_len)
         x = self.embed(input_ids)
         self._moe_aux = None
         moe = self.cfg.moe_num_experts > 0
@@ -275,6 +302,36 @@ class GPTModel(Layer):
                 x = block(x, attn_mask)
         return self.ln_f(x)
 
+    def _forward_cached(self, input_ids, attn_mask, cache, prompt_len,
+                        cache_max_len):
+        """Generation forward (eval only): prefill creates + fills the
+        KV cache (``cache=None``), decode consumes one. Returns
+        (hidden, cache). ``prompt_len`` [b] marks each row's true
+        length in a right-padded prompt; kv_len advances to it so the
+        pad tail is invisible to (and overwritten by) decode steps."""
+        from ..generation.kv_cache import KVCache
+        b, s = input_ids.shape
+        decode = cache is not None
+        if decode:
+            x = self.embed(input_ids, pos=cache.positions(s))
+        else:
+            x = self.embed(input_ids)
+            max_len = int(cache_max_len
+                          or self.cfg.max_position_embeddings)
+            cache = KVCache.create(
+                self.cfg.num_layers, b, max_len, self.cfg.num_heads,
+                self.cfg.hidden_size // self.cfg.num_heads,
+                dtype=x._data.dtype)
+        for i, block in enumerate(self.blocks):
+            x, cache = block(x, attn_mask, cache=cache, layer_idx=i,
+                             decode=decode)
+        if decode:
+            cache = cache.with_kv_len(cache.kv_len + s)
+        else:
+            cache = cache.with_kv_len(
+                s if prompt_len is None else prompt_len)
+        return self.ln_f(x), cache
+
 
 class GPTForCausalLM(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -288,7 +345,11 @@ class GPTForCausalLM(Layer):
         else:
             self.lm_head = None
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None,
+                use_cache=False, prompt_len=None, cache_max_len=None):
+        if cache is not None or use_cache:
+            return self._forward_cached(input_ids, attn_mask, cache,
+                                        prompt_len, cache_max_len)
         h = self.gpt(input_ids, attn_mask)
         if self.cfg.fused_lm_loss:
             # ship the head weight WITH the output (cloned while any
@@ -301,6 +362,42 @@ class GPTForCausalLM(Layer):
             return h, w.clone()
         return _lm_logits(h, self.lm_head,
                           self.gpt.embed.wte.weight)
+
+    def _forward_cached(self, input_ids, attn_mask, cache, prompt_len,
+                        cache_max_len):
+        """Generation forward: returns (logits, cache). Prefill returns
+        next-token logits only ([b, 1, vocab], gathered at each row's
+        last REAL position — the [b, s, vocab] prompt logits are never
+        materialized); decode returns logits for all (1..8) new
+        positions. Always the real LM head, even under fused_lm_loss
+        (generation samples from logits, not a loss)."""
+        import jax.numpy as jnp
+        decode = cache is not None
+        h, cache = self.gpt(input_ids, attn_mask, cache=cache,
+                            use_cache=True, prompt_len=prompt_len,
+                            cache_max_len=cache_max_len)
+        if not decode:
+            from ..core.tensor import dispatch
+            b, s = input_ids.shape
+            if prompt_len is None:
+                h = h[:, s - 1:s]
+            else:
+                idx = jnp.asarray(
+                    prompt_len._data if isinstance(prompt_len, Tensor)
+                    else prompt_len, jnp.int32) - 1
+                h = dispatch(
+                    "gather_last_hidden",
+                    lambda hr, ir: jnp.take_along_axis(
+                        hr, ir[:, None, None], axis=1),
+                    (h, idx), {}, differentiable=False)
+        logits = _lm_logits(h, self.lm_head, self.gpt.embed.wte.weight)
+        return logits, cache
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        """Autoregressive decoding with the KV cache — see
+        ``paddle_tpu.generation.generate`` for sampling options."""
+        from ..generation.api import generate as _generate
+        return _generate(self, input_ids, max_new_tokens, **kwargs)
 
     def _fused_loss(self, hidden, labels, w):
         """Chunked LM-head + cross-entropy: scan sequence chunks, each
