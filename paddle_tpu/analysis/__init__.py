@@ -1,0 +1,33 @@
+"""Static program analysis: jaxpr-level audits of jitted programs.
+
+The reference framework dedicates whole subsystems to catching bad
+programs before/as they run (``phi/core/enforce.h``, PAPER.md §1 layer
+0). The jax-native equivalent is cheaper and stronger: any program this
+framework jits can be TRACED WITHOUT EXECUTING and audited as data.
+
+    from paddle_tpu import analysis
+    report = analysis.audit(step_fn, params, opt_state, lr, n, *batch,
+                            donate=(0, 1))
+    report.raise_on_error()          # tier-1 gate: zero ERROR findings
+    assert report.donation_coverage == 1.0
+
+Detector passes (see ``detectors.py``): donation misses, host-callback
+syncs, dtype leaks (fp64 / bf16-region upcasts), over-budget baked
+constants, and per-mesh-axis collective byte accounting (cross-checked
+against the runtime ``comm.bytes`` counters via
+``cross_check_collectives``). The flagship programs expose ready-made
+entry points: ``TrainStep.audit()``, ``DistributedTrainStep.audit()``,
+``GenerationSession.audit()``, ``Predictor.audit_generation()``.
+
+The sibling static layer for *Python* (not traced programs) is the
+framework lint: ``python -m tools.lint paddle_tpu tests``.
+"""
+from .auditor import (AuditError, AuditReport, Finding, Severity,
+                      abstractify, audit, cross_check_collectives)
+from .detectors import AuditContext, DETECTORS, register_detector
+
+__all__ = [
+    "AuditContext", "AuditError", "AuditReport", "DETECTORS", "Finding",
+    "Severity", "abstractify", "audit", "cross_check_collectives",
+    "register_detector",
+]

@@ -1,0 +1,199 @@
+"""``audit()``: trace a program to its jaxpr and run detector passes.
+
+The entry point of the static-analysis layer (ISSUE 7 / reference
+enforce.h analog): where the reference spends whole subsystems catching
+bad programs *as they run*, a jax program can be traced WITHOUT
+executing and audited as data. ``audit(fn, *abstract_args)`` does
+exactly that — abstract inputs in, findings with severity and
+``file.py:line`` provenance out — so the invariants the perf/serving
+PRs established (donated state, no host syncs in hot paths, bf16-pure
+compute, no baked weights) hold for every current and future jitted
+program, enforced in tier-1.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+import jax
+
+from .detectors import DETECTORS, AuditContext
+from .findings import AuditError, AuditReport, Finding, Severity  # noqa: F401 (re-export)
+
+
+def abstractify(tree):
+    """Map a pytree of arrays/Tensors/numbers to ShapeDtypeStructs so
+    audits never hold (or transfer) real buffers."""
+    from ..core.tensor import Tensor
+
+    def _one(x):
+        if isinstance(x, Tensor):
+            x = x._data
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return x  # python scalars etc.: jax abstracts them itself
+
+    return jax.tree_util.tree_map(
+        _one, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _norm_argnums(argnums) -> tuple:
+    if argnums is None:
+        return ()
+    if isinstance(argnums, int):
+        return (argnums,)
+    return tuple(int(i) for i in argnums)
+
+
+def _allowed(finding: Finding, allow: Sequence[str]) -> bool:
+    """allow entries: a check id ("donation.miss", or a prefix like
+    "host_sync"), optionally "@source-substring" to scope it to one
+    call site ("host_sync@my_file.py")."""
+    for entry in allow:
+        check, _, where = entry.partition("@")
+        if check and not (finding.check == check
+                          or finding.check.startswith(check + ".")):
+            continue
+        if where and where not in (finding.source + " " + finding.message):
+            continue
+        return True
+    return False
+
+
+def audit(fn, *args, donate=(), static_argnums=(), name: Optional[str] = None,
+          checks: Optional[Iterable[str]] = None,
+          allow: Sequence[str] = (),
+          min_donation_bytes: int = 1024,
+          const_budget_bytes: int = 1 << 20,
+          bf16_compute: bool = False) -> AuditReport:
+    """Trace ``fn`` on abstract inputs and run the detector passes.
+
+    args: example inputs — real arrays, Tensors, or
+    ``ShapeDtypeStruct``s (everything is abstractified; nothing
+    executes and no buffer is allocated). Positional only, so a
+    misspelled audit option raises here instead of being silently
+    handed to ``fn`` as a traced operand. ``donate`` mirrors jit's
+    ``donate_argnums`` — the donation the DEPLOYED program uses (pass
+    the TPU intent even when auditing on CPU, where frameworks often
+    disable donation). ``static_argnums`` mirrors jit. ``checks``
+    selects a subset of detector passes; ``allow`` suppresses findings
+    (entries: check id, optionally ``@source-substring``) — suppressed
+    findings stay in the report at INFO with ``data['allowed']``.
+
+    Returns an :class:`AuditReport`; call ``.raise_on_error()`` to turn
+    ERROR findings into a failing assertion (the tier-1 gate pattern).
+    """
+    donate = _norm_argnums(donate)
+    static = set(_norm_argnums(static_argnums))
+    abstract_args = tuple(abstractify(a) if i not in static else a
+                          for i, a in enumerate(args))
+    # return_shape=True hands back the output avals in the function's
+    # own pytree structure (= eval_shape's result) from the SAME trace,
+    # so callers chaining audits (prefill -> decode) never re-trace
+    # just to recover operand shapes
+    closed, out_shape = jax.make_jaxpr(
+        fn, static_argnums=sorted(static), return_shape=True)(
+        *abstract_args)
+
+    # flatten the dynamic inputs in invar order with the donation mask
+    in_avals = list(closed.in_avals)
+    donated = []
+    for i, a in enumerate(abstract_args):
+        if i in static:
+            continue
+        n = len(jax.tree_util.tree_leaves(a))
+        donated.extend([i in donate] * n)
+    if len(donated) != len(in_avals):
+        # tracing-order mismatch (exotic pytree): fail safe — donation
+        # analysis would misattribute buffers, so skip it loudly
+        donated = None
+
+    name = name or getattr(fn, "__name__", "program")
+    options = {"min_donation_bytes": min_donation_bytes,
+               "const_budget_bytes": const_budget_bytes,
+               "bf16_compute": bf16_compute}
+    ctx = AuditContext(
+        closed_jaxpr=closed, name=name, in_avals=in_avals,
+        donated=donated if donated is not None else [False] * len(in_avals),
+        out_avals=list(closed.out_avals), options=options)
+
+    selected = dict(DETECTORS)
+    if checks is not None:
+        unknown = set(checks) - set(DETECTORS)
+        if unknown:
+            raise ValueError(f"unknown detector(s) {sorted(unknown)}; "
+                             f"have {sorted(DETECTORS)}")
+        selected = {k: DETECTORS[k] for k in checks}
+    if donated is None and "donation" in selected:
+        del selected["donation"]
+
+    findings = []
+    if donated is None:
+        findings.append(Finding(
+            "donation.skipped", Severity.INFO,
+            "input flattening did not line up with the traced invars; "
+            "donation analysis skipped"))
+    for detector in selected.values():
+        findings.extend(detector(ctx))
+
+    for f in findings:
+        if f.severity > Severity.INFO and _allowed(f, allow):
+            f.severity = Severity.INFO
+            f.data["allowed"] = True
+
+    report = AuditReport(
+        name, findings, donation=options.get("_donation"),
+        collectives=options.get("_collectives"))
+    report.out_shape = out_shape
+    # distinguish "pass ran and found nothing" from "pass never ran":
+    # cross_check_collectives refuses an unchecked report instead of
+    # reporting a spurious 0-vs-measured mismatch, and
+    # donation_coverage raises instead of reading a vacuous 1.0
+    report.collectives_checked = "_collectives" in options
+    report.donation_checked = "_donation" in options
+    from ..core import monitor
+    if monitor.enabled:
+        report.record()
+    return report
+
+
+def cross_check_collectives(report: AuditReport, snapshot=None,
+                            rtol: float = 0.0) -> AuditReport:
+    """Cross-check the report's static per-axis collective bytes
+    against the runtime ``comm.bytes{axis=...}`` counters (PR 2). Pass
+    the ``metrics.snapshot()`` of exactly ONE execution of the audited
+    program (enable -> run once -> snapshot). Appends a WARNING per
+    axis whose measured bytes diverge from the static estimate beyond
+    ``rtol`` — a divergence means the program's collectives are not the
+    ones the monitor thinks it is issuing (or vice versa)."""
+    if not getattr(report, "collectives_checked", True):
+        raise ValueError(
+            f"audit[{report.name}] ran without the 'collectives' "
+            "detector (checks= excluded it), so its static accounting "
+            "is absent, not zero; re-audit with the collectives pass "
+            "before cross-checking")
+    if snapshot is None:
+        from ..core import metrics
+        snapshot = metrics.snapshot()
+    measured = {}
+    for key, entry in snapshot.items():
+        if not key.startswith("comm.bytes{"):
+            continue
+        tags = dict(kv.split("=", 1)
+                    for kv in key[len("comm.bytes{"):-1].split(",")
+                    if "=" in kv)
+        ax = tags.get("axis")
+        if ax is not None and "op" in tags:
+            measured[ax] = measured.get(ax, 0) + int(entry["value"])
+    for ax in sorted(set(report.collectives) | set(measured)):
+        stat = report.collectives.get(ax, 0)
+        meas = measured.get(ax, 0)
+        tol = rtol * max(stat, meas)
+        if abs(stat - meas) > tol:
+            report.findings.append(Finding(
+                "collective.mismatch", Severity.WARNING,
+                f"axis {ax!r}: static accounting says {stat} bytes/step"
+                f", the comm.bytes counters measured {meas}",
+                data={"axis": ax, "static": stat, "measured": meas}))
+    return report

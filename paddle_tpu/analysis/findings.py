@@ -1,0 +1,151 @@
+"""Finding/report types for the program auditor.
+
+A ``Finding`` is one detected property violation with a severity and —
+whenever the detector had an equation to point at — ``source`` set to
+jax's ``file.py:line (fn)`` provenance for the offending operation, so
+a CI failure names the line of model/step code to fix, not the auditor.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+
+class Severity(enum.IntEnum):
+    """ERROR findings fail tier-1 audit gates; WARNING findings are
+    budgeted (donation coverage thresholds); INFO is accounting."""
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    # python >= 3.11 switched IntEnum str/format to the integer form;
+    # pin the name so reports and metric tags are stable across versions
+    def __str__(self):
+        return self.name
+
+    def __format__(self, spec):
+        return format(self.name, spec)
+
+
+@dataclasses.dataclass
+class Finding:
+    check: str                 # detector id, e.g. "donation.miss"
+    severity: Severity
+    message: str
+    source: str = ""           # "file.py:line (fn)" from eqn.source_info
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def __str__(self):
+        src = f" [{self.source}]" if self.source else ""
+        return f"{self.severity:>7}  {self.check}: {self.message}{src}"
+
+    def __format__(self, spec):
+        return format(str(self), spec)
+
+
+class AuditReport:
+    """All findings from one ``audit()`` run plus the accounting the
+    tier-1 gates assert on (donation coverage, per-axis collective
+    bytes)."""
+
+    def __init__(self, name: str, findings: List[Finding],
+                 donation: Optional[dict] = None,
+                 collectives: Optional[Dict[str, int]] = None):
+        self.name = name
+        self.findings = list(findings)
+        #: {'donated_bytes', 'missed_bytes', 'unused_bytes', 'coverage'}
+        self.donation = donation or {
+            "donated_bytes": 0, "missed_bytes": 0, "unused_bytes": 0,
+            "coverage": 1.0}
+        #: static per-mesh-axis collective payload bytes
+        self.collectives = dict(collectives or {})
+        #: the audited function's outputs as ShapeDtypeStructs in their
+        #: original pytree structure (set by audit(); = eval_shape of
+        #: the program, recovered from the same trace) — lets callers
+        #: chain audits without re-tracing
+        self.out_shape = None
+        #: False when audit(checks=...) excluded the collectives pass:
+        #: ``collectives == {}`` then means "not analyzed", not "none",
+        #: and cross_check_collectives refuses to compare against it
+        self.collectives_checked = True
+        #: False when the donation pass did not run (excluded via
+        #: checks=, or the invar/leaf-count fail-safe skipped it):
+        #: donation_coverage then RAISES instead of reading a vacuous
+        #: 1.0 through a tier-1 gate
+        self.donation_checked = True
+
+    # ------------------------------------------------------------ slicing
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    def by_check(self, check: str) -> List[Finding]:
+        """Findings whose check id equals ``check`` or is nested under
+        it (``by_check('dtype')`` matches ``dtype.fp64``)."""
+        return [f for f in self.findings
+                if f.check == check or f.check.startswith(check + ".")]
+
+    @property
+    def donation_coverage(self) -> float:
+        """donated / (donated + missed) bytes over inputs whose
+        shape/dtype matches an output (1.0 when nothing is donatable).
+        Raises when the donation pass did not run — an absent analysis
+        must never satisfy a coverage gate as a vacuous 1.0."""
+        if not self.donation_checked:
+            raise ValueError(
+                f"audit[{self.name}] ran without the donation pass "
+                "(checks= excluded it, or input flattening did not "
+                "line up with the traced invars); its coverage is "
+                "unknown, not 1.0 — re-audit with the 'donation' "
+                "detector")
+        return float(self.donation.get("coverage", 1.0))
+
+    # ------------------------------------------------------------- output
+    def raise_on_error(self):
+        if self.errors:
+            raise AuditError(self)
+        return self
+
+    def summary(self) -> str:
+        cov = (f"{self.donation_coverage:.2f}" if self.donation_checked
+               else "n/a (pass not run)")
+        lines = [f"audit[{self.name}]: {len(self.errors)} error(s), "
+                 f"{len(self.warnings)} warning(s), "
+                 f"{len(self.findings)} finding(s); donation coverage "
+                 f"{cov}"]
+        for f in sorted(self.findings, key=lambda f: -int(f.severity)):
+            lines.append(f"  {f}")
+        for axis, nbytes in sorted(self.collectives.items()):
+            lines.append(f"  collective[{axis}]: {nbytes} bytes/step")
+        return "\n".join(lines)
+
+    def record(self):
+        """Count findings into the runtime monitor
+        (``analysis.findings{check=...}``) — audit() calls this when
+        the monitor is enabled, so CI dashboards trend lint/audit debt
+        alongside the runtime counters."""
+        from ..core import monitor
+        for f in self.findings:
+            monitor.record_analysis_finding(f.check, f.severity.name)
+        return self
+
+    def __str__(self):
+        return self.summary()
+
+    def __repr__(self):
+        return (f"AuditReport({self.name!r}, errors={len(self.errors)}, "
+                f"warnings={len(self.warnings)})")
+
+
+class AuditError(AssertionError):
+    """Raised by AuditReport.raise_on_error(); the message carries the
+    full report so a CI failure is self-explaining."""
+
+    def __init__(self, report: AuditReport):
+        self.report = report
+        super().__init__(report.summary())

@@ -1,0 +1,104 @@
+"""Jaxpr walking utilities shared by the auditor's detector passes.
+
+A traced program is a tree of jaxprs: the top-level ``ClosedJaxpr`` plus
+every sub-jaxpr baked into equation params (``pjit``/``closed_call``
+bodies, ``scan``/``while`` carries, ``cond`` branches, ``shard_map``
+regions, custom-derivative rules). Detectors care about *every* level —
+a host callback hidden three ``pjit`` layers down is still a host
+callback — so the walkers here recurse uniformly and carry the
+axis-size environment (from ``shard_map`` meshes and ``pmap`` params)
+that collective accounting needs.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Tuple
+
+import numpy as np
+
+
+def source_of(eqn) -> str:
+    """``file.py:line (fn)`` provenance for one equation, via jax's own
+    source-info summarizer; degrades to "" on jaxprs that were built
+    without source info (e.g. deserialized programs)."""
+    try:
+        from jax._src import source_info_util
+        return str(source_info_util.summarize(eqn.source_info))
+    except Exception:
+        return ""
+
+
+def aval_bytes(aval) -> int:
+    """On-device bytes of one abstract value (0 for non-array avals,
+    e.g. abstract tokens from effectful primitives)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _sub_jaxprs(eqn):
+    """(open_jaxpr, consts_or_None) for every sub-jaxpr in an equation's
+    params. ClosedJaxpr params contribute their own consts (they are
+    separately baked into the program); open Jaxpr params share the
+    parent's."""
+    for val in eqn.params.values():
+        items = val if isinstance(val, (list, tuple)) else (val,)
+        for item in items:
+            if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                yield item.jaxpr, item
+            elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                yield item, None
+
+
+def _axis_sizes_of(eqn) -> Dict[str, int]:
+    """Named-axis sizes an equation brings into scope: shard_map carries
+    a Mesh param; pmap carries (axis_name, axis_size)."""
+    sizes: Dict[str, int] = {}
+    mesh = eqn.params.get("mesh")
+    if mesh is not None and hasattr(mesh, "shape"):
+        try:
+            sizes.update({str(k): int(v) for k, v in
+                          dict(mesh.shape).items()})
+        except Exception:
+            pass
+    name = eqn.params.get("axis_name")
+    size = eqn.params.get("axis_size")
+    if name is not None and size is not None:
+        for n in (name if isinstance(name, (list, tuple)) else (name,)):
+            sizes[str(n)] = int(size)
+    return sizes
+
+
+def walk_eqns(closed_jaxpr) -> Iterator[Tuple[object, Dict[str, int], int]]:
+    """Yield ``(eqn, axis_sizes, depth)`` for every equation at every
+    nesting level. ``axis_sizes`` maps named mesh/pmap axes visible at
+    that equation to their sizes (for collective byte accounting)."""
+
+    def _walk(jaxpr, env: Dict[str, int], depth: int):
+        for eqn in jaxpr.eqns:
+            yield eqn, env, depth
+            inner = _axis_sizes_of(eqn)
+            sub_env = {**env, **inner} if inner else env
+            for sub, _ in _sub_jaxprs(eqn):
+                yield from _walk(sub, sub_env, depth + 1)
+
+    yield from _walk(closed_jaxpr.jaxpr, {}, 0)
+
+
+def walk_closed(closed_jaxpr) -> Iterator[object]:
+    """Yield every ClosedJaxpr in the tree (top level first): each one
+    owns ``consts`` that get baked into the compiled program."""
+    yield closed_jaxpr
+
+    def _walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for sub, closed in _sub_jaxprs(eqn):
+                if closed is not None:
+                    yield closed
+                yield from _walk(sub)
+
+    yield from _walk(closed_jaxpr.jaxpr)

@@ -35,10 +35,38 @@ Metric name scheme (what the summary views group by):
     errors.swallowed{where=...} deliberately swallowed exceptions
     gen.tokens / gen.prefill_steps / gen.decode_steps   generation loop
     gen.cache_occupancy         gauge: KV cache fraction in use
+    analysis.findings{check=,severity=}   static-audit findings
 """
 from __future__ import annotations
 
 from . import metrics
+
+# The declared metric-name families. Every hot-path call site records
+# through this module's recorders, so this set IS the schema; the
+# framework lint (tools/lint rule `metric-name`) parses this literal
+# and rejects any `metrics.counter("...")` elsewhere in the package
+# whose name is not declared here — an undeclared name is either a typo
+# (a counter nobody will ever read) or a missing schema entry.
+DECLARED_METRICS = frozenset({
+    "jit.compile", "jit.compile.total",
+    "static.program_builds", "static.ops_recorded",
+    "comm.ops", "comm.bytes",
+    "io.batches", "io.samples", "io.bytes", "io.batch_bytes",
+    "io.worker.deaths", "io.worker.respawns", "io.sample.quarantined",
+    "io.host2device.placed", "io.host2device.skipped",
+    "io.host2device.bytes",
+    "train.loss_fetches", "train.host_syncs",
+    "amp.scaler.steps", "amp.scaler.skipped", "amp.loss_scale",
+    "device.memory.allocated", "device.memory.reserved",
+    "resilience.preemptions", "resilience.emergency_saves",
+    "resilience.emergency_save_step", "resilience.watchdog.timeouts",
+    "resilience.ckpt.fallback", "resilience.ckpt.last_skipped_step",
+    "train.anomalies", "train.anomaly_restores",
+    "errors.swallowed",
+    "gen.tokens", "gen.prefill_steps", "gen.decode_steps",
+    "gen.cache_occupancy",
+    "analysis.findings",
+})
 
 enabled = False  # mirrored from metrics.enable()/disable()
 
@@ -245,6 +273,19 @@ def record_cache_occupancy(frac: float):
     if not enabled:
         return
     metrics.gauge("gen.cache_occupancy").set(float(frac))
+
+
+# ------------------------------------------------------- analysis layer
+
+def record_analysis_finding(check: str, severity: str, n: int = 1):
+    """One static-analysis finding (program auditor): counted per
+    detector check id and severity so CI can trend audit debt the way
+    it trends retraces."""
+    if not enabled:
+        return
+    metrics.counter("analysis.findings", check=check,
+                    severity=severity).inc(int(n))
+    metrics.counter("analysis.findings").inc(int(n))
 
 
 # ---------------------------------------------------------- device layer

@@ -166,6 +166,7 @@ class DistributedTrainStep:
         # matcher is sharding-aware; the fallback costs one transient
         # copy of params+state, it never changes numerics
         donate = (0, 1) if SHARDING_AWARE_DONATION else ()
+        self._step_fn = step_fn
         self._jitted = jax.jit(
             step_fn, donate_argnums=donate,
             out_shardings=(NamedSharding(m, P()),
@@ -251,16 +252,14 @@ class DistributedTrainStep:
             parts[lead] = DATA_AXES
         return P(*parts)
 
-    def lower_abstract(self, *batch):
-        """jax Lowered built from abstract (ShapeDtypeStruct) operands:
-        no parameter, optimizer-state, or batch buffer is ever placed
-        on the mesh, so meshes far larger than host memory compile-plan
-        fine. `batch` leaves may be arrays, Tensors, or
-        ShapeDtypeStructs — only shape/dtype are read."""
-        if self._jitted is None:
-            self._build(None)
+    def _abstract_operands(self, *batch):
+        """ShapeDtypeStruct operands for step_fn — shapes, dtypes AND
+        shardings, exactly what the compiled program runs with. The ONE
+        construction shared by lower_abstract() and audit(), so the
+        audited program can never drift from the lowered one. `batch`
+        leaves may be arrays, Tensors, or ShapeDtypeStructs — only
+        shape/dtype are read."""
         m, s = self.mesh, self.strategy
-
         p_avals = [jax.ShapeDtypeStruct(tuple(p.data.shape), p.data.dtype,
                                         sharding=sh)
                    for p, sh in zip(self._params, self._param_shardings)]
@@ -289,6 +288,17 @@ class DistributedTrainStep:
             jax.tree_util.tree_map(
                 leaf_aval, b, is_leaf=lambda t: isinstance(t, Tensor))
             for b in batch)
+        return p_avals, opt_avals, lr_aval, no_aval, batch_avals
+
+    def lower_abstract(self, *batch):
+        """jax Lowered built from abstract (ShapeDtypeStruct) operands:
+        no parameter, optimizer-state, or batch buffer is ever placed
+        on the mesh, so meshes far larger than host memory compile-plan
+        fine."""
+        if self._jitted is None:
+            self._build(None)
+        p_avals, opt_avals, lr_aval, no_aval, batch_avals = \
+            self._abstract_operands(*batch)
         return self._jitted.lower(p_avals, opt_avals, lr_aval, no_aval,
                                   *batch_avals)
 
@@ -296,6 +306,24 @@ class DistributedTrainStep:
         """XLA cost analysis of the compiled distributed step."""
         ca = self.lower(*batch).compile().cost_analysis()
         return ca[0] if isinstance(ca, (list, tuple)) else ca
+
+    def audit(self, *batch, donate=(0, 1), **audit_kw):
+        """Static audit of the sharded step on abstract operands (works
+        for ``abstract=True`` plan-only steps too — nothing is placed
+        on the mesh). ``donate`` defaults to the DESIGN intent (params
+        + opt state donated) even where the running jax disables
+        donation via the SHARDING_AWARE_DONATION shim: the audit checks
+        the program we ship on TPU, not the fallback."""
+        from ...analysis import audit as _audit
+        if self._jitted is None:
+            self._build(None)
+        p_avals, opt_avals, lr_aval, no_aval, batch_avals = \
+            self._abstract_operands(*batch)
+        audit_kw.setdefault("name", "DistributedTrainStep.step_fn")
+        with self.mesh:
+            return _audit(self._step_fn, p_avals, opt_avals, lr_aval,
+                          no_aval, *batch_avals, donate=donate,
+                          **audit_kw)
 
     def __call__(self, *batch):
         params = self._params

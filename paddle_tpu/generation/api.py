@@ -195,6 +195,50 @@ class GenerationSession:
                                       str(cfg)), pre)
         return out
 
+    # ------------------------------------------------------------- audit
+    def audit(self, batch: int, prompt_len: int, cache_len: int,
+              cfg: Optional[GenerationConfig] = None, **audit_kw):
+        """Static audit of the (prefill, decode) pair for one padded
+        shape (analysis.audit over abstract operands — nothing
+        executes). Decode is audited with the TPU donation INTENT (the
+        KV cache donated) even on CPU, where the session deliberately
+        skips donation: the audit gates the program we serve, not the
+        test backend. Returns ``(prefill_report, decode_report)``; the
+        tier-1 gate asserts zero ERROR findings on both and full
+        donation coverage of the cache in decode."""
+        from ..analysis import audit as _audit
+        # same contract as every dispatch path: a mid-fit audit must
+        # trace the EVAL program (train-mode dropout would otherwise be
+        # baked into the traced jaxpr, and the report would describe a
+        # program that is never served)
+        self._ensure_eval()
+        cfg = cfg if cfg is not None else GenerationConfig()
+        # a caller-supplied name= prefixes the pair (the sibling audit
+        # entry points honor name overrides; here one call yields TWO
+        # reports, so the override becomes their common prefix)
+        base = audit_kw.pop("name", "generation")
+        # decode donation defaults to the TPU intent; donate=() audits
+        # the undonated variant the session dispatches on CPU backends
+        decode_donate = audit_kw.pop("donate", (2,))
+        sds = jax.ShapeDtypeStruct
+        state = tuple(sds(tuple(v.shape), v.dtype)
+                      for v in self.state_values())
+        ids = sds((batch, prompt_len), jnp.int32)
+        plen = sds((batch,), jnp.int32)
+        key = sds((2,), jnp.uint32)
+        prefill_report = _audit(
+            self._prefill_fn, state, ids, plen, key, cfg, cache_len,
+            static_argnums=(4, 5), name=f"{base}.prefill", **audit_kw)
+        # decode operand avals come straight from the prefill audit's
+        # own trace (report.out_shape) — no second prefill trace
+        _, cache_aval, _, fin = prefill_report.out_shape
+        tok = sds((batch,), jnp.int32)
+        decode_report = _audit(
+            self._decode_fn, state, tok, cache_aval, key, fin, cfg,
+            static_argnums=(5,), donate=decode_donate,
+            name=f"{base}.decode", **audit_kw)
+        return prefill_report, decode_report
+
     # --------------------------------------------------------------- aot
     def aot_compile(self, batch: int, prompt_len: int, cache_len: int,
                     cfg: GenerationConfig):
@@ -226,8 +270,8 @@ class GenerationSession:
 def _as_int_ids(input_ids) -> np.ndarray:
     ids = input_ids
     if isinstance(ids, Tensor):
-        ids = np.asarray(ids._data)
-    ids = np.asarray(ids)
+        ids = np.asarray(ids._data)  # lint: host-sync-ok (pre-dispatch input prep)
+    ids = np.asarray(ids)  # lint: host-sync-ok (pre-dispatch input prep)
     if ids.ndim == 1:
         ids = ids[None, :]
     if ids.ndim != 2:
@@ -285,7 +329,7 @@ def generate(network, input_ids, max_new_tokens: int = 32, *,
     if prompt_len is None:
         plen = np.full((b,), s, np.int32)
     else:
-        plen = np.asarray(
+        plen = np.asarray(  # lint: host-sync-ok (pre-dispatch input prep)
             prompt_len._data if isinstance(prompt_len, Tensor)
             else prompt_len).astype(np.int32).reshape(-1)
         if plen.shape != (b,):
@@ -350,7 +394,7 @@ def generate(network, input_ids, max_new_tokens: int = 32, *,
         # token — that would drain the dispatch queue)
         if cfg.eos_token_id is not None and \
                 (i + 1) % _EOS_CHECK_EVERY == 0 and \
-                bool(jnp.all(finished)):
+                bool(jnp.all(finished)):  # lint: host-sync-ok (every-K poll)
             break
     result = jnp.stack(outs, axis=1)                 # [B, n_done]
     if monitor.enabled:
@@ -359,7 +403,7 @@ def generate(network, input_ids, max_new_tokens: int = 32, *,
         # throughput). One [live, n_done] host read at call end — the
         # caller is about to transfer the result anyway.
         live = b if live_rows is None else min(int(live_rows), b)
-        arr = np.asarray(result[:live])
+        arr = np.asarray(result[:live])  # lint: host-sync-ok (one end-of-call read)
         if cfg.eos_token_id is not None:
             hit = arr == cfg.eos_token_id
             per_row = np.where(hit.any(1), hit.argmax(1) + 1, n_done)

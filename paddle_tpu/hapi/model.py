@@ -30,7 +30,7 @@ from .callbacks import (Callback, CallbackList, EarlyStopping,
 def _to_tensor(x):
     if isinstance(x, Tensor):
         return x
-    return Tensor(np.asarray(x))
+    return Tensor(np.asarray(x))  # lint: host-sync-ok (host input prep)
 
 
 def _as_list(x):
@@ -84,7 +84,7 @@ class AsyncScalarFetcher:
     def _ready(value) -> bool:
         arr = getattr(value, "_data", value)
         try:
-            return bool(arr.is_ready())
+            return bool(arr.is_ready())  # lint: host-sync-ok (non-blocking probe)
         except AttributeError:
             return True  # plain host scalar: nothing to wait for
 
@@ -97,7 +97,7 @@ class AsyncScalarFetcher:
             s, v = self._window.popleft()
             if self.record and monitor.enabled:
                 monitor.record_loss_fetch(not self._ready(v))
-            out.append((s, float(v)))
+            out.append((s, float(v)))  # lint: host-sync-ok (bounded lag window)
         return out
 
     def drain(self):
@@ -112,7 +112,7 @@ class AsyncScalarFetcher:
                 b = not self._ready(v)
                 monitor.record_loss_fetch(b and not blocked)
                 blocked = blocked or b
-            out.append((s, float(v)))
+            out.append((s, float(v)))  # lint: host-sync-ok (counted drain barrier)
         return out
 
     def sync(self):
@@ -412,7 +412,8 @@ class Model:
                 # a mid-epoch stop (NaN loss) skips the epoch tail:
                 # no checkpoint of poisoned weights, no wasted eval
                 break
-            logs = {"loss": float(np.mean(losses)) if losses else None}
+            logs = {"loss": float(np.mean(losses))  # lint: host-sync-ok (host floats)
+                    if losses else None}
             cbs.on_epoch_end(epoch, logs)
             if guard is not None:
                 self._take_good_snapshot()
@@ -457,7 +458,7 @@ class Model:
             cbs.on_eval_batch_end(s, {"loss": val})
         logs = {}
         if losses:
-            logs["loss"] = float(np.mean(losses))
+            logs["loss"] = float(np.mean(losses))  # lint: host-sync-ok (host floats)
         for m in self._metrics:
             logs[m.name()] = m.accumulate()
         cbs.on_eval_end(logs)
@@ -478,8 +479,9 @@ class Model:
             inputs = batch[0] if isinstance(batch, (list, tuple)) and \
                 len(batch) >= 1 else batch
             out = self.predict_batch(inputs)
-            outs.append(np.asarray(out.numpy() if isinstance(out, Tensor)
-                                   else out))
+            # predict() hands host arrays back by contract
+            out = out.numpy() if isinstance(out, Tensor) else out  # lint: host-sync-ok
+            outs.append(np.asarray(out))  # lint: host-sync-ok (already host)
         if stack_outputs and outs:
             return [np.concatenate(outs, axis=0)]
         return [outs]
@@ -591,7 +593,7 @@ class Model:
     def _take_good_snapshot(self):
         """Host-memory copy of network + optimizer state — what the
         anomaly guard restores when a non-finite streak poisons a run."""
-        net = {k: np.array(v.numpy(), copy=True)
+        net = {k: np.array(v.numpy(), copy=True)  # lint: host-sync-ok (anomaly-guard snapshot)
                for k, v in self.network.state_dict().items()}
         opt = self._optimizer.state_dict() \
             if self._optimizer is not None else None

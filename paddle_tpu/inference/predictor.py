@@ -207,6 +207,8 @@ class Predictor:
                         out, is_leaf=lambda x: isinstance(x, Tensor))]
 
         jitted = jax.jit(fwd)
+        # kept for audit_forward(): the raw traceable + its operands
+        self._fwd_fn, self._fwd_vals, self._fwd_specs = fwd, vals, specs
         low_prec = (PrecisionType.Bfloat16, PrecisionType.Half,
                     PrecisionType.Int8)
 
@@ -258,11 +260,17 @@ class Predictor:
                 f"max_position_embeddings={max_pos} with "
                 f"max_new_tokens={max_new}")
         self._gen_buckets = buckets
+        # the bucket -> cache_len mapping the executables are COMPILED
+        # with; generate() and audit_generation() read this, never
+        # re-derive it (a drifted re-derivation would dispatch/audit
+        # shapes no executable was built for)
+        self._gen_cache_lens = {b: _round_up(b + max_new)
+                                for b in buckets}
         self._gen_session = GenerationSession(layer)
         for b in buckets:
-            cache_len = _round_up(b + max_new)
             self._gen_session.aot_compile(opts["max_batch"], b,
-                                          cache_len, self._gen_cfg)
+                                          self._gen_cache_lens[b],
+                                          self._gen_cfg)
 
     def generate(self, prompts, max_new_tokens: Optional[int] = None,
                  seed: Optional[int] = None) -> List[np.ndarray]:
@@ -295,7 +303,6 @@ class Predictor:
         cfg = self._gen_cfg
         eos = cfg.eos_token_id
         results: List[np.ndarray] = []
-        from ..generation.api import _round_up
         for lo in range(0, len(rows), max_batch):
             chunk = rows[lo:lo + max_batch]
             longest = max(r.size for r in chunk)
@@ -313,8 +320,7 @@ class Predictor:
             out = _generate(
                 self.config._layer, ids,
                 max_new_tokens=max_new_tokens, prompt_len=plen,
-                cache_max_len=_round_up(
-                    bucket + opts["max_new_tokens"]),
+                cache_max_len=self._gen_cache_lens[bucket],
                 seed=seed, session=self._gen_session,
                 live_rows=len(chunk),
                 do_sample=cfg.do_sample, temperature=cfg.temperature,
@@ -328,6 +334,54 @@ class Predictor:
                         row = row[:hits[0]]
                 results.append(row.astype(np.int32))
         return results
+
+    # ------------------------------------------------------------- audit
+    def audit_generation(self, **audit_kw) -> Dict[tuple, object]:
+        """Static audit of every AOT bucket executable this predictor
+        serves: one (prefill, decode) report pair per prompt bucket,
+        keyed ``('prefill'|'decode', bucket)``. The tier-1 serving gate
+        asserts zero ERROR findings across all of them — a regression
+        (lost cache donation, a host callback snuck into a model
+        forward) fails CI before it ever reaches traffic."""
+        if self._gen_session is None:
+            raise RuntimeError("generation mode not enabled; call "
+                               "Config.enable_generation() before "
+                               "create_predictor")
+        opts = self._gen_opts
+        reports: Dict[tuple, object] = {}
+        for b in self._gen_buckets:
+            pre, dec = self._gen_session.audit(
+                opts["max_batch"], b, self._gen_cache_lens[b],
+                self._gen_cfg, **audit_kw)
+            reports[("prefill", b)] = pre
+            reports[("decode", b)] = dec
+        return reports
+
+    def audit_forward(self, **audit_kw):
+        """Static audit of the plain run() program (layer-backed
+        predictors only — artifact-backed programs were serialized
+        without a re-traceable Python callable). Input avals mirror
+        run()'s low-precision cast: under bf16/fp16/int8 configs the
+        served program sees bf16/fp16 floating feeds, so the audit
+        traces exactly that program — not the declared-dtype one."""
+        if getattr(self, "_fwd_fn", None) is None:
+            raise RuntimeError(
+                "audit_forward() needs a layer-backed predictor "
+                "(Config.from_layer); artifact-backed programs have no "
+                "traceable callable to audit")
+        from ..analysis import abstractify, audit as _audit
+        specs = [abstractify(s) for s in self._fwd_specs]
+        prec = self.config.precision
+        if prec in (PrecisionType.Bfloat16, PrecisionType.Half,
+                    PrecisionType.Int8):
+            tgt = jnp.float16 if prec == PrecisionType.Half \
+                else jnp.bfloat16
+            specs = [jax.ShapeDtypeStruct(s.shape, tgt)
+                     if jnp.issubdtype(s.dtype, jnp.floating) else s
+                     for s in specs]
+        audit_kw.setdefault("name", "Predictor.run")
+        return _audit(self._fwd_fn, abstractify(self._fwd_vals),
+                      *specs, **audit_kw)
 
     # --------------------------------------------------------------- api
     def get_input_names(self) -> List[str]:

@@ -420,6 +420,33 @@ class TrainStep:
             self.optimizer._lr.step()
         return _wrap(loss)
 
+    def audit(self, *batch, **audit_kw):
+        """Static audit of the fused training step (analysis.audit):
+        traces step_fn on abstract operands — nothing executes, no
+        buffer is allocated — and runs the detector passes (donation
+        misses, host callbacks, dtype leaks, baked consts, collective
+        accounting). The tier-1 gate asserts zero ERROR findings and
+        full donation coverage of params + optimizer state."""
+        from ..analysis import abstractify, audit as _audit
+        params = self._params_cache
+        p_avals = [jax.ShapeDtypeStruct(tuple(p._data.shape),
+                                        p._data.dtype) for p in params]
+        if self._opt_state_tree is not None:
+            opt_avals = abstractify(self._opt_state_tree)
+        else:
+            opt_avals = [jax.eval_shape(self.optimizer.init_state_for,
+                                        p._data) for p in params]
+        raw_batch = tuple(
+            jax.tree_util.tree_map(
+                _unwrap, b, is_leaf=lambda t: isinstance(t, Tensor))
+            for b in batch)
+        audit_kw.setdefault("name", "TrainStep.step_fn")
+        return _audit(
+            self._step_fn, p_avals, opt_avals,
+            jax.ShapeDtypeStruct((), np.float32),
+            jax.ShapeDtypeStruct((), np.int32), *abstractify(raw_batch),
+            donate=self._donate_argnums, **audit_kw)
+
     def cost_analysis(self, *batch):
         """XLA's cost model for the compiled step on these inputs
         (['flops'], bytes accessed, ...) — bench.py derives MFU from it

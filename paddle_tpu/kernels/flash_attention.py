@@ -881,8 +881,12 @@ def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
 
 
 def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
-                   block_k=_DECODE_BLOCK_K):
-    """q: [BH, sq<=8, D] (unscaled), caches [BH, T, D], kv_len [BH]."""
+                   block_k=_DECODE_BLOCK_K, group=1):
+    """q: [B*Hq, sq<=8, D] (unscaled), caches [B*Hk, T, D], kv_len
+    [B*Hk]. GQA/MQA (``group`` = Hq//Hk > 1) maps each query head to
+    its kv head via the k/v BlockSpec index maps (grid row b reads
+    cache row b // group): the hk-sized caches are streamed as-is, no
+    repeated copy is ever materialized."""
     bh, sq, d = q.shape
     t = k_cache.shape[1]
     qpad = _DECODE_QPAD
@@ -891,16 +895,16 @@ def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
         q = jnp.pad(q, ((0, 0), (0, qpad - sq), (0, 0)))
     bk = _pick_block(t, block_k)
     nk = t // bk
-    kvlen2 = kv_len.astype(jnp.int32).reshape(bh, 1)
+    kvlen2 = kv_len.astype(jnp.int32).reshape(k_cache.shape[0], 1)
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sq=sq, block_k=bk,
                           num_kblocks=nk),
         grid=(bh, nk),
         in_specs=[
             pl.BlockSpec((1, qpad, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, 1), lambda b, j: (b, 0),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b // group, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b // group, j, 0)),
+            pl.BlockSpec((1, 1), lambda b, j: (b // group, 0),
                          memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, qpad, d), lambda b, j: (b, 0, 0)),
@@ -919,24 +923,29 @@ def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
     return out[:, :sq]
 
 
-def _decode_xla(q, k_cache, v_cache, kv_len, scale):
+def _decode_xla(q, k_cache, v_cache, kv_len, scale, group=1):
     """Fallback decode attention (CPU/interpret, or cache lengths off
-    the 128 grid): fp32 masked softmax over the [BH, sq, T] scores —
-    fine at decode sizes, never used for training shapes."""
-    bh, sq, d = q.shape
+    the 128 grid): fp32 masked softmax over [B*Hk, group, sq, T]
+    scores — fine at decode sizes, never used for training shapes.
+    GQA/MQA query heads fold into the ``group`` dim so the hk-sized
+    caches broadcast in the einsum (head-index mapping, no repeat)."""
+    bhq, sq, d = q.shape
     t = k_cache.shape[1]
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+    q4 = q.reshape(k_cache.shape[0], group, sq, d)
+    s = jnp.einsum("bgqd,bkd->bgqk", q4.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) * scale
-    rows = jnp.arange(sq, dtype=jnp.int32)[None, :, None]
-    cols = jnp.arange(t, dtype=jnp.int32)[None, None, :]
-    valid = cols - rows <= (kv_len.astype(jnp.int32)[:, None, None] - sq)
+    rows = jnp.arange(sq, dtype=jnp.int32)[None, None, :, None]
+    cols = jnp.arange(t, dtype=jnp.int32)[None, None, None, :]
+    valid = cols - rows <= \
+        (kv_len.astype(jnp.int32)[:, None, None, None] - sq)
     s = jnp.where(valid, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     p = p / jnp.where(l == 0.0, 1.0, l)
-    return jnp.einsum("bqk,bkd->bqd", p.astype(v_cache.dtype),
-                      v_cache).astype(q.dtype)
+    out = jnp.einsum("bgqk,bkd->bgqd", p.astype(v_cache.dtype),
+                     v_cache).astype(q.dtype)
+    return out.reshape(bhq, sq, d)
 
 
 def flash_attention_decode(query, key_cache, value_cache, kv_len,
@@ -949,8 +958,12 @@ def flash_attention_decode(query, key_cache, value_cache, kv_len,
     one layer's slice of a ``generation.KVCache`` (new tokens already
     written). kv_len: [batch] int32 — valid entries per row INCLUDING
     the q_len new positions; query row i attends cache columns
-    ``<= kv_len - q_len + i`` (ragged causal). GQA/MQA kv heads are
-    repeated as in ``flash_attention``.
+    ``<= kv_len - q_len + i`` (ragged causal). GQA/MQA (kv heads
+    dividing q heads) attends by HEAD-INDEX MAPPING: query head h reads
+    cache head ``h // (hq//hk)`` directly — the kernel's k/v BlockSpecs
+    (and the fallback's grouped einsum) index the hk-sized caches, so
+    decode HBM traffic stays at the cache's true size; no repeated
+    copies are materialized.
 
     TPU runs the Pallas kernel; other backends (and cache lengths not
     on the 128 grid) take the XLA fallback — identical math.
@@ -963,26 +976,23 @@ def flash_attention_decode(query, key_cache, value_cache, kv_len,
             "flash_attention/prefill for longer query windows")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    if hk != hq:
-        assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"
-        # PERF TRAP (dormant — no shipped config uses hk < hq yet):
-        # this materializes group-size copies of both caches per call.
-        # Before enabling a GQA model, switch to head-index mapping in
-        # the [B*H] flatten (or group rows inside the kernel) so decode
-        # HBM traffic stays at the hk-sized cache.
-        key_cache = jnp.repeat(key_cache, hq // hk, axis=2)
-        value_cache = jnp.repeat(value_cache, hq // hk, axis=2)
+    assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"
+    group = hq // hk
+    # query rows [b, h] flatten so that row i's kv row is i // group
+    # (b*hq = (b*hk)*group, batch-major): the group-size broadcast is
+    # pure indexing, never a materialized repeat of the caches
     qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
-    kt = jnp.swapaxes(key_cache, 1, 2).reshape(b * hq, t, d)
-    vt = jnp.swapaxes(value_cache, 1, 2).reshape(b * hq, t, d)
+    kt = jnp.swapaxes(key_cache, 1, 2).reshape(b * hk, t, d)
+    vt = jnp.swapaxes(value_cache, 1, 2).reshape(b * hk, t, d)
     kv_len = jnp.asarray(kv_len, jnp.int32)
-    kl = jnp.repeat(kv_len, hq)                       # [B*H]
+    kl = jnp.repeat(kv_len, hk)                       # [B*Hk] int32
     use_pallas = (jax.default_backend() == "tpu"
                   and t % 128 == 0 and d in (64, 128, 256))
     if use_pallas:
-        out = _decode_pallas(qt, kt, vt, kl, float(scale), block_k)
+        out = _decode_pallas(qt, kt, vt, kl, float(scale), block_k,
+                             group=group)
     else:
-        out = _decode_xla(qt, kt, vt, kl, float(scale))
+        out = _decode_xla(qt, kt, vt, kl, float(scale), group=group)
     return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
 
 

@@ -58,3 +58,19 @@ def _seed_rng():
     import paddle_tpu
     paddle_tpu.seed(1234)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _restore_hybrid_mesh():
+    """Process-global mesh hygiene: a test that calls ``fleet.init``
+    (or sets the HybridCommunicateGroup directly) must not leak its
+    mesh into later modules — that is exactly the order-dependent
+    failure class where test_metrics' default-'world'-mesh collective
+    counters saw test_models' hybrid mesh. Each test still SEES
+    whatever was set before it (behavior unchanged mid-test); the
+    snapshot/restore only guarantees the leak stops at the test
+    boundary."""
+    from paddle_tpu.distributed import topology
+    prev = topology.get_hybrid_communicate_group()
+    yield
+    topology.set_hybrid_communicate_group(prev)

@@ -176,6 +176,7 @@ class TestCorruptionFallback:
     def _mgr(self, tmp_path, name="cf"):
         return CheckpointManager(str(tmp_path / name), async_save=False)
 
+    @pytest.mark.chaos
     def test_truncated_latest_restores_previous_and_counts(self, tmp_path):
         from paddle_tpu.profiler import metrics
         from paddle_tpu.utils import fault_injection as fi
@@ -230,6 +231,7 @@ class TestCorruptionFallback:
             os.path.join(mgr.directory, "0", COMMIT_MARKER))
         mgr.close()
 
+    @pytest.mark.chaos
     def test_all_steps_corrupt_raises(self, tmp_path):
         from paddle_tpu.distributed.checkpoint import CheckpointCorruption
         from paddle_tpu.utils import fault_injection as fi

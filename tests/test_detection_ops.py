@@ -98,6 +98,7 @@ class TestYoloLoss:
             checked += abs(fd) > 1e-6
         assert checked >= 3  # at least some non-zero-grad entries hit
 
+    @pytest.mark.slow  # ~7s train loop; FD-gradient test stays tier-1
     def test_trains_down(self):
         paddle.seed(0)
         head = nn.Conv2D(8, 3 * 9, 1)

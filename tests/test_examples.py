@@ -12,9 +12,13 @@ import pytest
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = os.path.join(_ROOT, "examples")
 
-# detection_train compiles the full PP-YOLOE stack (~30s on CPU — the
-# single longest tier-1 item): tier-2 via the slow marker
-_SLOW_SCRIPTS = {"detection_train.py"}
+# detection_train compiles the full PP-YOLOE stack (~30s on CPU) and
+# graph_and_pointcloud ~15s: tier-2 via the slow marker
+# each entry overlaps dedicated tier-1 suites (test_e2e_mnist,
+# test_fused_resnet/test_models, test_models bert, fleet tests)
+_SLOW_SCRIPTS = {"detection_train.py", "graph_and_pointcloud.py",
+                 "mnist_lenet.py", "resnet_train.py",
+                 "bert_finetune.py", "gpt2_hybrid_parallel.py"}
 SCRIPTS = [pytest.param(f, marks=pytest.mark.slow)
            if f in _SLOW_SCRIPTS else f
            for f in sorted(os.listdir(_EXAMPLES)) if f.endswith(".py")]

@@ -122,17 +122,44 @@ def test_flash_attention_decode_pallas_interpret_parity():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_attention_decode_gqa():
+@pytest.mark.parametrize("sq,hq,hk", [(1, 4, 2), (4, 4, 2), (1, 8, 1)])
+def test_flash_attention_decode_gqa(sq, hq, hk):
+    """GQA/MQA decode (hk < hq) via head-index mapping: parity against
+    the naive reference with explicitly repeated caches — the kernel
+    path itself never materializes the repeat."""
     from paddle_tpu.kernels.flash_attention import flash_attention_decode
     rng = np.random.RandomState(3)
-    b, hq, hk, d, t = 2, 4, 2, 64, 128
-    kv = np.array([7, 60], np.int32)
-    q = rng.randn(b, 1, hq, d).astype(np.float32)
+    b, d, t = 2, 64, 128
+    kv = np.array([7 + sq, 60], np.int32)
+    q = rng.randn(b, sq, hq, d).astype(np.float32)
     kc = rng.randn(b, t, hk, d).astype(np.float32)
     vc = rng.randn(b, t, hk, d).astype(np.float32)
     out = np.asarray(flash_attention_decode(q, kc, vc, kv))
     ref = _naive_decode(q, np.repeat(kc, hq // hk, 2),
                         np.repeat(vc, hq // hk, 2), kv)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_decode_gqa_pallas_interpret_parity():
+    """The Pallas kernel's GQA head-index mapping (k/v BlockSpec index
+    maps reading cache row b // group) in interpret mode: grid row i
+    must attend kv head i//group's cache, with that head's kv_len."""
+    from paddle_tpu.kernels.flash_attention import _decode_pallas
+    rng = np.random.RandomState(4)
+    b, hq, hk, d, t, sq = 2, 4, 2, 64, 256, 3
+    group = hq // hk
+    kv = np.array([5 + sq, 250], np.int32)
+    q = rng.randn(b, sq, hq, d).astype(np.float32)
+    kc = rng.randn(b, t, hk, d).astype(np.float32)
+    vc = rng.randn(b, t, hk, d).astype(np.float32)
+    qt = jnp.swapaxes(jnp.asarray(q), 1, 2).reshape(b * hq, sq, d)
+    kt = jnp.swapaxes(jnp.asarray(kc), 1, 2).reshape(b * hk, t, d)
+    vt = jnp.swapaxes(jnp.asarray(vc), 1, 2).reshape(b * hk, t, d)
+    out = _decode_pallas(qt, kt, vt, jnp.repeat(jnp.asarray(kv), hk),
+                         1.0 / np.sqrt(d), block_k=128, group=group)
+    out = np.asarray(jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2))
+    ref = _naive_decode(q, np.repeat(kc, group, 2),
+                        np.repeat(vc, group, 2), kv)
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
 
 

@@ -69,6 +69,27 @@ class ArangeDataset(Dataset):
         return (np.arange(4, dtype=np.float32) + 10.0 * i, np.int64(i))
 
 
+class _GatedArange(ArangeDataset):
+    """ArangeDataset whose ``gate_sample`` blocks while ``gate_file``
+    exists (bounded by a 20s safety cap). The gate crosses the fork
+    boundary — worker processes see the same filesystem — so a test can
+    PIN a chosen batch in flight until its fault lands, instead of
+    racing the prefetch pipeline."""
+
+    def __init__(self, n, delay=0.0, gate_sample=None, gate_file=None):
+        super().__init__(n, delay=delay)
+        self.gate_sample = gate_sample
+        self.gate_file = gate_file
+
+    def __getitem__(self, i):
+        if i == self.gate_sample and self.gate_file:
+            t0 = time.monotonic()
+            while os.path.exists(self.gate_file) and \
+                    time.monotonic() - t0 < 20.0:
+                time.sleep(0.005)
+        return super().__getitem__(i)
+
+
 def _arrs(batch):
     return np.asarray(batch[0].numpy())
 
@@ -173,7 +194,18 @@ def test_kill_then_preempt_resume_replays_exact_batches(tmp_path):
     loader state, and the relaunched job replays the exact remaining
     batch sequence — bitwise equal, <=1 step lost — with
     io.worker.respawns and io.sample.quarantined recorded."""
-    base = fi.FlakySamples(ArangeDataset(48, delay=0.01), nan_at={7})
+    # Deterministic kill window: batch 4 is worker 0's first batch the
+    # consumer has NOT yet received at the kill step (round-robin:
+    # batch i -> worker i % 2), so its first sample blocks on a gate
+    # file until the kill lands. Without the gate, a fast machine
+    # prefetches batch 4 before the kill and the stream finishes
+    # without ever NEEDING the respawn (flaky respawn-counter assert).
+    gate_file = str(tmp_path / "b4.gate")
+    probe = RandomSampler(ArangeDataset(48), generator=123)
+    first_of_b4 = list(probe)[16]  # epoch-0 permutation, position 16
+    base = fi.FlakySamples(
+        _GatedArange(48, delay=0.01, gate_sample=first_of_b4,
+                     gate_file=gate_file), nan_at={7})
 
     def make_loader():
         sampler = RandomSampler(base, generator=123)
@@ -181,7 +213,8 @@ def test_kill_then_preempt_resume_replays_exact_batches(tmp_path):
         return DataLoader(base, batch_sampler=bs, num_workers=2,
                           skip_bad_samples=True, worker_respawn_limit=2)
 
-    # uninterrupted reference stream (same seed -> same permutation)
+    # uninterrupted reference stream (same seed -> same permutation;
+    # the gate file does not exist yet, so nothing blocks)
     ref = [_arrs(b) for b in make_loader()]
     assert len(ref) == 12
 
@@ -189,6 +222,8 @@ def test_kill_then_preempt_resume_replays_exact_batches(tmp_path):
     quarantined0 = _counter("io.sample.quarantined")
 
     mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    with open(gate_file, "w"):
+        pass  # arm the gate: batch 4 now stalls until the kill step
     loader = make_loader()
     step_box = {"step": -1}
     mgr.save_on_preemption(
@@ -201,7 +236,11 @@ def test_kill_then_preempt_resume_replays_exact_batches(tmp_path):
                 seen.append(_arrs(batch))
                 step_box["step"] = step
                 if step == 3:
+                    # worker 0 is gated on batch 4: it dies holding it,
+                    # and only the RESPAWNED worker (gate lifted) can
+                    # deliver steps 4-5
                     fi.kill_worker(loader, worker_id=0)
+                    os.remove(gate_file)
                 kill.step()
                 resilience.poll(step)  # step 5: emergency save + exit
     assert exc.value.code == ELASTIC_EXIT_CODE

@@ -166,6 +166,7 @@ with open(os.environ["RESULT_JSON"] + "." + rank, "w") as f:
 """
 
 
+@pytest.mark.slow  # ~20s multi-process relaunch e2e on CPU: tier-2
 def test_preemption_chaos_resume_parity(tmp_path):
     """VERDICT r3 Next #6: SIGKILL a worker mid-epoch (a real kill,
     not exit-101 cooperation), let the launcher's fault-elastic path

@@ -180,6 +180,18 @@ class TestRetraceTracking:
 
 
 class TestCollectiveCounters:
+    @pytest.fixture(autouse=True)
+    def _default_world_mesh(self):
+        """This test asserts the DEFAULT single-axis 'world' mesh path;
+        clear any HybridCommunicateGroup a prior module leaked (e.g. a
+        fleet.init in test_models) and restore it afterwards, so the
+        test passes in any collection order."""
+        from paddle_tpu.distributed import topology
+        prev = topology.get_hybrid_communicate_group()
+        topology.set_hybrid_communicate_group(None)
+        yield
+        topology.set_hybrid_communicate_group(prev)
+
     def test_all_reduce_counts_bytes(self):
         metrics.enable()
         from paddle_tpu.distributed import collective

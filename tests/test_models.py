@@ -8,6 +8,10 @@ import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer  # noqa: F401
 
 
+# (fleet.init mesh leakage is handled by conftest's process-global
+# _restore_hybrid_mesh autouse fixture)
+
+
 def test_ernie_forward_and_loss():
     paddle.seed(0)
     from paddle_tpu.models.ernie import ernie
@@ -260,6 +264,7 @@ def test_fused_lm_loss_pipeline_loss_fn_still_works():
     assert np.isfinite(float(val))
 
 
+@pytest.mark.slow  # ~8s on CPU; GPT fused-LM-loss parity stays tier-1
 def test_ernie_fused_mlm_loss_matches_plain():
     """Gathered-position fused MLM == plain dense MLM loss AND grads
     (BASELINE config #3 head optimization)."""
@@ -299,6 +304,7 @@ def test_ernie_fused_mlm_loss_matches_plain():
     assert ln < l0
 
 
+@pytest.mark.slow  # ~4s; fused-resnet parity suite stays tier-1
 def test_resnet_nhwc_and_s2d_parity():
     """data_format=NHWC and the space-to-depth stem are numerically
     equal to the NCHW reference path (same state_dict)."""

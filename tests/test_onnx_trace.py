@@ -141,6 +141,7 @@ def test_full_gpt_model_onnx_roundtrip(tmp_path):
     np.testing.assert_allclose(out, ref, rtol=1e-3, atol=5e-4)
 
 
+@pytest.mark.slow  # ~5s; static-batch onnx export coverage stays tier-1
 def test_dynamic_batch_export(tmp_path):
     """dynamic_batch=True: trace at batch 3, execute at batch 5 — the
     reference's dynamic-batch export. Covers the batch-agnostic

@@ -86,6 +86,7 @@ def test_collective_bytes_parser():
     assert n_ici == 1 and n_dcn == 1
 
 
+@pytest.mark.slow  # ~21s on CPU (lowers candidate meshes): tier-2
 def test_tuner_picks_sane_config_gpt67b_block():
     """GPT-6.7B hidden size (h=4096, heads=32) scaled to 4 layers on 8
     devices: replicated-dp must be pruned for memory and the winner
@@ -256,6 +257,7 @@ def test_abstract_lowering_matches_concrete():
     assert ba == bc and ba[0] > 0, (ba, bc)
 
 
+@pytest.mark.slow  # ~9s full-space lowering on CPU: tier-2
 def test_engine_full_space_picks_pp():
     """VERDICT r3 Next #5: Engine(strategy='auto') reaches the FULL
     dp x sharding x pp x mp space through the fleet path. With a

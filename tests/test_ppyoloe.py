@@ -53,6 +53,7 @@ def test_loss_finite_and_positive(model):
     assert np.isfinite(val) and val > 0
 
 
+@pytest.mark.slow  # ~17s full-detector train compile on CPU: tier-2
 def test_train_step_reduces_loss():
     paddle.seed(0)
     from paddle_tpu import optimizer

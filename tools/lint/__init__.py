@@ -1,0 +1,154 @@
+"""Framework lint: stdlib-`ast` static checks for the Python layer.
+
+The program auditor (``paddle_tpu.analysis``) guards what a TRACED
+program may contain; this lint guards the Python that builds and drives
+those programs — the host-side hazards no jaxpr ever shows:
+
+    host-sync     eager ``.numpy()`` / ``float(x)`` / ``np.asarray(x)``
+                  in hot-path modules outside allowlisted sync points
+    jit-random    Python/`np.random` randomness inside functions that
+                  get jitted (baked into the trace as constants)
+    bare-except   ``except:`` that swallows without
+                  ``monitor.record_swallowed`` (silent failure — the
+                  fault-tolerance layer's cardinal sin)
+    metric-name   metric names recorded that are not declared in
+                  ``core/monitor.DECLARED_METRICS`` (typo'd counters
+                  nobody will ever read)
+    chaos-marker  tests importing ``utils.fault_injection`` without the
+                  ``chaos`` marker (the conftest collection guard,
+                  promoted to lint so function-level imports are caught
+                  too)
+
+Run it over the tree (CI does; nonzero exit on any finding):
+
+    python -m tools.lint paddle_tpu tests
+
+Allowlist a deliberate violation with a same-line marker naming the
+rule, e.g. ``np.asarray(ids)  # lint: host-sync-ok (pre-dispatch)`` —
+the reason in parentheses is for the reviewer, the token before it is
+what the lint matches.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional
+
+REPO_RULE_DOC = __doc__
+
+
+@dataclasses.dataclass(frozen=True)
+class LintFinding:
+    path: str      # repo-relative, posix
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}")
+
+
+class FileContext:
+    """One parsed source file plus the helpers rules share."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+
+    @property
+    def is_test_file(self) -> bool:
+        base = os.path.basename(self.relpath)
+        return (self.relpath.split("/")[0] == "tests"
+                or base.startswith("test_") or base == "conftest.py")
+
+    def allowed(self, node: ast.AST, rule: str) -> bool:
+        """True when any line the node spans carries the rule's
+        ``# lint: <rule>-ok`` marker (calls often wrap lines; the
+        marker may sit on whichever physical line survives the
+        formatter)."""
+        first = getattr(node, "lineno", 0)
+        last = getattr(node, "end_lineno", first) or first
+        token = f"lint: {rule}-ok"
+        return any(token in self.lines[i - 1]
+                   for i in range(max(first, 1),
+                                  min(last, len(self.lines)) + 1))
+
+
+RuleFn = Callable[[FileContext], List[LintFinding]]
+RULES: Dict[str, RuleFn] = {}
+
+
+def rule(name: str):
+    def deco(fn: RuleFn):
+        RULES[name] = fn
+        return fn
+    return deco
+
+
+# imported for the side effect of registering the rules
+from . import rules  # noqa: E402,F401
+
+
+def lint_file(path: str, relpath: str) -> List[LintFinding]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        ctx = FileContext(path, relpath, source)
+    except SyntaxError as e:
+        return [LintFinding(relpath, e.lineno or 0, e.offset or 0,
+                            "syntax", f"unparseable: {e.msg}")]
+    findings: List[LintFinding] = []
+    for fn in RULES.values():
+        findings.extend(fn(ctx))
+    return findings
+
+
+def repo_root() -> str:
+    """The repository this lint ships in (tools/lint/ lives two levels
+    below it). Path-scoped rules key on repo-relative paths, so this —
+    never the cwd — anchors relpath computation: the lint must behave
+    identically invoked from the repo root, a neutral cwd with absolute
+    paths, or CI."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def lint_paths(paths: List[str], root: Optional[str] = None,
+               stats: Optional[dict] = None) -> List[LintFinding]:
+    """Lint every .py under ``paths`` (files or directories; relative
+    paths resolve against ``root``, default the repo root — NOT the
+    cwd, so the path-scoped rules fire no matter where the lint is
+    invoked from). Returns findings sorted by location; ``stats`` (if
+    given) receives ``{'files': N}`` so callers can prove the walk
+    matched something."""
+    root = os.path.abspath(root if root is not None else repo_root())
+    files: List[str] = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full):
+            # a typo'd path must fail, never read as a clean pass (CI
+            # green-forever on `tools.lint paddel_tpu` is the failure
+            # mode this guards)
+            raise FileNotFoundError(
+                f"lint path {p!r} does not exist (resolved {full!r})")
+        if os.path.isfile(full):
+            files.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            files.extend(os.path.join(dirpath, f)
+                         for f in filenames if f.endswith(".py"))
+    files = sorted(set(files))
+    if stats is not None:
+        stats["files"] = len(files)
+    findings: List[LintFinding] = []
+    for f in files:
+        findings.extend(lint_file(f, os.path.relpath(f, root)))
+    return sorted(findings, key=lambda x: (x.path, x.line, x.col))

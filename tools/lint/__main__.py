@@ -1,0 +1,45 @@
+"""CLI: ``python -m tools.lint paddle_tpu tests`` — nonzero exit on any
+finding (the tier-1 gate shells exactly this)."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import RULES, lint_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="paddle_tpu framework lint (stdlib-ast static "
+                    "checks; see tools/lint/__init__.py for the rules "
+                    "and the allowlist-marker syntax)")
+    parser.add_argument("paths", nargs="*", default=["paddle_tpu", "tests"],
+                        help="files or directories to lint "
+                             "(default: paddle_tpu tests)")
+    parser.add_argument("--rules", action="store_true",
+                        help="list rule ids and exit")
+    args = parser.parse_args(argv)
+    if args.rules:
+        for name, fn in sorted(RULES.items()):
+            doc = (fn.__doc__ or "").strip().split("\n")[0]
+            print(f"{name:14} {doc}")
+        return 0
+    stats = {}
+    try:
+        findings = lint_paths(args.paths or ["paddle_tpu", "tests"],
+                              stats=stats)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f)
+    # the file count proves the walk matched something — a path typo
+    # (or a bad cwd) must read as "0 files", never as a clean pass
+    print(f"{stats['files']} file(s), {len(findings)} finding(s)",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

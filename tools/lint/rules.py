@@ -1,0 +1,320 @@
+"""The framework lint rules (see package docstring for the contract).
+
+Each rule is a function ``(FileContext) -> [LintFinding]`` registered
+under its id; ids double as the allowlist-marker names
+(``# lint: host-sync-ok``). Rules use only stdlib ``ast`` — the lint
+must run in any environment, including ones where jax cannot import.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from . import FileContext, LintFinding, rule
+
+# ---------------------------------------------------------------- config
+
+# Modules on the per-step hot path: one stray eager host read here is a
+# pipeline stall under traffic. Anything else may sync freely.
+HOST_SYNC_HOT_PATHS = frozenset({
+    "paddle_tpu/jit/api.py",
+    "paddle_tpu/distributed/fleet/train_step.py",
+    "paddle_tpu/io/device_prefetch.py",
+    "paddle_tpu/generation/api.py",
+    "paddle_tpu/generation/kv_cache.py",
+    "paddle_tpu/generation/attention.py",
+    "paddle_tpu/hapi/model.py",
+})
+
+# Files allowed to name metrics freely (the schema itself + the
+# registry implementation and its re-export).
+METRIC_NAME_EXEMPT = frozenset({
+    "paddle_tpu/core/monitor.py",
+    "paddle_tpu/core/metrics.py",
+    "paddle_tpu/profiler/metrics.py",
+})
+
+_FAULT_INJECTION_MODULE = "paddle_tpu.utils.fault_injection"
+
+
+def _dotted(node: ast.AST) -> str:
+    """'np.random.randn' for an Attribute/Name chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ------------------------------------------------------------- host-sync
+
+@rule("host-sync")
+def check_host_sync(ctx: FileContext) -> List[LintFinding]:
+    """Eager device->host reads in hot-path modules: ``.numpy()``,
+    ``.item()``, ``float(tensor)``, ``np.asarray(tensor)``, and
+    ``bool(<call>)`` (the ``bool(jnp.all(done))`` polling spelling)
+    each block the dispatch queue. Deliberate sync points (the async
+    loop's bounded loss fetch, generate()'s end-of-call transfer, the
+    every-K-steps eos poll) carry ``# lint: host-sync-ok`` with a
+    reason. Known limitation: ``bool(x)``/``int(x)`` on a BARE name
+    can't be told apart from config coercion without type info, so
+    only call/attribute arguments are flagged — reviewers should still
+    eyeball truthiness tests of device arrays."""
+    if ctx.relpath not in HOST_SYNC_HOT_PATHS:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        label = None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("numpy", "item") and not node.args:
+            label = f".{node.func.attr}()"
+        elif isinstance(node.func, ast.Name) and node.func.id == "float" \
+                and len(node.args) == 1 \
+                and not isinstance(node.args[0], ast.Constant):
+            label = "float(...)"
+        elif isinstance(node.func, ast.Name) and node.func.id == "bool" \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], (ast.Call, ast.Attribute)):
+            label = "bool(...)"
+        elif _dotted(node.func) in ("np.asarray", "numpy.asarray"):
+            label = "np.asarray(...)"
+        if label is None or ctx.allowed(node, "host-sync"):
+            continue
+        findings.append(LintFinding(
+            ctx.relpath, node.lineno, node.col_offset, "host-sync",
+            f"{label} in a hot-path module forces a host sync; move it "
+            "off the per-step path or mark the line "
+            "'# lint: host-sync-ok (reason)' if it is a deliberate "
+            "sync point"))
+    return findings
+
+
+# ------------------------------------------------------------ jit-random
+
+def _jitted_function_names(tree: ast.Module) -> Set[str]:
+    """Names of functions that get jitted in this module: decorated
+    with jit/to_static (any dotted spelling), or passed by name to a
+    ``jax.jit(...)`` / ``jit(...)`` / ``to_static(...)`` call."""
+    jit_entries = {"jit", "to_static"}
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dotted = _dotted(target)
+                if dotted.split(".")[-1] in jit_entries:
+                    names.add(node.name)
+                # functools.partial(jax.jit, ...) decorators
+                if isinstance(dec, ast.Call) and dec.args and \
+                        _dotted(dec.args[0]).split(".")[-1] in jit_entries:
+                    names.add(node.name)
+        elif isinstance(node, ast.Call):
+            if _dotted(node.func).split(".")[-1] in jit_entries and \
+                    node.args and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+    return names
+
+
+@rule("jit-random")
+def check_jit_randomness(ctx: FileContext) -> List[LintFinding]:
+    """``np.random.*`` / stdlib ``random.*`` inside a function that
+    gets jitted: the draw happens ONCE at trace time and is baked into
+    the program as a constant — every execution replays it. Use
+    ``jax.random`` with an explicit key (or draw outside the jitted
+    function and pass the result in)."""
+    jitted = _jitted_function_names(ctx.tree)
+    if not jitted:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or node.name not in jitted:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func)
+            if not (dotted.startswith("np.random.")
+                    or dotted.startswith("numpy.random.")
+                    or dotted.startswith("random.")):
+                continue
+            if ctx.allowed(sub, "jit-random"):
+                continue
+            findings.append(LintFinding(
+                ctx.relpath, sub.lineno, sub.col_offset, "jit-random",
+                f"{dotted}() inside jitted function "
+                f"'{node.name}' is drawn once at trace time and baked "
+                "into the program; use jax.random with an explicit "
+                "key"))
+    return findings
+
+
+# ----------------------------------------------------------- bare-except
+
+@rule("bare-except")
+def check_bare_except(ctx: FileContext) -> List[LintFinding]:
+    """``except:`` that neither re-raises nor records through
+    ``monitor.record_swallowed``: a silently swallowed error is how
+    fault-tolerance bugs hide (PR 3 added the recorder precisely so
+    deliberate swallows stay observable)."""
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or node.type is not None:
+            continue
+        ok = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                ok = True
+            elif isinstance(sub, ast.Call) and \
+                    _dotted(sub.func).endswith("record_swallowed"):
+                ok = True
+        if ok or ctx.allowed(node, "bare-except"):
+            continue
+        findings.append(LintFinding(
+            ctx.relpath, node.lineno, node.col_offset, "bare-except",
+            "bare 'except:' without re-raise or "
+            "monitor.record_swallowed(...): swallow observably (catch "
+            "a concrete exception type, or record the swallow)"))
+    return findings
+
+
+# ----------------------------------------------------------- metric-name
+
+_DECLARED_METRICS_CACHE: Optional[Set[str]] = None
+
+
+def _declared_metrics() -> Set[str]:
+    """The DECLARED_METRICS literal parsed out of core/monitor.py (AST
+    only — the lint never imports the framework)."""
+    global _DECLARED_METRICS_CACHE
+    if _DECLARED_METRICS_CACHE is not None:
+        return _DECLARED_METRICS_CACHE
+    from . import repo_root  # lazy: repo_root is defined after the
+    #                          rules module is imported by __init__
+    monitor_path = os.path.join(repo_root(), "paddle_tpu", "core",
+                                "monitor.py")
+    declared: Set[str] = set()
+    try:
+        with open(monitor_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "DECLARED_METRICS"
+                    for t in node.targets):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        declared.add(sub.value)
+    except OSError:
+        pass
+    _DECLARED_METRICS_CACHE = declared
+    return declared
+
+
+@rule("metric-name")
+def check_metric_names(ctx: FileContext) -> List[LintFinding]:
+    """Literal metric names passed to ``metrics.counter/gauge/
+    histogram`` in the framework must be declared in
+    ``core/monitor.DECLARED_METRICS``: an undeclared name is either a
+    typo (the real counter stays 0 forever) or schema drift nobody can
+    dashboard against."""
+    if not ctx.relpath.startswith("paddle_tpu/") \
+            or ctx.relpath in METRIC_NAME_EXEMPT or ctx.is_test_file:
+        return []
+    declared = _declared_metrics()
+    if not declared:
+        return []  # monitor.py unreadable: never cascade bogus findings
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")
+                and _dotted(node.func.value).split(".")[-1] == "metrics"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue  # dynamic names are the recorders' business
+        name = node.args[0].value
+        if name in declared or ctx.allowed(node, "metric-name"):
+            continue
+        findings.append(LintFinding(
+            ctx.relpath, node.lineno, node.col_offset, "metric-name",
+            f"metric {name!r} is not declared in "
+            "core/monitor.DECLARED_METRICS; declare it there (with a "
+            "docstring entry) or fix the typo"))
+    return findings
+
+
+# ---------------------------------------------------------- chaos-marker
+
+def _has_chaos_marker(nodes: List[ast.AST]) -> bool:
+    """True if any node in the chain (module, class, function) carries
+    a pytest chaos marker: module-level ``pytestmark = ...chaos...`` or
+    a ``@pytest.mark.chaos`` decorator."""
+    for node in nodes:
+        if isinstance(node, ast.Module):
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "pytestmark"
+                        for t in stmt.targets):
+                    if any(isinstance(s, ast.Attribute) and s.attr == "chaos"
+                           for s in ast.walk(stmt.value)):
+                        return True
+        else:
+            for dec in getattr(node, "decorator_list", []):
+                if any(isinstance(s, ast.Attribute) and s.attr == "chaos"
+                       for s in ast.walk(dec)):
+                    return True
+    return False
+
+
+@rule("chaos-marker")
+def check_chaos_marker(ctx: FileContext) -> List[LintFinding]:
+    """Tests importing ``paddle_tpu.utils.fault_injection`` must carry
+    the ``chaos`` marker — module-level ``pytestmark`` or a decorator
+    on the enclosing test/class — so ``pytest -m chaos`` runs the whole
+    chaos tier and ``-m 'not chaos'`` really excludes it. This promotes
+    the conftest collection guard (module-level imports only) to lint,
+    which also sees function-level imports."""
+    if not ctx.is_test_file or "conftest" in os.path.basename(ctx.relpath):
+        return []
+    findings = []
+
+    def _imports_fi(node) -> bool:
+        if isinstance(node, ast.Import):
+            return any(a.name.startswith(_FAULT_INJECTION_MODULE)
+                       for a in node.names)
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith(_FAULT_INJECTION_MODULE):
+                return True
+            return mod == "paddle_tpu.utils" and any(
+                a.name == "fault_injection" for a in node.names)
+        return False
+
+    def _walk(node, chain):
+        for child in ast.iter_child_nodes(node):
+            if _imports_fi(child):
+                if not _has_chaos_marker(chain) and \
+                        not ctx.allowed(child, "chaos-marker"):
+                    findings.append(LintFinding(
+                        ctx.relpath, child.lineno, child.col_offset,
+                        "chaos-marker",
+                        "imports paddle_tpu.utils.fault_injection "
+                        "without a chaos marker on the module "
+                        "(pytestmark), class, or test: add "
+                        "@pytest.mark.chaos"))
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                _walk(child, chain + [child])
+            else:
+                _walk(child, chain)
+
+    _walk(ctx.tree, [ctx.tree])
+    return findings
