@@ -466,9 +466,101 @@ def bench_decode(dev, on_tpu):
     }
 
 
+def bench_serve(dev, on_tpu):
+    """Serving-engine bench (ISSUE-8 serve mode): synthetic Poisson
+    arrivals of ragged prompts/budgets against the continuous-batching
+    ServingEngine on test-tiny GPT. A feeder thread submits with
+    exponential inter-arrival gaps (live traffic — requests land
+    mid-decode and are admitted into freed slots); the main thread
+    pumps the scheduler. Reports sustained QPS plus the SLA percentiles
+    the serve.* metrics family tracks — TTFT and per-token latency
+    p50/p95/p99 — as the BENCH_r06 row shape (the flat metric/value
+    keys stay BENCH-schema compatible; the new "sla" sub-dict carries
+    the percentile table). vs_baseline is 1.0 by definition — this row
+    DEFINES the serving baseline from this revision on."""
+    import os
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config
+    from paddle_tpu.models.gpt import gpt
+    from paddle_tpu.serving import RequestParams, ServingEngine
+
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                               96 if on_tpu else 32))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 64.0))  # req/sec
+    max_batch = int(os.environ.get("BENCH_SERVE_BATCH",
+                                   8 if on_tpu else 4))
+    max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", 32))
+    paddle.seed(0)
+    model = gpt("test-tiny", max_position_embeddings=1024)
+    model.bfloat16() if on_tpu else None
+    spec = [paddle.to_tensor(np.zeros((max_batch, 64), np.int32))]
+    cfg = (Config().from_layer(model, spec)
+           .enable_generation(max_new_tokens=max_new,
+                              prefill_buckets=(32, 64, 128),
+                              max_batch=max_batch)
+           .enable_serving(max_queue=n_req))
+    engine = ServingEngine(cfg, poll_every=2)  # warmup compiles here
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, model.cfg.vocab_size,
+                           rng.randint(4, 128)).astype(np.int32)
+               for _ in range(n_req)]
+    budgets = rng.randint(max(4, max_new // 4), max_new + 1,
+                          size=n_req)
+    gaps = rng.exponential(1.0 / rate, size=n_req)
+    handles = []
+
+    def feeder():
+        for p, b, g in zip(prompts, budgets, gaps):
+            time.sleep(g)
+            handles.append(engine.submit(
+                p, RequestParams(max_new_tokens=int(b))))
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=feeder, daemon=True)
+    th.start()
+    while th.is_alive() or engine.busy:
+        if engine.busy:
+            engine.step()
+        else:
+            time.sleep(0.0002)
+    dt = time.perf_counter() - t0
+    th.join()
+
+    assert len(handles) == n_req and \
+        all(h.status.value == "completed" for h in handles)
+    qps = n_req / dt
+    ttft = np.array([h.ttft for h in handles]) * 1e3        # ms
+    per_tok = np.array([h.per_token_latency for h in handles
+                        if h.per_token_latency is not None]) * 1e3
+    pct = lambda a, q: float(np.percentile(a, q))  # noqa: E731
+    sla = {
+        "qps": round(qps, 1),
+        "requests": n_req,
+        "ttft_ms": {q: round(pct(ttft, q), 2) for q in (50, 95, 99)},
+        "token_ms": {q: round(pct(per_tok, q), 2)
+                     for q in (50, 95, 99)},
+        "slots_reused": engine.stats["slots_reused"],
+        "decode_steps": engine.stats["decode_steps"],
+    }
+    return {
+        "metric": f"test-tiny serving QPS (continuous batching b{max_batch} "
+                  f"poisson@{rate:g}/s, ttft p50={sla['ttft_ms'][50]}ms "
+                  f"p99={sla['ttft_ms'][99]}ms, token p50="
+                  f"{sla['token_ms'][50]}ms p99={sla['token_ms'][99]}ms, "
+                  f"device={dev.device_kind})",
+        "value": round(qps, 1),
+        "unit": "req/sec",
+        "vs_baseline": 1.0,
+        "sla": sla,
+    }
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
     "decode": bench_decode,
+    "serve": bench_serve,
     "moe-block": bench_moe_block,
     "resnet50": bench_resnet50,
     "ernie-base": bench_ernie_base,

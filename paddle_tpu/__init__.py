@@ -138,8 +138,8 @@ _LAZY = {"audio", "callbacks", "compat", "dataset", "distributed",
          "geometric", "hub", "linalg", "reader", "regularizer",
          "sysconfig", "version",
          "models", "vision", "kernels", "hapi", "onnx", "profiler",
-         "incubate", "inference", "quantization", "signal", "sparse",
-         "static", "text", "utils"}
+         "incubate", "inference", "quantization", "serving", "signal",
+         "sparse", "static", "text", "utils"}
 
 
 _LAZY_ATTRS = {

@@ -181,6 +181,28 @@ class Histogram(_Metric):
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
+    def percentile(self, q: float) -> float:
+        """Estimate the ``q``-th percentile (0..100) from the bucket
+        counts: linear interpolation inside the bucket that holds the
+        target rank (lower edge = previous bound, first bucket starts
+        at 0). Observations in the overflow bucket clamp to the last
+        bound — the estimate is only as fine as the bounds, so latency
+        histograms should be created with latency-scaled bounds (the
+        serve.* recorders do). Read-side only: never on a hot path."""
+        with self._lock:
+            total = self._count
+            if not total:
+                return 0.0
+            target = total * min(max(float(q), 0.0), 100.0) / 100.0
+            cum = 0
+            lo = 0.0
+            for bound, c in zip(self.bounds, self._counts):
+                if c and cum + c >= target:
+                    return lo + (bound - lo) * (target - cum) / c
+                cum += c
+                lo = bound
+            return lo  # overflow bucket: clamp at the last bound
+
     def buckets(self) -> Dict[str, int]:
         with self._lock:
             out = {f"le_{b}": c for b, c in zip(self.bounds, self._counts)}
@@ -229,7 +251,29 @@ def gauge(name: str, **labels) -> Gauge:
 
 def histogram(name: str, bounds: Optional[Tuple[float, ...]] = None,
               **labels) -> Histogram:
-    return _get(name, Histogram, labels, bounds=bounds)
+    h = _get(name, Histogram, labels, bounds=bounds)
+    if bounds is not None and tuple(bounds) != h.bounds:
+        # registry creation is first-caller-wins; a bounds-less reader
+        # (dashboard polling percentile() before traffic) must not pin
+        # a latency histogram to the byte-scaled defaults. An EMPTY
+        # instance rebinds to the explicit bounds; a populated one
+        # under different bounds is a schema conflict — surfaced as a
+        # once-per-instance warning, never an exception: this call sits
+        # on recording hot paths (the serving scheduler), and telemetry
+        # must not crash the thing it measures.
+        with h._lock:
+            if h._count == 0:
+                h.bounds = tuple(bounds)
+                h._counts = [0] * (len(h.bounds) + 1)
+            elif not getattr(h, "_bounds_conflict_warned", False):
+                h._bounds_conflict_warned = True
+                import warnings
+                warnings.warn(
+                    f"histogram {h.name!r} already holds {h._count} "
+                    "observations under different bounds; keeping the "
+                    "existing bounds (percentiles use the original "
+                    "resolution)", stacklevel=2)
+    return h
 
 
 # ------------------------------------------------------------ lifecycle

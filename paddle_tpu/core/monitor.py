@@ -35,6 +35,13 @@ Metric name scheme (what the summary views group by):
     errors.swallowed{where=...} deliberately swallowed exceptions
     gen.tokens / gen.prefill_steps / gen.decode_steps   generation loop
     gen.cache_occupancy         gauge: KV cache fraction in use
+    serve.requests{status=...}  terminal request outcomes (completed/
+                                cancelled/rejected) — QPS = rate of this
+    serve.queue_depth           gauge: requests waiting for a slot
+    serve.ttft                  histogram (s): submit -> first token
+    serve.token_latency         histogram (s): per-token decode cadence
+    serve.slot_occupancy        gauge: busy decode slots / max_batch
+    serve.cancellations{reason=...}   deadline/shutdown cancellations
     analysis.findings{check=,severity=}   static-audit findings
 """
 from __future__ import annotations
@@ -65,6 +72,8 @@ DECLARED_METRICS = frozenset({
     "errors.swallowed",
     "gen.tokens", "gen.prefill_steps", "gen.decode_steps",
     "gen.cache_occupancy",
+    "serve.requests", "serve.queue_depth", "serve.ttft",
+    "serve.token_latency", "serve.slot_occupancy", "serve.cancellations",
     "analysis.findings",
 })
 
@@ -273,6 +282,64 @@ def record_cache_occupancy(frac: float):
     if not enabled:
         return
     metrics.gauge("gen.cache_occupancy").set(float(frac))
+
+
+# --------------------------------------------------------- serving layer
+
+# Latency-scaled histogram bounds (seconds): 100µs .. ~74s in sqrt(2)
+# steps, so percentile estimates stay within ~±20% across the whole
+# serving range (the default power-of-4 byte bounds would collapse every
+# sub-second latency into two buckets).
+_SERVE_LATENCY_BOUNDS = tuple(1e-4 * 2 ** (i / 2.0) for i in range(40))
+
+
+def record_serve_request(status: str):
+    """One request reaching a terminal status (completed | cancelled |
+    rejected). QPS is the rate of this counter."""
+    if not enabled:
+        return
+    metrics.counter("serve.requests", status=status).inc()
+    metrics.counter("serve.requests").inc()
+
+
+def record_serve_queue_depth(depth: int):
+    if not enabled:
+        return
+    metrics.gauge("serve.queue_depth").set(float(depth))
+
+
+def record_serve_ttft(seconds: float):
+    """Time-to-first-token: request submitted -> prefill's sampled
+    token on host (includes queue wait — the SLA the client sees)."""
+    if not enabled:
+        return
+    metrics.histogram("serve.ttft", bounds=_SERVE_LATENCY_BOUNDS) \
+        .observe(float(seconds))
+
+
+def record_serve_token_latency(seconds: float):
+    """Per-token decode cadence, observed once per scheduler poll
+    window (wall time across the window / decode steps in it)."""
+    if not enabled:
+        return
+    metrics.histogram("serve.token_latency",
+                      bounds=_SERVE_LATENCY_BOUNDS).observe(float(seconds))
+
+
+def record_serve_slot_occupancy(frac: float):
+    """Busy decode slots / max_batch at the last scheduler poll."""
+    if not enabled:
+        return
+    metrics.gauge("serve.slot_occupancy").set(float(frac))
+
+
+def record_serve_cancellation(reason: str):
+    """A request cancelled before completing (reason: deadline |
+    shutdown)."""
+    if not enabled:
+        return
+    metrics.counter("serve.cancellations", reason=reason).inc()
+    metrics.counter("serve.cancellations").inc()
 
 
 # ------------------------------------------------------- analysis layer

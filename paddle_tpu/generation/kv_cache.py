@@ -141,6 +141,38 @@ class KVCache:
         return self.kv_len[:, None] + \
             jnp.arange(s, dtype=jnp.int32)[None, :]
 
+    # -------------------------------------------------------- slot reuse
+    def reset_rows(self, rows) -> "KVCache":
+        """Free batch rows for reuse: zero ``kv_len`` at ``rows`` (one
+        row index, an int array of rows, or a [batch] bool mask)
+        without touching the K/V buffers or the pytree structure — the
+        serving scheduler calls this (jit-compiled, cache donated) when
+        a slot's request terminates, so slot turnover never rebuilds or
+        reallocates the cache. Stale K/V beyond a reset row's kv_len is
+        invisible (attention masks by kv_len) and the next
+        prefill-into-slot overwrites it; after a reset the ring write
+        position wraps back to 0 for that row."""
+        rows = jnp.asarray(_raw(rows))
+        if rows.dtype == jnp.bool_:
+            kv_len = jnp.where(rows, 0, self.kv_len)
+        else:
+            kv_len = self.kv_len.at[rows].set(0)
+        return KVCache(self.k, self.v, kv_len)
+
+    def copy_row_from(self, src: "KVCache", src_row, dst_row) -> "KVCache":
+        """Slot admission: overwrite row ``dst_row`` of this cache with
+        row ``src_row`` of ``src`` — K, V, and kv_len — leaving every
+        other row untouched. ``src`` must share layers/max_len/heads/
+        head_dim (typically a batch-1 prefill cache being installed
+        into a freed slot of the shared decode cache). Row indices may
+        be traced scalars, so ONE compiled program serves every slot."""
+        src_row = jnp.asarray(_raw(src_row), jnp.int32)
+        dst_row = jnp.asarray(_raw(dst_row), jnp.int32)
+        return KVCache(
+            self.k.at[:, dst_row].set(src.k[:, src_row].astype(self.k.dtype)),
+            self.v.at[:, dst_row].set(src.v[:, src_row].astype(self.v.dtype)),
+            self.kv_len.at[dst_row].set(src.kv_len[src_row]))
+
     def with_kv_len(self, kv_len) -> "KVCache":
         kv_len = jnp.asarray(_raw(kv_len), jnp.int32)
         if kv_len.ndim == 0:

@@ -386,6 +386,12 @@ class MetricsCallback(Callback):
         snap = metrics.snapshot().get(name)
         return int(snap["value"]) if snap else 0
 
+    @staticmethod
+    def _gauge(name: str):
+        from ..profiler import metrics
+        snap = metrics.snapshot().get(name)
+        return float(snap["value"]) if snap else None
+
     def on_train_begin(self, logs=None):
         from ..profiler import metrics
         self._was_enabled = metrics.is_enabled()
@@ -453,6 +459,15 @@ class MetricsCallback(Callback):
             getattr(self, "_gen_tokens0", 0)
         if gen_tokens:
             stats["gen_tokens_per_sec"] = gen_tokens / dt
+        # capacity gauges (generation KV-cache fill, serving engine slot
+        # occupancy) — surfaced whenever something recorded them
+        for gauge_name, label in (("gen.cache_occupancy",
+                                   "cache_occupancy"),
+                                  ("serve.slot_occupancy",
+                                   "slot_occupancy")):
+            val = self._gauge(gauge_name)
+            if val is not None:
+                stats[label] = val
         try:
             stats["peak_memory_bytes"] = device.max_memory_allocated()
         except Exception:

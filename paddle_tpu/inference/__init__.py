@@ -8,5 +8,6 @@ StableHLO artifact; precision conversion happens at trace time.
 """
 from .benchmark import Benchmark, device_time_per_run  # noqa: F401
 from .config import Config, PrecisionType  # noqa: F401
+from .precision import ServingParams, serving_params  # noqa: F401
 from .predictor import (InferTensor, Predictor,  # noqa: F401
                         create_predictor)

@@ -36,6 +36,7 @@ class Config:
         self._compile_cache_dir: Optional[str] = None
         self._math_threads = 1
         self._generation: Optional[dict] = None
+        self._serving: Optional[dict] = None
         if prog_file is not None:
             self.set_model(prog_file, params_file)
 
@@ -113,6 +114,26 @@ class Config:
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), eos_token_id=eos_token_id,
             pad_token_id=pad_token_id)
+        return self
+
+    def enable_serving(self, max_queue: int = 64, poll_every: int = 4,
+                       drain_timeout_s: float = 30.0,
+                       default_deadline_s=None, cache_max_len=None):
+        """Continuous-batching knobs for ``paddle_tpu.serving.
+        ServingEngine`` (which also needs ``enable_generation()`` — the
+        engine reuses its prompt-bucket set, fixed decode batch, and
+        sampling config). ``max_queue`` bounds admission (submit past
+        it raises QueueFull), ``poll_every`` sets the scheduler's
+        completion-poll cadence in decode steps, ``drain_timeout_s``
+        bounds the graceful-shutdown drain, ``default_deadline_s``
+        applies a deadline to requests that don't carry one, and
+        ``cache_max_len`` overrides the shared KV ring length (default:
+        largest bucket + max_new_tokens, rounded up)."""
+        self._serving = dict(
+            max_queue=int(max_queue), poll_every=int(poll_every),
+            drain_timeout_s=float(drain_timeout_s),
+            default_deadline_s=default_deadline_s,
+            cache_max_len=cache_max_len)
         return self
 
     def set_compile_cache_dir(self, path: str):

@@ -1,0 +1,135 @@
+"""Serving precision paths, shared by the Predictor and ServingEngine.
+
+One implementation of the serving-time parameter preparation the
+reference performs as inference IR passes
+(convert_to_mixed_precision.cc, the PTQ int8 deployment in
+slim/quantization/post_training_quantization.py):
+
+- bf16 / fp16: float params cast once at build, feeds cast per call,
+  compute traced in the low dtype (BASELINE.md measured 1.49-1.79x
+  matmul wins at bf16 on v5e);
+- int8 weight-only: Linear/Conv weights stored in HBM as int8 +
+  per-channel scales, dequantized INSIDE the compiled program where XLA
+  fuses the multiply into the matmul/conv read; remaining floats serve
+  bf16;
+- int8 compute (``Config.enable_int8_compute``): Linears swapped for
+  int8 x int8 -> int32 MXU modules before tracing
+  (quantization/int8_compute.py), remaining floats bf16.
+
+Both the Predictor's ``run()`` path and the serving engine's
+prefill/decode programs consume the same :class:`ServingParams`, so the
+precision a config declares can never drift between the one-shot and
+continuous-batching entry points (the audit entry points trace exactly
+what ``materialize`` produces).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .config import Config, PrecisionType
+
+__all__ = ["ServingParams", "serving_params"]
+
+
+@dataclasses.dataclass
+class ServingParams:
+    """The precision-prepared parameter set a serving program closes
+    over. ``vals`` are the stored arrays (possibly cast or int8);
+    ``materialize`` is the in-trace view the traced forward consumes."""
+
+    layer: object                       # possibly module-swapped
+    names: List[str]
+    vals: List[jax.Array]
+    scales: Dict[str, jax.Array]        # int8 weight-only: name -> s/127
+    compute_dtype: Optional[object]     # float feeds cast to this
+
+    def materialize(self, param_vals):
+        """In-trace parameter view: dequantize int8 weight-only entries
+        (bf16 * scale — XLA fuses the multiply into the consuming
+        matmul/conv read), pass everything else through unchanged."""
+        if not self.scales:
+            return list(param_vals)
+        out = []
+        for n, v in zip(self.names, param_vals):
+            if n in self.scales:
+                v = v.astype(jnp.bfloat16) * \
+                    self.scales[n].astype(jnp.bfloat16)
+            out.append(v)
+        return out
+
+    def cast_feed(self, arr):
+        """The serving input cast: float feeds move to the compute
+        dtype, everything else (ids, masks) passes through."""
+        if self.compute_dtype is not None and \
+                jnp.issubdtype(arr.dtype, jnp.floating):
+            return arr.astype(self.compute_dtype)
+        return arr
+
+
+def serving_params(layer, config: Config) -> ServingParams:
+    """Prepare ``layer``'s parameters for serving under ``config``'s
+    precision. Pure preparation — nothing is traced or compiled here."""
+    layer.eval()
+    state = layer.state_dict()
+    names = list(state.keys())
+    vals = [t._data for t in state.values()]
+    prec = config.precision
+    compute_dtype = None
+    scales: Dict[str, jax.Array] = {}
+
+    if prec in (PrecisionType.Bfloat16, PrecisionType.Half):
+        # mixed-precision convert pass analog
+        # (inference/analysis/passes/convert_to_mixed_precision.cc):
+        # cast float params at load, trace compute in that dtype
+        compute_dtype = jnp.bfloat16 if prec == PrecisionType.Bfloat16 \
+            else jnp.float16
+        vals = [v.astype(compute_dtype)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for v in vals]
+    elif prec == PrecisionType.Int8 and \
+            getattr(config, "_int8_compute", False):
+        # int8 COMPUTE: swap Linears for int8 x int8 -> int32 modules
+        # before tracing; remaining float params serve bf16
+        from ..quantization.int8_compute import convert_to_int8_compute
+        layer = convert_to_int8_compute(layer, inplace=False)
+        state = layer.state_dict()
+        names = list(state.keys())
+        vals = [t._data for t in state.values()]
+        vals = [v.astype(jnp.bfloat16)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for v in vals]
+        compute_dtype = jnp.bfloat16
+    elif prec == PrecisionType.Int8:
+        # int8 serving (the reference's PTQ deployment): Linear/Conv
+        # weights live in HBM as int8 + per-channel scales; activations
+        # run bf16 (weight-only int8 — the practical TPU mode). Works
+        # for PTQ-converted models and as dynamic weight-only
+        # quantization for plain models.
+        from ..nn.layers_common import Conv2D, Linear
+        from ..quantization.fake_quant import quantize_int8
+        axes: Dict[str, int] = {}
+        for lname, sub in layer.named_sublayers():
+            if isinstance(sub, Linear):
+                axes[f"{lname}.weight"] = 1
+            elif isinstance(sub, Conv2D):
+                axes[f"{lname}.weight"] = 0
+        new_vals = []
+        for n, v in zip(names, vals):
+            if n in axes and jnp.issubdtype(v.dtype, jnp.floating):
+                q, s = quantize_int8(v, axis=axes[n])
+                new_vals.append(q)
+                # q = round(x / s * 127)  =>  x ≈ q * (s / 127)
+                scales[n] = jnp.asarray(s, jnp.float32) / 127.0
+            elif jnp.issubdtype(v.dtype, jnp.floating):
+                new_vals.append(v.astype(jnp.bfloat16))
+            else:
+                new_vals.append(v)
+        vals = new_vals
+        compute_dtype = jnp.bfloat16
+
+    return ServingParams(layer=layer, names=names, vals=vals,
+                         scales=scales, compute_dtype=compute_dtype)

@@ -128,78 +128,20 @@ class Predictor:
         from ..core.tensor import Tensor
         from ..jit.api import functional_call
         from ..jit.save_load import _to_sds
+        from .precision import serving_params
 
-        layer = self.config._layer
-        layer.eval()
-        state = layer.state_dict()
-        names = list(state.keys())
-        vals = [t._data for t in state.values()]
-        prec = self.config.precision
-        if prec in (PrecisionType.Bfloat16, PrecisionType.Half):
-            # mixed-precision convert pass analog
-            # (inference/analysis/passes/convert_to_mixed_precision.cc):
-            # cast float params at load, trace compute in that dtype
-            target = jnp.bfloat16 if prec == PrecisionType.Bfloat16 \
-                else jnp.float16
-            vals = [v.astype(target)
-                    if jnp.issubdtype(v.dtype, jnp.floating) else v
-                    for v in vals]
-        scales: Dict[str, jax.Array] = {}
-        if prec == PrecisionType.Int8 and \
-                getattr(self.config, "_int8_compute", False):
-            # int8 COMPUTE: swap Linears for int8 x int8 -> int32
-            # modules before tracing (quantization/int8_compute.py);
-            # remaining float params serve bf16
-            from ..quantization.int8_compute import \
-                convert_to_int8_compute
-            layer = convert_to_int8_compute(layer, inplace=False)
-            state = layer.state_dict()
-            names = list(state.keys())
-            vals = [t._data for t in state.values()]
-            vals = [v.astype(jnp.bfloat16)
-                    if jnp.issubdtype(v.dtype, jnp.floating) else v
-                    for v in vals]
-        elif prec == PrecisionType.Int8:
-            # int8 serving (the reference's PTQ deployment,
-            # slim/quantization/post_training_quantization.py):
-            # Linear/Conv weights live in HBM as int8 + per-channel
-            # scales; dequant happens INSIDE the compiled program where
-            # XLA fuses it into the matmul/conv read. Activations run
-            # bf16 (weight-only int8 — the practical TPU mode; a PTQ'd
-            # model additionally fake-quants activations with its
-            # calibrated scales). Works for PTQ-converted models and as
-            # dynamic weight-only quantization for plain models.
-            from ..nn.layers_common import Conv2D, Linear
-            from ..quantization.fake_quant import quantize_int8
-            axes: Dict[str, int] = {}
-            for lname, sub in layer.named_sublayers():
-                if isinstance(sub, Linear):
-                    axes[f"{lname}.weight"] = 1
-                elif isinstance(sub, Conv2D):
-                    axes[f"{lname}.weight"] = 0
-            new_vals = []
-            for n, v in zip(names, vals):
-                if n in axes and jnp.issubdtype(v.dtype, jnp.floating):
-                    q, s = quantize_int8(v, axis=axes[n])
-                    new_vals.append(q)
-                    # q = round(x / s * 127)  =>  x ≈ q * (s / 127)
-                    scales[n] = jnp.asarray(s, jnp.float32) / 127.0
-                elif jnp.issubdtype(v.dtype, jnp.floating):
-                    new_vals.append(v.astype(jnp.bfloat16))
-                else:
-                    new_vals.append(v)
-            vals = new_vals
+        # the serving precision passes (bf16/fp16 cast, int8 weight-only
+        # quant + in-trace dequant, int8-compute module swap) live in
+        # precision.serving_params — one implementation shared with the
+        # continuous-batching ServingEngine
+        sp = serving_params(self.config._layer, self.config)
+        layer, names, vals = sp.layer, sp.names, sp.vals
         specs = [_to_sds(s) for s in self.config._input_spec]
         self._input_names = [f"x{i}" for i in range(len(specs))]
         self._output_names = None
 
         def fwd(param_vals, *inputs):
-            dequant = []
-            for n, v in zip(names, param_vals):
-                if n in scales:
-                    v = v.astype(jnp.bfloat16) * \
-                        scales[n].astype(jnp.bfloat16)
-                dequant.append(v)
+            dequant = sp.materialize(param_vals)
             out = functional_call(layer, dict(zip(names, dequant)),
                                   *[Tensor(i) for i in inputs])
             return [t._data if isinstance(t, Tensor) else t
@@ -209,19 +151,10 @@ class Predictor:
         jitted = jax.jit(fwd)
         # kept for audit_forward(): the raw traceable + its operands
         self._fwd_fn, self._fwd_vals, self._fwd_specs = fwd, vals, specs
-        low_prec = (PrecisionType.Bfloat16, PrecisionType.Half,
-                    PrecisionType.Int8)
+        self._serving_params = sp
 
         def run_fn(feeds: List[jax.Array]):
-            cast = []
-            for f, spec in zip(feeds, specs):
-                if prec in low_prec and \
-                        jnp.issubdtype(f.dtype, jnp.floating):
-                    tgt = jnp.float16 if prec == PrecisionType.Half \
-                        else jnp.bfloat16
-                    f = f.astype(tgt)
-                cast.append(f)
-            return jitted(vals, *cast)
+            return jitted(vals, *[sp.cast_feed(f) for f in feeds])
 
         self._run_fn = run_fn
 
@@ -371,11 +304,10 @@ class Predictor:
                 "traceable callable to audit")
         from ..analysis import abstractify, audit as _audit
         specs = [abstractify(s) for s in self._fwd_specs]
-        prec = self.config.precision
-        if prec in (PrecisionType.Bfloat16, PrecisionType.Half,
-                    PrecisionType.Int8):
-            tgt = jnp.float16 if prec == PrecisionType.Half \
-                else jnp.bfloat16
+        # the feed dtype comes from the SAME ServingParams run() casts
+        # with — the audited program cannot drift from the served one
+        tgt = self._serving_params.compute_dtype
+        if tgt is not None:
             specs = [jax.ShapeDtypeStruct(s.shape, tgt)
                      if jnp.issubdtype(s.dtype, jnp.floating) else s
                      for s in specs]

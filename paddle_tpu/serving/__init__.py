@@ -1,0 +1,19 @@
+"""paddle_tpu.serving — continuous-batching serving engine.
+
+Slot-scheduled decode over one shared donated KV cache: requests queue
+through a Future-style front-end, prefill at a small fixed set of
+prompt shape buckets, and decode at a fixed batch where finished rows
+free their slot in place for the next admission — XLA never retraces
+under live traffic (``jit.compile{cause=new_shape}`` == 0 at steady
+state) and the decode loop never drains.
+
+See docs/architecture.md "Serving engine".
+"""
+from .engine import ServingEngine  # noqa: F401
+from .request import (QueueFull, Request, RequestFailed,  # noqa: F401
+                      RequestParams, RequestStatus)
+
+__all__ = [
+    "QueueFull", "Request", "RequestFailed", "RequestParams",
+    "RequestStatus", "ServingEngine",
+]

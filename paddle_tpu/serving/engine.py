@@ -1,0 +1,763 @@
+"""Continuous-batching serving engine: slot-scheduled decode over ONE
+shared, donated KV cache.
+
+The reference ships serving as a whole layer (paddle/fluid/inference,
+~90k LoC — PAPER.md §1); ours is a slot scheduler over the AOT
+(prefill, decode) machinery PR 6 built:
+
+- **decode never drains and never retraces.** The decode step always
+  runs at the fixed batch of ``max_batch`` slots against the shared
+  ring KVCache. A finished row (eos or budget) is masked by its
+  ``finished`` lane, its tokens stop advancing, and its ``kv_len`` is
+  pinned to 0 in-trace — the slot is freed IN PLACE, no reshape, no
+  re-trace, no rebuild of the cache pytree.
+- **admission = prefill into a slot.** A queued request is prefilled
+  alone (batch 1) at its prompt's shape bucket (the
+  ``Config.enable_generation`` bucket set), then a jitted admit program
+  copies the row cache into the freed slot (``KVCache.copy_row_from``)
+  and resets that slot's token/finished/step/budget lanes. One admit
+  program serves every slot — the slot index is data, not shape.
+- **every program is compiled at warmup.** ``warmup()`` AOT-lowers one
+  prefill executable per bucket plus the decode/admit/free trio; after
+  it, a compile the engine is ever forced to do mid-traffic is recorded
+  as ``jit.compile{cause=new_shape}`` — the steady-state no-retrace
+  invariant the tier-1 gate asserts stays 0.
+- **precision**: the engine serves the bf16/fp16 cast (and the int8
+  weight-only / int8-compute hooks) through the same
+  ``inference.precision.serving_params`` the Predictor audits —
+  BASELINE.md measured 1.49-1.79x matmul wins at bf16.
+- **SLA observability**: the ``serve.*`` metrics family (requests by
+  terminal status, queue-depth gauge, TTFT + per-token latency
+  histograms, slot occupancy, cancellations) flows through
+  ``core.monitor`` into the existing Perfetto export.
+
+Host syncs are confined to the scheduler's poll cadence (every
+``poll_every`` decode steps: two [batch]-lane reads), one small sync
+per admission (the TTFT measurement point), and one row read per
+completion — the decode hot loop itself dispatches without waiting.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import monitor
+from ..core.tensor import Tensor
+from ..generation.api import (GenerationConfig, _expect_logits_cache,
+                              _round_up, _sample_cfg)
+from ..generation.sampling import sample
+from .request import (QueueFull, Request, RequestParams, RequestStatus)
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    """Slot-scheduled continuous batching over a live generative layer.
+
+    ::
+
+        cfg = (inference.Config().from_layer(model, input_spec)
+               .enable_generation(max_new_tokens=64,
+                                  prefill_buckets=(64, 128, 256),
+                                  max_batch=8, eos_token_id=50256)
+               .enable_serving(max_queue=128))
+        engine = ServingEngine(cfg)
+        handle = engine.submit(prompt_ids,
+                               RequestParams(max_new_tokens=32))
+        tokens = handle.result()          # pumps inline if no thread
+        # or: engine.serve_forever(request_iter)   # blocking loop
+        # or: engine.start(); ...; engine.shutdown()
+
+    The config must name a live layer implementing the KV-cache
+    protocol (``Config.from_layer``) and have ``enable_generation()``
+    set; ``enable_serving()`` and the keyword arguments below tune the
+    scheduler (kwargs win)."""
+
+    def __init__(self, config, *, max_queue: Optional[int] = None,
+                 poll_every: Optional[int] = None,
+                 drain_timeout_s: Optional[float] = None,
+                 default_deadline_s: Optional[float] = None,
+                 cache_max_len: Optional[int] = None,
+                 warmup: bool = True, seed: Optional[int] = None):
+        from ..inference.precision import serving_params
+        from ..jit.api import _unwrap, functional_call
+
+        layer = getattr(config, "_layer", None)
+        if layer is None:
+            raise ValueError("ServingEngine needs a live layer: use "
+                             "Config.from_layer(...) (artifact-backed "
+                             "configs have no cache protocol to drive)")
+        opts = getattr(config, "_generation", None)
+        if opts is None:
+            raise ValueError("ServingEngine reuses the generation "
+                             "serving setup: call "
+                             "Config.enable_generation() first")
+        sopts = getattr(config, "_serving", None) or {}
+
+        def _opt(kw, key, default):
+            if kw is not None:
+                return kw
+            v = sopts.get(key)
+            return default if v is None else v
+
+        self.max_queue = int(_opt(max_queue, "max_queue", 64))
+        self.poll_every = max(1, int(_opt(poll_every, "poll_every", 4)))
+        self.drain_timeout_s = float(  # lint: host-sync-ok (config coercion)
+            _opt(drain_timeout_s, "drain_timeout_s", 30.0))
+        self.default_deadline_s = _opt(default_deadline_s,
+                                       "default_deadline_s", None)
+        cache_max_len = _opt(cache_max_len, "cache_max_len", None)
+
+        # precision: the same serving cast/quant pass the Predictor's
+        # run() path audits (int8-compute may swap modules)
+        self._sp = serving_params(layer, config)
+        layer = self._sp.layer
+        layer.eval()
+        self.network = layer
+        self.config = config
+
+        self._cfg = GenerationConfig(
+            do_sample=opts["do_sample"], temperature=opts["temperature"],
+            top_k=opts["top_k"], top_p=opts["top_p"],
+            eos_token_id=opts["eos_token_id"],
+            pad_token_id=opts["pad_token_id"])
+        self.max_new_tokens = int(opts["max_new_tokens"])
+        self.max_batch = int(opts["max_batch"])
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+
+        max_pos = getattr(getattr(layer, "cfg", None),
+                          "max_position_embeddings", None)
+        buckets = sorted(int(b) for b in opts["prefill_buckets"]
+                         if max_pos is None
+                         or b + self.max_new_tokens <= int(max_pos))
+        if not buckets:
+            raise ValueError(
+                f"no prefill bucket in {opts['prefill_buckets']} fits "
+                f"max_position_embeddings={max_pos} with "
+                f"max_new_tokens={self.max_new_tokens}")
+        self.buckets = buckets
+        self.max_len = int(cache_max_len) if cache_max_len else \
+            _round_up(buckets[-1] + self.max_new_tokens)
+        if self.max_len < buckets[-1] + self.max_new_tokens:
+            raise ValueError(
+                f"cache_max_len {self.max_len} < largest bucket "
+                f"{buckets[-1]} + max_new_tokens {self.max_new_tokens}; "
+                "the shared ring cache would wrap under a full-length "
+                "request")
+
+        names = self._sp.names
+        sp = self._sp
+        cfg = self._cfg
+
+        def prefill_fn(state_vals, ids, plen, key, cfg, cache_len):
+            params = sp.materialize(state_vals)
+            out = functional_call(
+                layer, dict(zip(names, params)), Tensor(ids),
+                use_cache=True, prompt_len=plen, cache_max_len=cache_len)
+            logits, cache = _expect_logits_cache(out)
+            logits = _unwrap(logits)[:, -1].astype(jnp.float32)
+            k0, k1 = jax.random.split(key)
+            tok = sample(logits, k0, **_sample_cfg(cfg))
+            if cfg.eos_token_id is not None:
+                finished = tok == cfg.eos_token_id
+            else:
+                finished = jnp.zeros(tok.shape, bool)
+            return tok, cache, k1, finished
+
+        def step_fn(state_vals, tok, cache, key, finished, steps,
+                    budget, out_buf, cfg):
+            params = sp.materialize(state_vals)
+            out = functional_call(layer, dict(zip(names, params)),
+                                  Tensor(tok[:, None]), cache=cache)
+            logits, cache = _expect_logits_cache(out)
+            logits = _unwrap(logits)[:, -1].astype(jnp.float32)
+            k0, k1 = jax.random.split(key)
+            nxt = sample(logits, k0, **_sample_cfg(cfg))
+            rows = jnp.arange(nxt.shape[0], dtype=jnp.int32)
+            idx = jnp.clip(steps, 0, out_buf.shape[1] - 1)
+            # finished lanes are masked: their buffer entry and step
+            # count stay frozen while the fixed-batch step runs on
+            out_buf = out_buf.at[rows, idx].set(
+                jnp.where(finished, out_buf[rows, idx], nxt))
+            steps = steps + jnp.where(finished, 0, 1)
+            if cfg.eos_token_id is not None:
+                finished = finished | (nxt == cfg.eos_token_id)
+            finished = finished | (steps >= budget)
+            # dead slots: pin kv_len at 0 so an idle lane neither wraps
+            # the ring nor walks the position table out of range while
+            # it waits for its next admission
+            cache = cache.with_kv_len(
+                jnp.where(finished, 0, cache.kv_len))
+            return nxt, cache, k1, finished, steps, budget, out_buf
+
+        def admit_fn(cache, tok, finished, steps, budget, out_buf,
+                     slot, row_cache, first_tok, first_fin, row_budget):
+            # install the batch-1 prefill row into the freed slot; the
+            # slot index is a traced scalar — one program, every slot
+            cache = cache.copy_row_from(row_cache, 0, slot)
+            tok = tok.at[slot].set(first_tok[0])
+            steps = steps.at[slot].set(1)
+            budget = budget.at[slot].set(row_budget)
+            row = jnp.zeros((out_buf.shape[1],), jnp.int32) \
+                .at[0].set(first_tok[0])
+            out_buf = out_buf.at[slot].set(row)
+            finished = finished.at[slot].set(
+                first_fin[0] | (row_budget <= 1))
+            return cache, tok, finished, steps, budget, out_buf
+
+        def free_fn(cache, finished, slot):
+            return cache.reset_rows(slot), finished.at[slot].set(True)
+
+        self._prefill_fn, self._step_fn = prefill_fn, step_fn
+        self._admit_fn, self._free_fn = admit_fn, free_fn
+        # donate on TPU only (CPU/GPU donation is a no-op that warns
+        # once per program); audit() gates the TPU donation INTENT
+        tpu = jax.default_backend() == "tpu"
+        self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
+        self._step_jit = jax.jit(
+            step_fn, static_argnums=(8,),
+            donate_argnums=(1, 2, 3, 4, 5, 6, 7) if tpu else ())
+        self._admit_jit = jax.jit(
+            admit_fn,
+            donate_argnums=(0, 1, 2, 3, 4, 5, 7) if tpu else ())
+        self._free_jit = jax.jit(
+            free_fn, donate_argnums=(0, 1) if tpu else ())
+
+        # ------------------------------------------------------- state
+        self._state = tuple(self._sp.vals)
+        if seed is not None:
+            self._key = jax.random.PRNGKey(int(seed))
+        elif cfg.do_sample:
+            from ..core import random as _random
+            self._key = _random.next_key()
+        else:
+            self._key = jax.random.PRNGKey(0)  # greedy: never consumed
+
+        B, cap = self.max_batch, self.max_new_tokens
+        sds = jax.ShapeDtypeStruct
+        cache_aval = jax.eval_shape(
+            lambda s, i, p, k: prefill_fn(s, i, p, k, cfg, self.max_len),
+            self._state, sds((B, buckets[0]), jnp.int32),
+            sds((B,), jnp.int32), self._key)[1]
+        self._cache = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), cache_aval)
+        self._tok = jnp.zeros((B,), jnp.int32)
+        self._finished = jnp.ones((B,), bool)   # empty slots are masked
+        self._steps = jnp.zeros((B,), jnp.int32)
+        self._budget = jnp.zeros((B,), jnp.int32)
+        self._out_buf = jnp.zeros((B, cap), jnp.int32)
+
+        self._slots: List[Optional[Request]] = [None] * B
+        self._slot_used = [False] * B          # reuse detection
+        self._queue = collections.deque()
+        self._qlock = threading.Lock()
+        self._pump_lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._exes: Dict = {}
+        self._warm = False
+        self._shutdown = False
+        self._steps_since_poll = 0
+        self._window_t0: Optional[float] = None
+        self._window_steps = 0
+        self.stats = dict(submitted=0, admitted=0, completed=0,
+                          cancelled=0, rejected=0, slots_reused=0,
+                          decode_steps=0, prefills=0)
+        if warmup:
+            self.warmup()
+
+    # ------------------------------------------------------ compilation
+    def _ensure_eval(self):
+        # a fit() loop sharing this layer flips it back to train mode
+        # every batch; tracing then would bake active dropout into the
+        # served program — or close over extra RNG inputs and break the
+        # compiled call signature. Same contract as
+        # GenerationSession._ensure_eval: force eval at every trace
+        # point (executable dispatches are mode-independent).
+        if self.network.training:
+            self.network.eval()
+
+    def _compiled(self, cache_key, build):
+        exe = self._exes.get(cache_key)
+        if exe is None:
+            self._ensure_eval()
+            # a compile after warmup means live traffic hit a shape no
+            # executable was built for — exactly what the steady-state
+            # no-retrace gate (jit.compile{cause=new_shape} == 0) guards
+            monitor.record_retrace(
+                "first" if not self._warm else "new_shape")
+            exe = build()
+            self._exes[cache_key] = exe
+        return exe
+
+    def _exe_prefill(self, bucket: int):
+        sds = jax.ShapeDtypeStruct
+        return self._compiled(("prefill", bucket),
+                              lambda: self._prefill_jit.lower(
+            self._state, sds((1, bucket), jnp.int32),
+            sds((1,), jnp.int32), sds((2,), jnp.uint32), self._cfg,
+            self.max_len).compile())
+
+    def _exe_step(self):
+        return self._compiled(("step",), lambda: self._step_jit.lower(
+            self._state, self._tok, self._cache, self._key,
+            self._finished, self._steps, self._budget, self._out_buf,
+            self._cfg).compile())
+
+    def _row_avals(self):
+        """(tok, row_cache, finished) avals of a batch-1 prefill — the
+        admit program's source operands (bucket-independent: every
+        bucket prefills into a cache of the shared max_len)."""
+        tok_a, row_cache_a, _, fin_a = jax.eval_shape(
+            lambda s, i, p, k: self._prefill_fn(s, i, p, k, self._cfg,
+                                                self.max_len),
+            self._state,
+            jax.ShapeDtypeStruct((1, self.buckets[0]), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        return tok_a, row_cache_a, fin_a
+
+    def _exe_admit(self):
+        def build():
+            tok_a, row_cache_a, fin_a = self._row_avals()
+            scalar = jnp.asarray(0, jnp.int32)
+            return self._admit_jit.lower(
+                self._cache, self._tok, self._finished, self._steps,
+                self._budget, self._out_buf, scalar, row_cache_a,
+                tok_a, fin_a, scalar).compile()
+        return self._compiled(("admit",), build)
+
+    def _exe_free(self):
+        return self._compiled(("free",), lambda: self._free_jit.lower(
+            self._cache, self._finished,
+            jnp.asarray(0, jnp.int32)).compile())
+
+    def warmup(self):
+        """Compile every program the scheduler can dispatch (one
+        prefill per bucket + the decode/admit/free trio). After this,
+        live traffic only ever hits warm executables; any later compile
+        is recorded as ``jit.compile{cause=new_shape}``."""
+        for b in self.buckets:
+            self._exe_prefill(b)
+        self._exe_step()
+        self._exe_admit()
+        self._exe_free()
+        self._warm = True
+        return self
+
+    # -------------------------------------------------------- admission
+    def submit(self, prompt, params: Optional[RequestParams] = None) \
+            -> Request:
+        """Enqueue one prompt; returns the Future-style handle
+        immediately. Raises :class:`QueueFull` at the queue-depth bound
+        and ``ValueError`` for prompts no compiled bucket can hold —
+        admission control happens here, not deep in the scheduler."""
+        if isinstance(prompt, Tensor):
+            prompt = prompt._data
+        ids = np.asarray(prompt).reshape(-1).astype(np.int32)  # lint: host-sync-ok (pre-dispatch input prep)
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if ids.size > self.buckets[-1]:
+            raise ValueError(
+                f"prompt of {ids.size} tokens exceeds the largest "
+                f"compiled prefill bucket {self.buckets[-1]}")
+        params = params if params is not None else RequestParams()
+        budget = self.max_new_tokens if params.max_new_tokens is None \
+            else int(params.max_new_tokens)
+        if not 1 <= budget <= self.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {budget} outside [1, "
+                f"{self.max_new_tokens}] (the compiled budget; raise it "
+                "in enable_generation())")
+        dl = params.deadline_s if params.deadline_s is not None \
+            else self.default_deadline_s
+        deadline = None if dl is None \
+            else time.monotonic() + float(dl)  # lint: host-sync-ok (config coercion)
+        req = Request(ids, params, budget, deadline, engine=self)
+        with self._qlock:
+            if self._shutdown:
+                req._finish(RequestStatus.REJECTED, "shutdown")
+                self.stats["rejected"] += 1
+                monitor.record_serve_request("rejected")
+                raise RuntimeError(
+                    "serving engine is shut down; no new requests")
+            if len(self._queue) >= self.max_queue:
+                req._finish(RequestStatus.REJECTED, "queue_full")
+                self.stats["rejected"] += 1
+                monitor.record_serve_request("rejected")
+                raise QueueFull(
+                    f"request queue at bound ({self.max_queue})")
+            self._queue.append(req)
+            self.stats["submitted"] += 1
+            monitor.record_serve_queue_depth(len(self._queue))
+        return req
+
+    def _queue_room(self) -> bool:
+        with self._qlock:
+            return len(self._queue) < self.max_queue
+
+    @property
+    def busy(self) -> bool:
+        """True while anything is queued or occupies a slot."""
+        with self._qlock:
+            if self._queue:
+                return True
+        return any(s is not None for s in self._slots)
+
+    # -------------------------------------------------------- scheduler
+    def step(self):
+        """One scheduler iteration: admit queued requests into free
+        slots, dispatch one fixed-batch decode step, poll completions
+        every ``poll_every`` steps."""
+        with self._pump_lock:
+            self._admit_ready()
+            if any(s is not None for s in self._slots):
+                self._dispatch_decode()
+                if self._steps_since_poll >= self.poll_every:
+                    self._poll()
+
+    def _pop_queue(self) -> Optional[Request]:
+        with self._qlock:
+            while self._queue:
+                req = self._queue.popleft()
+                monitor.record_serve_queue_depth(len(self._queue))
+                if req.deadline is not None and \
+                        time.monotonic() > req.deadline:
+                    self._cancel(req, "deadline")
+                    continue
+                return req
+        return None
+
+    def _admit_ready(self):
+        for slot, occupant in enumerate(self._slots):
+            if occupant is not None:
+                continue
+            req = self._pop_queue()
+            if req is None:
+                break
+            try:
+                self._admit(req, slot)
+            except Exception as e:
+                # the request left the queue but reached no slot: it
+                # MUST still go terminal or its Future would hang
+                # forever; the engine keeps serving the others
+                self._cancel(req, f"admission error: "
+                                  f"{type(e).__name__}: {e}",
+                             label="error")
+                monitor.record_swallowed("serving.admit", e)
+
+    def _admit(self, req: Request, slot: int):
+        bucket = next(b for b in self.buckets if b >= req.prompt.size)
+        ids = np.full((1, bucket), self._cfg.pad_value, np.int32)
+        ids[0, :req.prompt.size] = req.prompt
+        plen = np.array([req.prompt.size], np.int32)
+        exe = self._exe_prefill(bucket)
+        tok, row_cache, self._key, fin = exe(
+            self._state, jnp.asarray(ids), jnp.asarray(plen), self._key)
+        # TTFT measurement point: the request's first token exists once
+        # the prefill lands — one small sync per ADMISSION (not per
+        # decode step)
+        tok.block_until_ready()
+        now = time.monotonic()
+        req.admitted_at = req.first_token_at = now
+        monitor.record_serve_ttft(now - req.submitted_at)
+        monitor.record_generation(prefill_steps=1)
+        self.stats["prefills"] += 1
+        admit = self._exe_admit()
+        (self._cache, self._tok, self._finished, self._steps,
+         self._budget, self._out_buf) = admit(
+            self._cache, self._tok, self._finished, self._steps,
+            self._budget, self._out_buf, jnp.asarray(slot, jnp.int32),
+            row_cache, tok, fin, jnp.asarray(req.budget, jnp.int32))
+        if self._slot_used[slot]:
+            self.stats["slots_reused"] += 1
+        self._slot_used[slot] = True
+        self._slots[slot] = req
+        req.status = RequestStatus.RUNNING
+        self.stats["admitted"] += 1
+        monitor.record_serve_slot_occupancy(
+            sum(s is not None for s in self._slots) / self.max_batch)
+        # the blocking prefill sync above must not be attributed to
+        # per-token decode latency: restart the poll window so the next
+        # dispatch re-anchors it (same artifact class as idle gaps)
+        self._window_steps = 0
+
+    def _dispatch_decode(self):
+        exe = self._exe_step()
+        (self._tok, self._cache, self._key, self._finished, self._steps,
+         self._budget, self._out_buf) = exe(
+            self._state, self._tok, self._cache, self._key,
+            self._finished, self._steps, self._budget, self._out_buf)
+        self._steps_since_poll += 1
+        if self._window_steps == 0:
+            # anchor the latency window at the first dispatch after a
+            # poll — idle gaps between traffic bursts must not be
+            # attributed to per-token latency
+            self._window_t0 = time.monotonic()
+        self._window_steps += 1
+        self.stats["decode_steps"] += 1
+        monitor.record_generation(decode_steps=1)
+
+    def _poll(self):
+        """Scheduler poll: read the [batch] finished/step lanes (the
+        only per-window host sync on the decode path), complete
+        finished rows, cancel over-deadline ones, time the window."""
+        self._steps_since_poll = 0
+        fin = np.asarray(self._finished)  # lint: host-sync-ok (scheduler poll, every poll_every steps)
+        steps = np.asarray(self._steps)  # lint: host-sync-ok (same poll read)
+        now = time.monotonic()
+        if self._window_t0 is not None and self._window_steps:
+            monitor.record_serve_token_latency(
+                (now - self._window_t0) / self._window_steps)
+        self._window_steps = 0   # next dispatch re-anchors _window_t0
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if fin[i]:
+                toks = np.asarray(self._out_buf[i])[:int(steps[i])]  # lint: host-sync-ok (one row read per completion)
+                self._complete(req, toks)
+                self._slots[i] = None   # freed in place; next admission
+                #                         overwrites the row
+            elif req.deadline is not None and now > req.deadline:
+                self._evict(i, req, "deadline", int(steps[i]))
+        # expire queued requests that can no longer meet their deadline
+        with self._qlock:
+            for req in list(self._queue):
+                if req.deadline is not None and now > req.deadline:
+                    self._queue.remove(req)
+                    self._cancel(req, "deadline")
+            monitor.record_serve_queue_depth(len(self._queue))
+        monitor.record_serve_slot_occupancy(
+            sum(s is not None for s in self._slots) / self.max_batch)
+        if monitor.enabled:
+            monitor.record_cache_occupancy(self._cache.occupancy())
+
+    def _complete(self, req: Request, toks: np.ndarray):
+        eos = self._cfg.eos_token_id
+        req.n_emitted = int(toks.size)
+        n_real = int(toks.size)
+        if eos is not None:
+            hits = np.nonzero(toks == eos)[0]
+            if hits.size:
+                n_real = int(hits[0]) + 1    # the eos itself counts
+                toks = toks[:int(hits[0])]   # result is eos-trimmed
+        req.tokens = toks.astype(np.int32)
+        monitor.record_generation(tokens=n_real)
+        req._finish(RequestStatus.COMPLETED)
+        self.stats["completed"] += 1
+        monitor.record_serve_request("completed")
+
+    def _cancel(self, req: Request, reason: str,
+                label: Optional[str] = None):
+        """Terminal CANCELLED for a request not occupying a slot.
+        ``label`` overrides the metric label when ``reason`` carries
+        free text (error messages must not become label cardinality)."""
+        req._finish(RequestStatus.CANCELLED, reason)
+        self.stats["cancelled"] += 1
+        monitor.record_serve_request("cancelled")
+        monitor.record_serve_cancellation(label or reason)
+
+    def _evict(self, slot: int, req: Request, reason: str,
+               n_done: int = 0):
+        """Cancel an in-flight request: mask its lane + reset its cache
+        row via the free program, keep whatever it produced."""
+        exe = self._exe_free()
+        self._cache, self._finished = exe(
+            self._cache, self._finished, jnp.asarray(slot, jnp.int32))
+        if n_done:
+            row = np.asarray(self._out_buf[slot])  # lint: host-sync-ok (partial row on eviction)
+            req.tokens = row[:n_done].astype(np.int32)
+            req.n_emitted = n_done
+        self._slots[slot] = None
+        self._cancel(req, reason)
+
+    # -------------------------------------------------------- front-end
+    def _submit_item(self, item) -> Request:
+        if isinstance(item, tuple) and len(item) == 2 and \
+                isinstance(item[1], RequestParams):
+            return self.submit(item[0], item[1])
+        return self.submit(item)
+
+    def serve_forever(self, request_iter=None, *, shutdown=None,
+                      on_step=None, idle_sleep_s: float = 0.0005):
+        """Blocking serve loop. With ``request_iter`` it pulls prompts
+        (or ``(prompt, RequestParams)`` tuples; the iterator must not
+        block in ``__next__``) whenever the queue has room and returns
+        the submitted handles once the iterator is exhausted and every
+        request is terminal. With ``request_iter=None`` it really does
+        serve forever — pumping ``submit()`` traffic from other threads
+        through idle gaps — until a preemption or ``shutdown()`` ends
+        it.
+
+        Preemption: when the active ``GracefulShutdown`` context (or
+        ``shutdown``) reports preempted — or ``shutdown()`` was called —
+        the loop drains: queued requests get a clean REJECTED, in-flight
+        slots keep decoding up to ``drain_timeout_s`` then are cancelled;
+        nothing hangs. ``on_step(engine)`` runs once per loop iteration
+        (traffic shaping, fault injection in tests)."""
+        from ..distributed import resilience
+        handles: List[Request] = []
+        it = iter(request_iter) if request_iter is not None else None
+        exhausted = False   # an iterator-less loop never "finishes"
+        while True:
+            gs = shutdown if shutdown is not None else resilience.active()
+            if self._shutdown or (gs is not None and gs.preempted):
+                self.drain()
+                break
+            while it is not None and not exhausted and \
+                    self._queue_room():
+                try:
+                    item = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                handles.append(self._submit_item(item))
+            if on_step is not None:
+                on_step(self)
+            if self.busy:
+                self.step()
+            elif exhausted:
+                break
+            else:
+                time.sleep(idle_sleep_s)
+        return handles
+
+    def drain(self):
+        """Graceful shutdown: reject everything still queued, keep
+        decoding in-flight slots until each reaches a terminal status
+        or ``drain_timeout_s``, then cancel the stragglers. Every
+        request ends terminal; none hang. Idempotent; the engine
+        accepts no new work afterwards."""
+        with self._pump_lock:
+            with self._qlock:
+                self._shutdown = True
+                queued, self._queue = \
+                    list(self._queue), collections.deque()
+                monitor.record_serve_queue_depth(0)
+            for req in queued:
+                req._finish(RequestStatus.REJECTED, "shutdown")
+                self.stats["rejected"] += 1
+                monitor.record_serve_request("rejected")
+            deadline = time.monotonic() + self.drain_timeout_s
+            while any(s is not None for s in self._slots) and \
+                    time.monotonic() < deadline:
+                self._dispatch_decode()
+                if self._steps_since_poll >= self.poll_every:
+                    self._poll()
+            if any(s is not None for s in self._slots):
+                # final poll before declaring stragglers: rows that
+                # finished since the last cadence poll must complete,
+                # not get mislabeled CANCELLED
+                self._poll()
+            steps = np.asarray(self._steps)  # lint: host-sync-ok (drain-cutoff lane read)
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    self._evict(i, req, "shutdown", int(steps[i]))
+            monitor.record_serve_slot_occupancy(0.0)
+
+    shutdown_now = drain
+
+    # ----------------------------------------------------- thread mode
+    def start(self) -> "ServingEngine":
+        """Background pump thread: ``submit()``/``result()`` from any
+        thread, ``shutdown()`` to drain and stop."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._thread = threading.Thread(
+            target=self._run_loop, daemon=True, name="serving-engine")
+        self._thread.start()
+        return self
+
+    def _run_loop(self):
+        while not self._shutdown:
+            if self.busy:
+                with self._pump_lock:
+                    if not self._shutdown:
+                        self.step()
+            else:
+                time.sleep(0.001)
+
+    def shutdown(self):
+        """Drain (every request terminal) and stop the pump thread."""
+        self.drain()
+        if self._thread is not None:
+            self._thread.join(timeout=self.drain_timeout_s + 5.0)
+            self._thread = None
+
+    def _try_pump(self) -> bool:
+        """Inline pump for handle.result() when no thread owns the
+        engine; returns True when it made progress."""
+        if self._thread is not None and self._thread.is_alive():
+            return False
+        if not self._pump_lock.acquire(blocking=False):
+            return False
+        try:
+            if self.busy and not self._shutdown:
+                self.step()
+                return True
+            return False
+        finally:
+            self._pump_lock.release()
+
+    # ------------------------------------------------------------ audit
+    def audit(self, **audit_kw) -> Dict:
+        """Static audit of every program the scheduler dispatches: one
+        prefill report per bucket plus the decode/admit/free trio
+        (analysis.audit over abstract operands — nothing executes).
+        The slot-decode and admit programs are audited with the TPU
+        donation INTENT (KV cache + every token/flag lane donated) even
+        on CPU; the tier-1 gate asserts zero ERROR findings everywhere
+        and donation coverage 1.0 on the slot-decode program — the
+        cache and token buffers must stay in-place across scheduler
+        steps."""
+        from ..analysis import audit as _audit
+        # audit must describe the EVAL program the engine serves, even
+        # when called mid-fit on a shared layer
+        self._ensure_eval()
+        base = audit_kw.pop("name", "serving")
+        sds = jax.ShapeDtypeStruct
+        state = tuple(sds(tuple(v.shape), v.dtype) for v in self._state)
+        key = sds((2,), jnp.uint32)
+        reports: Dict = {}
+        for b in self.buckets:
+            reports[("prefill", b)] = _audit(
+                self._prefill_fn, state, sds((1, b), jnp.int32),
+                sds((1,), jnp.int32), key, self._cfg, self.max_len,
+                static_argnums=(4, 5), name=f"{base}.prefill.{b}",
+                **audit_kw)
+        # decode avals are the engine's own lanes; the row-cache aval
+        # comes from the smallest bucket's prefill report (same trace)
+        tok_a, row_cache_a, _, fin_a = \
+            reports[("prefill", self.buckets[0])].out_shape
+        reports["decode"] = _audit(
+            self._step_fn, state, self._tok, self._cache, self._key,
+            self._finished, self._steps, self._budget, self._out_buf,
+            self._cfg, static_argnums=(8,),
+            donate=(1, 2, 3, 4, 5, 6, 7), name=f"{base}.decode",
+            **audit_kw)
+        scalar = sds((), jnp.int32)
+        reports["admit"] = _audit(
+            self._admit_fn, self._cache, self._tok, self._finished,
+            self._steps, self._budget, self._out_buf, scalar,
+            row_cache_a, tok_a, fin_a, scalar,
+            donate=(0, 1, 2, 3, 4, 5, 7), name=f"{base}.admit",
+            **audit_kw)
+        reports["free"] = _audit(
+            self._free_fn, self._cache, self._finished, scalar,
+            donate=(0, 1), name=f"{base}.free", **audit_kw)
+        return reports
+
+    def __repr__(self):
+        occ = sum(s is not None for s in self._slots)
+        with self._qlock:
+            q = len(self._queue)
+        return (f"ServingEngine(slots={occ}/{self.max_batch}, "
+                f"queued={q}, buckets={self.buckets}, "
+                f"cache_len={self.max_len}, "
+                f"warm={self._warm}, shutdown={self._shutdown})")

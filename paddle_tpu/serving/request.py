@@ -1,0 +1,151 @@
+"""Serving request front-end: Future-style handles + admission types.
+
+A :class:`Request` is both the scheduler's bookkeeping record and the
+caller's handle: ``submit()`` returns it immediately, ``result()``
+blocks until the request reaches a terminal status (pumping the engine
+inline when no background pump thread owns it, so a single-threaded
+caller can ``submit(); result()`` without deadlocking).
+
+Terminal statuses and how a request gets there:
+
+    COMPLETED   decoded to eos or its token budget
+    CANCELLED   deadline expired (queued or mid-decode), or the drain
+                timeout hit during a graceful shutdown
+    REJECTED    queue at bound when submitted, or still queued when a
+                shutdown drain started
+
+``result()`` returns the generated token ids for COMPLETED and raises
+:class:`RequestFailed` otherwise (partial tokens, if any, stay on
+``handle.tokens``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["QueueFull", "Request", "RequestFailed", "RequestParams",
+           "RequestStatus"]
+
+
+class RequestStatus(str, enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    COMPLETED = "completed"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (RequestStatus.COMPLETED, RequestStatus.CANCELLED,
+                        RequestStatus.REJECTED)
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestParams:
+    """Per-request knobs. ``max_new_tokens`` must not exceed the
+    engine's compiled budget (the out-buffer width); ``deadline_s`` is
+    relative to submit time — a request still queued or still decoding
+    past it is cancelled with a timeout status."""
+    max_new_tokens: Optional[int] = None
+    deadline_s: Optional[float] = None
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the request queue is at its depth bound."""
+
+
+class RequestFailed(RuntimeError):
+    """result() on a request that did not complete."""
+
+    def __init__(self, status: RequestStatus, detail: str):
+        super().__init__(f"request {status.value}: {detail}")
+        self.status = status
+        self.detail = detail
+
+
+_ids = itertools.count()
+
+
+class Request:
+    """One submitted prompt: scheduler record + caller handle."""
+
+    def __init__(self, prompt: np.ndarray, params: RequestParams,
+                 budget: int, deadline: Optional[float], engine=None):
+        self.id = next(_ids)
+        self.prompt = prompt                  # [plen] int32
+        self.params = params
+        self.budget = int(budget)             # tokens incl. the prefill one
+        self.deadline = deadline              # absolute monotonic, or None
+        self.status = RequestStatus.QUEUED
+        self.detail = ""
+        self.tokens: Optional[np.ndarray] = None   # eos-trimmed on success
+        self.n_emitted = 0                    # raw tokens incl. eos
+        self.submitted_at = time.monotonic()
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._engine = engine
+        self._event = threading.Event()
+
+    # ------------------------------------------------------------ handle
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until terminal. Without a background pump thread the
+        calling thread drives the engine itself, so a synchronous
+        ``submit(); result()`` makes progress instead of deadlocking."""
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while not self._event.is_set():
+            pumped = self._engine._try_pump() \
+                if self._engine is not None else False
+            if not pumped:
+                self._event.wait(0.005)
+            if deadline is not None and time.monotonic() > deadline \
+                    and not self._event.is_set():
+                raise TimeoutError(
+                    f"request {self.id} not finished within {timeout}s "
+                    f"(status {self.status.value})")
+        if self.status is RequestStatus.COMPLETED:
+            return self.tokens
+        raise RequestFailed(self.status, self.detail)
+
+    # --------------------------------------------------------- scheduler
+    def _finish(self, status: RequestStatus, detail: str = ""):
+        """Terminal transition; idempotent (a drain racing a completion
+        keeps the first outcome)."""
+        if self._event.is_set():
+            return
+        self.status = status
+        self.detail = detail
+        self.finished_at = time.monotonic()
+        self._event.set()
+
+    # ----------------------------------------------------------- timings
+    @property
+    def ttft(self) -> Optional[float]:
+        """Submit -> first token (seconds) — includes queue wait."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def per_token_latency(self) -> Optional[float]:
+        """Mean decode seconds/token after the first (None until
+        terminal or when the request never decoded)."""
+        if self.first_token_at is None or self.finished_at is None \
+                or self.n_emitted <= 1:
+            return None
+        return (self.finished_at - self.first_token_at) / \
+            (self.n_emitted - 1)
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, status={self.status.value}, "
+                f"prompt={self.prompt.size} toks, budget={self.budget})")

@@ -69,6 +69,68 @@ def test_kv_cache_is_a_pytree():
     assert c.occupancy() == 5 / 8
 
 
+def test_kv_cache_reset_rows_and_ring_reuse():
+    """Slot-reuse helper: reset_rows zeroes kv_len (index, int array,
+    or bool mask) without touching K/V or the pytree, and a reused row
+    writes from position 0 again instead of wrapping."""
+    c = KVCache.create(1, 3, 8, 1, 4).with_kv_len(np.array([3, 7, 5]))
+    ones = np.ones((3, 2, 1, 4), np.float32)
+    c = c.update(0, ones, ones, c.kv_len)
+
+    r = c.reset_rows(1)                                   # scalar index
+    np.testing.assert_array_equal(np.asarray(r.kv_len), [3, 0, 5])
+    r2 = c.reset_rows(np.array([0, 2]))                   # int array
+    np.testing.assert_array_equal(np.asarray(r2.kv_len), [0, 7, 0])
+    r3 = c.reset_rows(np.array([True, False, True]))      # bool mask
+    np.testing.assert_array_equal(np.asarray(r3.kv_len), [0, 7, 0])
+    # K/V untouched, pytree structure unchanged
+    np.testing.assert_array_equal(np.asarray(r.k), np.asarray(c.k))
+    assert len(jax.tree_util.tree_leaves(r)) == 3
+
+    # reuse: the reset row's next write starts at 0 (no wrap); before
+    # the reset, row 1 at kv_len 7 would have wrapped to [7, 0]
+    new = np.full((3, 2, 1, 4), 2.0, np.float32)
+    w = r.update(0, new, new, r.kv_len)
+    got = np.asarray(w.k[0][1, :, 0, 0])
+    np.testing.assert_array_equal(got, [2, 2, 0, 0, 0, 0, 0, 1])
+
+    # donation-compatible: reset inside jit with the cache donated
+    reset = jax.jit(lambda cc, rows: cc.reset_rows(rows),
+                    donate_argnums=() if jax.default_backend() != "tpu"
+                    else (0,))
+    d = reset(c, jnp.asarray(1, jnp.int32))
+    assert isinstance(d, KVCache)
+    np.testing.assert_array_equal(np.asarray(d.kv_len), [3, 0, 5])
+
+
+def test_kv_cache_copy_row_from_slot_admission():
+    """copy_row_from installs a batch-1 prefill row into one slot of a
+    shared cache (K, V, kv_len), leaving other rows alone; traced slot
+    indices compile to ONE program for every slot."""
+    shared = KVCache.create(2, 3, 8, 1, 4).with_kv_len(
+        np.array([2, 6, 4]))
+    src = KVCache.create(2, 1, 8, 1, 4)
+    fill = np.arange(1 * 5 * 1 * 4, dtype=np.float32).reshape(1, 5, 1, 4)
+    for layer in range(2):
+        src = src.update(layer, fill, fill + 10.0, src.kv_len)
+    src = src.with_kv_len(5)
+
+    admit = jax.jit(lambda dst, s, slot: dst.copy_row_from(s, 0, slot))
+    out = admit(shared, src, jnp.asarray(1, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out.kv_len), [2, 5, 4])
+    np.testing.assert_array_equal(np.asarray(out.k[:, 1]),
+                                  np.asarray(src.k[:, 0]))
+    np.testing.assert_array_equal(np.asarray(out.v[:, 1]),
+                                  np.asarray(src.v[:, 0]))
+    # untouched rows stay zero
+    assert float(jnp.abs(out.k[:, 0]).max()) == 0.0
+    # same compiled program serves a different slot (traced index)
+    out2 = admit(shared, src, jnp.asarray(2, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out2.kv_len), [2, 6, 5])
+    np.testing.assert_array_equal(np.asarray(out2.k[:, 2]),
+                                  np.asarray(src.k[:, 0]))
+
+
 # ------------------------------------------------------- decode kernel
 
 

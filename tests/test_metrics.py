@@ -40,6 +40,47 @@ class TestRegistry:
             h.observe(v)
         assert h.count == 3 and h.sum == 1110 and h.mean == 370
 
+    def test_histogram_percentile(self):
+        metrics.enable()
+        h = metrics.histogram("t.pct", bounds=(1.0, 2.0, 4.0, 8.0))
+        assert h.percentile(50) == 0.0          # empty -> 0
+        for v in (0.5, 0.5, 1.5, 1.5, 3.0, 3.0, 3.0, 3.0):
+            h.observe(v)
+        # ranks: bucket le_1 holds 2, le_2 holds 2, le_4 holds 4
+        # p25 -> target rank 2 = top of the first bucket
+        assert h.percentile(25) == pytest.approx(1.0)
+        # p50 -> rank 4 = top of the second bucket
+        assert h.percentile(50) == pytest.approx(2.0)
+        # p75 -> rank 6 = halfway into the (2, 4] bucket
+        assert h.percentile(75) == pytest.approx(3.0)
+        # monotonic and clamped
+        assert h.percentile(0) <= h.percentile(99) <= 4.0
+        h.observe(1e9)                          # overflow bucket
+        assert h.percentile(100) == 8.0         # clamps at last bound
+        # an EMPTY histogram created by a bounds-less reader rebinds to
+        # the first explicit bounds (a dashboard polling percentile()
+        # before traffic must not pin a latency histogram to the
+        # byte-scaled defaults)
+        early_reader = metrics.histogram("t.rebind")
+        assert early_reader.bounds == metrics.Histogram.DEFAULT_BOUNDS
+        rb = metrics.histogram("t.rebind", bounds=(1.0, 2.0, 4.0))
+        assert rb is early_reader and rb.bounds == (1.0, 2.0, 4.0)
+        rb.observe(1.5)
+        # a POPULATED histogram under different bounds is a schema
+        # conflict — warned once, never raised (this call sits on
+        # recording hot paths; telemetry must not crash the scheduler)
+        with pytest.warns(UserWarning, match="different bounds"):
+            keep = metrics.histogram("t.rebind", bounds=(9.0,))
+        assert keep is rb and keep.bounds == (1.0, 2.0, 4.0)
+        metrics.histogram("t.rebind", bounds=(9.0,))  # warns only once
+        # the serve recorders' latency-scaled bounds give sub-ms
+        # percentile resolution end-to-end
+        monitor.enable()
+        monitor.record_serve_ttft(0.003)
+        monitor.record_serve_ttft(0.004)
+        assert 0.001 < metrics.histogram("serve.ttft").percentile(50) \
+            < 0.01
+
     def test_same_name_same_instance(self):
         assert metrics.counter("t.same") is metrics.counter("t.same")
         assert metrics.counter("t.same", axis="dp") is not \
