@@ -49,10 +49,10 @@ def run(fused_bn, pallas3x3, remat=()):
 
 def main():
     import jax
+    from paddle_tpu.jit import enable_compile_cache
     cache = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_compile_cache(cache, min_compile_time_secs=1.0)
     cfgs = [("unfused (r3 baseline)", False, False, ()),
             ("fused, XLA 3x3", True, False, ()),
             ("fused, Pallas 3x3", True, True, ()),

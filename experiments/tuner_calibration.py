@@ -122,10 +122,10 @@ def _steps():
 
 def measure():
     import jax
+    from paddle_tpu.jit import enable_compile_cache
     cache = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), ".jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    enable_compile_cache(cache, min_compile_time_secs=1.0)
     rows = []
     for name, build in _steps():
         step, (x, y) = build()

@@ -14,6 +14,10 @@ Metric name scheme (what the summary views group by):
 
     jit.compile{cause=...}      retraces by cause (first/new_shape/...)
     jit.compile.total           all retraces
+    jit.compile_cache.hits      executable-store loads (zero XLA compiles)
+    jit.compile_cache.misses{cause=...}   absent | corrupt | stale_ref
+    jit.compile_cache.bytes     serialized-executable bytes moved
+    jit.compile_cache.load_ms / .save_ms  store latency histograms (ms)
     static.program_builds       program_guard graph captures
     static.ops_recorded         ops appended to static programs
     comm.ops{axis=...,op=...}   collective launches per mesh axis
@@ -56,6 +60,9 @@ from . import metrics
 # (a counter nobody will ever read) or a missing schema entry.
 DECLARED_METRICS = frozenset({
     "jit.compile", "jit.compile.total",
+    "jit.compile_cache.hits", "jit.compile_cache.misses",
+    "jit.compile_cache.bytes", "jit.compile_cache.load_ms",
+    "jit.compile_cache.save_ms",
     "static.program_builds", "static.ops_recorded",
     "comm.ops", "comm.bytes",
     "io.batches", "io.samples", "io.bytes", "io.batch_bytes",
@@ -100,6 +107,36 @@ def record_retrace(cause: str, target: str = "jit"):
         return
     metrics.counter(f"{target}.compile", cause=cause).inc()
     metrics.counter("jit.compile.total").inc()
+
+
+def record_compile_cache_hit(nbytes: int, load_ms: float):
+    """One executable-store hit: a compiled program deserialized from
+    disk instead of compiled — the warm-restart fast path. The tier-1
+    warm gate asserts a rebuilt engine hits for EVERY program."""
+    if not enabled:
+        return
+    metrics.counter("jit.compile_cache.hits").inc()
+    metrics.counter("jit.compile_cache.bytes").inc(int(nbytes))
+    metrics.histogram("jit.compile_cache.load_ms").observe(float(load_ms))
+
+
+def record_compile_cache_miss(cause: str):
+    """One executable-store miss. cause: absent (cold — the entry will
+    be written) | corrupt (bad entry dropped, fresh compile rewrites
+    it) | stale_ref (verify mode caught a manifest entry disagreeing
+    with the real program fingerprint)."""
+    if not enabled:
+        return
+    metrics.counter("jit.compile_cache.misses", cause=cause).inc()
+    metrics.counter("jit.compile_cache.misses").inc()
+
+
+def record_compile_cache_save(nbytes: int, save_ms: float):
+    """One executable serialized + atomically committed to the store."""
+    if not enabled:
+        return
+    metrics.counter("jit.compile_cache.bytes").inc(int(nbytes))
+    metrics.histogram("jit.compile_cache.save_ms").observe(float(save_ms))
 
 
 def record_static_build():

@@ -110,6 +110,8 @@ class DistributedTrainStep:
             for p in self._params]
         self._opt_state_tree = None
         self._jitted = None
+        self._warm_store = None   # enable_warm_start() opt-in
+        self._warm_exe = None
 
     # ----------------------------------------------------------------- build
     def _build(self, batch_ndims):
@@ -166,11 +168,23 @@ class DistributedTrainStep:
         # matcher is sharding-aware; the fallback costs one transient
         # copy of params+state, it never changes numerics
         donate = (0, 1) if SHARDING_AWARE_DONATION else ()
+        self._donate = donate
         self._step_fn = step_fn
         self._jitted = jax.jit(
             step_fn, donate_argnums=donate,
             out_shardings=(NamedSharding(m, P()),
                            self._param_shardings, None))
+        # warm/AOT path: donation baked only where the backend
+        # implements it — deserialized aliasing double-frees donated
+        # buffers on CPU (see TrainStep.__init__); the audit keeps the
+        # donation intent regardless
+        self._aot_donate = donate if jax.default_backend() == "tpu" \
+            else ()
+        self._aot_jitted = self._jitted if self._aot_donate == donate \
+            else jax.jit(
+                step_fn, donate_argnums=self._aot_donate,
+                out_shardings=(NamedSharding(m, P()),
+                               self._param_shardings, None))
 
     # ------------------------------------------------------------------ call
     def batch_sharding_for(self, leaf) -> NamedSharding:
@@ -325,14 +339,81 @@ class DistributedTrainStep:
                           no_aval, *batch_avals, donate=donate,
                           **audit_kw)
 
+    def enable_warm_start(self, store=None):
+        """Opt-in executable persistence for the sharded step (same
+        contract as ``TrainStep.enable_warm_start``): the first call
+        lowers and loads a serialized executable from the store —
+        keyed on the mesh axes too, so a resize can never replay the
+        wrong program — falling back to (and persisting) a fresh
+        compile on a cold store."""
+        from ...jit import compile_cache
+        self._warm_store = store if store is not None \
+            else compile_cache.default_store()
+        return self
+
+    def _mesh_signature(self):
+        return tuple(zip(self.mesh.axis_names,
+                         self.mesh.devices.shape))
+
+    def _warm_signature(self, args):
+        """Traceless manifest key for the sharded step (same contract
+        as TrainStep._warm_signature) — the mesh axes and sharding
+        strategy join the key, so a resized mesh or changed ZeRO stage
+        can never resolve to a stale executable."""
+        from ...jit import compile_cache
+        sig = compile_cache.network_signature(self.model)
+        loss_sig = compile_cache.callable_signature(self.loss_fn)
+        opt_src = compile_cache.source_hash(type(self.optimizer))
+        flags = repr((self.accumulate_steps, self._recompute))
+        if sig is None or loss_sig is None or opt_src is None \
+                or "0x" in flags:
+            return None
+        sig.update(
+            program=("DistributedTrainStep",), loss=loss_sig,
+            opt=(type(self.optimizer).__qualname__, opt_src,
+                 compile_cache.scalar_signature(self.optimizer)),
+            strategy=(type(self.strategy).__qualname__,
+                      compile_cache.scalar_signature(self.strategy)),
+            flags=flags, mesh=self._mesh_signature(),
+            operands=compile_cache.aval_signature(args))
+        return sig
+
     def __call__(self, *batch):
         params = self._params
         raw_batch = self._prepare(batch)
         lr = self.optimizer.get_lr()
         self.optimizer._step_count += 1
-        loss, new_vals, self._opt_state_tree = self._jitted(
-            [p._data for p in params], self._opt_state_tree,
-            np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)
+        args = ([p._data for p in params], self._opt_state_tree,
+                np.float32(lr), np.int32(self.optimizer._step_count),
+                *raw_batch)
+        if self._warm_store is not None and self._warm_exe is None:
+            from ...core import monitor
+            from ...jit import compile_cache
+            try:
+                self._warm_exe = compile_cache.build_or_load(
+                    self._warm_signature(args),
+                    lambda: self._aot_jitted.lower(*args),
+                    store=self._warm_store,
+                    extra=dict(kind="DistributedTrainStep",
+                               donation=self._aot_donate,
+                               mesh=self._mesh_signature()),
+                    label="fleet.train_step")
+            except Exception as e:
+                # never let persistence break a training step
+                monitor.record_swallowed(
+                    "jit.compile_cache.fleet_warm", e)
+            self._warm_store = None  # warmed once; drift falls back
+        if self._warm_exe is not None:
+            try:
+                loss, new_vals, self._opt_state_tree = \
+                    self._warm_exe(*args)
+            except (TypeError, ValueError) as e:
+                from ...core import monitor
+                monitor.record_swallowed(
+                    "jit.compile_cache.fleet_warm_step", e)
+                self._warm_exe = None
+        if self._warm_exe is None:
+            loss, new_vals, self._opt_state_tree = self._jitted(*args)
         for p, v in zip(params, new_vals):
             p._data = v
         for p, st in zip(params, self._opt_state_tree):

@@ -96,12 +96,16 @@ class GenerationSession:
     Built once per network and reused across ``generate()`` calls, so
     jax's jit cache carries warm executables between requests.
     ``aot_compile`` additionally stores ahead-of-time compiled
-    executables for fixed shapes (the Predictor's serving mode)."""
+    executables for fixed shapes (the Predictor's serving mode) —
+    persisted through ``executable_store`` (default: the process
+    ``jit.compile_cache`` store, when enabled) so a relaunched process
+    loads them instead of recompiling."""
 
-    def __init__(self, network):
+    def __init__(self, network, executable_store=None):
         from ..jit.api import _RetraceTracker, _unwrap, functional_call
         network.eval()
         self.network = network
+        self.executable_store = executable_store
         self._names = list(network.state_dict().keys())
         # one tracker per jitted fn: prefill and decode each classify
         # their first compile as cause=first, and any later miss on the
@@ -143,6 +147,7 @@ class GenerationSession:
         # donate the cache on TPU only: CPU/GPU donation is a no-op
         # that warns once per program
         donate = (2,) if jax.default_backend() == "tpu" else ()
+        self._decode_donate = donate
         self._prefill_fn = prefill_fn
         self._decode_fn = decode_fn
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
@@ -245,24 +250,55 @@ class GenerationSession:
         """Ahead-of-time compile the (prefill, decode) pair for one
         fixed padded shape (serving: compile at startup, zero retraces
         under live traffic). Compiled executables are called WITHOUT
-        the static args — they are baked in."""
+        the static args — they are baked in. With an executable store
+        active (``self.executable_store`` or the process default) the
+        pair is loaded from disk when a relaunch already compiled it —
+        zero XLA work, and on a manifest hit zero TRACE work, on the
+        warm path."""
+        from ..jit import compile_cache
+        store = self.executable_store
         sds = jax.ShapeDtypeStruct
         state = tuple(sds(v.shape, v.dtype) for v in self.state_values())
         ids = sds((batch, prompt_len), jnp.int32)
         plen = sds((batch,), jnp.int32)
         key = sds((2,), jnp.uint32)
-        pexe = self._prefill_jit.lower(
-            state, ids, plen, key, cfg, cache_len).compile()
+        base_sig = compile_cache.network_signature(self.network)
+
+        def sig_for(kind):
+            if base_sig is None:
+                return None   # no sound traceless key: traced path
+            sig = dict(base_sig)
+            sig.update(program=(kind, batch, prompt_len, cache_len),
+                       generation=repr(cfg),
+                       operands=compile_cache.aval_signature(state))
+            return sig
+
+        pexe = compile_cache.build_or_load(
+            sig_for("generation.prefill"),
+            lambda: self._prefill_jit.lower(state, ids, plen, key, cfg,
+                                            cache_len),
+            store=store, extra=dict(kind="generation.prefill",
+                                    donation=()),
+            label=f"generation.prefill.b{batch}s{prompt_len}")
         self._compiled[("prefill", (batch, prompt_len), cache_len,
                         cfg)] = pexe
-        # decode avals come from the prefill's own outputs
-        _, cache_aval, _, fin = jax.eval_shape(
-            lambda s, i, p, k: self._prefill_fn(s, i, p, k, cfg,
-                                                cache_len),
-            state, ids, plen, key)
-        tok = sds((batch,), jnp.int32)
-        dexe = self._decode_jit.lower(
-            state, tok, cache_aval, key, fin, cfg).compile()
+
+        def lower_decode():
+            # decode avals come from the prefill's own outputs (an
+            # abstract trace — only paid when the manifest misses)
+            _, cache_aval, _, fin = jax.eval_shape(
+                lambda s, i, p, k: self._prefill_fn(s, i, p, k, cfg,
+                                                    cache_len),
+                state, ids, plen, key)
+            tok = sds((batch,), jnp.int32)
+            return self._decode_jit.lower(state, tok, cache_aval, key,
+                                          fin, cfg)
+
+        dexe = compile_cache.build_or_load(
+            sig_for("generation.decode"), lower_decode,
+            store=store, extra=dict(kind="generation.decode",
+                                    donation=self._decode_donate),
+            label=f"generation.decode.b{batch}c{cache_len}")
         self._compiled[("decode", (batch,), cache_len, cfg)] = dexe
         return pexe, dexe
 

@@ -417,6 +417,8 @@ class MetricsCallback(Callback):
         self._retraces0 = self._counter("jit.compile.total")
         self._syncs0 = self._counter("train.host_syncs")
         self._gen_tokens0 = self._counter("gen.tokens")
+        self._cc_hits0 = self._counter("jit.compile_cache.hits")
+        self._cc_misses0 = self._counter("jit.compile_cache.misses")
         try:
             device.reset_peak_memory_stats()
             # per-batch polling advances the tracked high-water, but
@@ -453,6 +455,15 @@ class MetricsCallback(Callback):
             if self.tokens_per_sample:
                 stats["tokens_per_sec"] = \
                     samples * self.tokens_per_sample / dt
+        # executable-store traffic (the fit(resume=True) warm path):
+        # a warm relaunch shows hits>0 misses==0 on its first epoch
+        cc_hits = self._counter("jit.compile_cache.hits") - \
+            getattr(self, "_cc_hits0", 0)
+        cc_misses = self._counter("jit.compile_cache.misses") - \
+            getattr(self, "_cc_misses0", 0)
+        if cc_hits or cc_misses:
+            stats["compile_cache_hits"] = cc_hits
+            stats["compile_cache_misses"] = cc_misses
         # generation inside the epoch (eval-time generate() calls):
         # surface the gen.* recorder family as tokens/sec
         gen_tokens = self._counter("gen.tokens") - \

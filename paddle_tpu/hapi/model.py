@@ -319,6 +319,16 @@ class Model:
             start_epoch = self._load_resume(prefix, loader)
 
         guard = self._resolve_anomaly_guard(anomaly_guard, resilience)
+        if resume and self._train_step is not None:
+            # relaunch warm path (opt-in by the resume request): with an
+            # executable store active (enable_compile_cache /
+            # PADDLE_COMPILE_CACHE_DIR) the first step loads the
+            # serialized fused-step executable instead of recompiling —
+            # after the guard resolution above, which may have rebuilt
+            # the TrainStep
+            from ..jit import compile_cache
+            if compile_cache.default_store() is not None:
+                self._train_step.enable_warm_start()
 
         cbs = CallbackList([ProgBarLogger(log_freq, verbose=verbose)]
                            + _as_list(callbacks))

@@ -137,10 +137,19 @@ class Config:
         return self
 
     def set_compile_cache_dir(self, path: str):
-        """Persistent XLA compile cache (the AOT 'optimized program'
-        cache the reference keeps per AnalysisPredictor)."""
+        """Persistent XLA compile cache + serialized-executable store
+        (the AOT 'optimized program' cache the reference keeps per
+        AnalysisPredictor). The predictor delegates the process-global
+        setup — set-once, warn-on-conflict — to the one shared
+        implementation in ``paddle_tpu.jit.compile_cache``; generation
+        buckets built under this config persist their compiled
+        executables there and warm-load on relaunch."""
         self._compile_cache_dir = path
         return self
+
+    # paddle.inference parity spelling; the reference's
+    # exp_enable_use_gpu-era configs call this enable_*
+    enable_compile_cache = set_compile_cache_dir
 
     def summary(self) -> str:
         return (f"Config(model={self._model_prefix or self._layer}, "

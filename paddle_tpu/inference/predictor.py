@@ -17,29 +17,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..jit import compile_cache
 from .config import Config, PrecisionType
 
 __all__ = ["InferTensor", "Predictor", "create_predictor"]
 
-_COMPILE_CACHE_DIR: Optional[str] = None
-
-
-def _ensure_compile_cache(path: str) -> None:
-    """jax's persistent compile cache is process-global; set it once and
-    refuse to silently re-point it (predictor B must not hijack A's
-    cache dir)."""
-    global _COMPILE_CACHE_DIR
-    if _COMPILE_CACHE_DIR is None:
-        os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          0.0)
-        _COMPILE_CACHE_DIR = path
-    elif os.path.abspath(path) != os.path.abspath(_COMPILE_CACHE_DIR):
-        import warnings
-        warnings.warn(
-            f"compile cache already at {_COMPILE_CACHE_DIR!r}; the jax "
-            f"cache dir is process-global, ignoring {path!r}")
+# the one shared implementation (jit/compile_cache.py) — same
+# set-once + process-global-conflict-warning semantics this module's
+# private copy used to carry
+_ensure_compile_cache = compile_cache.enable_compile_cache
 
 
 class InferTensor:
@@ -79,8 +65,10 @@ class InferTensor:
 class Predictor:
     def __init__(self, config: Config):
         self.config = config
+        self._exe_store = None
         if config._compile_cache_dir:
-            _ensure_compile_cache(config._compile_cache_dir)
+            self._exe_store = _ensure_compile_cache(
+                config._compile_cache_dir)
         self._feeds: Dict[str, jax.Array] = {}
         self._outputs: Dict[str, jax.Array] = {}
         self._gen_session = None
@@ -199,7 +187,8 @@ class Predictor:
         # shapes no executable was built for)
         self._gen_cache_lens = {b: _round_up(b + max_new)
                                 for b in buckets}
-        self._gen_session = GenerationSession(layer)
+        self._gen_session = GenerationSession(
+            layer, executable_store=self._exe_store)
         for b in buckets:
             self._gen_session.aot_compile(opts["max_batch"], b,
                                           self._gen_cache_lens[b],

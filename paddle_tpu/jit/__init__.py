@@ -3,4 +3,7 @@ from .api import (ProgramTranslator, TracedLayer, TrainStep,  # noqa: F401
                   not_to_static, set_code_level, set_verbosity,
                   to_static,
                   value_and_grad)
+from .compile_cache import (ExecutableStore, compile_or_load,  # noqa: F401
+                            default_store, enable_compile_cache,
+                            set_default_store)
 from .save_load import load, save  # noqa: F401

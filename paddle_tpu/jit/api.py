@@ -344,6 +344,65 @@ class TrainStep:
         self._donate_argnums = donate_argnums
         self._jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
         self._tracker = _RetraceTracker()
+        self._warm_store = None   # enable_warm_start() opt-in
+        self._warm_exe = None
+        # the warm/AOT path bakes donation only where the backend
+        # implements it: a serialized executable REPLAYS its
+        # input_output_aliases on load, and deserialized-on-CPU
+        # aliasing double-frees the donated buffers (heap corruption)
+        # where the live jit path merely drops the request with a
+        # warning. audit() keeps gating the donation INTENT.
+        self._aot_donate = donate_argnums \
+            if jax.default_backend() == "tpu" else ()
+        self._aot_jitted = self._jitted \
+            if self._aot_donate == donate_argnums \
+            else jax.jit(step_fn, donate_argnums=self._aot_donate)
+
+    def enable_warm_start(self, store=None):
+        """Opt-in executable persistence for the fused step — the
+        ``Model.fit(resume=True)`` warm path. The first call lowers the
+        step and loads a serialized executable from ``store`` (default:
+        the ``jit.compile_cache`` process store), so a relaunched
+        trainer reaches its first step in load time, not compile time;
+        a cold store compiles once and persists for the next relaunch.
+        Dispatch falls back to the regular jit path the moment the
+        operand signature drifts from the warmed executable.
+
+        No-op under ``offload_opt_state``: the offload path re-jits a
+        ``device_put``-wrapped program in ``_setup_offload``, and
+        persisting the resident-state variant would silently disable
+        the offload (and its HBM relief) on relaunch."""
+        if self._offload:
+            return self
+        from . import compile_cache
+        self._warm_store = store if store is not None \
+            else compile_cache.default_store()
+        return self
+
+    def _warm_signature(self, args):
+        """Structural identity of the fused step WITHOUT tracing it
+        (the store's traceless manifest key): model code + config,
+        loss/optimizer code and their baked scalar constants, the
+        recompute/skip flags, and the full operand aval tree. None —
+        forcing the always-correct traced path — when any piece has no
+        deterministic description (REPL lambdas, address-bearing
+        reprs, opaque closure cells)."""
+        from . import compile_cache
+        sig = compile_cache.network_signature(self.model)
+        loss_sig = compile_cache.callable_signature(self.loss_fn)
+        opt_src = compile_cache.source_hash(type(self.optimizer))
+        flags = repr((self._skip_nonfinite, self._offload,
+                      self._recompute))
+        if sig is None or loss_sig is None or opt_src is None \
+                or "0x" in flags:
+            return None
+        sig.update(
+            program=("TrainStep",), loss=loss_sig,
+            opt=(type(self.optimizer).__qualname__, opt_src,
+                 compile_cache.scalar_signature(self.optimizer)),
+            flags=flags,
+            operands=compile_cache.aval_signature(args))
+        return sig
 
     def _setup_offload(self):
         """Re-jit with the opt state parked in pinned host memory: the
@@ -400,14 +459,36 @@ class TrainStep:
             jax.tree_util.tree_map(
                 _unwrap, b, is_leaf=lambda t: isinstance(t, Tensor))
             for b in batch)
-        pre_cache = self._tracker.pre(self._jitted)
-        loss, new_vals, self._opt_state_tree = self._jitted(
-            [p._data for p in params], self._opt_state_tree,
-            np.float32(lr), np.int32(self.optimizer._step_count), *raw_batch)
-        if monitor.enabled:  # donated args keep their aval metadata
-            self._tracker.observe(
-                self._jitted, ([p._data for p in params], raw_batch),
-                pre_cache)
+        args = ([p._data for p in params], self._opt_state_tree,
+                np.float32(lr), np.int32(self.optimizer._step_count),
+                *raw_batch)
+        if self._warm_store is not None and self._warm_exe is None:
+            from . import compile_cache
+            self._warm_exe = compile_cache.build_or_load(
+                self._warm_signature(args),
+                lambda: self._aot_jitted.lower(*args),
+                store=self._warm_store,
+                extra=dict(kind="TrainStep",
+                           donation=self._aot_donate),
+                label="train_step")
+            self._warm_store = None  # warmed once; drift falls back
+        if self._warm_exe is not None:
+            try:
+                loss, new_vals, self._opt_state_tree = \
+                    self._warm_exe(*args)
+            except (TypeError, ValueError) as e:
+                # operand signature drifted from the warmed executable
+                # (input validation fails BEFORE execution — no donated
+                # buffer was consumed): permanent fallback to jit
+                monitor.record_swallowed("jit.compile_cache.warm_step",
+                                         e)
+                self._warm_exe = None
+        if self._warm_exe is None:
+            pre_cache = self._tracker.pre(self._jitted)
+            loss, new_vals, self._opt_state_tree = self._jitted(*args)
+            if monitor.enabled:  # donated args keep their aval metadata
+                self._tracker.observe(
+                    self._jitted, (args[0], raw_batch), pre_cache)
         for p, v in zip(params, new_vals):
             p._data = v
         # mirror the functional state back so optimizer.state_dict()

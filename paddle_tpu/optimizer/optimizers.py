@@ -3,9 +3,26 @@ lamb,...}.py; fused GPU kernels phi/kernels/gpu/{adam,adamw,lamb}_kernel.cu).
 Each is one pure `_update` rule; XLA fuses the whole step."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .optimizer import Optimizer
+
+
+def _zeros(shape, dtype):
+    """Moment-buffer zeros built on HOST and device_put: a relaunch
+    initializes dozens of these, and ``jnp.zeros`` compiles one tiny
+    broadcast program per distinct shape (~150ms of XLA across a
+    test-tiny AdamW state on a cold jit cache — measured on the
+    ISSUE-9 warm-restart path); device_put of a host buffer skips XLA
+    entirely. Under tracing (eval_shape / audit) the constant stays
+    abstract — numerics unchanged."""
+    return jax.device_put(np.zeros(shape, np.dtype(dtype)))
+
+
+def _full(shape, value, dtype):
+    return jax.device_put(np.full(shape, value, np.dtype(dtype)))
 
 
 class SGD(Optimizer):
@@ -40,7 +57,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _init_state(self, shape, dtype):
-        st = {"velocity": jnp.zeros(shape, jnp.float32)}
+        st = {"velocity": _zeros(shape, jnp.float32)}
         if self.multi_precision and jnp.dtype(dtype) in (
                 jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
             st["master"] = None  # filled lazily from the param
@@ -68,7 +85,7 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _init_state(self, shape, dtype):
-        return {"moment": jnp.full(shape, self._init_acc, dtype)}
+        return {"moment": _full(shape, self._init_acc, dtype)}
 
     def _update(self, p, g, state, lr, step):
         m = state["moment"] + jnp.square(g)
@@ -84,10 +101,10 @@ class RMSProp(Optimizer):
         self._momentum, self._centered = momentum, centered
 
     def _init_state(self, shape, dtype):
-        st = {"mean_square": jnp.zeros(shape, dtype),
-              "momentum": jnp.zeros(shape, dtype)}
+        st = {"mean_square": _zeros(shape, dtype),
+              "momentum": _zeros(shape, dtype)}
         if self._centered:
-            st["mean_grad"] = jnp.zeros(shape, dtype)
+            st["mean_grad"] = _zeros(shape, dtype)
         return st
 
     def _update(self, p, g, state, lr, step):
@@ -118,8 +135,8 @@ class _AdamBase(Optimizer):
         # itself is low precision) in fp32 — the reference's multi_precision
         # path (phi/kernels/gpu/adamw_kernel.cu master-weight arguments)
         mdtype = jnp.float32
-        st = {"moment1": jnp.zeros(shape, mdtype),
-              "moment2": jnp.zeros(shape, mdtype)}
+        st = {"moment1": _zeros(shape, mdtype),
+              "moment2": _zeros(shape, mdtype)}
         if self.multi_precision and jnp.dtype(dtype) in (
                 jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
             st["master"] = None  # filled lazily from the param on first step
@@ -184,8 +201,8 @@ class AdamW(_AdamBase):
 
 class Adamax(_AdamBase):
     def _init_state(self, shape, dtype):
-        return {"moment": jnp.zeros(shape, jnp.float32),
-                "inf_norm": jnp.zeros(shape, jnp.float32)}
+        return {"moment": _zeros(shape, jnp.float32),
+                "inf_norm": _zeros(shape, jnp.float32)}
 
     def _update(self, p, g, state, lr, step):
         g32 = g.astype(jnp.float32)

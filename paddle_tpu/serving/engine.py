@@ -21,7 +21,11 @@ The reference ships serving as a whole layer (paddle/fluid/inference,
   prefill executable per bucket plus the decode/admit/free trio; after
   it, a compile the engine is ever forced to do mid-traffic is recorded
   as ``jit.compile{cause=new_shape}`` — the steady-state no-retrace
-  invariant the tier-1 gate asserts stays 0.
+  invariant the tier-1 gate asserts stays 0. With an executable store
+  active (``executable_store=`` or the ``jit.compile_cache`` process
+  default) warmup loads serialized executables a previous launch
+  persisted — a rolling relaunch warm-starts with zero XLA compiles
+  (``jit.compile_cache.hits`` == program count, ``misses`` == 0).
 - **precision**: the engine serves the bf16/fp16 cast (and the int8
   weight-only / int8-compute hooks) through the same
   ``inference.precision.serving_params`` the Predictor audits —
@@ -84,7 +88,8 @@ class ServingEngine:
                  drain_timeout_s: Optional[float] = None,
                  default_deadline_s: Optional[float] = None,
                  cache_max_len: Optional[int] = None,
-                 warmup: bool = True, seed: Optional[int] = None):
+                 warmup: bool = True, seed: Optional[int] = None,
+                 executable_store=None):
         from ..inference.precision import serving_params
         from ..jit.api import _unwrap, functional_call
 
@@ -217,18 +222,24 @@ class ServingEngine:
 
         self._prefill_fn, self._step_fn = prefill_fn, step_fn
         self._admit_fn, self._free_fn = admit_fn, free_fn
+        # executable persistence: every program warmup() compiles goes
+        # through jit.compile_cache (this store, or the process default
+        # when None) so a relaunched engine loads instead of recompiling
+        self._exe_store = executable_store
         # donate on TPU only (CPU/GPU donation is a no-op that warns
         # once per program); audit() gates the TPU donation INTENT
         tpu = jax.default_backend() == "tpu"
+        self._step_donate = (1, 2, 3, 4, 5, 6, 7) if tpu else ()
+        self._admit_donate = (0, 1, 2, 3, 4, 5, 7) if tpu else ()
+        self._free_donate = (0, 1) if tpu else ()
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
         self._step_jit = jax.jit(
             step_fn, static_argnums=(8,),
-            donate_argnums=(1, 2, 3, 4, 5, 6, 7) if tpu else ())
+            donate_argnums=self._step_donate)
         self._admit_jit = jax.jit(
-            admit_fn,
-            donate_argnums=(0, 1, 2, 3, 4, 5, 7) if tpu else ())
+            admit_fn, donate_argnums=self._admit_donate)
         self._free_jit = jax.jit(
-            free_fn, donate_argnums=(0, 1) if tpu else ())
+            free_fn, donate_argnums=self._free_donate)
 
         # ------------------------------------------------------- state
         self._state = tuple(self._sp.vals)
@@ -246,13 +257,19 @@ class ServingEngine:
             lambda s, i, p, k: prefill_fn(s, i, p, k, cfg, self.max_len),
             self._state, sds((B, buckets[0]), jnp.int32),
             sds((B,), jnp.int32), self._key)[1]
+        # lane/cache buffers built on HOST and device_put: jnp.zeros
+        # would compile one tiny broadcast program per shape — dead
+        # weight on the warm-relaunch path the executable store keeps
+        # otherwise XLA-free
         self._cache = jax.tree_util.tree_map(
-            lambda a: jnp.zeros(a.shape, a.dtype), cache_aval)
-        self._tok = jnp.zeros((B,), jnp.int32)
-        self._finished = jnp.ones((B,), bool)   # empty slots are masked
-        self._steps = jnp.zeros((B,), jnp.int32)
-        self._budget = jnp.zeros((B,), jnp.int32)
-        self._out_buf = jnp.zeros((B, cap), jnp.int32)
+            lambda a: jax.device_put(np.zeros(a.shape, a.dtype)),
+            cache_aval)
+        self._tok = jax.device_put(np.zeros((B,), np.int32))
+        self._finished = jax.device_put(np.ones((B,), bool))  # empty
+        #                                       slots are masked
+        self._steps = jax.device_put(np.zeros((B,), np.int32))
+        self._budget = jax.device_put(np.zeros((B,), np.int32))
+        self._out_buf = jax.device_put(np.zeros((B, cap), np.int32))
 
         self._slots: List[Optional[Request]] = [None] * B
         self._slot_used = [False] * B          # reuse detection
@@ -283,16 +300,45 @@ class ServingEngine:
         if self.network.training:
             self.network.eval()
 
-    def _compiled(self, cache_key, build):
+    def _program_signature(self, cache_key):
+        """Structural identity of one scheduler program WITHOUT tracing
+        it (the store's traceless manifest key): network code + weights
+        structure, the full bucket/shape/sampling/precision config, and
+        the engine's own lane avals. None (→ traced path) when the
+        network has no deterministic description."""
+        from ..jit import compile_cache
+        sig = compile_cache.network_signature(self.network)
+        if sig is None:
+            return None
+        sig.update(
+            program=("serving",) + tuple(cache_key),
+            generation=repr(self._cfg),
+            buckets=tuple(self.buckets),
+            shape=(self.max_batch, self.max_len, self.max_new_tokens),
+            precision=(self.config.precision,
+                       getattr(self.config, "_int8_compute", False)),
+            operands=compile_cache.aval_signature(self._state))
+        return sig
+
+    def _compiled(self, cache_key, build, donation=()):
+        """One warm program: ``build`` returns the LOWERED module; the
+        executable comes from the store on a warm relaunch (manifest
+        hit: zero traces, zero XLA compiles) or a fresh ``compile()``
+        that is then persisted."""
         exe = self._exes.get(cache_key)
         if exe is None:
+            from ..jit import compile_cache
             self._ensure_eval()
             # a compile after warmup means live traffic hit a shape no
             # executable was built for — exactly what the steady-state
             # no-retrace gate (jit.compile{cause=new_shape} == 0) guards
             monitor.record_retrace(
                 "first" if not self._warm else "new_shape")
-            exe = build()
+            label = "serving." + ".".join(str(p) for p in cache_key)
+            exe = compile_cache.build_or_load(
+                self._program_signature(cache_key), build,
+                store=self._exe_store,
+                extra=dict(kind=label, donation=donation), label=label)
             self._exes[cache_key] = exe
         return exe
 
@@ -302,13 +348,13 @@ class ServingEngine:
                               lambda: self._prefill_jit.lower(
             self._state, sds((1, bucket), jnp.int32),
             sds((1,), jnp.int32), sds((2,), jnp.uint32), self._cfg,
-            self.max_len).compile())
+            self.max_len))
 
     def _exe_step(self):
         return self._compiled(("step",), lambda: self._step_jit.lower(
             self._state, self._tok, self._cache, self._key,
             self._finished, self._steps, self._budget, self._out_buf,
-            self._cfg).compile())
+            self._cfg), donation=self._step_donate)
 
     def _row_avals(self):
         """(tok, row_cache, finished) avals of a batch-1 prefill — the
@@ -330,13 +376,14 @@ class ServingEngine:
             return self._admit_jit.lower(
                 self._cache, self._tok, self._finished, self._steps,
                 self._budget, self._out_buf, scalar, row_cache_a,
-                tok_a, fin_a, scalar).compile()
-        return self._compiled(("admit",), build)
+                tok_a, fin_a, scalar)
+        return self._compiled(("admit",), build,
+                              donation=self._admit_donate)
 
     def _exe_free(self):
         return self._compiled(("free",), lambda: self._free_jit.lower(
             self._cache, self._finished,
-            jnp.asarray(0, jnp.int32)).compile())
+            jnp.asarray(0, jnp.int32)), donation=self._free_donate)
 
     def warmup(self):
         """Compile every program the scheduler can dispatch (one

@@ -27,6 +27,12 @@ Injectable faults:
 - ``kill_worker(...)``          — SIGKILL one of a DataLoader's worker
                                   processes (crashed/OOM-killed worker;
                                   drives the supervised respawn path).
+- ``truncate_executable(...)``  — truncate a serialized-executable
+                                  entry of a ``jit.compile_cache``
+                                  store (torn write during relaunch).
+- ``corrupt_executable(...)``   — flip payload bytes of an entry (bit
+                                  rot; the checksum must catch it and
+                                  the load must fall back to compile).
 - ``suspend_worker(...)``       — SIGSTOP a worker (wedged worker; the
                                   per-fetch deadline must fire).
 - ``FlakySamples(ds, ...)``     — dataset wrapper raising / returning
@@ -46,13 +52,16 @@ __all__ = [
     "NaNLoss",
     "StoreFaults",
     "checkpoint_data_files",
+    "corrupt_executable",
     "dataloader_workers",
+    "executable_entries",
     "kill_worker",
     "poison_batch",
     "remove_commit_marker",
     "resume_worker",
     "suspend_worker",
     "truncate_checkpoint",
+    "truncate_executable",
 ]
 
 
@@ -226,6 +235,54 @@ def poison_batch(batch):
         return poison(node)
 
     return walk(batch)
+
+
+# -------------------------------------------- executable-store faults
+
+def executable_entries(store_or_root) -> list:
+    """The serialized-executable entries of a ``jit.compile_cache``
+    store (an :class:`~paddle_tpu.jit.compile_cache.ExecutableStore`
+    or its root dir), sorted — deterministic handle for the
+    corruptions below."""
+    root = getattr(store_or_root, "root", store_or_root)
+    from ..jit.compile_cache import ENTRY_SUFFIX
+    try:
+        names = os.listdir(root)
+    except OSError:
+        raise FileNotFoundError(f"no executable store at {root}")
+    out = sorted(os.path.join(root, n) for n in names
+                 if n.endswith(ENTRY_SUFFIX))
+    if not out:
+        raise FileNotFoundError(f"no executable entries under {root}")
+    return out
+
+
+def truncate_executable(store_or_root, index: int = 0,
+                        keep_bytes: int = 0) -> str:
+    """Truncate one store entry to ``keep_bytes`` — a torn write from a
+    process killed mid-relaunch. The next load of that program must
+    fall back to a fresh compile (``jit.compile_cache.misses{cause=
+    corrupt}``) and rewrite a good entry. Returns the truncated
+    path."""
+    path = executable_entries(store_or_root)[index]
+    with open(path, "r+b") as f:
+        f.truncate(int(keep_bytes))
+    return path
+
+
+def corrupt_executable(store_or_root, index: int = 0,
+                       offset: int = -64, n: int = 8) -> str:
+    """XOR-flip ``n`` bytes of one store entry at ``offset`` (negative:
+    from the end — the payload tail, past the checksum header) — bit
+    rot the entry's sha256 must catch. Returns the corrupted path."""
+    path = executable_entries(store_or_root)[index]
+    with open(path, "r+b") as f:
+        f.seek(offset, os.SEEK_END if offset < 0 else os.SEEK_SET)
+        pos = f.tell()
+        data = f.read(int(n))
+        f.seek(pos)
+        f.write(bytes(b ^ 0xFF for b in data))
+    return path
 
 
 # ------------------------------------------------- dataloader faults

@@ -146,6 +146,41 @@ class TestMetricNameRule:
                                  "paddle_tpu/core/monitor.py")
 
 
+class TestCompileCacheDirRule:
+    def test_flags_direct_config_update(self, tmp_path):
+        found = _lint_snippet(tmp_path, """
+            import jax
+            def setup(path):
+                jax.config.update("jax_compilation_cache_dir", path)
+                jax.config.update("jax_default_matmul_precision",
+                                  "highest")   # other keys: fine
+            """, "paddle_tpu/inference/predictor.py")
+        assert _rules_of(found) == ["compile-cache-dir"]
+        assert len(found) == 1 and found[0].line == 4
+        assert "enable_compile_cache" in found[0].message
+
+    def test_owner_module_and_marker_pass(self, tmp_path):
+        src = """
+            import jax
+            def setup(path):
+                jax.config.update("jax_compilation_cache_dir", path)
+            """
+        # the owning module sets it freely
+        assert not _lint_snippet(tmp_path, src,
+                                 "paddle_tpu/jit/compile_cache.py")
+        # ...everyone else needs the marker
+        marked = """
+            import jax
+            def restore(prev):
+                jax.config.update("jax_compilation_cache_dir", prev)  # lint: compile-cache-dir-ok (test restore)
+            """
+        assert not _lint_snippet(tmp_path, marked,
+                                 "tests/test_whatever.py")
+        # and tests/benches are NOT exempt without one
+        assert _lint_snippet(tmp_path, src, "tests/test_whatever.py")
+        assert _lint_snippet(tmp_path, src, "bench.py")
+
+
 class TestChaosMarkerRule:
     def test_flags_unmarked_import(self, tmp_path):
         found = _lint_snippet(tmp_path, """
@@ -181,7 +216,8 @@ class TestChaosMarkerRule:
 class TestEngine:
     def test_all_rules_registered(self):
         assert set(RULES) == {"host-sync", "jit-random", "bare-except",
-                              "metric-name", "chaos-marker"}
+                              "metric-name", "chaos-marker",
+                              "compile-cache-dir"}
 
     def test_syntax_error_is_reported_not_raised(self, tmp_path):
         found = _lint_snippet(tmp_path, "def broken(:\n",

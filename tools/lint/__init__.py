@@ -18,6 +18,10 @@ those programs — the host-side hazards no jaxpr ever shows:
                   ``chaos`` marker (the conftest collection guard,
                   promoted to lint so function-level imports are caught
                   too)
+    compile-cache-dir  direct ``jax.config.update(
+                  "jax_compilation_cache_dir", ...)`` outside
+                  ``jit/compile_cache.py`` (process-global cache-dir
+                  hijack; call ``jit.enable_compile_cache``)
 
 Run it over the tree (CI does; nonzero exit on any finding):
 

@@ -252,6 +252,43 @@ def check_metric_names(ctx: FileContext) -> List[LintFinding]:
     return findings
 
 
+# ------------------------------------------------------ compile-cache-dir
+
+# the one module allowed to touch jax's process-global compile-cache
+# config (owns the set-once + conflict-warning semantics)
+_COMPILE_CACHE_OWNER = "paddle_tpu/jit/compile_cache.py"
+
+
+@rule("compile-cache-dir")
+def check_compile_cache_dir(ctx: FileContext) -> List[LintFinding]:
+    """Direct ``jax.config.update("jax_compilation_cache_dir", ...)``
+    outside ``jit/compile_cache.py``: the jax cache dir is
+    process-global state — a stray update silently re-points (or races)
+    every other subsystem's cache, the predictor global-hijack bug
+    class. Call ``paddle_tpu.jit.enable_compile_cache(dir)`` instead;
+    it owns the set-once/warn-on-conflict semantics."""
+    if ctx.relpath == _COMPILE_CACHE_OWNER:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _dotted(node.func).endswith("config.update")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "jax_compilation_cache_dir"):
+            continue
+        if ctx.allowed(node, "compile-cache-dir"):
+            continue
+        findings.append(LintFinding(
+            ctx.relpath, node.lineno, node.col_offset,
+            "compile-cache-dir",
+            "direct jax.config.update('jax_compilation_cache_dir', ...) "
+            "re-points process-global state under every other "
+            "subsystem; use paddle_tpu.jit.enable_compile_cache(dir) "
+            "(jit/compile_cache.py owns the set-once semantics)"))
+    return findings
+
+
 # ---------------------------------------------------------- chaos-marker
 
 def _has_chaos_marker(nodes: List[ast.AST]) -> bool:
