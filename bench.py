@@ -675,6 +675,50 @@ def bench_warmstart(dev, on_tpu):
     }
 
 
+# counter families attached to every BENCH row (flat keys always
+# present so the row schema is stable; the labeled cause/... breakdown
+# rides along when nonzero)
+_COUNTER_KEYS = ("jit.compile.total", "jit.compile_cache.hits",
+                 "jit.compile_cache.misses", "train.host_syncs",
+                 "train.loss_fetches")
+_COUNTER_PREFIXES = ("jit.compile{", "jit.compile_cache.misses{")
+
+
+def _counter_values():
+    from paddle_tpu.profiler import metrics
+    snap = metrics.snapshot()
+    out = {k: int(snap[k]["value"]) if k in snap else 0
+           for k in _COUNTER_KEYS}
+    for name, d in snap.items():
+        if d["kind"] == "counter" and \
+                any(name.startswith(p) for p in _COUNTER_PREFIXES):
+            out[name] = int(d["value"])
+    return out
+
+
+def _with_counters(fn, dev, on_tpu):
+    """Run one bench with the metrics registry on and attach the
+    counter deltas as the row's "counters" sub-dict — a perf
+    regression's first triage question ("did it retrace? miss the
+    executable store? stall on host syncs?") answers itself from the
+    BENCH json."""
+    from paddle_tpu.profiler import metrics
+    was = metrics.is_enabled()
+    metrics.enable()
+    before = _counter_values()
+    try:
+        row = fn(dev, on_tpu)
+    finally:
+        if not was:
+            metrics.disable()
+    after = _counter_values()
+    row["counters"] = {k: after[k] - before.get(k, 0)
+                       for k in sorted(after)
+                       if k in _COUNTER_KEYS
+                       or after[k] - before.get(k, 0)}
+    return row
+
+
 BENCHES = {
     "gpt2": bench_gpt2,
     "decode": bench_decode,
@@ -708,16 +752,17 @@ def main():
                       file=sys.stderr)
                 continue
             try:
-                print(json.dumps(fn(dev, on_tpu)), file=sys.stderr)
+                print(json.dumps(_with_counters(fn, dev, on_tpu)),
+                      file=sys.stderr)
             except Exception as e:  # one failing config must not
                 print(json.dumps({"metric": f"{name} FAILED: {e}"}),
                       file=sys.stderr)  # silence the flagship line
-        print(json.dumps(bench_gpt2(dev, on_tpu)))
+        print(json.dumps(_with_counters(bench_gpt2, dev, on_tpu)))
         return
     if which not in BENCHES:
         raise SystemExit(f"unknown bench {which!r}; one of "
                          f"{sorted(BENCHES)} or 'all'")
-    print(json.dumps(BENCHES[which](dev, on_tpu)))
+    print(json.dumps(_with_counters(BENCHES[which], dev, on_tpu)))
 
 
 if __name__ == "__main__":

@@ -158,16 +158,24 @@ class Histogram(_Metric):
     def observe(self, v: float):
         if not _enabled:
             return
+        v = float(v)
+        finite = v - v == 0.0  # False for nan/±inf, no math import
         with self._lock:
             self._count += 1
-            self._sum += v
+            if finite:
+                # a single poisoned observation (nan/inf latency from a
+                # broken clock) must not turn _sum/mean — and every
+                # /metrics render after it — non-finite forever; the
+                # observation still counts (overflow bucket below)
+                self._sum += v
             for i, b in enumerate(self.bounds):
-                if v <= b:
+                if finite and v <= b:
                     self._counts[i] += 1
                     break
             else:
                 self._counts[-1] += 1
-            self._sample(v)
+            if finite:  # never fabricate a 0.0 sample for poison
+                self._sample(v)
 
     @property
     def count(self) -> int:
@@ -188,7 +196,12 @@ class Histogram(_Metric):
         at 0). Observations in the overflow bucket clamp to the last
         bound — the estimate is only as fine as the bounds, so latency
         histograms should be created with latency-scaled bounds (the
-        serve.* recorders do). Read-side only: never on a hot path."""
+        serve.* recorders do). Read-side only: never on a hot path.
+
+        Pinned edge cases (a /metrics render must never show NaN/inf):
+        empty histogram -> 0.0; q clamped to [0, 100]; all mass in the
+        overflow bucket -> the last finite bound; a non-finite bound
+        (user-supplied inf sentinel) -> its bucket's lower edge."""
         with self._lock:
             total = self._count
             if not total:
@@ -198,16 +211,26 @@ class Histogram(_Metric):
             lo = 0.0
             for bound, c in zip(self.bounds, self._counts):
                 if c and cum + c >= target:
+                    if bound - bound != 0.0:  # inf bound: clamp at lo
+                        return lo
                     return lo + (bound - lo) * (target - cum) / c
                 cum += c
-                lo = bound
-            return lo  # overflow bucket: clamp at the last bound
+                if bound - bound == 0.0:
+                    lo = bound
+            return lo  # overflow bucket: clamp at the last finite bound
 
     def buckets(self) -> Dict[str, int]:
         with self._lock:
             out = {f"le_{b}": c for b, c in zip(self.bounds, self._counts)}
             out["overflow"] = self._counts[-1]
         return out
+
+    def raw(self) -> Tuple[Tuple[float, ...], List[int], int, float]:
+        """(bounds, per-bucket counts incl. trailing overflow, count,
+        sum) as one consistent snapshot — what the Prometheus text
+        renderer cumulates into ``_bucket{le=...}`` lines."""
+        with self._lock:
+            return self.bounds, list(self._counts), self._count, self._sum
 
     def reset(self):
         with self._lock:
@@ -346,6 +369,15 @@ def stop_sampling() -> Dict[str, List[Tuple[int, float]]]:
         if s:
             out[m.name] = s
     return out
+
+
+def all_metrics() -> Dict[str, _Metric]:
+    """Consistent copy of the live registry ({labeled name -> metric
+    instance}) — the read surface the telemetry server renders from
+    (snapshot() flattens histograms; the renderer needs their raw
+    bounds)."""
+    with _registry_lock:
+        return dict(_metrics)
 
 
 def snapshot() -> Dict[str, dict]:

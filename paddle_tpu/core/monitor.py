@@ -47,10 +47,12 @@ Metric name scheme (what the summary views group by):
     serve.slot_occupancy        gauge: busy decode slots / max_batch
     serve.cancellations{reason=...}   deadline/shutdown cancellations
     analysis.findings{check=,severity=}   static-audit findings
+    telemetry.scrapes{endpoint=...}   telemetry-server HTTP requests
+    flightrecorder.dumps{reason=...}  flight-recorder dump files written
 """
 from __future__ import annotations
 
-from . import metrics
+from . import flight_recorder, metrics
 
 # The declared metric-name families. Every hot-path call site records
 # through this module's recorders, so this set IS the schema; the
@@ -82,7 +84,141 @@ DECLARED_METRICS = frozenset({
     "serve.requests", "serve.queue_depth", "serve.ttft",
     "serve.token_latency", "serve.slot_occupancy", "serve.cancellations",
     "analysis.findings",
+    "telemetry.scrapes", "flightrecorder.dumps",
 })
+
+# The human-facing schema behind DECLARED_METRICS: name -> (kind,
+# label names, one-line description). `python -m tools.metrics_doc`
+# renders docs/metrics.md from this table, and a tier-1 drift test
+# asserts (a) its keys == DECLARED_METRICS and (b) the generated doc
+# matches the committed one — the schema cannot silently diverge from
+# its documentation. (DECLARED_METRICS stays a separate frozenset
+# literal because tools/lint parses it by AST without importing us.)
+METRIC_DOC = {
+    "jit.compile": ("counter", ("cause",),
+                    "jax.jit cache misses (retraces) by cause: first | "
+                    "new_shape | new_dtype | new_structure | "
+                    "donation_miss"),
+    "jit.compile.total": ("counter", (),
+                          "all retraces across every jitted entry point"),
+    "jit.compile_cache.hits": ("counter", (),
+                               "executable-store loads (a compiled "
+                               "program deserialized instead of "
+                               "XLA-compiled)"),
+    "jit.compile_cache.misses": ("counter", ("cause",),
+                                 "executable-store misses: absent | "
+                                 "corrupt | stale_ref"),
+    "jit.compile_cache.bytes": ("counter", (),
+                                "serialized-executable bytes moved "
+                                "(loads + saves)"),
+    "jit.compile_cache.load_ms": ("histogram", (),
+                                  "executable deserialize+load latency "
+                                  "(ms)"),
+    "jit.compile_cache.save_ms": ("histogram", (),
+                                  "executable serialize+commit latency "
+                                  "(ms)"),
+    "static.program_builds": ("counter", (),
+                              "program_guard static-graph captures"),
+    "static.ops_recorded": ("counter", (),
+                            "ops appended to static programs"),
+    "comm.ops": ("counter", ("axis", "op"),
+                 "eager collective launches per mesh axis"),
+    "comm.bytes": ("counter", ("axis", "op"),
+                   "eager collective payload bytes per mesh axis"),
+    "io.batches": ("counter", (), "DataLoader batches produced"),
+    "io.samples": ("counter", (), "DataLoader samples produced"),
+    "io.bytes": ("counter", (), "DataLoader bytes produced"),
+    "io.batch_bytes": ("histogram", (),
+                       "per-batch byte-size distribution"),
+    "io.worker.deaths": ("counter", ("worker",),
+                         "DataLoader workers found dead "
+                         "(crash/OOM/SIGKILL)"),
+    "io.worker.respawns": ("counter", ("worker",),
+                           "dead DataLoader workers respawned"),
+    "io.sample.quarantined": ("counter", (),
+                              "bad/non-finite samples skipped by the "
+                              "quarantine"),
+    "io.host2device.placed": ("counter", (),
+                              "batch leaves transferred host->device"),
+    "io.host2device.skipped": ("counter", (),
+                               "leaves already resident on their target "
+                               "sharding (idempotent placement)"),
+    "io.host2device.bytes": ("counter", (),
+                             "host->device bytes transferred"),
+    "train.loss_fetches": ("counter", (),
+                           "loss scalars read back by the async train "
+                           "loop"),
+    "train.host_syncs": ("counter", (),
+                         "loss read-backs that actually blocked (true "
+                         "pipeline stalls; gated by "
+                         "test_host_sync_gate)"),
+    "amp.scaler.steps": ("counter", (), "GradScaler steps"),
+    "amp.scaler.skipped": ("counter", (),
+                           "GradScaler steps skipped on found_inf"),
+    "amp.loss_scale": ("gauge", (), "current loss scale"),
+    "device.memory.allocated": ("gauge", (),
+                                "live device bytes (peak tracked)"),
+    "device.memory.reserved": ("gauge", (),
+                               "reserved device bytes (peak tracked)"),
+    "resilience.preemptions": ("counter", (),
+                               "preemptions observed at a step boundary"),
+    "resilience.emergency_saves": ("counter", (),
+                                   "emergency checkpoint rounds run"),
+    "resilience.emergency_save_step": ("gauge", (),
+                                       "step id of the last emergency "
+                                       "save"),
+    "resilience.watchdog.timeouts": ("counter", ("label",),
+                                     "hang-watchdog expiries by guarded "
+                                     "region"),
+    "resilience.ckpt.fallback": ("counter", (),
+                                 "corrupt/uncommitted checkpoint steps "
+                                 "skipped on restore"),
+    "resilience.ckpt.last_skipped_step": ("gauge", (),
+                                          "step id last skipped as "
+                                          "corrupt"),
+    "train.anomalies": ("counter", (),
+                        "non-finite losses skipped by the anomaly "
+                        "guard"),
+    "train.anomaly_restores": ("counter", (),
+                               "anomaly-guard restores from the last "
+                               "good snapshot"),
+    "errors.swallowed": ("counter", ("where",),
+                         "deliberately swallowed exceptions (always "
+                         "logged)"),
+    "gen.tokens": ("counter", (),
+                   "real generated tokens (live rows, up to eos)"),
+    "gen.prefill_steps": ("counter", (), "prefill dispatches"),
+    "gen.decode_steps": ("counter", (), "decode dispatches"),
+    "gen.cache_occupancy": ("gauge", (),
+                            "KV-cache fraction in use (max over rows)"),
+    "serve.requests": ("counter", ("status",),
+                       "requests reaching a terminal status: completed "
+                       "| cancelled | rejected (QPS = rate of this)"),
+    "serve.queue_depth": ("gauge", (),
+                          "requests waiting for a decode slot"),
+    "serve.ttft": ("histogram", (),
+                   "time-to-first-token (s), submit -> prefill token, "
+                   "includes queue wait"),
+    "serve.token_latency": ("histogram", (),
+                            "per-token decode cadence (s) per scheduler "
+                            "poll window"),
+    "serve.slot_occupancy": ("gauge", (),
+                             "busy decode slots / max_batch"),
+    "serve.cancellations": ("counter", ("reason",),
+                            "requests cancelled before completing: "
+                            "deadline | shutdown | error"),
+    "analysis.findings": ("counter", ("check", "severity"),
+                          "static-audit findings by detector and "
+                          "severity"),
+    "telemetry.scrapes": ("counter", ("endpoint",),
+                          "telemetry-server HTTP requests by endpoint "
+                          "(metrics | healthz | readyz | "
+                          "flightrecorder)"),
+    "flightrecorder.dumps": ("counter", ("reason",),
+                             "flight-recorder dump files written "
+                             "(watchdog | preemption | anomaly_restore "
+                             "| serve_crash | fit_crash | manual)"),
+}
 
 enabled = False  # mirrored from metrics.enable()/disable()
 
@@ -102,7 +238,11 @@ disable = metrics.disable
 
 def record_retrace(cause: str, target: str = "jit"):
     """One jax.jit cache miss. cause: first | new_shape | new_dtype |
-    new_structure | donation_miss."""
+    new_structure | donation_miss. Also lands in the flight recorder
+    (its own enable flag): a post-mortem must show what compiled in the
+    seconds before death even when nobody enabled the registry."""
+    if flight_recorder.enabled:
+        flight_recorder.record("jit.compile", cause=cause, target=target)
     if not enabled:
         return
     metrics.counter(f"{target}.compile", cause=cause).inc()
@@ -154,6 +294,9 @@ def record_static_op():
 # ----------------------------------------------------- distributed layer
 
 def record_collective(op: str, axis: str, nbytes: int):
+    if flight_recorder.enabled:
+        flight_recorder.record("comm.dispatch", op=op, axis=axis,
+                               bytes=int(nbytes))
     if not enabled:
         return
     metrics.counter("comm.ops", axis=axis, op=op).inc()
@@ -162,6 +305,9 @@ def record_collective(op: str, axis: str, nbytes: int):
 
 
 def record_p2p(op: str, nbytes: int):
+    if flight_recorder.enabled:
+        flight_recorder.record("comm.dispatch", op=op, axis="p2p",
+                               bytes=int(nbytes))
     if not enabled:
         return
     metrics.counter("comm.ops", axis="p2p", op=op).inc()
@@ -390,6 +536,26 @@ def record_analysis_finding(check: str, severity: str, n: int = 1):
     metrics.counter("analysis.findings", check=check,
                     severity=severity).inc(int(n))
     metrics.counter("analysis.findings").inc(int(n))
+
+
+# ------------------------------------------------------- telemetry layer
+
+def record_scrape(endpoint: str):
+    """One telemetry-server HTTP request (endpoint: metrics | healthz |
+    readyz | flightrecorder)."""
+    if not enabled:
+        return
+    metrics.counter("telemetry.scrapes", endpoint=endpoint).inc()
+    metrics.counter("telemetry.scrapes").inc()
+
+
+def record_flight_dump(reason: str):
+    """One flight-recorder dump written (watchdog | preemption |
+    anomaly_restore | serve_crash | fit_crash | manual)."""
+    if not enabled:
+        return
+    metrics.counter("flightrecorder.dumps", reason=reason).inc()
+    metrics.counter("flightrecorder.dumps").inc()
 
 
 # ---------------------------------------------------------- device layer

@@ -39,7 +39,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-from ..core import monitor
+from ..core import flight_recorder, monitor
 from ..core.tensor import Tensor
 
 COMMIT_MARKER = "_PADDLE_COMMIT"
@@ -200,6 +200,9 @@ class CheckpointManager:
             with open(tmp, "w") as f:
                 json.dump({"step": int(step), "leaves": meta}, f)
             os.replace(tmp, self._marker_path(step))
+            # black-box breadcrumb: a post-mortem dump shows which step
+            # last committed, next to the preemption/watchdog events
+            flight_recorder.record("checkpoint.commit", step=int(step))
         except OSError as e:
             monitor.record_swallowed("checkpoint.commit_marker", e)
 

@@ -35,7 +35,7 @@ import time
 import traceback
 from typing import Callable, List, Optional, Tuple
 
-from ..core import monitor
+from ..core import flight_recorder, monitor
 from .elastic import ELASTIC_EXIT_CODE
 
 __all__ = [
@@ -148,6 +148,12 @@ class Watchdog:
         if self.dump_stacks:
             _dump_all_stacks(self.label, self.timeout)
         monitor.record_watchdog_timeout(self.label)
+        # the black box: record the expiry and dump the ring — a hung
+        # process about to be force-killed must leave behind what it
+        # was doing (the stalled request's spans, the last compiles)
+        flight_recorder.record("watchdog.timeout", label=self.label,
+                               timeout_s=self.timeout)
+        flight_recorder.auto_dump("watchdog")
         # abort actions run under the lock and re-check _closed, so a
         # region that exited between the dump and here is never hit: no
         # closing a socket some LATER op now owns, no async exception
@@ -243,6 +249,9 @@ class Watchdog:
             if dump_stacks:
                 _dump_all_stacks(label, timeout)
             monitor.record_watchdog_timeout(label)
+            flight_recorder.record("watchdog.timeout", label=label,
+                                   timeout_s=float(timeout))
+            flight_recorder.auto_dump("watchdog")
             raise WatchdogTimeout(
                 f"watchdog '{label}' expired after {timeout:.1f}s "
                 f"(worker thread abandoned)")
@@ -434,6 +443,11 @@ class GracefulShutdown:
         if not self.preempted:
             return False
         monitor.record_preemption()
+        # the preemption dump happens BEFORE the emergency saves: if a
+        # save wedges, the black box already shows the step the process
+        # reached and everything it was doing when the signal landed
+        flight_recorder.record("resilience.preemption", step=int(step))
+        flight_recorder.auto_dump("preemption")
         save_step = int(step)
         if self.store is not None:
             try:
@@ -515,6 +529,8 @@ class AnomalyGuard:
         self.consecutive += 1
         self.total += 1
         monitor.record_anomaly()
+        flight_recorder.record("train.anomaly",
+                               consecutive=self.consecutive)
         sys.stderr.write(
             f"AnomalyGuard: non-finite loss "
             f"({self.consecutive}/{self.max_consecutive} consecutive); "
@@ -524,6 +540,11 @@ class AnomalyGuard:
             if self.restore_fn is not None:
                 self.restores += 1
                 monitor.record_anomaly_restore()
+                # dump before rolling back: the events leading into the
+                # anomaly streak are the evidence the restore destroys
+                flight_recorder.record("train.anomaly_restore",
+                                       total=self.total)
+                flight_recorder.auto_dump("anomaly_restore")
                 sys.stderr.write(
                     "AnomalyGuard: restoring from last good checkpoint\n")
                 self.restore_fn()

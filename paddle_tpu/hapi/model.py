@@ -16,7 +16,7 @@ import jax
 import numpy as np
 
 from .. import framework_io
-from ..core import monitor
+from ..core import flight_recorder, monitor
 from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
 from ..io.dataset import Dataset
@@ -349,7 +349,16 @@ class Model:
         try:
             self._fit_loop(loader, eval_loader, epochs, eval_freq, cbs,
                            guard, resilience, start_epoch)
-        except BaseException:
+        except BaseException as abort:
+            # uncaught exception in fit(): leave the black box before
+            # anything else — the last steps, compiles, anomalies and
+            # loader events explain the crash. SystemExit is the
+            # GracefulShutdown preemption path, which already dumped.
+            if not isinstance(abort, SystemExit):
+                flight_recorder.record(
+                    "fit.crash",
+                    error=f"{type(abort).__name__}: {abort}")
+                flight_recorder.auto_dump("fit_crash")
             # on_train_end will not run: let callbacks release what
             # on_train_begin acquired (emergency-saver registrations,
             # the metrics registry, ...) before the abort propagates.
@@ -367,6 +376,11 @@ class Model:
         """Host-side handling of ONE matured loss value (float): the
         anomaly guard and the batch-end callbacks observe losses here,
         ``lag`` steps after the step that produced them was launched."""
+        if flight_recorder.enabled:
+            # ...and train.step_end marks the last loss that MATURED
+            # out of the async window (up to lag steps behind dispatch)
+            flight_recorder.record("train.step_end", step=step,
+                                   loss=float(loss))  # lint: host-sync-ok (loss already matured to a host float)
         if guard is not None and not guard.observe(loss):
             # anomaly: loss not recorded, params were kept
             # unchanged in-jit (skip_nonfinite TrainStep)
@@ -400,6 +414,12 @@ class Model:
             for step, batch in enumerate(loader):
                 cbs.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
+                if flight_recorder.enabled:
+                    # black-box step boundary: a post-mortem dump shows
+                    # the last step the host DISPATCHED...
+                    flight_recorder.record("train.step_begin",
+                                           step=global_step + 1,
+                                           epoch=epoch)
                 loss = self.train_batch(inputs, labels)
                 global_step += 1
                 progress["step"] = global_step

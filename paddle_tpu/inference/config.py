@@ -118,7 +118,8 @@ class Config:
 
     def enable_serving(self, max_queue: int = 64, poll_every: int = 4,
                        drain_timeout_s: float = 30.0,
-                       default_deadline_s=None, cache_max_len=None):
+                       default_deadline_s=None, cache_max_len=None,
+                       trace_sample=None, telemetry_port=None):
         """Continuous-batching knobs for ``paddle_tpu.serving.
         ServingEngine`` (which also needs ``enable_generation()`` — the
         engine reuses its prompt-bucket set, fixed decode batch, and
@@ -128,12 +129,18 @@ class Config:
         bounds the graceful-shutdown drain, ``default_deadline_s``
         applies a deadline to requests that don't carry one, and
         ``cache_max_len`` overrides the shared KV ring length (default:
-        largest bucket + max_new_tokens, rounded up)."""
+        largest bucket + max_new_tokens, rounded up). ``trace_sample``
+        traces 1-in-N requests end to end into the flight recorder
+        (default 8; 0 = off), and ``telemetry_port`` starts the
+        ``core.telemetry_server`` export surface (/metrics, /healthz,
+        /readyz, /flightrecorder; 0 = ephemeral port) — both also
+        settable via ``PADDLE_TRACE_SAMPLE`` / ``PADDLE_TELEMETRY_PORT``."""
         self._serving = dict(
             max_queue=int(max_queue), poll_every=int(poll_every),
             drain_timeout_s=float(drain_timeout_s),
             default_deadline_s=default_deadline_s,
-            cache_max_len=cache_max_len)
+            cache_max_len=cache_max_len,
+            trace_sample=trace_sample, telemetry_port=telemetry_port)
         return self
 
     def set_compile_cache_dir(self, path: str):

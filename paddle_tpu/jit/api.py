@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import numpy as np
 
+from ..core import flight_recorder as _flight_recorder
 from ..core import monitor
 from ..core.tensor import Parameter, Tensor, no_grad
 from ..optimizer.optimizer import opt_key as _opt_key
@@ -87,8 +88,9 @@ class _RetraceTracker:
 
     def pre(self, jitted):
         """Call BEFORE the jitted call: cache size going in, or None
-        when the monitor is off (observe() will no-op)."""
-        if not monitor.enabled:
+        when neither the monitor nor the flight recorder is on
+        (observe() will no-op)."""
+        if not (monitor.enabled or _flight_recorder.enabled):
             return None
         return self._cache_of(jitted)
 
@@ -97,12 +99,20 @@ class _RetraceTracker:
         counted only when the compiled cache actually grew during this
         call, so enabling the monitor against a warmed function never
         reports phantom compiles; without cache introspection the
-        signature novelty is the (over-approximate) fallback."""
-        if not monitor.enabled:
+        signature novelty is the (over-approximate) fallback. Runs for
+        the flight recorder too — a post-mortem must show what
+        compiled even when the metrics registry was never enabled
+        (monitor.record_retrace feeds both streams)."""
+        if not (monitor.enabled or _flight_recorder.enabled):
             return
         cache = self._cache_of(jitted)
         known = cache is not None and pre_cache is not None
         compiled = known and cache > pre_cache
+        if not monitor.enabled and known and not compiled:
+            # flight-recorder-only mode: nothing compiled this call, so
+            # skip the per-leaf signature walk — the black box only
+            # needs the (rare) compile events, not a hot-path tax
+            return
         sig = self._signature(trees)
         if sig in self._seen_set:
             if compiled:
@@ -486,7 +496,8 @@ class TrainStep:
         if self._warm_exe is None:
             pre_cache = self._tracker.pre(self._jitted)
             loss, new_vals, self._opt_state_tree = self._jitted(*args)
-            if monitor.enabled:  # donated args keep their aval metadata
+            if monitor.enabled or _flight_recorder.enabled:
+                # donated args keep their aval metadata
                 self._tracker.observe(
                     self._jitted, (args[0], raw_batch), pre_cache)
         for p, v in zip(params, new_vals):

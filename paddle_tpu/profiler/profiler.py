@@ -386,6 +386,14 @@ class Profiler:
             _host_disable()
             events = self._pending_events + _host_collect()
             self._pending_events = []
+            # sampled serving-request spans (flight recorder) that
+            # completed inside the record window join the same trace:
+            # queue-wait/prefill/decode segments render as "ph": "X"
+            # slices next to RecordEvent spans and counter tracks
+            from ..core import flight_recorder
+            t0_ns = int(getattr(self, "_record_t0", 0) * 1e9)
+            events += flight_recorder.spans_between(
+                t0_ns, time.perf_counter_ns())
         else:
             events = []
         if not self.timer_only:

@@ -43,6 +43,7 @@ completion — the decode hot loop itself dispatches without waiting.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -51,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import monitor
+from ..core import flight_recorder, monitor
 from ..core.tensor import Tensor
 from ..generation.api import (GenerationConfig, _expect_logits_cache,
                               _round_up, _sample_cfg)
@@ -89,7 +90,9 @@ class ServingEngine:
                  default_deadline_s: Optional[float] = None,
                  cache_max_len: Optional[int] = None,
                  warmup: bool = True, seed: Optional[int] = None,
-                 executable_store=None):
+                 executable_store=None,
+                 trace_sample: Optional[int] = None,
+                 telemetry_port: Optional[int] = None):
         from ..inference.precision import serving_params
         from ..jit.api import _unwrap, functional_call
 
@@ -118,6 +121,23 @@ class ServingEngine:
         self.default_deadline_s = _opt(default_deadline_s,
                                        "default_deadline_s", None)
         cache_max_len = _opt(cache_max_len, "cache_max_len", None)
+        # per-request tracing: 1-in-N requests carry full queue-wait /
+        # prefill / decode-segment spans into the flight recorder (and
+        # through it the Perfetto export). Default 8 keeps the span
+        # cost off the steady-state p95; 0 turns tracing off.
+        env_sample = os.environ.get("PADDLE_TRACE_SAMPLE", "").strip()
+        if env_sample.lower() in ("off", "false", "no"):
+            env_default = 0
+        elif env_sample.isdigit():
+            env_default = int(env_sample)
+        else:
+            if env_sample:  # garbage must not silently re-enable
+                monitor.record_swallowed(
+                    "serving.trace_sample",
+                    ValueError(f"PADDLE_TRACE_SAMPLE={env_sample!r}"))
+            env_default = 8
+        self.trace_sample = int(_opt(trace_sample, "trace_sample",
+                                     env_default))
 
         # precision: the same serving cast/quant pass the Predictor's
         # run() path audits (int8-compute may swap modules)
@@ -286,8 +306,36 @@ class ServingEngine:
         self.stats = dict(submitted=0, admitted=0, completed=0,
                           cancelled=0, rejected=0, slots_reused=0,
                           decode_steps=0, prefills=0)
+        # live export surface: opt-in via telemetry_port= (here or in
+        # Config.enable_serving) or PADDLE_TELEMETRY_PORT. Started
+        # BEFORE warmup so /healthz answers while the replica warms
+        # (/readyz stays 503 until warm — a router must not route yet).
+        # A bind failure (port still held by a drained-but-not-stopped
+        # predecessor) must never crash the engine it would measure:
+        # the engine serves un-scraped, the swallow is logged.
+        self.telemetry = None
+        tp = _opt(telemetry_port, "telemetry_port", None)
+        from ..core import telemetry_server
+        try:
+            if tp is not None:
+                self.telemetry = telemetry_server.TelemetryServer(
+                    port=int(tp)).start().attach_engine(self)
+            else:
+                self.telemetry = telemetry_server.start_from_env(self)
+        except OSError as e:
+            monitor.record_swallowed("serving.telemetry_bind", e)
         if warmup:
-            self.warmup()
+            try:
+                self.warmup()
+            except BaseException:
+                # constructor abort: the caller never gets a handle, so
+                # shutdown() can never release the port — stop the
+                # server here or it leaks (bound, answering "engine
+                # gone" forever, blocking the retried engine's bind)
+                if self.telemetry is not None:
+                    self.telemetry.stop()
+                    self.telemetry = None
+                raise
 
     # ------------------------------------------------------ compilation
     def _ensure_eval(self):
@@ -440,9 +488,17 @@ class ServingEngine:
                 monitor.record_serve_request("rejected")
                 raise QueueFull(
                     f"request queue at bound ({self.max_queue})")
+            if self.trace_sample and req.id % self.trace_sample == 0:
+                req.traced = True
+                req._t_submit_ns = flight_recorder.now_ns()
             self._queue.append(req)
             self.stats["submitted"] += 1
-            monitor.record_serve_queue_depth(len(self._queue))
+            qdepth = len(self._queue)
+            monitor.record_serve_queue_depth(qdepth)
+        if flight_recorder.enabled:
+            flight_recorder.record("serve.submit", req=req.id,
+                                   prompt_len=int(ids.size),
+                                   budget=budget, queue_depth=qdepth)
         return req
 
     def _queue_room(self) -> bool:
@@ -504,6 +560,7 @@ class ServingEngine:
         ids = np.full((1, bucket), self._cfg.pad_value, np.int32)
         ids[0, :req.prompt.size] = req.prompt
         plen = np.array([req.prompt.size], np.int32)
+        t_admit_ns = flight_recorder.now_ns() if req.traced else 0
         exe = self._exe_prefill(bucket)
         tok, row_cache, self._key, fin = exe(
             self._state, jnp.asarray(ids), jnp.asarray(plen), self._key)
@@ -514,6 +571,16 @@ class ServingEngine:
         now = time.monotonic()
         req.admitted_at = req.first_token_at = now
         monitor.record_serve_ttft(now - req.submitted_at)
+        if flight_recorder.enabled:
+            flight_recorder.record("serve.admit", req=req.id, slot=slot,
+                                   bucket=bucket)
+        if req.traced:
+            # the sampled request's first two trace segments: time spent
+            # queued, then the (synchronous) prefill-into-slot
+            t1 = flight_recorder.now_ns()
+            req.span("queue_wait", req._t_submit_ns, t_admit_ns)
+            req.span("prefill", t_admit_ns, t1, bucket=bucket, slot=slot)
+            req._t_seg_ns = t1
         monitor.record_generation(prefill_steps=1)
         self.stats["prefills"] += 1
         admit = self._exe_admit()
@@ -563,6 +630,7 @@ class ServingEngine:
             monitor.record_serve_token_latency(
                 (now - self._window_t0) / self._window_steps)
         self._window_steps = 0   # next dispatch re-anchors _window_t0
+        t_poll_ns = flight_recorder.now_ns()
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
@@ -573,6 +641,12 @@ class ServingEngine:
                 #                         overwrites the row
             elif req.deadline is not None and now > req.deadline:
                 self._evict(i, req, "deadline", int(steps[i]))
+            elif req.traced:
+                # rolling decode segment: one span per poll window, so
+                # a mid-flight dump shows how far the request got
+                req.span("decode", req._t_seg_ns, t_poll_ns,
+                         tokens=int(steps[i]))
+                req._t_seg_ns = t_poll_ns
         # expire queued requests that can no longer meet their deadline
         with self._qlock:
             for req in list(self._queue):
@@ -614,6 +688,9 @@ class ServingEngine:
                n_done: int = 0):
         """Cancel an in-flight request: mask its lane + reset its cache
         row via the free program, keep whatever it produced."""
+        if flight_recorder.enabled:
+            flight_recorder.record("serve.evict", req=req.id, slot=slot,
+                                   reason=reason, tokens=n_done)
         exe = self._exe_free()
         self._cache, self._finished = exe(
             self._cache, self._finished, jnp.asarray(slot, jnp.int32))
@@ -652,27 +729,49 @@ class ServingEngine:
         handles: List[Request] = []
         it = iter(request_iter) if request_iter is not None else None
         exhausted = False   # an iterator-less loop never "finishes"
-        while True:
-            gs = shutdown if shutdown is not None else resilience.active()
-            if self._shutdown or (gs is not None and gs.preempted):
-                self.drain()
-                break
-            while it is not None and not exhausted and \
-                    self._queue_room():
-                try:
-                    item = next(it)
-                except StopIteration:
-                    exhausted = True
+        try:
+            while True:
+                gs = shutdown if shutdown is not None \
+                    else resilience.active()
+                if self._shutdown or (gs is not None and gs.preempted):
+                    if gs is not None and gs.preempted and \
+                            not self._shutdown:
+                        # preemption landed mid-serve: leave the black
+                        # box BEFORE draining, while the in-flight
+                        # requests' spans still show what was running
+                        flight_recorder.record(
+                            "serve.preempted",
+                            in_flight=sum(s is not None
+                                          for s in self._slots))
+                        flight_recorder.auto_dump("preemption")
+                    self.drain()
                     break
-                handles.append(self._submit_item(item))
-            if on_step is not None:
-                on_step(self)
-            if self.busy:
-                self.step()
-            elif exhausted:
-                break
-            else:
-                time.sleep(idle_sleep_s)
+                while it is not None and not exhausted and \
+                        self._queue_room():
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    handles.append(self._submit_item(item))
+                if on_step is not None:
+                    on_step(self)
+                if self.busy:
+                    self.step()
+                elif exhausted:
+                    break
+                else:
+                    time.sleep(idle_sleep_s)
+        except BaseException as e:
+            # an uncaught scheduler/device error — or an operator's
+            # Ctrl-C — is exactly when the flight recorder earns its
+            # keep: dump, then propagate (same contract as fit();
+            # SystemExit means a preemption path that already dumped)
+            if not isinstance(e, SystemExit):
+                flight_recorder.record(
+                    "serve.crash", error=f"{type(e).__name__}: {e}")
+                flight_recorder.auto_dump("serve_crash")
+            raise
         return handles
 
     def drain(self):
@@ -683,10 +782,16 @@ class ServingEngine:
         accepts no new work afterwards."""
         with self._pump_lock:
             with self._qlock:
+                already = self._shutdown and not self._queue \
+                    and all(s is None for s in self._slots)
                 self._shutdown = True
                 queued, self._queue = \
                     list(self._queue), collections.deque()
                 monitor.record_serve_queue_depth(0)
+            if flight_recorder.enabled and not already:
+                flight_recorder.record(
+                    "serve.drain_begin", queued=len(queued),
+                    in_flight=sum(s is not None for s in self._slots))
             for req in queued:
                 req._finish(RequestStatus.REJECTED, "shutdown")
                 self.stats["rejected"] += 1
@@ -707,6 +812,8 @@ class ServingEngine:
                 if req is not None:
                     self._evict(i, req, "shutdown", int(steps[i]))
             monitor.record_serve_slot_occupancy(0.0)
+            if flight_recorder.enabled and not already:
+                flight_recorder.record("serve.drain_end")
 
     shutdown_now = drain
 
@@ -731,11 +838,18 @@ class ServingEngine:
                 time.sleep(0.001)
 
     def shutdown(self):
-        """Drain (every request terminal) and stop the pump thread."""
+        """Drain (every request terminal), stop the pump thread, and
+        release the telemetry port. drain() alone deliberately keeps
+        the server up — a post-drain scrape is how the fleet observes
+        the exit — but full shutdown() must free the port so a
+        relaunched engine on the same fixed port can bind."""
         self.drain()
         if self._thread is not None:
             self._thread.join(timeout=self.drain_timeout_s + 5.0)
             self._thread = None
+        if self.telemetry is not None:
+            self.telemetry.stop()
+            self.telemetry = None
 
     def _try_pump(self) -> bool:
         """Inline pump for handle.result() when no thread owns the
@@ -751,6 +865,33 @@ class ServingEngine:
             return False
         finally:
             self._pump_lock.release()
+
+    # ----------------------------------------------------------- health
+    def health(self) -> Dict:
+        """Readiness snapshot for the telemetry server's ``/readyz``:
+        ready iff warm (every program compiled/loaded), not draining/
+        shut down, and the queue is below its bound — the backpressure
+        signal a multi-replica router needs to stop sending traffic
+        BEFORE submits start raising QueueFull. Always includes the
+        capacity detail (queue depth, slot occupancy) so a 503 is
+        self-explaining."""
+        with self._qlock:
+            depth = len(self._queue)
+        busy = sum(s is not None for s in self._slots)
+        reasons = []
+        if self._shutdown:
+            reasons.append("draining")
+        if not self._warm:
+            reasons.append("warming")
+        if depth >= self.max_queue:
+            reasons.append("queue_full")
+        return {
+            "ready": not reasons,
+            **({"reason": ",".join(reasons)} if reasons else {}),
+            "queue_depth": depth, "max_queue": self.max_queue,
+            "slots_busy": busy, "max_batch": self.max_batch,
+            "warm": self._warm, "draining": self._shutdown,
+        }
 
     # ------------------------------------------------------------ audit
     def audit(self, **audit_kw) -> Dict:

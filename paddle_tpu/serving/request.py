@@ -23,11 +23,14 @@ from __future__ import annotations
 import dataclasses
 import enum
 import itertools
+import os
 import threading
 import time
 from typing import Optional
 
 import numpy as np
+
+from ..core import flight_recorder
 
 __all__ = ["QueueFull", "Request", "RequestFailed", "RequestParams",
            "RequestStatus"]
@@ -92,6 +95,28 @@ class Request:
         self.finished_at: Optional[float] = None
         self._engine = engine
         self._event = threading.Event()
+        # ---- per-request tracing (tentpole 2): every request carries a
+        # trace id; SAMPLED requests (the engine sets traced=True for
+        # 1-in-N) additionally record queue-wait/prefill/decode spans
+        # into the flight recorder, so a dump or a Perfetto export shows
+        # what each in-flight request was doing. The off path is one
+        # attribute check (gated by test_overhead_gate).
+        self.trace_id = f"{os.getpid():x}.{self.id}"
+        self.traced = False
+        self._t_submit_ns = 0   # set by the engine when traced
+        self._t_seg_ns = 0      # rolling decode-segment anchor
+
+    def span(self, name: str, start_ns: int, end_ns: int, **fields):
+        """Record one trace span for this request (no-op unless the
+        engine sampled it). Spans land in the flight recorder ring and,
+        through it, in the Profiler's Perfetto export; the tid keys
+        each request onto its own trace row."""
+        if not self.traced:
+            return
+        flight_recorder.record_span(
+            f"req{self.id}.{name}", start_ns, end_ns,
+            trace_id=self.trace_id, tid=1000 + self.id % 64,
+            req=self.id, **fields)
 
     # ------------------------------------------------------------ handle
     def done(self) -> bool:
@@ -120,12 +145,29 @@ class Request:
     # --------------------------------------------------------- scheduler
     def _finish(self, status: RequestStatus, detail: str = ""):
         """Terminal transition; idempotent (a drain racing a completion
-        keeps the first outcome)."""
+        keeps the first outcome). Records the terminal event — and, for
+        sampled requests, the final trace segment — into the flight
+        recorder, so a dump taken moments later explains every request
+        that just ended."""
         if self._event.is_set():
             return
         self.status = status
         self.detail = detail
         self.finished_at = time.monotonic()
+        if flight_recorder.enabled:
+            flight_recorder.record(
+                "serve.finish", req=self.id, status=status.value,
+                tokens=self.n_emitted,
+                **({"detail": detail} if detail else {}))
+            if self.traced:
+                t = flight_recorder.now_ns()
+                if self.admitted_at is None and self._t_submit_ns:
+                    # never admitted: its whole life was queue wait
+                    self.span("queue_wait", self._t_submit_ns, t,
+                              status=status.value)
+                elif self._t_seg_ns:
+                    self.span("decode", self._t_seg_ns, t,
+                              tokens=self.n_emitted, status=status.value)
         self._event.set()
 
     # ----------------------------------------------------------- timings

@@ -1,0 +1,314 @@
+"""Flight recorder coverage (ISSUE 10): the bounded event ring, span
+storage, Perfetto/plaintext dumps, auto-dump rate limiting, the wiring
+into retraces and the profiler export — and the chaos-tier acceptance
+scenarios: a Watchdog timeout and a SIGTERM mid-``serve_forever`` each
+leave a dump containing the stalled/in-flight request's spans."""
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flight_recorder as fr
+from paddle_tpu.core import monitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Every test starts with an empty, enabled ring and leaves the
+    process defaults behind (capacity reset also clears the auto-dump
+    rate-limit state, so scenarios don't starve each other)."""
+    fr.configure(capacity=fr.DEFAULT_CAPACITY, on=True)
+    yield
+    fr.configure(capacity=fr.DEFAULT_CAPACITY, on=True)
+
+
+# ----------------------------------------------------------------- ring
+
+
+class TestRing:
+    def test_record_and_read(self):
+        fr.record("test.alpha", a=1)
+        fr.record("test.beta")
+        evs = fr.events()
+        kinds = [k for _, k, _ in evs]
+        assert kinds == ["test.alpha", "test.beta"]
+        assert evs[0][2] == {"a": 1}
+        assert evs[1][2] is None
+        assert evs[0][0] <= evs[1][0]  # ns timestamps, monotonic
+
+    def test_ring_bound_evicts_oldest(self):
+        r = fr.configure(capacity=8)
+        for i in range(20):
+            fr.record("test.n", i=i)
+        evs = r.events()
+        assert len(evs) == 8
+        assert [e[2]["i"] for e in evs] == list(range(12, 20))
+        assert r._dropped == 12
+
+    def test_disabled_records_nothing(self):
+        fr.disable()
+        fr.record("test.off", x=1)
+        fr.record_span("test.span", 0, 1)
+        assert fr.events() == []
+        fr.enable()
+        fr.record("test.on")
+        assert len(fr.events()) == 1
+
+    def test_spans_between(self):
+        t0 = fr.now_ns()
+        fr.record_span("req1.decode", t0, t0 + 1000, trace_id="x.1",
+                       tid=1001, tokens=3)
+        fr.record("test.point")  # point events never surface as spans
+        fr.record_span("early", t0 - 5000, t0 - 4000)
+        spans = fr.spans_between(t0 - 100, t0 + 2000)
+        assert spans == [("req1.decode", t0, t0 + 1000, 1001, 0)]
+
+    def test_env_capacity_parse(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER", "off")
+        assert fr._env_capacity() == (False, fr.DEFAULT_CAPACITY)
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER", "0")
+        assert fr._env_capacity()[0] is False
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER", "128")
+        assert fr._env_capacity() == (True, 128)
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER", "bogus")
+        assert fr._env_capacity() == (True, fr.DEFAULT_CAPACITY)
+
+
+# ---------------------------------------------------------------- dumps
+
+
+class TestDumps:
+    def test_dump_writes_perfetto_and_tail(self, tmp_path):
+        t = fr.now_ns()
+        fr.record("test.kind", a=1)
+        fr.record_span("req7.prefill", t, t + 500000, trace_id="p.7",
+                       tid=1007)
+        path = fr.dump(str(tmp_path / "d"), reason="unit")
+        assert path.endswith(".json")
+        with open(path) as f:
+            d = json.load(f)
+        assert d["metadata"]["reason"] == "unit"
+        names = {e["name"] for e in d["traceEvents"]}
+        assert {"test.kind", "req7.prefill"} <= names
+        span = next(e for e in d["traceEvents"]
+                    if e["name"] == "req7.prefill")
+        assert span["ph"] == "X" and span["dur"] == pytest.approx(500.0)
+        assert span["args"]["trace"] == "p.7"
+        inst = next(e for e in d["traceEvents"]
+                    if e["name"] == "test.kind")
+        assert inst["ph"] == "i" and inst["args"] == {"a": 1}
+        txt = (tmp_path / "d.txt").read_text()
+        assert "reason: unit" in txt
+        assert "test.kind a=1" in txt
+        assert "span req7.prefill" in txt
+
+    def test_auto_dump_rate_limit_and_counter(self, tmp_path,
+                                              monkeypatch):
+        from paddle_tpu.profiler import metrics
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        metrics.enable()
+        try:
+            fr.record("test.crash")
+            p1 = fr.auto_dump("unitreason")
+            p2 = fr.auto_dump("unitreason")       # inside min interval
+            p3 = fr.auto_dump("unitreason2")      # different reason: ok
+            assert p1 is not None and os.path.exists(p1)
+            assert p2 is None
+            assert p3 is not None
+            snap = metrics.snapshot()
+            assert snap["flightrecorder.dumps{reason=unitreason}"][
+                "value"] == 1
+            assert snap["flightrecorder.dumps{reason=unitreason2}"][
+                "value"] == 1
+        finally:
+            metrics.disable()
+
+    def test_auto_dump_cap(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        r = fr.recorder()
+        r._auto_dumps = fr.MAX_AUTO_DUMPS
+        assert fr.auto_dump("capped") is None
+
+    def test_disabled_auto_dump_noop(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        fr.disable()
+        assert fr.auto_dump("off") is None
+        assert not list(tmp_path.iterdir())
+
+
+# --------------------------------------------------------------- wiring
+
+
+class TestWiring:
+    def test_retrace_lands_in_recorder_without_monitor(self):
+        """jit compiles reach the black box even when the metrics
+        registry was never enabled — the post-mortem contract."""
+        from paddle_tpu.profiler import metrics
+        assert not metrics.is_enabled()
+
+        def _total():
+            snap = metrics.snapshot().get("jit.compile.total")
+            return snap["value"] if snap else 0
+
+        import paddle_tpu.jit as jit
+        before = _total()  # registry history survives disable by design
+
+        @jit.to_static
+        def f(x):
+            return x * 2
+
+        f(paddle.to_tensor(np.ones((3,), np.float32)))
+        compiles = [e for e in fr.events() if e[1] == "jit.compile"]
+        assert compiles and compiles[0][2]["cause"] == "first"
+        # and the (disabled) metrics registry stayed untouched
+        assert _total() == before
+
+    def test_profiler_export_includes_recorder_spans(self, tmp_path):
+        """Spans recorded while a Profiler records join its Perfetto
+        JSON — sampled request traces and RecordEvent spans share one
+        timeline."""
+        from paddle_tpu import profiler as P
+        prof = P.Profiler(trace_dir=str(tmp_path))
+        prof.start()
+        t = fr.now_ns()
+        fr.record_span("req3.decode", t, t + 100000, trace_id="z.3",
+                       tid=1003)
+        with P.RecordEvent("host_work"):
+            pass
+        prof.stop()
+        out = tmp_path / "trace.json"
+        prof.result.export_chrome_tracing(str(out))
+        names = {e["name"] for e in
+                 json.load(open(out))["traceEvents"]}
+        assert "req3.decode" in names
+        assert "host_work" in names
+
+    def test_fit_crash_dumps(self, tmp_path, monkeypatch):
+        """An uncaught exception inside Model.fit leaves a fit_crash
+        dump with the last dispatched steps in it."""
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Bomb(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step >= 1:
+                    raise RuntimeError("injected trainer bug")
+
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        m = Model(net)
+        m.prepare(optimizer=optimizer.SGD(learning_rate=0.1,
+                                          parameters=net.parameters()),
+                  loss=lambda out, lbl: (out ** 2).mean())
+        data = [(np.ones((2, 4), np.float32),
+                 np.zeros((2,), np.int64)) for _ in range(4)]
+        monkeypatch.setenv("PADDLE_ASYNC_STEPS", "0")
+        with pytest.raises(RuntimeError, match="injected trainer bug"):
+            m.fit(data, epochs=1, verbose=0, callbacks=[Bomb()])
+        dumps = glob.glob(str(tmp_path / "flightrecorder_fit_crash_*"
+                              ".json"))
+        assert len(dumps) == 1
+        d = json.load(open(dumps[0]))
+        names = [e["name"] for e in d["traceEvents"]]
+        assert "train.step_begin" in names
+        assert "fit.crash" in names
+
+
+# ---------------------------------------------------------------- chaos
+# The acceptance scenarios: each failure mode leaves a dump from which
+# the in-flight request's trace can be read back.
+
+
+def _tiny_engine(**kw):
+    from paddle_tpu.inference import Config
+    from paddle_tpu.models.gpt import gpt
+    from paddle_tpu.serving import ServingEngine
+    paddle.seed(0)
+    m = gpt("test-tiny")
+    m.eval()
+    spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+    cfg = (Config().from_layer(m, spec)
+           .enable_generation(max_new_tokens=8, prefill_buckets=(16,),
+                              max_batch=2))
+    return ServingEngine(cfg, trace_sample=1, **kw)
+
+
+def _req_spans(dump_path):
+    d = json.load(open(dump_path))
+    return [e for e in d["traceEvents"]
+            if e["ph"] == "X" and e["name"].startswith("req")], d
+
+
+@pytest.mark.chaos
+def test_watchdog_timeout_dumps_inflight_request_spans(tmp_path,
+                                                       monkeypatch):
+    """A Watchdog expiry while a request is mid-decode produces a dump
+    whose trace holds that request's queue-wait/prefill spans — the
+    post-mortem shows what the wedged replica was serving."""
+    from paddle_tpu.distributed.resilience import (Watchdog,
+                                                   WatchdogTimeout)
+    monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+    eng = _tiny_engine(poll_every=4)
+    h = eng.submit(np.arange(1, 9, dtype=np.int32))
+    eng.step()                        # admit: queue_wait+prefill spans
+    assert h.status.value == "running"
+    with pytest.raises(WatchdogTimeout):
+        with Watchdog(timeout=0.2, label="test.stall",
+                      dump_stacks=False):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 10:   # stalled host loop
+                pass
+    dumps = glob.glob(str(tmp_path / "flightrecorder_watchdog_*.json"))
+    assert len(dumps) == 1
+    spans, d = _req_spans(dumps[0])
+    names = {e["name"] for e in spans}
+    assert f"req{h.id}.queue_wait" in names
+    assert f"req{h.id}.prefill" in names
+    assert any(e["name"] == "watchdog.timeout"
+               and e["args"]["label"] == "test.stall"
+               for e in d["traceEvents"])
+    eng.drain()
+
+
+@pytest.mark.chaos
+def test_sigterm_mid_serve_dumps_inflight_request_spans(tmp_path,
+                                                        monkeypatch):
+    """SIGTERM mid-serve_forever: the preemption dump (written BEFORE
+    the drain) carries the spans of the requests that were decoding
+    when the signal landed, plus the drain's own begin/end events in a
+    follow-up read of the ring."""
+    import signal
+    from paddle_tpu.distributed.resilience import GracefulShutdown
+    from paddle_tpu.utils.fault_injection import KillAfter
+    monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+    eng = _tiny_engine(poll_every=2, drain_timeout_s=60.0)
+    rng = np.random.RandomState(1)
+    traffic = [rng.randint(0, 512, 4 + i).astype(np.int32)
+               for i in range(4)]
+    killer = KillAfter(4, signal.SIGTERM)
+    with GracefulShutdown(exit_on_save=False):
+        handles = eng.serve_forever(iter(traffic),
+                                    on_step=lambda e: killer.step())
+    assert killer.fired
+    assert all(h.status.terminal for h in handles)
+    dumps = glob.glob(str(tmp_path /
+                          "flightrecorder_preemption_*.json"))
+    assert len(dumps) == 1
+    spans, d = _req_spans(dumps[0])
+    names = [e["name"] for e in d["traceEvents"]]
+    assert "serve.preempted" in names
+    # the dump happens before the drain, so at least one admitted
+    # request's spans are already in the ring
+    admitted = [h for h in handles if h.admitted_at is not None]
+    assert admitted
+    span_names = {e["name"] for e in spans}
+    assert any(f"req{h.id}.prefill" in span_names for h in admitted)
+    # the ring (post-drain) holds the drain bracket too
+    kinds = [k for _, k, _ in fr.events()]
+    assert "serve.drain_begin" in kinds and "serve.drain_end" in kinds

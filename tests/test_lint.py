@@ -146,6 +146,58 @@ class TestMetricNameRule:
                                  "paddle_tpu/core/monitor.py")
 
 
+class TestDeadMetricRule:
+    """The metric-name rule pointed the other way: a DECLARED name no
+    ``metrics.counter/gauge/histogram`` call under paddle_tpu/ ever
+    records is schema rot."""
+
+    MONITOR = "paddle_tpu/core/monitor.py"
+
+    def test_flags_declared_but_never_recorded(self, tmp_path):
+        found = _lint_snippet(tmp_path, """
+            DECLARED_METRICS = frozenset({
+                "serve.requests",
+                "zombie.metric.nobody.records",
+            })
+            """, self.MONITOR)
+        assert _rules_of(found) == ["dead-metric"]
+        assert len(found) == 1
+        assert "zombie.metric.nobody.records" in found[0].message
+        # the finding anchors on the stale declaration's line
+        assert found[0].line == 4
+
+    def test_recorded_names_pass(self, tmp_path):
+        # "serve.requests" is recorded by the real tree; "jit.compile"
+        # only via an f-string (f"{target}.compile") — both live.
+        # "snippet.local" is recorded by this very module's own call.
+        src = """
+            from . import metrics
+            DECLARED_METRICS = frozenset({
+                "serve.requests",
+                "jit.compile",
+                "snippet.local",
+            })
+            def record_local():
+                metrics.counter("snippet.local").inc()
+            """
+        assert not _lint_snippet(tmp_path, src, self.MONITOR)
+
+    def test_marker_and_scope(self, tmp_path):
+        src = """
+            DECLARED_METRICS = frozenset({
+                "zombie.allowed",  # lint: dead-metric-ok (wired next PR)
+            })
+            """
+        assert not _lint_snippet(tmp_path, src, self.MONITOR)
+        # the rule only fires on the schema-declaring core module
+        bad = """
+            DECLARED_METRICS = frozenset({"zombie.elsewhere"})
+            """
+        assert not _lint_snippet(tmp_path, bad,
+                                 "paddle_tpu/vision/ops.py")
+        assert not _lint_snippet(tmp_path, bad, "tests/test_x.py")
+
+
 class TestCompileCacheDirRule:
     def test_flags_direct_config_update(self, tmp_path):
         found = _lint_snippet(tmp_path, """
@@ -217,7 +269,7 @@ class TestEngine:
     def test_all_rules_registered(self):
         assert set(RULES) == {"host-sync", "jit-random", "bare-except",
                               "metric-name", "chaos-marker",
-                              "compile-cache-dir"}
+                              "compile-cache-dir", "dead-metric"}
 
     def test_syntax_error_is_reported_not_raised(self, tmp_path):
         found = _lint_snippet(tmp_path, "def broken(:\n",

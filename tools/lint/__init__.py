@@ -14,6 +14,10 @@ those programs — the host-side hazards no jaxpr ever shows:
     metric-name   metric names recorded that are not declared in
                   ``core/monitor.DECLARED_METRICS`` (typo'd counters
                   nobody will ever read)
+    dead-metric   names declared in ``DECLARED_METRICS`` that no
+                  ``metrics.counter/gauge/histogram`` call under
+                  ``paddle_tpu/`` ever records (schema rot: a series
+                  promised to dashboards that stays zero forever)
     chaos-marker  tests importing ``utils.fault_injection`` without the
                   ``chaos`` marker (the conftest collection guard,
                   promoted to lint so function-level imports are caught

@@ -252,6 +252,119 @@ def check_metric_names(ctx: FileContext) -> List[LintFinding]:
     return findings
 
 
+# ------------------------------------------------------------ dead-metric
+
+_RECORDED_NAMES_CACHE = None  # (literals: Set[str], patterns: List[regex])
+
+
+def _recording_calls(tree: ast.Module):
+    """(literal names, f-string regexes) from every ``metrics.counter/
+    gauge/histogram(...)`` first argument in one module. F-string names
+    (``f"{target}.compile"``) become anchored regexes with ``.+`` at
+    each formatted field, so dynamically-prefixed recordings still
+    count as live."""
+    import re
+    literals: Set[str] = set()
+    patterns = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")
+                and _dotted(node.func.value).split(".")[-1] == "metrics"):
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            literals.add(arg.value)
+        elif isinstance(arg, ast.JoinedStr):
+            parts = []
+            for v in arg.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(re.escape(str(v.value)))
+                else:
+                    parts.append(".+")
+            patterns.append(re.compile("^" + "".join(parts) + "$"))
+    return literals, patterns
+
+
+def _recorded_names():
+    """Every metric name recorded anywhere under paddle_tpu/ (scanned
+    once per process, stdlib ast only)."""
+    global _RECORDED_NAMES_CACHE
+    if _RECORDED_NAMES_CACHE is not None:
+        return _RECORDED_NAMES_CACHE
+    from . import repo_root
+    literals: Set[str] = set()
+    patterns: list = []
+    pkg = os.path.join(repo_root(), "paddle_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d != "__pycache__" and not d.startswith(".")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), "r",
+                          encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            lit, pat = _recording_calls(tree)
+            literals |= lit
+            patterns += pat
+    _RECORDED_NAMES_CACHE = (literals, patterns)
+    return _RECORDED_NAMES_CACHE
+
+
+@rule("dead-metric")
+def check_dead_metrics(ctx: FileContext) -> List[LintFinding]:
+    """Every name in ``DECLARED_METRICS`` must be RECORDED somewhere
+    under ``paddle_tpu/`` (a ``metrics.counter/gauge/histogram`` call,
+    literal or f-string first arg — the same AST machinery as
+    ``metric-name``, pointed the other way). A declared-but-never-
+    recorded name is schema rot: dashboards and docs promise a series
+    that will sit at zero forever. Fires on the module that declares
+    the schema (``DECLARED_METRICS`` assignment in a paddle_tpu core
+    module), so the finding lands on the stale declaration line."""
+    if not ctx.relpath.startswith("paddle_tpu/core/") \
+            or ctx.is_test_file:
+        return []
+    declared_nodes = []  # (name, lineno, col)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "DECLARED_METRICS"
+                for t in node.targets):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, str):
+                    declared_nodes.append(
+                        (sub.value, sub.lineno, sub.col_offset))
+    if not declared_nodes:
+        return []
+    literals, patterns = _recorded_names()
+    # the declaring module's own recorders count too (snippet tests
+    # lint a synthetic monitor.py that is not under the real package)
+    own_lit, own_pat = _recording_calls(ctx.tree)
+    literals = literals | own_lit
+    patterns = patterns + own_pat
+    findings = []
+    for name, line, col in declared_nodes:
+        if name in literals or any(p.match(name) for p in patterns):
+            continue
+        node = ast.Constant(value=name)
+        node.lineno, node.col_offset, node.end_lineno = line, col, line
+        if ctx.allowed(node, "dead-metric"):
+            continue
+        findings.append(LintFinding(
+            ctx.relpath, line, col, "dead-metric",
+            f"metric {name!r} is declared in DECLARED_METRICS but never "
+            "recorded anywhere under paddle_tpu/ (no metrics.counter/"
+            "gauge/histogram call names it); wire a recorder or drop "
+            "the declaration"))
+    return findings
+
+
 # ------------------------------------------------------ compile-cache-dir
 
 # the one module allowed to touch jax's process-global compile-cache

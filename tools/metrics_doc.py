@@ -1,0 +1,86 @@
+"""Generate docs/metrics.md from core/monitor's declared metric schema.
+
+The registry's schema lives in ``core/monitor.py`` twice: the
+``DECLARED_METRICS`` frozenset the framework lint enforces (an
+undeclared name recorded anywhere in ``paddle_tpu/`` fails CI) and the
+``METRIC_DOC`` table carrying each name's kind, labels and description.
+This tool renders the table as a markdown reference, and the tier-1
+drift test (``tests/test_telemetry.py``) regenerates it on every run —
+a schema change that forgets the doc (or a doc edit that drifts from
+the schema) fails CI, the same contract the lint's ``dead-metric`` rule
+applies to the recording side.
+
+    python -m tools.metrics_doc            # rewrite docs/metrics.md
+    python -m tools.metrics_doc --check    # exit 1 if stale
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+_HEADER = """\
+# Metrics reference
+
+<!-- GENERATED FILE — do not edit by hand.
+     Regenerate with `python -m tools.metrics_doc`; the schema lives in
+     `paddle_tpu/core/monitor.py` (METRIC_DOC / DECLARED_METRICS). -->
+
+Every metric the framework records, as declared in
+`core/monitor.DECLARED_METRICS`. All of them flow through the
+process-global registry (`core/metrics.py`): scrape them live from the
+telemetry server's `/metrics` (Prometheus text; dots become
+underscores, label sets render as `{k="v"}`), snapshot them with
+`profiler.metrics.snapshot()`, or watch them as counter tracks in the
+Perfetto export. Labeled metrics also keep an unlabeled aggregate
+under the same name.
+
+| Metric | Kind | Labels | Description |
+|---|---|---|---|
+"""
+
+
+def render() -> str:
+    from paddle_tpu.core.monitor import DECLARED_METRICS, METRIC_DOC
+    missing = DECLARED_METRICS - set(METRIC_DOC)
+    extra = set(METRIC_DOC) - DECLARED_METRICS
+    if missing or extra:
+        raise SystemExit(
+            f"METRIC_DOC out of sync with DECLARED_METRICS: "
+            f"missing={sorted(missing)} extra={sorted(extra)}")
+    rows = []
+    for name in sorted(METRIC_DOC):
+        kind, labels, desc = METRIC_DOC[name]
+        lab = ", ".join(labels) if labels else "—"
+        rows.append(f"| `{name}` | {kind} | {lab} | {desc} |")
+    return _HEADER + "\n".join(rows) + "\n"
+
+
+def doc_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "docs", "metrics.md")
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    text = render()
+    path = doc_path()
+    if "--check" in argv:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != text:
+            sys.stderr.write(
+                f"{path} is stale; regenerate with "
+                "`python -m tools.metrics_doc`\n")
+            return 1
+        return 0
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+    sys.stderr.write(f"wrote {path}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
