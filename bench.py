@@ -408,12 +408,67 @@ def bench_moe_block(dev, on_tpu):
     }
 
 
+def _bench_spec_rows(model, draft, on_tpu, new_tokens):
+    """Speculative-decode comparison rows (ISSUE-11): batch-1 greedy
+    decode — the latency-bound regime speculation targets — off vs
+    self-speculative (prompt-lookup) vs draft-model, on a prompt with
+    the input-grounded repetition prompt-lookup exists for (a repeated
+    motif: the summarization/code-edit/RAG shape). Each variant reports
+    decode tokens/sec, accept_rate from the gen.spec.* counters, and
+    its own post-warmup retrace counters — the PR-10 sub-dict proving
+    the timed pass dispatched warm executables only."""
+    from paddle_tpu.profiler import metrics as _metrics
+    rng = np.random.RandomState(0)
+    motif = rng.randint(0, model.cfg.vocab_size, 16)
+    ids = np.tile(motif, 32)[None, :512].astype(np.int32)  # batch 1
+
+    def counter(name):
+        snap = _metrics.snapshot().get(name)
+        return int(snap["value"]) if snap else 0
+
+    def run(label, **kw):
+        model.generate(ids, max_new_tokens=new_tokens, **kw)  # warmup
+        before = {k: counter(k) for k in
+                  ("jit.compile.total", "jit.compile{cause=new_shape}",
+                   "gen.spec.proposed", "gen.spec.accepted")}
+        t0 = time.perf_counter()
+        model.generate(ids, max_new_tokens=new_tokens, **kw)
+        dt = time.perf_counter() - t0
+        prop = counter("gen.spec.proposed") - before["gen.spec.proposed"]
+        acc = counter("gen.spec.accepted") - before["gen.spec.accepted"]
+        return {
+            "tokens_per_sec": round(new_tokens / dt, 1),
+            **({"accept_rate": round(acc / prop, 3)} if prop else {}),
+            "counters": {
+                "jit.compile.total":
+                    counter("jit.compile.total")
+                    - before["jit.compile.total"],
+                "jit.compile{cause=new_shape}":
+                    counter("jit.compile{cause=new_shape}")
+                    - before["jit.compile{cause=new_shape}"],
+            },
+        }
+
+    rows = {"batch": 1, "prompt": "16-token motif x32 (prompt-lookup "
+                                  "regime)", "new_tokens": new_tokens}
+    rows["off"] = run("off")
+    rows["ngram"] = run("ngram", speculative="ngram")
+    rows["draft"] = run("draft", speculative="draft", draft_model=draft)
+    off = rows["off"]["tokens_per_sec"]
+    for v in ("ngram", "draft"):
+        rows[v]["speedup_vs_off"] = round(
+            rows[v]["tokens_per_sec"] / off, 2)
+    return rows
+
+
 def bench_decode(dev, on_tpu):
     """Serving-trajectory bench: prefill 512 + decode 128 on test-tiny
     GPT (ISSUE-6 decode mode). Reports decode tokens/sec (pipelined
     host loop, no per-token sync) plus p50/p95 per-token latency from a
-    second, per-step-synced pass. vs_baseline is 1.0 by definition —
-    this row DEFINES the decode baseline from this revision on."""
+    second, per-step-synced pass, and the ISSUE-11 speculative rows
+    (off / self-spec / draft-model at batch 1) as the "spec" sub-dict.
+    vs_baseline is 1.0 by definition — this row DEFINES the decode
+    baseline from this revision on."""
     import os
     import paddle_tpu as paddle
     from paddle_tpu.generation import GenerationConfig, GenerationSession
@@ -461,14 +516,23 @@ def bench_decode(dev, on_tpu):
     decode_tps = b * (new_tokens - 1) / dt
     p50 = float(np.percentile(per_step, 50) * 1e3)
     p95 = float(np.percentile(per_step, 95) * 1e3)
+    paddle.seed(7)
+    draft = gpt("test-tiny-draft", max_position_embeddings=1024)
+    draft.bfloat16() if on_tpu else None
+    spec = _bench_spec_rows(model, draft, on_tpu, new_tokens)
     return {
         "metric": f"test-tiny decode tokens/sec/chip (b{b} "
                   f"prefill{prefill_len}+decode{new_tokens}, "
                   f"p50={p50:.2f}ms, p95={p95:.2f}ms per token, "
+                  f"spec b1 off={spec['off']['tokens_per_sec']} "
+                  f"ngram={spec['ngram']['tokens_per_sec']} "
+                  f"({spec['ngram']['speedup_vs_off']}x, accept "
+                  f"{spec['ngram'].get('accept_rate', 0)}), "
                   f"device={dev.device_kind})",
         "value": round(decode_tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": 1.0,
+        "spec": spec,
     }
 
 

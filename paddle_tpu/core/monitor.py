@@ -39,6 +39,9 @@ Metric name scheme (what the summary views group by):
     errors.swallowed{where=...} deliberately swallowed exceptions
     gen.tokens / gen.prefill_steps / gen.decode_steps   generation loop
     gen.cache_occupancy         gauge: KV cache fraction in use
+    gen.spec.proposed / .accepted   speculative draft tokens in/out of
+                                the single-dispatch verify
+    gen.spec.accept_rate        gauge: accepted/proposed, last window
     serve.requests{status=...}  terminal request outcomes (completed/
                                 cancelled/rejected) — QPS = rate of this
     serve.queue_depth           gauge: requests waiting for a slot
@@ -81,6 +84,7 @@ DECLARED_METRICS = frozenset({
     "errors.swallowed",
     "gen.tokens", "gen.prefill_steps", "gen.decode_steps",
     "gen.cache_occupancy",
+    "gen.spec.proposed", "gen.spec.accepted", "gen.spec.accept_rate",
     "serve.requests", "serve.queue_depth", "serve.ttft",
     "serve.token_latency", "serve.slot_occupancy", "serve.cancellations",
     "analysis.findings",
@@ -191,6 +195,15 @@ METRIC_DOC = {
     "gen.decode_steps": ("counter", (), "decode dispatches"),
     "gen.cache_occupancy": ("gauge", (),
                             "KV-cache fraction in use (max over rows)"),
+    "gen.spec.proposed": ("counter", (),
+                          "draft tokens proposed to speculative verify "
+                          "(k per live row per window)"),
+    "gen.spec.accepted": ("counter", (),
+                          "draft tokens accepted by speculative verify "
+                          "(emitted without a correction)"),
+    "gen.spec.accept_rate": ("gauge", (),
+                             "accepted/proposed over the last recorded "
+                             "speculative window batch"),
     "serve.requests": ("counter", ("status",),
                        "requests reaching a terminal status: completed "
                        "| cancelled | rejected (QPS = rate of this)"),
@@ -457,6 +470,22 @@ def record_generation(prefill_steps: int = 0, decode_steps: int = 0,
         metrics.counter("gen.decode_steps").inc(int(decode_steps))
     if tokens:
         metrics.counter("gen.tokens").inc(int(tokens))
+
+
+def record_speculative(proposed: int, accepted: int):
+    """Speculative-decoding progress: draft tokens proposed to (and
+    accepted by) the single-dispatch verify since the last record —
+    generate() records once per call, the serving engine once per
+    scheduler poll. accept_rate is the ratio of this record's window
+    (the counters carry the lifetime totals)."""
+    if not enabled:
+        return
+    if proposed:
+        metrics.counter("gen.spec.proposed").inc(int(proposed))
+        metrics.gauge("gen.spec.accept_rate").set(
+            float(accepted) / float(proposed))
+    if accepted:
+        metrics.counter("gen.spec.accepted").inc(int(accepted))
 
 
 def record_cache_occupancy(frac: float):

@@ -8,8 +8,12 @@ from .api import GenerationConfig, GenerationSession, generate  # noqa: F401
 from .kv_cache import KVCache  # noqa: F401
 from .sampling import (apply_temperature, apply_top_k,  # noqa: F401
                        apply_top_p, sample)
+from .speculative import (SpeculativeConfig,  # noqa: F401
+                          SpeculativeSession, ngram_propose, spec_accept)
 
 __all__ = [
     "GenerationConfig", "GenerationSession", "generate", "KVCache",
     "sample", "apply_temperature", "apply_top_k", "apply_top_p",
+    "SpeculativeConfig", "SpeculativeSession", "ngram_propose",
+    "spec_accept",
 ]

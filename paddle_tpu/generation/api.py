@@ -113,6 +113,7 @@ class GenerationSession:
         self._prefill_tracker = _RetraceTracker()
         self._decode_tracker = _RetraceTracker()
         self._compiled = {}  # (kind, shape key) -> AOT executable
+        self._spec_sessions = {}  # (SpeculativeConfig, draft id) -> sess
         names = self._names
 
         def prefill_fn(state_vals, ids, prompt_len, key, cfg, cache_len):
@@ -200,9 +201,26 @@ class GenerationSession:
                                       str(cfg)), pre)
         return out
 
+    # -------------------------------------------------------- speculative
+    def speculative(self, spec, draft_network=None):
+        """The cached :class:`speculative.SpeculativeSession` (the
+        jitted draft+verify program pair) for one SpeculativeConfig —
+        built once, reused across ``generate(speculative=...)`` calls
+        so the pair's executables stay warm like prefill/decode."""
+        from .speculative import SpeculativeSession
+        key = (spec, id(draft_network))
+        sess = self._spec_sessions.get(key)
+        if sess is None:
+            sess = SpeculativeSession(self, spec,
+                                      draft_network=draft_network)
+            self._spec_sessions[key] = sess
+        return sess
+
     # ------------------------------------------------------------- audit
     def audit(self, batch: int, prompt_len: int, cache_len: int,
-              cfg: Optional[GenerationConfig] = None, **audit_kw):
+              cfg: Optional[GenerationConfig] = None, *,
+              speculative=None, draft_network=None, max_new: int = 32,
+              **audit_kw):
         """Static audit of the (prefill, decode) pair for one padded
         shape (analysis.audit over abstract operands — nothing
         executes). Decode is audited with the TPU donation INTENT (the
@@ -210,7 +228,11 @@ class GenerationSession:
         skips donation: the audit gates the program we serve, not the
         test backend. Returns ``(prefill_report, decode_report)``; the
         tier-1 gate asserts zero ERROR findings on both and full
-        donation coverage of the cache in decode."""
+        donation coverage of the cache in decode. With ``speculative=``
+        set (a SpeculativeConfig or mode string) the tuple grows to
+        ``(prefill, decode, spec_draft, spec_verify)`` — the draft and
+        single-dispatch verify programs audited under the same
+        contract, verify with every state lane donated."""
         from ..analysis import audit as _audit
         # same contract as every dispatch path: a mid-fit audit must
         # trace the EVAL program (train-mode dropout would otherwise be
@@ -242,11 +264,20 @@ class GenerationSession:
             self._decode_fn, state, tok, cache_aval, key, fin, cfg,
             static_argnums=(5,), donate=decode_donate,
             name=f"{base}.decode", **audit_kw)
-        return prefill_report, decode_report
+        if speculative is None:
+            return prefill_report, decode_report
+        from .speculative import as_spec_config
+        spec = as_spec_config(speculative, draft_network)
+        draft_report, verify_report = self.speculative(
+            spec, draft_network).audit(
+            batch, prompt_len, cache_len, max_new, cfg,
+            name=f"{base}.spec", **audit_kw)
+        return (prefill_report, decode_report, draft_report,
+                verify_report)
 
     # --------------------------------------------------------------- aot
     def aot_compile(self, batch: int, prompt_len: int, cache_len: int,
-                    cfg: GenerationConfig):
+                    cfg: GenerationConfig, decode: bool = True):
         """Ahead-of-time compile the (prefill, decode) pair for one
         fixed padded shape (serving: compile at startup, zero retraces
         under live traffic). Compiled executables are called WITHOUT
@@ -254,7 +285,9 @@ class GenerationSession:
         active (``self.executable_store`` or the process default) the
         pair is loaded from disk when a relaunch already compiled it —
         zero XLA work, and on a manifest hit zero TRACE work, on the
-        warm path."""
+        warm path. ``decode=False`` builds the prefill only (the
+        speculative draft model's admission path — its decode program
+        is never dispatched)."""
         from ..jit import compile_cache
         store = self.executable_store
         sds = jax.ShapeDtypeStruct
@@ -282,6 +315,8 @@ class GenerationSession:
             label=f"generation.prefill.b{batch}s{prompt_len}")
         self._compiled[("prefill", (batch, prompt_len), cache_len,
                         cfg)] = pexe
+        if not decode:
+            return pexe, None
 
         def lower_decode():
             # decode avals come from the prefill's own outputs (an
@@ -333,7 +368,8 @@ def generate(network, input_ids, max_new_tokens: int = 32, *,
              prompt_len=None, cache_max_len: Optional[int] = None,
              seed: Optional[int] = None,
              session: Optional[GenerationSession] = None,
-             live_rows: Optional[int] = None) -> Tensor:
+             live_rows: Optional[int] = None,
+             speculative=None, draft_model=None) -> Tensor:
     """Generate ``max_new_tokens`` tokens after ``input_ids``.
 
     input_ids: [batch, seq] int prompt (right-padded for ragged
@@ -355,6 +391,17 @@ def generate(network, input_ids, max_new_tokens: int = 32, *,
     are real requests (the Predictor's fixed-batch padding rows are
     not) — the ``gen.tokens`` metric counts only live rows, and only
     up to each row's first eos.
+
+    ``speculative`` turns on speculative decoding: ``"ngram"`` (the
+    model-free prompt-lookup drafter), ``"draft"`` (with
+    ``draft_model=`` a small LM sharing the vocabulary), or a
+    :class:`speculative.SpeculativeConfig` for the draft-k / n-gram
+    knobs. One target dispatch then verifies up to ``k + 1`` tokens
+    per row; greedy outputs are bitwise-identical to the sequential
+    path, sampling matches distributionally. The KV ring (and the
+    position table) must carry ``k`` extra slack beyond
+    prompt + max_new_tokens for the last verify window's unaccepted
+    overhang — validated here, never discovered as ring corruption.
     """
     ids = _as_int_ids(input_ids)
     b, s = ids.shape
@@ -375,26 +422,55 @@ def generate(network, input_ids, max_new_tokens: int = 32, *,
             raise ValueError("prompt_len entries must be in [1, "
                              f"{s}], got {plen.tolist()}")
 
+    from .speculative import as_spec_config
+    spec = as_spec_config(speculative, draft_model)
+    # the speculative verify window writes (and embeds positions for)
+    # up to k unaccepted draft tokens past the last real token: both
+    # the position table and the KV ring need that slack
+    overhang = spec.k if spec is not None else 0
+
     # out-of-range decode positions fail HERE, not as a silent clipped
     # position-embedding gather deep in the model
     cfg_obj = getattr(network, "cfg", None)
     max_pos = getattr(cfg_obj, "max_position_embeddings", None)
     total = int(plen.max()) + max_new_tokens
-    if max_pos is not None and total > int(max_pos):
+    if max_pos is not None and total + overhang > int(max_pos):
         raise ValueError(
             f"generate(): prompt ({int(plen.max())} tokens) + "
-            f"max_new_tokens ({max_new_tokens}) = {total} exceeds the "
+            f"max_new_tokens ({max_new_tokens})"
+            + (f" + speculative window overhang ({overhang})"
+               if overhang else "")
+            + f" = {total + overhang} exceeds the "
             f"model's max_position_embeddings ({int(max_pos)}); shorten "
             "the prompt, lower max_new_tokens, or build the model with "
             "a larger max_position_embeddings")
+    # the draft model walks the same positions (its cache stays
+    # aligned with the target's): a smaller draft position table would
+    # otherwise clip its gathers silently — garbage proposals and a
+    # mysteriously low accept rate instead of an error
+    if spec is not None and spec.mode == "draft":
+        d_max = getattr(getattr(draft_model, "cfg", None),
+                        "max_position_embeddings", None)
+        if d_max is not None and total + overhang > int(d_max):
+            raise ValueError(
+                f"generate(): prompt + max_new_tokens + speculative "
+                f"overhang = {total + overhang} exceeds the DRAFT "
+                f"model's max_position_embeddings ({int(d_max)}); the "
+                "draft model must cover the same positions as the "
+                "target (build it with a larger "
+                "max_position_embeddings)")
 
     cache_len = int(cache_max_len) if cache_max_len is not None \
-        else _round_up(s + max_new_tokens)
-    if cache_len < s + max_new_tokens:
+        else _round_up(s + max_new_tokens + overhang)
+    if cache_len < s + max_new_tokens + overhang:
         raise ValueError(
             f"cache_max_len {cache_len} < prompt {s} + max_new_tokens "
-            f"{max_new_tokens}; the ring cache would wrap and overwrite "
-            "the oldest context")
+            f"{max_new_tokens}"
+            + (f" + speculative verify-window overhang {overhang} (the "
+               "last window's unaccepted draft tokens still write "
+               "their KV before rollback)" if overhang else "")
+            + "; the ring cache would wrap and overwrite the oldest "
+            "context")
 
     cfg = GenerationConfig(do_sample=do_sample, temperature=temperature,
                            top_k=top_k, top_p=top_p,
@@ -411,6 +487,12 @@ def generate(network, input_ids, max_new_tokens: int = 32, *,
         key = _random.next_key()
     else:
         key = jax.random.PRNGKey(0)  # greedy: key is never consumed
+
+    if spec is not None:
+        from .speculative import decode_loop
+        return decode_loop(network, sess, state_vals, ids, plen, cfg,
+                           spec, draft_model, cache_len, max_new_tokens,
+                           key, live_rows)
 
     tok, cache, key, finished = sess.prefill(
         state_vals, jnp.asarray(ids), jnp.asarray(plen), key, cfg,

@@ -254,9 +254,13 @@ class Model:
         implement the cache protocol (``forward(input_ids,
         use_cache=..., cache=...)`` returning (logits, cache) — e.g.
         ``models.gpt.GPTForCausalLM``). Sampling options
-        (do_sample/temperature/top_k/top_p/eos_token_id/seed/...) are
-        forwarded to ``paddle_tpu.generation.generate``. Returns the
-        generated ids only, [batch, max_new_tokens] int32."""
+        (do_sample/temperature/top_k/top_p/eos_token_id/seed/...) and
+        speculative decoding (``speculative="ngram"`` for model-free
+        prompt-lookup drafting, ``speculative="draft"`` with
+        ``draft_model=`` — up to draft-k+1 tokens per dispatch, greedy
+        outputs bitwise-unchanged) are forwarded to
+        ``paddle_tpu.generation.generate``. Returns the generated ids
+        only, [batch, max_new_tokens] int32."""
         from ..generation.api import generate as _generate
         return _generate(self.network, input_ids, max_new_tokens,
                          **kwargs)

@@ -98,7 +98,8 @@ class Config:
                           max_batch: int = 1, do_sample: bool = False,
                           temperature: float = 1.0, top_k: int = 0,
                           top_p: float = 1.0, eos_token_id=None,
-                          pad_token_id=None):
+                          pad_token_id=None, speculative=None,
+                          draft_model=None):
         """Generation serving mode: the predictor AOT-compiles one
         (prefill, decode) executable pair per prompt bucket at build
         time and batches ``Predictor.generate()`` requests at that
@@ -106,14 +107,27 @@ class Config:
         retraces under live traffic (``jit.retraces{cause=new_shape}``
         ≈ 0 at steady state). Requires a live layer implementing the
         KV-cache protocol (``Config.from_layer`` with e.g.
-        ``models.gpt.GPTForCausalLM``)."""
+        ``models.gpt.GPTForCausalLM``).
+
+        ``speculative`` enables speculative decoding on every serving
+        surface built from this config (Predictor buckets and the
+        ServingEngine slot scheduler): ``"ngram"`` for model-free
+        prompt-lookup drafting, ``"draft"`` with ``draft_model=`` a
+        small live LM sharing the vocabulary (Predictor only), or a
+        ``generation.SpeculativeConfig`` to set draft-k / n-gram. The
+        spec draft+verify pair is AOT-compiled per bucket next to
+        prefill/decode; greedy outputs stay bitwise-equal to
+        non-speculative decoding."""
+        from ..generation.speculative import as_spec_config
+        as_spec_config(speculative, draft_model)  # validate eagerly
         self._generation = dict(
             max_new_tokens=int(max_new_tokens),
             prefill_buckets=tuple(sorted(int(b) for b in prefill_buckets)),
             max_batch=int(max_batch), do_sample=bool(do_sample),
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), eos_token_id=eos_token_id,
-            pad_token_id=pad_token_id)
+            pad_token_id=pad_token_id, speculative=speculative,
+            draft_model=draft_model)
         return self
 
     def enable_serving(self, max_queue: int = 64, poll_every: int = 4,

@@ -158,6 +158,7 @@ class Predictor:
         for low-precision decoding."""
         from ..generation.api import (GenerationConfig, GenerationSession,
                                       _round_up)
+        from ..generation.speculative import as_spec_config
         layer = self.config._layer
         if layer is None:
             raise ValueError("generation mode needs a live layer: use "
@@ -170,22 +171,31 @@ class Predictor:
             top_k=opts["top_k"], top_p=opts["top_p"],
             eos_token_id=opts["eos_token_id"],
             pad_token_id=opts["pad_token_id"])
+        self._gen_spec = as_spec_config(opts.get("speculative"),
+                                        opts.get("draft_model"))
+        # the speculative verify window needs k extra position-table /
+        # ring slots past prompt + max_new (the last window's
+        # unaccepted overhang)
+        overhang = self._gen_spec.k if self._gen_spec is not None else 0
         max_new = opts["max_new_tokens"]
         max_pos = getattr(getattr(layer, "cfg", None),
                           "max_position_embeddings", None)
         buckets = [b for b in opts["prefill_buckets"]
-                   if max_pos is None or b + max_new <= int(max_pos)]
+                   if max_pos is None
+                   or b + max_new + overhang <= int(max_pos)]
         if not buckets:
             raise ValueError(
                 f"no prefill bucket in {opts['prefill_buckets']} fits "
                 f"max_position_embeddings={max_pos} with "
-                f"max_new_tokens={max_new}")
+                f"max_new_tokens={max_new}"
+                + (f" + speculative overhang {overhang}" if overhang
+                   else ""))
         self._gen_buckets = buckets
         # the bucket -> cache_len mapping the executables are COMPILED
         # with; generate() and audit_generation() read this, never
         # re-derive it (a drifted re-derivation would dispatch/audit
         # shapes no executable was built for)
-        self._gen_cache_lens = {b: _round_up(b + max_new)
+        self._gen_cache_lens = {b: _round_up(b + max_new + overhang)
                                 for b in buckets}
         self._gen_session = GenerationSession(
             layer, executable_store=self._exe_store)
@@ -193,6 +203,15 @@ class Predictor:
             self._gen_session.aot_compile(opts["max_batch"], b,
                                           self._gen_cache_lens[b],
                                           self._gen_cfg)
+        if self._gen_spec is not None:
+            # the draft + single-dispatch verify pair, AOT per bucket
+            # beside prefill/decode (new generation.spec_* store kinds)
+            spec_sess = self._gen_session.speculative(
+                self._gen_spec, opts.get("draft_model"))
+            for b in buckets:
+                spec_sess.aot_compile(opts["max_batch"], b,
+                                      self._gen_cache_lens[b],
+                                      max_new, self._gen_cfg)
 
     def generate(self, prompts, max_new_tokens: Optional[int] = None,
                  seed: Optional[int] = None) -> List[np.ndarray]:
@@ -247,7 +266,9 @@ class Predictor:
                 live_rows=len(chunk),
                 do_sample=cfg.do_sample, temperature=cfg.temperature,
                 top_k=cfg.top_k, top_p=cfg.top_p, eos_token_id=eos,
-                pad_token_id=cfg.pad_token_id)
+                pad_token_id=cfg.pad_token_id,
+                speculative=self._gen_spec,
+                draft_model=self._gen_opts.get("draft_model"))
             out = np.asarray(out._data)[:len(chunk)]
             for row in out:
                 if eos is not None:
@@ -272,11 +293,16 @@ class Predictor:
         opts = self._gen_opts
         reports: Dict[tuple, object] = {}
         for b in self._gen_buckets:
-            pre, dec = self._gen_session.audit(
+            out = self._gen_session.audit(
                 opts["max_batch"], b, self._gen_cache_lens[b],
-                self._gen_cfg, **audit_kw)
-            reports[("prefill", b)] = pre
-            reports[("decode", b)] = dec
+                self._gen_cfg, speculative=self._gen_spec,
+                draft_network=opts.get("draft_model"),
+                max_new=opts["max_new_tokens"], **audit_kw)
+            reports[("prefill", b)] = out[0]
+            reports[("decode", b)] = out[1]
+            if self._gen_spec is not None:
+                reports[("spec_draft", b)] = out[2]
+                reports[("spec_verify", b)] = out[3]
         return reports
 
     def audit_forward(self, **audit_kw):

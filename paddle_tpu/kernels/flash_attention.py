@@ -828,6 +828,12 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 # the grid is static).
 
 _DECODE_QPAD = 8          # min fp32 sublane tile: q rows pad to this
+#: public cap on the decode kernel's query window (the 8-row fp32
+#: sublane tile): a speculative verify window of K draft tokens + 1
+#: needs K + 1 <= this — generation.speculative validates against it
+#: at the config boundary so the limit fails fast with its name, not
+#: as a padding-path fallthrough deep in a trace.
+MAX_DECODE_QLEN = _DECODE_QPAD
 _DECODE_BLOCK_K = 512
 
 
@@ -972,8 +978,11 @@ def flash_attention_decode(query, key_cache, value_cache, kv_len,
     t, hk = key_cache.shape[1], key_cache.shape[2]
     if sq > _DECODE_QPAD:
         raise ValueError(
-            f"flash_attention_decode: q_len {sq} > {_DECODE_QPAD}; use "
-            "flash_attention/prefill for longer query windows")
+            f"flash_attention_decode: q_len {sq} > MAX_DECODE_QLEN "
+            f"({_DECODE_QPAD}, the fp32 sublane tile); use "
+            "flash_attention/prefill for longer query windows, or cap "
+            "the speculative verify window at draft_k <= "
+            f"{_DECODE_QPAD - 1}")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"

@@ -513,6 +513,12 @@ CONFIGS = {
                            max_position_embeddings=2048),
     "test-tiny": GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
                            num_heads=4, max_position_embeddings=128),
+    # draft companion for speculative decoding tests/bench: same vocab
+    # and position table as test-tiny (a draft LM must share both), a
+    # quarter of the compute — the KVCache layout class is identical
+    "test-tiny-draft": GPTConfig(vocab_size=512, hidden_size=32,
+                                 num_layers=1, num_heads=2,
+                                 max_position_embeddings=128),
 }
 
 

@@ -30,6 +30,16 @@ The reference ships serving as a whole layer (paddle/fluid/inference,
   weight-only / int8-compute hooks) through the same
   ``inference.precision.serving_params`` the Predictor audits —
   BASELINE.md measured 1.49-1.79x matmul wins at bf16.
+- **speculative decoding on the slots**
+  (``enable_generation(speculative="ngram")``): the decode step becomes
+  a fused prompt-lookup draft + single-dispatch verify — every live
+  row advances 1..k+1 tokens per dispatch, with accepted-length-aware
+  ``steps``/budget/eos accounting (clamped so a row never writes past
+  its budget or ring capacity), per-slot token-history lanes installed
+  at admit, and on-device proposed/accepted counters drained into
+  ``gen.spec.*`` at each poll. Greedy outputs stay bitwise-equal to
+  sequential decode; drain/eviction semantics are unchanged (partial
+  results are accepted-only).
 - **SLA observability**: the ``serve.*`` metrics family (requests by
   terminal status, queue-depth gauge, TTFT + per-token latency
   histograms, slot occupancy, cancellations) flows through
@@ -157,25 +167,48 @@ class ServingEngine:
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
 
+        # speculative decoding on the slots: the per-poll decode step
+        # becomes a fused ngram-draft + single-dispatch verify over the
+        # live lanes — each dispatch advances every live row by 1..k+1
+        # tokens. Only the model-free self-speculative drafter runs on
+        # the engine (a draft model would need its own per-slot cache
+        # admission path); generate()/the Predictor serve draft mode.
+        from ..generation.speculative import as_spec_config
+        self._spec = as_spec_config(opts.get("speculative"),
+                                    opts.get("draft_model"))
+        if self._spec is not None and self._spec.mode != "ngram":
+            raise ValueError(
+                "ServingEngine supports speculative='ngram' (the "
+                "model-free prompt-lookup drafter); draft-model "
+                "speculation is a generate()/Predictor path for now")
+        overhang = self._spec.k if self._spec is not None else 0
+
         max_pos = getattr(getattr(layer, "cfg", None),
                           "max_position_embeddings", None)
-        buckets = sorted(int(b) for b in opts["prefill_buckets"]
-                         if max_pos is None
-                         or b + self.max_new_tokens <= int(max_pos))
+        buckets = sorted(
+            int(b) for b in opts["prefill_buckets"]
+            if max_pos is None
+            or b + self.max_new_tokens + overhang <= int(max_pos))
         if not buckets:
             raise ValueError(
                 f"no prefill bucket in {opts['prefill_buckets']} fits "
                 f"max_position_embeddings={max_pos} with "
-                f"max_new_tokens={self.max_new_tokens}")
+                f"max_new_tokens={self.max_new_tokens}"
+                + (f" + speculative overhang {overhang}" if overhang
+                   else ""))
         self.buckets = buckets
         self.max_len = int(cache_max_len) if cache_max_len else \
-            _round_up(buckets[-1] + self.max_new_tokens)
-        if self.max_len < buckets[-1] + self.max_new_tokens:
+            _round_up(buckets[-1] + self.max_new_tokens + overhang)
+        if self.max_len < buckets[-1] + self.max_new_tokens + overhang:
             raise ValueError(
                 f"cache_max_len {self.max_len} < largest bucket "
-                f"{buckets[-1]} + max_new_tokens {self.max_new_tokens}; "
-                "the shared ring cache would wrap under a full-length "
-                "request")
+                f"{buckets[-1]} + max_new_tokens {self.max_new_tokens}"
+                + (f" + speculative verify-window overhang {overhang} "
+                   "(the last window's unaccepted draft tokens still "
+                   "write their KV before rollback)" if overhang
+                   else "")
+                + "; the shared ring cache would wrap under a "
+                "full-length request")
 
         names = self._sp.names
         sp = self._sp
@@ -222,6 +255,33 @@ class ServingEngine:
                 jnp.where(finished, 0, cache.kv_len))
             return nxt, cache, k1, finished, steps, budget, out_buf
 
+        spec = self._spec
+
+        def spec_step_fn(state_vals, tok, cache, key, finished, steps,
+                         budget, out_buf, tok_buf, tok_len, proposed,
+                         accepted, cfg, spec):
+            from ..generation.speculative import (apply_verify_window,
+                                                  ngram_propose)
+            params = sp.materialize(state_vals)
+            draft = ngram_propose(tok_buf, tok_len, k=spec.k,
+                                  n=spec.ngram)
+            window = jnp.concatenate([tok[:, None], draft], axis=1)
+            out = functional_call(layer, dict(zip(names, params)),
+                                  Tensor(window), cache=cache)
+            logits, cache = _expect_logits_cache(out)
+            logits = _unwrap(logits).astype(jnp.float32)
+            k0, k1 = jax.random.split(key)
+            # the shared acceptance/clamp/scatter/rollback core —
+            # pin_finished_kv is the engine's idle-lane contract (a
+            # parked slot must never wrap the ring)
+            (tok, cache, finished, steps, out_buf, tok_buf, tok_len,
+             proposed, accepted) = apply_verify_window(
+                logits, draft, k0, cfg, spec, tok, cache, finished,
+                steps, budget, out_buf, tok_buf, tok_len, proposed,
+                accepted, pin_finished_kv=True)
+            return (tok, cache, k1, finished, steps, budget, out_buf,
+                    tok_buf, tok_len, proposed, accepted)
+
         def admit_fn(cache, tok, finished, steps, budget, out_buf,
                      slot, row_cache, first_tok, first_fin, row_budget):
             # install the batch-1 prefill row into the freed slot; the
@@ -237,11 +297,28 @@ class ServingEngine:
                 first_fin[0] | (row_budget <= 1))
             return cache, tok, finished, steps, budget, out_buf
 
+        def spec_admit_fn(cache, tok, finished, steps, budget, out_buf,
+                          slot, row_cache, first_tok, first_fin,
+                          row_budget, tok_buf, tok_len, ids_row,
+                          row_plen):
+            # base admission + the drafter's token history: the padded
+            # prompt row with the prefill token appended — the n-gram
+            # drafter reads prompt AND emitted tokens from one buffer
+            (cache, tok, finished, steps, budget, out_buf) = admit_fn(
+                cache, tok, finished, steps, budget, out_buf, slot,
+                row_cache, first_tok, first_fin, row_budget)
+            row = ids_row.at[row_plen].set(first_tok[0])
+            tok_buf = tok_buf.at[slot].set(row)
+            tok_len = tok_len.at[slot].set(row_plen + 1)
+            return (cache, tok, finished, steps, budget, out_buf,
+                    tok_buf, tok_len)
+
         def free_fn(cache, finished, slot):
             return cache.reset_rows(slot), finished.at[slot].set(True)
 
-        self._prefill_fn, self._step_fn = prefill_fn, step_fn
-        self._admit_fn, self._free_fn = admit_fn, free_fn
+        self._prefill_fn, self._free_fn = prefill_fn, free_fn
+        self._step_fn = step_fn if spec is None else spec_step_fn
+        self._admit_fn = admit_fn if spec is None else spec_admit_fn
         # executable persistence: every program warmup() compiles goes
         # through jit.compile_cache (this store, or the process default
         # when None) so a relaunched engine loads instead of recompiling
@@ -249,15 +326,25 @@ class ServingEngine:
         # donate on TPU only (CPU/GPU donation is a no-op that warns
         # once per program); audit() gates the TPU donation INTENT
         tpu = jax.default_backend() == "tpu"
-        self._step_donate = (1, 2, 3, 4, 5, 6, 7) if tpu else ()
-        self._admit_donate = (0, 1, 2, 3, 4, 5, 7) if tpu else ()
+        if spec is None:
+            self._step_donate = (1, 2, 3, 4, 5, 6, 7) if tpu else ()
+            self._admit_donate = (0, 1, 2, 3, 4, 5, 7) if tpu else ()
+            step_static = (8,)
+        else:
+            # the spec step additionally carries the drafter's token
+            # buffer/length lanes and the proposed/accepted counters —
+            # all donated (in-place across polls, audited as intent)
+            self._step_donate = tuple(range(1, 12)) if tpu else ()
+            self._admit_donate = (0, 1, 2, 3, 4, 5, 7, 11, 12) \
+                if tpu else ()
+            step_static = (12, 13)
         self._free_donate = (0, 1) if tpu else ()
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
         self._step_jit = jax.jit(
-            step_fn, static_argnums=(8,),
+            self._step_fn, static_argnums=step_static,
             donate_argnums=self._step_donate)
         self._admit_jit = jax.jit(
-            admit_fn, donate_argnums=self._admit_donate)
+            self._admit_fn, donate_argnums=self._admit_donate)
         self._free_jit = jax.jit(
             free_fn, donate_argnums=self._free_donate)
 
@@ -290,6 +377,16 @@ class ServingEngine:
         self._steps = jax.device_put(np.zeros((B,), np.int32))
         self._budget = jax.device_put(np.zeros((B,), np.int32))
         self._out_buf = jax.device_put(np.zeros((B, cap), np.int32))
+        if spec is not None:
+            # drafter lanes: per-slot token history (prompt + emitted,
+            # the n-gram lookup corpus) and the on-device
+            # proposed/accepted counters the poll drains into gen.spec.*
+            self._tok_buf = jax.device_put(
+                np.zeros((B, self.max_len), np.int32))
+            self._tok_len = jax.device_put(np.zeros((B,), np.int32))
+            self._proposed = jax.device_put(np.zeros((), np.int32))
+            self._accepted = jax.device_put(np.zeros((), np.int32))
+            self._spec_seen = (0, 0)   # host mirror for poll deltas
 
         self._slots: List[Optional[Request]] = [None] * B
         self._slot_used = [False] * B          # reuse detection
@@ -305,7 +402,8 @@ class ServingEngine:
         self._window_steps = 0
         self.stats = dict(submitted=0, admitted=0, completed=0,
                           cancelled=0, rejected=0, slots_reused=0,
-                          decode_steps=0, prefills=0)
+                          decode_steps=0, prefills=0,
+                          spec_proposed=0, spec_accepted=0)
         # live export surface: opt-in via telemetry_port= (here or in
         # Config.enable_serving) or PADDLE_TELEMETRY_PORT. Started
         # BEFORE warmup so /healthz answers while the replica warms
@@ -361,6 +459,7 @@ class ServingEngine:
         sig.update(
             program=("serving",) + tuple(cache_key),
             generation=repr(self._cfg),
+            speculative=repr(self._spec),
             buckets=tuple(self.buckets),
             shape=(self.max_batch, self.max_len, self.max_new_tokens),
             precision=(self.config.precision,
@@ -399,10 +498,20 @@ class ServingEngine:
             self.max_len))
 
     def _exe_step(self):
-        return self._compiled(("step",), lambda: self._step_jit.lower(
-            self._state, self._tok, self._cache, self._key,
-            self._finished, self._steps, self._budget, self._out_buf,
-            self._cfg), donation=self._step_donate)
+        if self._spec is None:
+            return self._compiled(
+                ("step",), lambda: self._step_jit.lower(
+                    self._state, self._tok, self._cache, self._key,
+                    self._finished, self._steps, self._budget,
+                    self._out_buf, self._cfg),
+                donation=self._step_donate)
+        return self._compiled(
+            ("spec_step",), lambda: self._step_jit.lower(
+                self._state, self._tok, self._cache, self._key,
+                self._finished, self._steps, self._budget,
+                self._out_buf, self._tok_buf, self._tok_len,
+                self._proposed, self._accepted, self._cfg, self._spec),
+            donation=self._step_donate)
 
     def _row_avals(self):
         """(tok, row_cache, finished) avals of a batch-1 prefill — the
@@ -421,10 +530,17 @@ class ServingEngine:
         def build():
             tok_a, row_cache_a, fin_a = self._row_avals()
             scalar = jnp.asarray(0, jnp.int32)
+            if self._spec is None:
+                return self._admit_jit.lower(
+                    self._cache, self._tok, self._finished, self._steps,
+                    self._budget, self._out_buf, scalar, row_cache_a,
+                    tok_a, fin_a, scalar)
+            ids_row = jax.ShapeDtypeStruct((self.max_len,), jnp.int32)
             return self._admit_jit.lower(
                 self._cache, self._tok, self._finished, self._steps,
                 self._budget, self._out_buf, scalar, row_cache_a,
-                tok_a, fin_a, scalar)
+                tok_a, fin_a, scalar, self._tok_buf, self._tok_len,
+                ids_row, scalar)
         return self._compiled(("admit",), build,
                               donation=self._admit_donate)
 
@@ -584,11 +700,28 @@ class ServingEngine:
         monitor.record_generation(prefill_steps=1)
         self.stats["prefills"] += 1
         admit = self._exe_admit()
-        (self._cache, self._tok, self._finished, self._steps,
-         self._budget, self._out_buf) = admit(
-            self._cache, self._tok, self._finished, self._steps,
-            self._budget, self._out_buf, jnp.asarray(slot, jnp.int32),
-            row_cache, tok, fin, jnp.asarray(req.budget, jnp.int32))
+        if self._spec is None:
+            (self._cache, self._tok, self._finished, self._steps,
+             self._budget, self._out_buf) = admit(
+                self._cache, self._tok, self._finished, self._steps,
+                self._budget, self._out_buf,
+                jnp.asarray(slot, jnp.int32), row_cache, tok, fin,
+                jnp.asarray(req.budget, jnp.int32))
+        else:
+            # the drafter's corpus row: the full-width padded prompt
+            # (the admit program appends the prefill token in-trace)
+            ids_row = np.full((self.max_len,), self._cfg.pad_value,
+                              np.int32)
+            ids_row[:req.prompt.size] = req.prompt
+            (self._cache, self._tok, self._finished, self._steps,
+             self._budget, self._out_buf, self._tok_buf,
+             self._tok_len) = admit(
+                self._cache, self._tok, self._finished, self._steps,
+                self._budget, self._out_buf,
+                jnp.asarray(slot, jnp.int32), row_cache, tok, fin,
+                jnp.asarray(req.budget, jnp.int32), self._tok_buf,
+                self._tok_len, jnp.asarray(ids_row),
+                jnp.asarray(req.prompt.size, jnp.int32))
         if self._slot_used[slot]:
             self.stats["slots_reused"] += 1
         self._slot_used[slot] = True
@@ -604,10 +737,20 @@ class ServingEngine:
 
     def _dispatch_decode(self):
         exe = self._exe_step()
-        (self._tok, self._cache, self._key, self._finished, self._steps,
-         self._budget, self._out_buf) = exe(
-            self._state, self._tok, self._cache, self._key,
-            self._finished, self._steps, self._budget, self._out_buf)
+        if self._spec is None:
+            (self._tok, self._cache, self._key, self._finished,
+             self._steps, self._budget, self._out_buf) = exe(
+                self._state, self._tok, self._cache, self._key,
+                self._finished, self._steps, self._budget,
+                self._out_buf)
+        else:
+            (self._tok, self._cache, self._key, self._finished,
+             self._steps, self._budget, self._out_buf, self._tok_buf,
+             self._tok_len, self._proposed, self._accepted) = exe(
+                self._state, self._tok, self._cache, self._key,
+                self._finished, self._steps, self._budget,
+                self._out_buf, self._tok_buf, self._tok_len,
+                self._proposed, self._accepted)
         self._steps_since_poll += 1
         if self._window_steps == 0:
             # anchor the latency window at the first dispatch after a
@@ -625,6 +768,21 @@ class ServingEngine:
         self._steps_since_poll = 0
         fin = np.asarray(self._finished)  # lint: host-sync-ok (scheduler poll, every poll_every steps)
         steps = np.asarray(self._steps)  # lint: host-sync-ok (same poll read)
+        if self._spec is not None:
+            # drain the on-device speculation counters in the same poll
+            # window (two int32 scalars — no extra sync cadence). The
+            # device counters are lifetime-monotonic int32 and WRAP on
+            # a long-lived engine; per-poll deltas are tiny, so modular
+            # subtraction recovers them exactly across the wrap
+            prop = int(np.asarray(self._proposed))  # lint: host-sync-ok (same poll read)
+            acc = int(np.asarray(self._accepted))  # lint: host-sync-ok (same poll read)
+            dp = (prop - self._spec_seen[0]) % (1 << 32)
+            da = (acc - self._spec_seen[1]) % (1 << 32)
+            if dp or da:
+                self._spec_seen = (prop, acc)
+                self.stats["spec_proposed"] += dp
+                self.stats["spec_accepted"] += da
+                monitor.record_speculative(dp, da)
         now = time.monotonic()
         if self._window_t0 is not None and self._window_steps:
             monitor.record_serve_token_latency(
@@ -923,19 +1081,38 @@ class ServingEngine:
         # comes from the smallest bucket's prefill report (same trace)
         tok_a, row_cache_a, _, fin_a = \
             reports[("prefill", self.buckets[0])].out_shape
-        reports["decode"] = _audit(
-            self._step_fn, state, self._tok, self._cache, self._key,
-            self._finished, self._steps, self._budget, self._out_buf,
-            self._cfg, static_argnums=(8,),
-            donate=(1, 2, 3, 4, 5, 6, 7), name=f"{base}.decode",
-            **audit_kw)
         scalar = sds((), jnp.int32)
-        reports["admit"] = _audit(
-            self._admit_fn, self._cache, self._tok, self._finished,
-            self._steps, self._budget, self._out_buf, scalar,
-            row_cache_a, tok_a, fin_a, scalar,
-            donate=(0, 1, 2, 3, 4, 5, 7), name=f"{base}.admit",
-            **audit_kw)
+        if self._spec is None:
+            reports["decode"] = _audit(
+                self._step_fn, state, self._tok, self._cache, self._key,
+                self._finished, self._steps, self._budget, self._out_buf,
+                self._cfg, static_argnums=(8,),
+                donate=(1, 2, 3, 4, 5, 6, 7), name=f"{base}.decode",
+                **audit_kw)
+            reports["admit"] = _audit(
+                self._admit_fn, self._cache, self._tok, self._finished,
+                self._steps, self._budget, self._out_buf, scalar,
+                row_cache_a, tok_a, fin_a, scalar,
+                donate=(0, 1, 2, 3, 4, 5, 7), name=f"{base}.admit",
+                **audit_kw)
+        else:
+            # the speculative step IS the decode program the scheduler
+            # dispatches: fused ngram draft + single-dispatch verify,
+            # every state lane (cache, token buffers, counters) donated
+            reports["decode"] = _audit(
+                self._step_fn, state, self._tok, self._cache, self._key,
+                self._finished, self._steps, self._budget, self._out_buf,
+                self._tok_buf, self._tok_len, self._proposed,
+                self._accepted, self._cfg, self._spec,
+                static_argnums=(12, 13), donate=tuple(range(1, 12)),
+                name=f"{base}.decode", **audit_kw)
+            reports["admit"] = _audit(
+                self._admit_fn, self._cache, self._tok, self._finished,
+                self._steps, self._budget, self._out_buf, scalar,
+                row_cache_a, tok_a, fin_a, scalar, self._tok_buf,
+                self._tok_len, sds((self.max_len,), jnp.int32), scalar,
+                donate=(0, 1, 2, 3, 4, 5, 7, 11, 12),
+                name=f"{base}.admit", **audit_kw)
         reports["free"] = _audit(
             self._free_fn, self._cache, self._finished, scalar,
             donate=(0, 1), name=f"{base}.free", **audit_kw)

@@ -24,6 +24,7 @@ HOST_SYNC_HOT_PATHS = frozenset({
     "paddle_tpu/generation/api.py",
     "paddle_tpu/generation/kv_cache.py",
     "paddle_tpu/generation/attention.py",
+    "paddle_tpu/generation/speculative.py",
     "paddle_tpu/hapi/model.py",
     "paddle_tpu/serving/engine.py",
 })
