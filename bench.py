@@ -536,6 +536,137 @@ def bench_decode(dev, on_tpu):
     }
 
 
+def bench_serve_shared_prefix(dev, on_tpu):
+    """`bench.py serve --shared-prefix` (ISSUE-12): the capacity-at-
+    equal-HBM gate for the paged KV cache. Poisson arrivals over K
+    distinct LONG system prompts x short user suffixes — the traffic
+    shape that dominates real fleets — served twice at the SAME cache
+    HBM byte budget:
+
+      dense:  max_batch slots x max_len ring rows   (the PR-8 engine)
+      paged:  4x the slots over a page pool of the dense cache's exact
+              token footprint (shared prefixes are stored once and
+              reference-counted; each request's pages cover only ITS
+              prompt + budget)
+
+    The row's value is the ratio of peak concurrent in-flight requests
+    (paged / dense); the acceptance gate is > 2x, so vs_baseline =
+    ratio / 2. prefix_hits > 0 and page conservation at drain are
+    asserted, and the PR-10 counters sub-dict rides along to show zero
+    post-warmup retraces (`jit.compile{cause=new_shape}` == 0)."""
+    import os
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config
+    from paddle_tpu.models.gpt import gpt
+    from paddle_tpu.serving import RequestParams, ServingEngine
+
+    from paddle_tpu.generation.api import _round_up
+
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                               96 if on_tpu else 48))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 256.0))  # req/sec
+    dense_batch = int(os.environ.get("BENCH_SERVE_BATCH",
+                                     8 if on_tpu else 4))
+    paged_batch = 4 * dense_batch
+    max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", 16))
+    page = int(os.environ.get("PADDLE_KV_PAGE_SIZE",
+                              128 if on_tpu else 16))
+    # system prompts span 6 FULL pages whatever the page size (sharing
+    # is page-granular — a sys prompt shorter than one page would never
+    # produce a prefix key, and the gate below would be vacuous): 96
+    # tokens at the CPU page 16, 768 at the TPU page 128
+    sys_len = 6 * page
+    bucket = _round_up(sys_len + 32)
+    paddle.seed(0)
+    model = gpt("test-tiny", max_position_embeddings=1024)
+    model.bfloat16() if on_tpu else None
+    assert bucket + max_new <= model.cfg.max_position_embeddings
+
+    rng = np.random.RandomState(0)
+    n_sys = 4
+    sys_prompts = [rng.randint(0, model.cfg.vocab_size, sys_len)
+                   .astype(np.int32) for _ in range(n_sys)]
+    prompts = [np.concatenate([sys_prompts[i % n_sys],
+                               rng.randint(0, model.cfg.vocab_size,
+                                           rng.randint(8, 17))
+                               .astype(np.int32)])
+               for i in range(n_req)]
+    budgets = rng.randint(max(4, max_new // 2), max_new + 1, size=n_req)
+    gaps = rng.exponential(1.0 / rate, size=n_req)
+
+    def run(paged):
+        spec = [paddle.to_tensor(np.zeros((dense_batch, 64), np.int32))]
+        cfg = (Config().from_layer(model, spec)
+               .enable_generation(max_new_tokens=max_new,
+                                  prefill_buckets=(bucket,),
+                                  max_batch=paged_batch if paged
+                                  else dense_batch))
+        if paged:
+            # EQUAL cache HBM: the pool holds exactly the dense
+            # engine's dense_batch * max_len tokens (plus the reserved
+            # null page); 4x the decode slots share it
+            max_len = _round_up(bucket + max_new)
+            cfg.enable_serving(max_queue=n_req, paged=True,
+                               kv_page_size=page,
+                               kv_pages=dense_batch * max_len // page + 1)
+        else:
+            cfg.enable_serving(max_queue=n_req)
+        engine = ServingEngine(cfg, poll_every=2)
+        handles = []
+
+        def feeder():
+            for p, b, g in zip(prompts, budgets, gaps):
+                time.sleep(g)
+                handles.append(engine.submit(
+                    p, RequestParams(max_new_tokens=int(b))))
+
+        peak = 0
+        busy_sum = steps = 0
+        t0 = time.perf_counter()
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        while th.is_alive() or engine.busy:
+            if engine.busy:
+                engine.step()
+                n_busy = sum(s is not None for s in engine._slots)
+                peak = max(peak, n_busy)
+                busy_sum += n_busy
+                steps += 1
+            else:
+                time.sleep(0.0002)
+        dt = time.perf_counter() - t0
+        th.join()
+        assert len(handles) == n_req and \
+            all(h.status.value == "completed" for h in handles)
+        stats = dict(engine._alloc.stats) if engine._alloc else {}
+        if engine._alloc is not None:
+            engine.drain()
+            engine._alloc.assert_conserved()   # no leaked/double-freed
+        return dict(peak=peak, mean_busy=round(busy_sum / max(1, steps), 2),
+                    qps=round(n_req / dt, 1), **stats)
+
+    dense = run(paged=False)
+    paged_r = run(paged=True)
+    assert paged_r["prefix_hits"] > 0, "shared-prefix traffic never hit"
+    ratio = paged_r["peak"] / dense["peak"]
+    max_len = _round_up(bucket + max_new)
+    return {
+        "metric": f"test-tiny paged-KV capacity at equal HBM "
+                  f"({dense_batch * max_len} cache tokens, page {page}, "
+                  f"{n_sys} shared {sys_len}-tok system prompts, "
+                  f"poisson@{rate:g}/s): peak {paged_r['peak']} vs "
+                  f"{dense['peak']} concurrent (device={dev.device_kind})",
+        "value": round(ratio, 2),
+        "unit": "x concurrent capacity",
+        "vs_baseline": round(ratio / 2.0, 2),   # gate: > 2x -> >= 1.0
+        "paged": {"dense": dense, "paged": paged_r,
+                  "hbm_cache_tokens": dense_batch * max_len,
+                  "page_size": page, "conserved": True},
+    }
+
+
+
 def bench_serve(dev, on_tpu):
     """Serving-engine bench (ISSUE-8 serve mode): synthetic Poisson
     arrivals of ragged prompts/budgets against the continuous-batching
@@ -787,6 +918,7 @@ BENCHES = {
     "gpt2": bench_gpt2,
     "decode": bench_decode,
     "serve": bench_serve,
+    "serve-prefix": bench_serve_shared_prefix,
     "warmstart": bench_warmstart,
     "moe-block": bench_moe_block,
     "resnet50": bench_resnet50,
@@ -799,6 +931,10 @@ BENCHES = {
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "gpt2"
+    # `bench.py serve --shared-prefix`: the paged-KV capacity gate
+    # (ISSUE-12) instead of the PR-8 SLA row
+    if which == "serve" and "--shared-prefix" in sys.argv[2:]:
+        which = "serve-prefix"
     # warmstart measures COLD compiles: it must not inherit a populated
     # process-global cache (it anchors its own fresh store per phase)
     dev, on_tpu = _setup(configure_cache=(which != "warmstart"))

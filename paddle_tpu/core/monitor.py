@@ -39,6 +39,10 @@ Metric name scheme (what the summary views group by):
     errors.swallowed{where=...} deliberately swallowed exceptions
     gen.tokens / gen.prefill_steps / gen.decode_steps   generation loop
     gen.cache_occupancy         gauge: KV cache fraction in use
+    gen.cache.pages_allocated / .pages_freed   paged-pool allocator churn
+    serve.cache.page_occupancy  gauge: referenced pages / pool
+    serve.cache.prefix_hits / .prefix_shared_pages / .cow_copies
+                                shared-prefix reuse at admission
     gen.spec.proposed / .accepted   speculative draft tokens in/out of
                                 the single-dispatch verify
     gen.spec.accept_rate        gauge: accepted/proposed, last window
@@ -84,9 +88,12 @@ DECLARED_METRICS = frozenset({
     "errors.swallowed",
     "gen.tokens", "gen.prefill_steps", "gen.decode_steps",
     "gen.cache_occupancy",
+    "gen.cache.pages_allocated", "gen.cache.pages_freed",
     "gen.spec.proposed", "gen.spec.accepted", "gen.spec.accept_rate",
     "serve.requests", "serve.queue_depth", "serve.ttft",
     "serve.token_latency", "serve.slot_occupancy", "serve.cancellations",
+    "serve.cache.page_occupancy", "serve.cache.prefix_hits",
+    "serve.cache.prefix_shared_pages", "serve.cache.cow_copies",
     "analysis.findings",
     "telemetry.scrapes", "flightrecorder.dumps",
 })
@@ -195,6 +202,13 @@ METRIC_DOC = {
     "gen.decode_steps": ("counter", (), "decode dispatches"),
     "gen.cache_occupancy": ("gauge", (),
                             "KV-cache fraction in use (max over rows)"),
+    "gen.cache.pages_allocated": ("counter", (),
+                                  "paged-KV pool pages taken from the "
+                                  "free list (admission installs)"),
+    "gen.cache.pages_freed": ("counter", (),
+                              "paged-KV pool pages returned to the "
+                              "free list (request completion/eviction "
+                              "and prefix-registry reclaims)"),
     "gen.spec.proposed": ("counter", (),
                           "draft tokens proposed to speculative verify "
                           "(k per live row per window)"),
@@ -220,6 +234,23 @@ METRIC_DOC = {
     "serve.cancellations": ("counter", ("reason",),
                             "requests cancelled before completing: "
                             "deadline | shutdown | error"),
+    "serve.cache.page_occupancy": ("gauge", (),
+                                   "paged-KV pool pressure: pages "
+                                   "referenced by live rows / pool "
+                                   "size (excl. the null page)"),
+    "serve.cache.prefix_hits": ("counter", (),
+                                "admissions whose prompt prefix "
+                                "hash-matched registered pages (shared "
+                                "instead of re-stored)"),
+    "serve.cache.prefix_shared_pages": ("counter", (),
+                                        "pages REFERENCED instead of "
+                                        "allocated at admission (the "
+                                        "HBM the sharing saved, in "
+                                        "pages)"),
+    "serve.cache.cow_copies": ("counter", (),
+                               "copy-on-write page privatizations: a "
+                               "prompt diverged inside a shared page "
+                               "and got a private copy at admission"),
     "analysis.findings": ("counter", ("check", "severity"),
                           "static-audit findings by detector and "
                           "severity"),
@@ -494,6 +525,38 @@ def record_cache_occupancy(frac: float):
     if not enabled:
         return
     metrics.gauge("gen.cache_occupancy").set(float(frac))
+
+
+def record_paged_cache(allocated: int = 0, freed: int = 0,
+                       prefix_hits: int = 0, shared_pages: int = 0,
+                       cow_copies: int = 0):
+    """Paged-KV allocator progress since the last record (the serving
+    engine drains its host-side page stats at the poll cadence):
+    pages allocated/freed, admissions that hash-matched a registered
+    prompt prefix, the pages those hits referenced instead of storing,
+    and copy-on-write privatizations of partially-shared pages."""
+    if not enabled:
+        return
+    if allocated:
+        metrics.counter("gen.cache.pages_allocated").inc(int(allocated))
+    if freed:
+        metrics.counter("gen.cache.pages_freed").inc(int(freed))
+    if prefix_hits:
+        metrics.counter("serve.cache.prefix_hits").inc(int(prefix_hits))
+    if shared_pages:
+        metrics.counter("serve.cache.prefix_shared_pages").inc(
+            int(shared_pages))
+    if cow_copies:
+        metrics.counter("serve.cache.cow_copies").inc(int(cow_copies))
+
+
+def record_page_occupancy(frac: float):
+    """Paged-KV pool pressure at the last scheduler poll: pages
+    referenced by live rows over the allocatable pool (the memory-side
+    capacity signal beside serve.slot_occupancy's admission side)."""
+    if not enabled:
+        return
+    metrics.gauge("serve.cache.page_occupancy").set(float(frac))
 
 
 # --------------------------------------------------------- serving layer

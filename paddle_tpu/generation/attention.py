@@ -23,9 +23,23 @@ def cached_attention(q, k, v, cache, layer_idx, *, decode: bool,
     from ..nn import functional as F
     cache = cache.update(layer_idx, k, v, cache.kv_len)
     if decode:
-        from ..kernels.flash_attention import flash_attention_decode
         s = q.shape[1]
         mask_len = cache.kv_len + s  # includes the new rows
+        if getattr(cache, "page_table", None) is not None:
+            # paged cache: attend the pooled pages through the row's
+            # page table (index-map indirection on TPU, gather+mask
+            # off it — bitwise-equal either way)
+            from ..kernels.flash_attention import \
+                flash_attention_decode_paged
+            out = dispatch(
+                "flash_attention_decode_paged",
+                lambda q_, kp, vp, pt, kl: flash_attention_decode_paged(
+                    q_, kp, vp, pt, kl),
+                (q, cache.k[layer_idx], cache.v[layer_idx],
+                 cache.page_table, mask_len), {},
+                differentiable=False)
+            return out, cache
+        from ..kernels.flash_attention import flash_attention_decode
         out = dispatch(
             "flash_attention_decode",
             lambda q_, kc, vc, kl: flash_attention_decode(

@@ -1,0 +1,479 @@
+"""Block-table paged KV cache with shared-prefix reuse.
+
+The dense ring cache (``kv_cache.KVCache``) charges every slot
+``max_len`` HBM whether its request uses 40 tokens or 4000, and N
+concurrent requests sharing a system prompt each store their own copy
+of its K/V. This module replaces the per-slot rows with a POOL of
+fixed-size pages plus per-row page tables — the PagedAttention design
+(Kwon et al., SOSP '23) with Hydragen-style shared-prefix reuse —
+built natively on the decode kernel's index-map indirection (the same
+mechanism its GQA head mapping already uses):
+
+- **PagedKVCache** (device pytree): ``k, v`` pools of shape
+  ``[layers, n_pages, page_size, heads, head_dim]``, a per-row int32
+  ``page_table [batch, pages_per_row]``, and the familiar per-row
+  ``kv_len``. ``update``/``install_row``/``reset_rows`` are
+  pure-functional (donated in the engine's compiled programs, same as
+  the dense cache) and every write resolves its destination page
+  through the table in-trace — the page ids are DATA, so one compiled
+  program serves every allocation layout.
+- **Page 0 is the reserved null page**: masked install positions,
+  out-of-table positions, and idle engine lanes (``kv_len == 0``, the
+  finished-slot contract) all route their writes there. Nothing ever
+  reads it unmasked — this is what makes a parked slot with a stale
+  table harmless while its pages are already re-owned by another row.
+- **PageAllocator** (host): free-list allocation, per-page refcounts,
+  and a prompt-prefix registry hashed at page granularity — an
+  admission whose leading full pages hash-match a registered prompt
+  REFERENCES those pages (prefill once, reference-count many) instead
+  of storing a private copy. A prompt diverging INSIDE a shared page
+  (its tail is a partial page of a fully-matched prefix) gets a
+  private copy-on-write page at admission — the only moment a write
+  could land on shared content, because full prompt pages are never
+  written after install and decode writes always start at the row's
+  own ``kv_len``. Registered pages with refcount 0 stay cached for
+  future prefix hits and are reclaimed LRU when allocation runs dry.
+
+Host syncs: the allocator runs entirely on host metadata (page ids,
+token hashes) — it never touches device arrays; the only device reads
+on this path stay the engine's existing poll-cadence lane reads.
+
+Reference analog: the reference's serving layer keeps contiguous
+CacheKV tensors per request (fused_multi_transformer); vLLM proved the
+block-table form is what survives real traffic. Here the table rides
+the same BlockSpec/SMEM machinery as the per-row ``kv_len``.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import KVCache, _raw
+
+__all__ = ["PagedKVCache", "PageAllocator", "AdmissionPlan"]
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedKVCache:
+    """Paged K/V pool + per-row page tables + per-row valid lengths.
+
+    Implements the decode half of the KV-cache protocol (``update``,
+    ``positions``, ``with_kv_len``, ``reset_rows``, ``kv_len``) so the
+    model stack and the speculative verify core drive it unchanged;
+    prefill stays on the dense batch-1 row cache, which
+    ``install_row`` then scatters into the pool through the table.
+    """
+
+    __slots__ = ("k", "v", "page_table", "kv_len")
+
+    def __init__(self, k, v, page_table, kv_len):
+        self.k = k
+        self.v = v
+        self.page_table = page_table
+        self.kv_len = kv_len
+
+    # ------------------------------------------------------------ pytree
+    def tree_flatten(self):
+        return (self.k, self.v, self.page_table, self.kv_len), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # ------------------------------------------------------------- shape
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def n_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def batch(self) -> int:
+        return self.page_table.shape[0]
+
+    @property
+    def pages_per_row(self) -> int:
+        return self.page_table.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        """Logical per-row capacity (the dense cache's ``max_len``)."""
+        return self.pages_per_row * self.page_size
+
+    @property
+    def dtype(self):
+        return self.k.dtype
+
+    # ---------------------------------------------------------- creation
+    @classmethod
+    def create(cls, num_layers: int, batch: int, n_pages: int,
+               page_size: int, pages_per_row: int, num_heads: int,
+               head_dim: int, dtype=jnp.float32) -> "PagedKVCache":
+        shape = (num_layers, n_pages, page_size, num_heads, head_dim)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((batch, pages_per_row), jnp.int32),
+                   jnp.zeros((batch,), jnp.int32))
+
+    # ------------------------------------------------------------ update
+    def _write_pages(self, pos):
+        """(page, offset) destinations for per-row write positions
+        ``pos`` ([batch, s] int32): resolve through the table, routing
+        idle lanes (row write base 0 — the engine pins finished slots'
+        kv_len to 0) and out-of-table positions to the null page 0."""
+        slot = pos // self.page_size
+        page = jnp.take_along_axis(
+            self.page_table,
+            jnp.minimum(slot, self.pages_per_row - 1), axis=1)
+        dead = (pos[:, 0:1] == 0) | (slot >= self.pages_per_row)
+        return jnp.where(dead, 0, page), pos % self.page_size
+
+    def update(self, layer: int, k_new, v_new, pos) -> "PagedKVCache":
+        """Write ``k_new``/``v_new`` ([batch, s, heads, head_dim]) into
+        ``layer`` at per-row start position ``pos`` through the page
+        table. Decode-path contract: a live row's ``pos`` (its
+        ``kv_len``) is >= 1 (it holds at least its prompt), so a row
+        writing at position 0 is an idle engine lane and lands on the
+        null page. Does NOT advance ``kv_len`` (same contract as the
+        dense cache: the model advances it once per forward)."""
+        k_new, v_new = _raw(k_new), _raw(v_new)
+        pos = jnp.asarray(_raw(pos), jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (k_new.shape[0],))
+        b, s = k_new.shape[0], k_new.shape[1]
+        positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        page, off = self._write_pages(positions)          # [b, s] each
+        page_f, off_f = page.reshape(-1), off.reshape(-1)
+
+        def write(buf, new):
+            flat = new.reshape((b * s,) + new.shape[2:]).astype(buf.dtype)
+            return buf.at[layer, page_f, off_f].set(flat)
+
+        return PagedKVCache(write(self.k, k_new), write(self.v, v_new),
+                            self.page_table, self.kv_len)
+
+    def install_row(self, src: KVCache, slot, table_row,
+                    start) -> "PagedKVCache":
+        """Slot admission: scatter the batch-1 dense prefill cache
+        ``src`` into the pool pages named by ``table_row``
+        ([pages_per_row] int32), install the table row and ``kv_len``
+        at ``slot``. Positions below ``start`` are covered by shared
+        prefix pages and are NOT written (the whole point); positions
+        at/past ``src.kv_len[0]`` route to the null page. ``slot``,
+        ``table_row`` and ``start`` are traced data — ONE compiled
+        program serves every slot and every allocation layout."""
+        slot = jnp.asarray(_raw(slot), jnp.int32)
+        table_row = jnp.asarray(_raw(table_row), jnp.int32)
+        start = jnp.asarray(_raw(start), jnp.int32)
+        length = src.kv_len[0]
+        t = src.max_len
+        pos = jnp.arange(t, dtype=jnp.int32)
+        page_slot = pos // self.page_size
+        page = table_row[jnp.minimum(page_slot, self.pages_per_row - 1)]
+        valid = (pos >= start) & (pos < length) & \
+            (page_slot < self.pages_per_row)
+        page = jnp.where(valid, page, 0)
+        off = pos % self.page_size
+
+        def write(buf, row):  # row: [layers, t, heads, head_dim]
+            return buf.at[:, page, off].set(row.astype(buf.dtype))
+
+        return PagedKVCache(
+            write(self.k, src.k[:, 0]), write(self.v, src.v[:, 0]),
+            self.page_table.at[slot].set(table_row),
+            self.kv_len.at[slot].set(length))
+
+    def positions(self, s: int):
+        """Absolute positions of ``s`` appended tokens per row — the
+        decode position-embedding offsets (dense-cache contract)."""
+        return self.kv_len[:, None] + \
+            jnp.arange(s, dtype=jnp.int32)[None, :]
+
+    # -------------------------------------------------------- slot reuse
+    def reset_rows(self, rows) -> "PagedKVCache":
+        """Free rows for reuse: zero ``kv_len`` AND null the page-table
+        row (one row index, an int array, or a [batch] bool mask). The
+        HOST allocator owns returning the pages themselves to the free
+        list — this program only severs the row's pointers so a stale
+        lane can never write through them once the pages are
+        re-owned."""
+        rows = jnp.asarray(_raw(rows))
+        if rows.dtype == jnp.bool_:
+            kv_len = jnp.where(rows, 0, self.kv_len)
+            table = jnp.where(rows[:, None], 0, self.page_table)
+        else:
+            kv_len = self.kv_len.at[rows].set(0)
+            table = self.page_table.at[rows].set(0)
+        return PagedKVCache(self.k, self.v, table, kv_len)
+
+    def with_kv_len(self, kv_len) -> "PagedKVCache":
+        kv_len = jnp.asarray(_raw(kv_len), jnp.int32)
+        if kv_len.ndim == 0:
+            kv_len = jnp.broadcast_to(kv_len, (self.batch,))
+        return PagedKVCache(self.k, self.v, self.page_table, kv_len)
+
+    # --------------------------------------------------------- telemetry
+    def occupancy(self) -> float:
+        """Host-side fraction of the LOGICAL per-row capacity in use
+        (max over rows) — the gen.cache_occupancy gauge; page-level
+        occupancy is the allocator's (host-only) page_occupancy."""
+        top = np.max(np.asarray(self.kv_len))  # lint: host-sync-ok (tiny read)
+        return float(top) / self.max_len  # lint: host-sync-ok (host scalar)
+
+    def __repr__(self):
+        return (f"PagedKVCache(layers={self.num_layers}, "
+                f"batch={self.batch}, pages={self.n_pages}x"
+                f"{self.page_size}, per_row={self.pages_per_row}, "
+                f"dtype={self.k.dtype})")
+
+
+class AdmissionPlan:
+    """One admission's page plan (host-only): the shared prefix pages to
+    reference, how many private pages to allocate, and whether the
+    divergence point sits inside a shared page (copy-on-write)."""
+
+    __slots__ = ("shared_pages", "shared_len", "n_private", "cow",
+                 "total_pages", "keys")
+
+    def __init__(self, shared_pages, shared_len, n_private, cow,
+                 total_pages, keys):
+        self.shared_pages = shared_pages    # List[int]
+        self.shared_len = shared_len        # tokens covered by sharing
+        self.n_private = n_private          # pages to allocate
+        self.cow = cow                      # divergence inside a shared
+        #                                     page -> private copy made
+        self.total_pages = total_pages
+        self.keys = keys                    # full-page registry keys
+
+
+class PageAllocator:
+    """Host-side page bookkeeping: free list, refcounts, and the
+    prompt-prefix registry. Page 0 is reserved (the null page) and is
+    never allocated. All state is host ints — no device arrays, no
+    syncs; the engine calls ``plan``/``commit`` at admission,
+    ``register`` after install, and ``free_row`` at completion or
+    eviction. ``assert_conserved`` is the drain-time invariant: every
+    page is exactly one of {null, free, referenced, cached}."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the "
+                             "reserved null page)")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        # leaf lock: the scheduler mutates under the engine's pump
+        # lock while the telemetry HTTP thread reads free_pages() for
+        # /readyz — an unguarded registry iteration there would raise
+        # mid-scrape exactly when the router signal matters
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._ref: Dict[int, int] = {}          # page -> live row refs
+        # prefix registry: full-page key -> page id (insertion order is
+        # the LRU order; re-registration moves to the back)
+        self._prefix: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._page_key: Dict[int, bytes] = {}   # page -> its registry key
+        self.stats = dict(pages_allocated=0, pages_freed=0,
+                          prefix_hits=0, shared_pages=0, cow_copies=0,
+                          reclaimed=0)
+        # bumped on every state mutation: the engine caches a blocked
+        # queue head's failed plan against this, so a saturated pool is
+        # re-planned only when something actually changed (a free, a
+        # reclaim, a registration) instead of on every pump iteration
+        self.version = 0
+
+    # ---------------------------------------------------------- hashing
+    def _page_keys(self, ids: np.ndarray) -> List[bytes]:
+        """Chained per-page digests of the prompt's FULL pages: key i
+        commits to every token in pages 0..i, so a match at page i
+        implies the whole prefix matches."""
+        keys, h = [], hashlib.blake2b(digest_size=16)
+        ps = self.page_size
+        for i in range(len(ids) // ps):
+            h.update(np.ascontiguousarray(
+                ids[i * ps:(i + 1) * ps]).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    # ------------------------------------------------------- accounting
+    def free_pages(self) -> int:
+        """Pages allocatable right now: the free list plus cached
+        (registered, refcount-0) pages the reclaimer may take.
+        Thread-safe: the telemetry thread calls this mid-traffic."""
+        with self._lock:
+            return len(self._free) + sum(
+                1 for p in self._prefix.values() if not self._ref.get(p))
+
+    def used_pages(self) -> int:
+        return len(self._ref)
+
+    def page_occupancy(self) -> float:
+        """Referenced pages / allocatable universe (excludes null)."""
+        return len(self._ref) / max(1, self.n_pages - 1)
+
+    # -------------------------------------------------------- admission
+    def plan(self, ids: np.ndarray, extra_tokens: int) -> AdmissionPlan:
+        """Plan one admission: prompt ``ids`` plus ``extra_tokens`` of
+        decode budget (incl. any speculative overhang). Pure read —
+        commits nothing."""
+        ids = np.asarray(ids, np.int32).reshape(-1)  # lint: host-sync-ok (host token ids)
+        ps = self.page_size
+        plen = int(ids.size)
+        total = -(-(plen + int(extra_tokens)) // ps)
+        keys = self._page_keys(ids)
+        shared: List[int] = []
+        with self._lock:
+            for key in keys:
+                page = self._prefix.get(key)
+                if page is None:
+                    break
+                shared.append(page)
+        shared_len = len(shared) * ps
+        # copy-on-write: the prompt diverges INSIDE a page whose prefix
+        # is shared (its full pages all matched and a partial tail
+        # remains) — the install privatizes that page's content
+        cow = bool(shared) and shared_len == (plen // ps) * ps \
+            and plen % ps != 0
+        return AdmissionPlan(shared, shared_len, total - len(shared),
+                             cow, total, keys)
+
+    def commit(self, plan: AdmissionPlan) -> Optional[List[int]]:
+        """Acquire the plan's pages: reference the shared prefix pages
+        and allocate the private ones (reclaiming cached prefix pages
+        LRU if the free list runs dry). Returns the row's full page
+        list (shared + private, position order), or None when the pool
+        cannot cover it — the caller leaves the request queued."""
+        with self._lock:
+            if plan.n_private > len(self._free):
+                self._reclaim(plan.n_private - len(self._free),
+                              protect=set(plan.shared_pages))
+            if plan.n_private > len(self._free):
+                return None
+            private = [self._free.pop() for _ in range(plan.n_private)]
+            for p in private:
+                self._ref[p] = 1
+            for p in plan.shared_pages:
+                self._ref[p] = self._ref.get(p, 0) + 1
+            self.version += 1
+            self.stats["pages_allocated"] += len(private)
+            if plan.shared_pages:
+                self.stats["prefix_hits"] += 1
+                self.stats["shared_pages"] += len(plan.shared_pages)
+            if plan.cow:
+                self.stats["cow_copies"] += 1
+            return plan.shared_pages + private
+
+    def register(self, plan: AdmissionPlan, pages: List[int]):
+        """Register the admitted prompt's FULL pages for future prefix
+        hits (key i -> pages[i]). Safe because full prompt pages are
+        never written after install: decode appends at the row's
+        kv_len, past the last full prompt page's content. Re-registering
+        a shared page refreshes its LRU position."""
+        with self._lock:
+            for i, key in enumerate(plan.keys):
+                old = self._prefix.pop(key, None)
+                if old is not None and old != pages[i]:
+                    # the key was re-installed onto a different page
+                    # while the old one still exists (it was referenced
+                    # when this admission planned around it): drop the
+                    # old binding
+                    self._page_key.pop(old, None)
+                    self._maybe_release(old)
+                self._prefix[key] = pages[i]
+                self._page_key[pages[i]] = key
+            if plan.keys:
+                self.version += 1
+
+    def free_row(self, pages: List[int]):
+        """Release one row's page references (completion/eviction).
+        Unreferenced unregistered pages return to the free list;
+        unreferenced REGISTERED pages stay cached for future prefix
+        hits until reclaimed."""
+        with self._lock:
+            for p in pages:
+                n = self._ref.get(p, 0) - 1
+                if n > 0:
+                    self._ref[p] = n
+                else:
+                    self._ref.pop(p, None)
+                    self._maybe_release(p)
+            self.version += 1
+
+    def _maybe_release(self, page: int):
+        # caller holds self._lock
+        if page in self._ref or page in self._page_key:
+            return
+        self._free.append(page)
+        self.stats["pages_freed"] += 1
+
+    def _reclaim(self, need: int, protect=frozenset()):
+        """Evict cached (refcount-0, registered) prefix pages LRU-first
+        until ``need`` pages were freed or nothing reclaimable is
+        left. Caller holds self._lock."""
+        for key in list(self._prefix):
+            if need <= 0:
+                break
+            page = self._prefix[key]
+            if self._ref.get(page) or page in protect:
+                continue
+            del self._prefix[key]
+            del self._page_key[page]
+            self._free.append(page)
+            self.version += 1
+            self.stats["pages_freed"] += 1
+            self.stats["reclaimed"] += 1
+            need -= 1
+
+    def drop_registry(self):
+        """Forget every cached prefix (refcount-0 registered pages go
+        back to the free list) — test/diagnostic hook."""
+        with self._lock:
+            self._reclaim(len(self._prefix))
+            # still-referenced registered pages lose their registry entry
+            for key in list(self._prefix):
+                page = self._prefix.pop(key)
+                self._page_key.pop(page, None)
+            self.version += 1
+
+    # ------------------------------------------------------ invariants
+    def assert_conserved(self):
+        """Every page is exactly one of {null, free, referenced,
+        cached}: no leaks, no double frees. The chaos drain gate."""
+        with self._lock:
+            return self._assert_conserved_locked()
+
+    def _assert_conserved_locked(self):
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise AssertionError("double-freed page(s): free list has "
+                                 "duplicates")
+        refd = set(self._ref)
+        cached = {p for p in self._page_key if p not in refd}
+        if free & refd or free & cached:
+            raise AssertionError(
+                f"page in two states: free∩ref={sorted(free & refd)} "
+                f"free∩cached={sorted(free & cached)}")
+        if 0 in free or 0 in refd or 0 in cached:
+            raise AssertionError("reserved null page 0 was allocated")
+        total = 1 + len(free) + len(refd) + len(cached)
+        if total != self.n_pages:
+            raise AssertionError(
+                f"page leak: null+free({len(free)})+referenced"
+                f"({len(refd)})+cached({len(cached)}) = {total} != "
+                f"pool {self.n_pages}")
+
+    def __repr__(self):
+        return (f"PageAllocator(pages={self.n_pages}x{self.page_size}, "
+                f"free={len(self._free)}, used={len(self._ref)}, "
+                f"cached={len(self._prefix)})")

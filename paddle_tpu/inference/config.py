@@ -133,7 +133,9 @@ class Config:
     def enable_serving(self, max_queue: int = 64, poll_every: int = 4,
                        drain_timeout_s: float = 30.0,
                        default_deadline_s=None, cache_max_len=None,
-                       trace_sample=None, telemetry_port=None):
+                       trace_sample=None, telemetry_port=None,
+                       paged: bool = False, kv_page_size=None,
+                       kv_pages=None):
         """Continuous-batching knobs for ``paddle_tpu.serving.
         ServingEngine`` (which also needs ``enable_generation()`` — the
         engine reuses its prompt-bucket set, fixed decode batch, and
@@ -148,13 +150,25 @@ class Config:
         (default 8; 0 = off), and ``telemetry_port`` starts the
         ``core.telemetry_server`` export surface (/metrics, /healthz,
         /readyz, /flightrecorder; 0 = ephemeral port) — both also
-        settable via ``PADDLE_TRACE_SAMPLE`` / ``PADDLE_TELEMETRY_PORT``."""
+        settable via ``PADDLE_TRACE_SAMPLE`` / ``PADDLE_TELEMETRY_PORT``.
+
+        ``paged=True`` swaps the dense per-slot KV ring for the
+        block-table PAGED cache (``generation.PagedKVCache``): K/V live
+        in a pool of ``kv_pages`` fixed-size pages (default: the dense
+        cache's exact HBM footprint), each slot holds an int32 page
+        table, admission is gated on free PAGES as well as free slots,
+        and identical prompt prefixes share pages copy-on-write —
+        prefill once, reference-count many. ``kv_page_size`` (or
+        ``PADDLE_KV_PAGE_SIZE``; default 128) must divide the cache
+        length; outputs stay bitwise-equal to the dense cache."""
         self._serving = dict(
             max_queue=int(max_queue), poll_every=int(poll_every),
             drain_timeout_s=float(drain_timeout_s),
             default_deadline_s=default_deadline_s,
             cache_max_len=cache_max_len,
-            trace_sample=trace_sample, telemetry_port=telemetry_port)
+            trace_sample=trace_sample, telemetry_port=telemetry_port,
+            paged=bool(paged), kv_page_size=kv_page_size,
+            kv_pages=kv_pages)
         return self
 
     def set_compile_cache_dir(self, path: str):

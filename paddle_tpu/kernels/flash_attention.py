@@ -837,6 +837,48 @@ MAX_DECODE_QLEN = _DECODE_QPAD
 _DECODE_BLOCK_K = 512
 
 
+def _decode_init(m_scr, l_scr, acc_scr):
+    m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+
+def _decode_accumulate(q, k, v, col_base, kv_len, sq,
+                       m_scr, l_scr, acc_scr):
+    """One k-block of the decode online softmax — the ONE copy of the
+    accumulate math shared by the dense and paged decode kernels, so
+    their numerics can never silently diverge (the paged/dense
+    bitwise-parity gate depends on them staying locked together).
+
+    Query row i sits at global position kv_len - sq + i: it may attend
+    keys at cols <= kv_len - sq + i (ragged causal; ``col_base`` is
+    this block's first logical column). Rows past sq-1 are padding;
+    their outputs are sliced off outside."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [qpad, bk] base-2
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + col_base
+    s = jnp.where(cols - rows <= kv_len - sq, s, _NEG_INF)
+    m_prev = m_scr[:, 0:1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp2(m_prev - m_new)
+    p = jnp.exp2(s - m_new)
+    l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
+def _decode_write_out(o_ref, l_scr, acc_scr):
+    l = l_scr[:, 0:1]
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros
+    o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
 def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, sq, block_k, num_kblocks):
     # q_ref holds q * (scale * log2e); scores are base-2 logits
@@ -844,9 +886,7 @@ def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
 
     @pl.when(ik == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        _decode_init(m_scr, l_scr, acc_scr)
 
     kv_len = kvlen_ref[0, 0]  # this row's valid cache length (incl. the
     #                           sq new positions, already written)
@@ -854,36 +894,12 @@ def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
     # skip k-blocks entirely past the valid prefix
     @pl.when(ik * block_k < kv_len)
     def _compute():
-        q = q_ref[0]                                 # [qpad, D]
-        k = k_ref[0]                                 # [bk, D]
-        v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)      # [qpad, bk] base-2
-        # query row i sits at global position kv_len - sq + i: it may
-        # attend keys at cols <= kv_len - sq + i (ragged causal). Rows
-        # past sq-1 are padding; their outputs are sliced off outside.
-        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-            + ik * block_k
-        s = jnp.where(cols - rows <= kv_len - sq, s, _NEG_INF)
-        m_prev = m_scr[:, 0:1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp2(m_prev - m_new)
-        p = jnp.exp2(s - m_new)
-        l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        _decode_accumulate(q_ref[0], k_ref[0], v_ref[0], ik * block_k,
+                           kv_len, sq, m_scr, l_scr, acc_scr)
 
     @pl.when(ik == num_kblocks - 1)
     def _finalize():
-        l = l_scr[:, 0:1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros
-        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        _decode_write_out(o_ref, l_scr, acc_scr)
 
 
 def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
@@ -1002,6 +1018,159 @@ def flash_attention_decode(query, key_cache, value_cache, kv_len,
                              group=group)
     else:
         out = _decode_xla(qt, kt, vt, kl, float(scale), group=group)
+    return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
+
+
+# ------------------------------------------------ paged decode forward
+#
+# Decode attention over the block-table paged KV cache
+# (generation.paged_cache.PagedKVCache): K/V live in a shared pool of
+# fixed-size pages and each batch row names its pages in an int32 page
+# table. The kernel extends the dense decode kernel's existing
+# indirection mechanisms — per-row kv_len from SMEM, GQA head mapping
+# in the k/v BlockSpec index maps — one step further: the k-block
+# index map reads the PAGE ID from the scalar-prefetched table, so the
+# pool streams through VMEM page by page and the logical [max_len]
+# row is never materialized. Off-TPU (and for page sizes off the 128
+# grid) an XLA gather fallback materializes the gathered rows with
+# IDENTICAL math to the dense _decode_xla path — the bitwise-parity
+# gate between paged and dense serving rests on that.
+
+def _paged_decode_kernel(table_ref, kvlen_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_scr, l_scr, acc_scr, *, sq,
+                         page_size, num_page_slots, heads_q):
+    # q_ref holds q * (scale * log2e); scores are base-2 logits. The
+    # accumulate body is the SAME _decode_accumulate as the dense
+    # kernel — only the k-block addressing differs (pages through the
+    # scalar-prefetched table vs contiguous blocks).
+    r = pl.program_id(0)           # flattened [batch, q-head] row
+    j = pl.program_id(1)           # page slot within the row's table
+
+    @pl.when(j == 0)
+    def _init():
+        _decode_init(m_scr, l_scr, acc_scr)
+
+    kv_len = kvlen_ref[r // heads_q]   # this row's valid cache length
+
+    # page slots entirely past the valid prefix skip their compute
+    # (their DMA still runs; the grid is static — same caveat as the
+    # dense decode kernel's k-block skip)
+    @pl.when(j * page_size < kv_len)
+    def _compute():
+        _decode_accumulate(q_ref[0], k_ref[0, 0], v_ref[0, 0],
+                           j * page_size, kv_len, sq,
+                           m_scr, l_scr, acc_scr)
+
+    @pl.when(j == num_page_slots - 1)
+    def _finalize():
+        _decode_write_out(o_ref, l_scr, acc_scr)
+
+
+def _paged_decode_pallas(q, k_pool, v_pool, page_table, kv_len, scale,
+                         group=1, interpret=None):
+    """q: [B*Hq, sq<=8, D] (unscaled), pools [Hk, n_pages, page, D],
+    page_table [B, P] int32, kv_len [B]. The k/v BlockSpec index maps
+    resolve (kv head, page id) from the grid row and the
+    scalar-prefetched table — page indirection rides the same
+    index-map mechanism as the GQA head mapping."""
+    bh, sq, d = q.shape
+    hk, n_pages, page, _ = k_pool.shape
+    b, num_slots = page_table.shape
+    hq = bh // b
+    qpad = _DECODE_QPAD
+    q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    if sq < qpad:
+        q = jnp.pad(q, ((0, 0), (0, qpad - sq), (0, 0)))
+    table = page_table.astype(jnp.int32)
+    kvl = kv_len.astype(jnp.int32)
+
+    def k_index(r, j, tbl, kl):
+        return ((r % hq) // group, tbl[r // hq, j], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, num_slots),
+        in_specs=[
+            pl.BlockSpec((1, qpad, d), lambda r, j, tbl, kl: (r, 0, 0)),
+            pl.BlockSpec((1, 1, page, d), k_index),
+            pl.BlockSpec((1, 1, page, d), k_index),
+        ],
+        out_specs=pl.BlockSpec((1, qpad, d),
+                               lambda r, j, tbl, kl: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((qpad, _LANES), jnp.float32),
+            pltpu.VMEM((qpad, _LANES), jnp.float32),
+            pltpu.VMEM((qpad, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, sq=sq, page_size=page,
+                          num_page_slots=num_slots, heads_q=hq),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, qpad, d), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * qpad * num_slots * page * d,
+            bytes_accessed=2 * bh * (qpad + 2 * num_slots * page) * d,
+            transcendentals=bh * qpad * num_slots * page),
+        interpret=_interpret() if interpret is None else interpret,
+    )(table, kvl, q, k_pool, v_pool)
+    return out[:, :sq]
+
+
+def flash_attention_decode_paged(query, key_pool, value_pool,
+                                 page_table, kv_len, scale=None):
+    """Decode-shaped attention over a PAGED KV cache: 1..8 new query
+    tokens per row against K/V stored in a shared page pool addressed
+    through per-row page tables.
+
+    query: [batch, q_len<=8, num_heads, head_dim] (framework layout).
+    key_pool/value_pool: [n_pages, page_size, num_kv_heads, head_dim] —
+    one layer's slice of a ``generation.PagedKVCache`` (new tokens
+    already written through the table). page_table: [batch,
+    pages_per_row] int32 (entry 0 = the reserved null page). kv_len:
+    [batch] int32 — valid entries per row INCLUDING the q_len new
+    positions; masking is identical to ``flash_attention_decode``.
+
+    TPU with a lane-aligned page size runs the Pallas kernel (page ids
+    resolved in the k/v BlockSpec index maps from the scalar-prefetched
+    table — no gather ever materializes the logical row); other
+    backends gather the row's pages and run the dense XLA decode math
+    bit-for-bit (garbage in pages past kv_len is masked to exact
+    zeros, so paged results are bitwise-equal to the dense cache)."""
+    b, sq, hq, d = query.shape
+    ps, hk = key_pool.shape[1], key_pool.shape[2]
+    num_slots = page_table.shape[1]
+    if sq > _DECODE_QPAD:
+        raise ValueError(
+            f"flash_attention_decode_paged: q_len {sq} > "
+            f"MAX_DECODE_QLEN ({_DECODE_QPAD}); same contract as "
+            "flash_attention_decode")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"
+    group = hq // hk
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    use_pallas = (jax.default_backend() == "tpu"
+                  and ps % 128 == 0 and d in (64, 128, 256))
+    if use_pallas:
+        qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
+        kp = jnp.transpose(key_pool, (2, 0, 1, 3))    # [hk, pages, ps, d]
+        vp = jnp.transpose(value_pool, (2, 0, 1, 3))
+        out = _paged_decode_pallas(qt, kp, vp, page_table, kv_len,
+                                   float(scale), group=group)
+        return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
+    # XLA fallback: gather the row's pages into the logical
+    # [b, pages_per_row * page_size, hk, d] layout and run the exact
+    # dense decode math — t equals the dense cache's max_len, so the
+    # reduction order (and thus every bit) matches the dense engine
+    k_rows = key_pool[page_table].reshape(b, num_slots * ps, hk, d)
+    v_rows = value_pool[page_table].reshape(b, num_slots * ps, hk, d)
+    t = num_slots * ps
+    qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
+    kt = jnp.swapaxes(k_rows, 1, 2).reshape(b * hk, t, d)
+    vt = jnp.swapaxes(v_rows, 1, 2).reshape(b * hk, t, d)
+    kl = jnp.repeat(kv_len, hk)
+    out = _decode_xla(qt, kt, vt, kl, float(scale), group=group)
     return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
 
 

@@ -17,6 +17,17 @@ The reference ships serving as a whole layer (paddle/fluid/inference,
   copies the row cache into the freed slot (``KVCache.copy_row_from``)
   and resets that slot's token/finished/step/budget lanes. One admit
   program serves every slot — the slot index is data, not shape.
+- **paged KV cache + shared-prefix reuse**
+  (``enable_serving(paged=True)``): the dense ring is replaced by a
+  pool of fixed-size pages addressed through per-slot int32 page
+  tables (``generation.PagedKVCache``). Admission plans pages on the
+  host (prompt + the request's OWN budget), hashes the prompt's full
+  pages against the prefix registry so identical system prompts are
+  stored once and reference-counted (copy-on-write at divergence), and
+  blocks on FREE PAGES as well as free slots — ``health()`` tells the
+  two pressures apart (``no_free_pages`` vs ``no_free_slots``).
+  Outputs stay bitwise-equal to the dense cache; page conservation is
+  asserted at drain in the chaos tier.
 - **every program is compiled at warmup.** ``warmup()`` AOT-lowers one
   prefill executable per bucket plus the decode/admit/free trio; after
   it, a compile the engine is ever forced to do mid-traffic is recorded
@@ -102,7 +113,10 @@ class ServingEngine:
                  warmup: bool = True, seed: Optional[int] = None,
                  executable_store=None,
                  trace_sample: Optional[int] = None,
-                 telemetry_port: Optional[int] = None):
+                 telemetry_port: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 kv_page_size: Optional[int] = None,
+                 kv_pages: Optional[int] = None):
         from ..inference.precision import serving_params
         from ..jit.api import _unwrap, functional_call
 
@@ -210,6 +224,63 @@ class ServingEngine:
                 + "; the shared ring cache would wrap under a "
                 "full-length request")
 
+        # ------------------------------------------------- paged KV cache
+        # block-table paged cache + shared-prefix reuse (ROADMAP item 3):
+        # K/V live in a pool of fixed-size pages, each slot holds an
+        # int32 page table, admission is gated on FREE PAGES (memory)
+        # as well as free slots (batch lanes), and identical prompt
+        # prefixes reference the same pages copy-on-write.
+        self._alloc = None
+        self._overhang = overhang
+        if bool(_opt(paged, "paged", False)):  # lint: host-sync-ok (config coercion)
+            from ..generation.paged_cache import PageAllocator
+            env_ps = os.environ.get("PADDLE_KV_PAGE_SIZE", "").strip()
+            if env_ps and not env_ps.isdigit():
+                # garbage must not silently re-shape the cache (same
+                # contract as PADDLE_TRACE_SAMPLE above)
+                monitor.record_swallowed(
+                    "serving.kv_page_size",
+                    ValueError(f"PADDLE_KV_PAGE_SIZE={env_ps!r}"))
+            ps = int(_opt(kv_page_size, "kv_page_size",
+                          int(env_ps) if env_ps.isdigit() else 128))
+            if ps < 1 or self.max_len % ps:
+                raise ValueError(
+                    f"kv_page_size {ps} must divide the cache length "
+                    f"{self.max_len} (PADDLE_KV_PAGE_SIZE / "
+                    "enable_serving(kv_page_size=...))")
+            self.page_size = ps
+            self.pages_per_row = self.max_len // ps
+            # default pool: the dense cache's exact HBM footprint
+            # (max_batch rows of max_len) plus the reserved null page —
+            # the capacity win comes from requests that don't USE
+            # max_len and from shared prefixes, not from a bigger pool
+            n_pages = int(_opt(kv_pages, "kv_pages",
+                               self.max_batch * self.pages_per_row + 1))
+            # a pool that cannot cover ONE max-size request would stall
+            # the queue head forever with no error — same fail-fast
+            # contract as the dense "ring would wrap" check above
+            worst = -(-(buckets[-1] + self.max_new_tokens + overhang)
+                      // ps)
+            if n_pages - 1 < worst:
+                raise ValueError(
+                    f"kv_pages {n_pages} (1 reserved) cannot hold one "
+                    f"full-size request: bucket {buckets[-1]} + "
+                    f"max_new_tokens {self.max_new_tokens}"
+                    + (f" + speculative overhang {overhang}" if overhang
+                       else "")
+                    + f" needs {worst} pages of {ps}; raise kv_pages "
+                    "or kv_page_size")
+            self._alloc = PageAllocator(n_pages, ps)
+            self._page_seen: Dict[str, int] = {}
+            self._pending_pages: Dict[int, tuple] = {}
+            self._row_pages: List[Optional[list]] = [None] * self.max_batch
+            self._page_blocked = False
+            # (req.id, allocator version) of the last head whose plan
+            # failed to commit: while nothing changed in the pool, the
+            # pump loop skips re-hashing the prompt and re-walking the
+            # registry on every iteration
+            self._blocked_key = None
+
         names = self._sp.names
         sp = self._sp
         cfg = self._cfg
@@ -282,11 +353,12 @@ class ServingEngine:
             return (tok, cache, k1, finished, steps, budget, out_buf,
                     tok_buf, tok_len, proposed, accepted)
 
-        def admit_fn(cache, tok, finished, steps, budget, out_buf,
-                     slot, row_cache, first_tok, first_fin, row_budget):
-            # install the batch-1 prefill row into the freed slot; the
-            # slot index is a traced scalar — one program, every slot
-            cache = cache.copy_row_from(row_cache, 0, slot)
+        def admit_lanes(tok, finished, steps, budget, out_buf, slot,
+                        first_tok, first_fin, row_budget):
+            # the slot's scheduler lanes after admission (shared by the
+            # dense and paged admit programs — only the cache install
+            # differs); the slot index is a traced scalar, so one
+            # program serves every slot
             tok = tok.at[slot].set(first_tok[0])
             steps = steps.at[slot].set(1)
             budget = budget.at[slot].set(row_budget)
@@ -295,30 +367,77 @@ class ServingEngine:
             out_buf = out_buf.at[slot].set(row)
             finished = finished.at[slot].set(
                 first_fin[0] | (row_budget <= 1))
+            return tok, finished, steps, budget, out_buf
+
+        def drafter_lanes(tok_buf, tok_len, slot, ids_row, row_plen,
+                          first_tok):
+            # the drafter's token history: the padded prompt row with
+            # the prefill token appended — the n-gram drafter reads
+            # prompt AND emitted tokens from one buffer
+            row = ids_row.at[row_plen].set(first_tok[0])
+            return (tok_buf.at[slot].set(row),
+                    tok_len.at[slot].set(row_plen + 1))
+
+        def admit_fn(cache, tok, finished, steps, budget, out_buf,
+                     slot, row_cache, first_tok, first_fin, row_budget):
+            # install the batch-1 prefill row into the freed slot
+            cache = cache.copy_row_from(row_cache, 0, slot)
+            (tok, finished, steps, budget, out_buf) = admit_lanes(
+                tok, finished, steps, budget, out_buf, slot, first_tok,
+                first_fin, row_budget)
             return cache, tok, finished, steps, budget, out_buf
 
         def spec_admit_fn(cache, tok, finished, steps, budget, out_buf,
                           slot, row_cache, first_tok, first_fin,
                           row_budget, tok_buf, tok_len, ids_row,
                           row_plen):
-            # base admission + the drafter's token history: the padded
-            # prompt row with the prefill token appended — the n-gram
-            # drafter reads prompt AND emitted tokens from one buffer
             (cache, tok, finished, steps, budget, out_buf) = admit_fn(
                 cache, tok, finished, steps, budget, out_buf, slot,
                 row_cache, first_tok, first_fin, row_budget)
-            row = ids_row.at[row_plen].set(first_tok[0])
-            tok_buf = tok_buf.at[slot].set(row)
-            tok_len = tok_len.at[slot].set(row_plen + 1)
+            tok_buf, tok_len = drafter_lanes(tok_buf, tok_len, slot,
+                                             ids_row, row_plen,
+                                             first_tok)
             return (cache, tok, finished, steps, budget, out_buf,
                     tok_buf, tok_len)
 
         def free_fn(cache, finished, slot):
             return cache.reset_rows(slot), finished.at[slot].set(True)
 
+        def paged_admit_fn(cache, tok, finished, steps, budget, out_buf,
+                           slot, row_cache, first_tok, first_fin,
+                           row_budget, table_row, start):
+            # paged admission: scatter the batch-1 prefill row into the
+            # pool pages named by table_row, SKIPPING the shared-prefix
+            # positions below start (they already hold this content —
+            # prefill once, reference-count many). slot/table/start are
+            # traced data — one program, every slot, every layout.
+            cache = cache.install_row(row_cache, slot, table_row, start)
+            (tok, finished, steps, budget, out_buf) = admit_lanes(
+                tok, finished, steps, budget, out_buf, slot, first_tok,
+                first_fin, row_budget)
+            return cache, tok, finished, steps, budget, out_buf
+
+        def paged_spec_admit_fn(cache, tok, finished, steps, budget,
+                                out_buf, slot, row_cache, first_tok,
+                                first_fin, row_budget, table_row, start,
+                                tok_buf, tok_len, ids_row, row_plen):
+            (cache, tok, finished, steps, budget, out_buf) = \
+                paged_admit_fn(cache, tok, finished, steps, budget,
+                               out_buf, slot, row_cache, first_tok,
+                               first_fin, row_budget, table_row, start)
+            tok_buf, tok_len = drafter_lanes(tok_buf, tok_len, slot,
+                                             ids_row, row_plen,
+                                             first_tok)
+            return (cache, tok, finished, steps, budget, out_buf,
+                    tok_buf, tok_len)
+
         self._prefill_fn, self._free_fn = prefill_fn, free_fn
         self._step_fn = step_fn if spec is None else spec_step_fn
-        self._admit_fn = admit_fn if spec is None else spec_admit_fn
+        if self._alloc is None:
+            self._admit_fn = admit_fn if spec is None else spec_admit_fn
+        else:
+            self._admit_fn = paged_admit_fn if spec is None \
+                else paged_spec_admit_fn
         # executable persistence: every program warmup() compiles goes
         # through jit.compile_cache (this store, or the process default
         # when None) so a relaunched engine loads instead of recompiling
@@ -326,6 +445,12 @@ class ServingEngine:
         # donate on TPU only (CPU/GPU donation is a no-op that warns
         # once per program); audit() gates the TPU donation INTENT
         tpu = jax.default_backend() == "tpu"
+        # the spec admit's drafter tok_buf/tok_len positions — shifted
+        # by the paged table_row/start args. ONE definition shared by
+        # the jit donation wiring below and audit(): the audited
+        # donation set must be the set the production program uses.
+        self._spec_admit_buf = (11, 12) if self._alloc is None \
+            else (13, 14)
         if spec is None:
             self._step_donate = (1, 2, 3, 4, 5, 6, 7) if tpu else ()
             self._admit_donate = (0, 1, 2, 3, 4, 5, 7) if tpu else ()
@@ -333,10 +458,12 @@ class ServingEngine:
         else:
             # the spec step additionally carries the drafter's token
             # buffer/length lanes and the proposed/accepted counters —
-            # all donated (in-place across polls, audited as intent)
+            # all donated (in-place across polls, audited as intent).
+            # The paged spec admit's tok_buf/tok_len sit two positions
+            # later (after table_row/start).
             self._step_donate = tuple(range(1, 12)) if tpu else ()
-            self._admit_donate = (0, 1, 2, 3, 4, 5, 7, 11, 12) \
-                if tpu else ()
+            self._admit_donate = (0, 1, 2, 3, 4, 5, 7) \
+                + self._spec_admit_buf if tpu else ()
             step_static = (12, 13)
         self._free_donate = (0, 1) if tpu else ()
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
@@ -368,9 +495,22 @@ class ServingEngine:
         # would compile one tiny broadcast program per shape — dead
         # weight on the warm-relaunch path the executable store keeps
         # otherwise XLA-free
-        self._cache = jax.tree_util.tree_map(
-            lambda a: jax.device_put(np.zeros(a.shape, a.dtype)),
-            cache_aval)
+        if self._alloc is None:
+            self._cache = jax.tree_util.tree_map(
+                lambda a: jax.device_put(np.zeros(a.shape, a.dtype)),
+                cache_aval)
+        else:
+            # paged pool: layers/heads/head_dim/dtype from the dense
+            # prefill aval, rows replaced by the page pool + tables
+            from ..generation.paged_cache import PagedKVCache
+            L, _, _, H, D = cache_aval.k.shape
+            pool = (L, self._alloc.n_pages, self.page_size, H, D)
+            self._cache = PagedKVCache(
+                jax.device_put(np.zeros(pool, cache_aval.k.dtype)),
+                jax.device_put(np.zeros(pool, cache_aval.v.dtype)),
+                jax.device_put(np.zeros((B, self.pages_per_row),
+                                        np.int32)),
+                jax.device_put(np.zeros((B,), np.int32)))
         self._tok = jax.device_put(np.zeros((B,), np.int32))
         self._finished = jax.device_put(np.ones((B,), bool))  # empty
         #                                       slots are masked
@@ -462,6 +602,9 @@ class ServingEngine:
             speculative=repr(self._spec),
             buckets=tuple(self.buckets),
             shape=(self.max_batch, self.max_len, self.max_new_tokens),
+            paged=(None if self._alloc is None else
+                   (self.page_size, self.pages_per_row,
+                    self._alloc.n_pages)),
             precision=(self.config.precision,
                        getattr(self.config, "_int8_compute", False)),
             operands=compile_cache.aval_signature(self._state))
@@ -530,17 +673,20 @@ class ServingEngine:
         def build():
             tok_a, row_cache_a, fin_a = self._row_avals()
             scalar = jnp.asarray(0, jnp.int32)
+            paged = () if self._alloc is None else (
+                jax.ShapeDtypeStruct((self.pages_per_row,), jnp.int32),
+                scalar)
             if self._spec is None:
                 return self._admit_jit.lower(
                     self._cache, self._tok, self._finished, self._steps,
                     self._budget, self._out_buf, scalar, row_cache_a,
-                    tok_a, fin_a, scalar)
+                    tok_a, fin_a, scalar, *paged)
             ids_row = jax.ShapeDtypeStruct((self.max_len,), jnp.int32)
             return self._admit_jit.lower(
                 self._cache, self._tok, self._finished, self._steps,
                 self._budget, self._out_buf, scalar, row_cache_a,
-                tok_a, fin_a, scalar, self._tok_buf, self._tok_len,
-                ids_row, scalar)
+                tok_a, fin_a, scalar, *paged, self._tok_buf,
+                self._tok_len, ids_row, scalar)
         return self._compiled(("admit",), build,
                               donation=self._admit_donate)
 
@@ -641,15 +787,58 @@ class ServingEngine:
                 if self._steps_since_poll >= self.poll_every:
                     self._poll()
 
+    def _unblock_if(self, req: Request):
+        """Clear the page-pressure flag when the request it was
+        computed FOR leaves the queue (deadline sweep, drain): a stale
+        flag would steer the router's no_free_pages/no_free_slots
+        signal at the next health() until a slot freed."""
+        if self._alloc is not None and self._blocked_key is not None \
+                and self._blocked_key[0] == req.id:
+            self._blocked_key = None
+            self._page_blocked = False
+
     def _pop_queue(self) -> Optional[Request]:
         with self._qlock:
             while self._queue:
-                req = self._queue.popleft()
-                monitor.record_serve_queue_depth(len(self._queue))
+                req = self._queue[0]
                 if req.deadline is not None and \
                         time.monotonic() > req.deadline:
+                    self._queue.popleft()
+                    monitor.record_serve_queue_depth(len(self._queue))
+                    self._unblock_if(req)
                     self._cancel(req, "deadline")
                     continue
+                if self._alloc is not None:
+                    # admission counts FREE PAGES, not just free slots:
+                    # the head request's page plan (its prompt prefix
+                    # hashed against the registry, its own budget +
+                    # speculative overhang) must commit before the slot
+                    # is spent. A pool too full leaves the head QUEUED —
+                    # memory pressure, which health() reports as
+                    # no_free_pages so a router can tell it from
+                    # slot/admission pressure. While the pool state is
+                    # UNCHANGED since the head last failed to commit,
+                    # the pump loop skips the (identical) replan
+                    # entirely instead of burning hash+registry walks
+                    # every sub-millisecond iteration.
+                    ver = self._alloc.version
+                    if self._blocked_key == (req.id, ver):
+                        self._page_blocked = True
+                        return None
+                    plan = self._alloc.plan(
+                        req.prompt, req.budget + self._overhang)
+                    pages = self._alloc.commit(plan)
+                    if pages is None:
+                        # a failed commit may still have reclaimed
+                        # cached pages — key on the post-attempt version
+                        self._blocked_key = (req.id, self._alloc.version)
+                        self._page_blocked = True
+                        return None
+                    self._blocked_key = None
+                    self._page_blocked = False
+                    self._pending_pages[req.id] = (pages, plan)
+                self._queue.popleft()
+                monitor.record_serve_queue_depth(len(self._queue))
                 return req
         return None
 
@@ -665,7 +854,9 @@ class ServingEngine:
             except Exception as e:
                 # the request left the queue but reached no slot: it
                 # MUST still go terminal or its Future would hang
-                # forever; the engine keeps serving the others
+                # forever (and its committed pages must return to the
+                # free list); the engine keeps serving the others
+                self._release_pending(req)
                 self._cancel(req, f"admission error: "
                                   f"{type(e).__name__}: {e}",
                              label="error")
@@ -700,13 +891,27 @@ class ServingEngine:
         monitor.record_generation(prefill_steps=1)
         self.stats["prefills"] += 1
         admit = self._exe_admit()
+        paged_args, pages, plan = (), None, None
+        if self._alloc is not None:
+            # the row's page table: shared prefix pages first (position
+            # order), then the freshly allocated private ones; unused
+            # table slots stay 0 (the null page). start marks the first
+            # position the install actually writes — everything below
+            # it is referenced shared content. The pending entry is
+            # popped only AFTER the install lands: an admit failure
+            # must leave it for _release_pending to roll back.
+            pages, plan = self._pending_pages[req.id]
+            table_np = np.zeros((self.pages_per_row,), np.int32)
+            table_np[:len(pages)] = pages
+            paged_args = (jnp.asarray(table_np),
+                          jnp.asarray(plan.shared_len, jnp.int32))
         if self._spec is None:
             (self._cache, self._tok, self._finished, self._steps,
              self._budget, self._out_buf) = admit(
                 self._cache, self._tok, self._finished, self._steps,
                 self._budget, self._out_buf,
                 jnp.asarray(slot, jnp.int32), row_cache, tok, fin,
-                jnp.asarray(req.budget, jnp.int32))
+                jnp.asarray(req.budget, jnp.int32), *paged_args)
         else:
             # the drafter's corpus row: the full-width padded prompt
             # (the admit program appends the prefill token in-trace)
@@ -719,9 +924,15 @@ class ServingEngine:
                 self._cache, self._tok, self._finished, self._steps,
                 self._budget, self._out_buf,
                 jnp.asarray(slot, jnp.int32), row_cache, tok, fin,
-                jnp.asarray(req.budget, jnp.int32), self._tok_buf,
-                self._tok_len, jnp.asarray(ids_row),
+                jnp.asarray(req.budget, jnp.int32), *paged_args,
+                self._tok_buf, self._tok_len, jnp.asarray(ids_row),
                 jnp.asarray(req.prompt.size, jnp.int32))
+        if self._alloc is not None:
+            # the row now references its pages; register the prompt's
+            # full pages so later identical prefixes hit them
+            self._pending_pages.pop(req.id)
+            self._alloc.register(plan, pages)
+            self._row_pages[slot] = pages
         if self._slot_used[slot]:
             self.stats["slots_reused"] += 1
         self._slot_used[slot] = True
@@ -797,6 +1008,7 @@ class ServingEngine:
                 self._complete(req, toks)
                 self._slots[i] = None   # freed in place; next admission
                 #                         overwrites the row
+                self._free_slot_pages(i)
             elif req.deadline is not None and now > req.deadline:
                 self._evict(i, req, "deadline", int(steps[i]))
             elif req.traced:
@@ -810,12 +1022,14 @@ class ServingEngine:
             for req in list(self._queue):
                 if req.deadline is not None and now > req.deadline:
                     self._queue.remove(req)
+                    self._unblock_if(req)
                     self._cancel(req, "deadline")
             monitor.record_serve_queue_depth(len(self._queue))
         monitor.record_serve_slot_occupancy(
             sum(s is not None for s in self._slots) / self.max_batch)
         if monitor.enabled:
             monitor.record_cache_occupancy(self._cache.occupancy())
+            self._drain_page_stats()
 
     def _complete(self, req: Request, toks: np.ndarray):
         eos = self._cfg.eos_token_id
@@ -857,7 +1071,44 @@ class ServingEngine:
             req.tokens = row[:n_done].astype(np.int32)
             req.n_emitted = n_done
         self._slots[slot] = None
+        self._free_slot_pages(slot)
         self._cancel(req, reason)
+
+    # ------------------------------------------------- page bookkeeping
+    def _free_slot_pages(self, slot: int):
+        """Return a terminal slot's page references to the allocator
+        (pages referenced by other rows or cached in the prefix
+        registry stay resident — that is the sharing)."""
+        if self._alloc is None:
+            return
+        pages, self._row_pages[slot] = self._row_pages[slot], None
+        if pages:
+            self._alloc.free_row(pages)
+
+    def _release_pending(self, req: Request):
+        """Roll back a committed page plan whose admission failed."""
+        if self._alloc is None:
+            return
+        ent = self._pending_pages.pop(req.id, None)
+        if ent is not None:
+            self._alloc.free_row(ent[0])
+
+    def _drain_page_stats(self):
+        """Forward the allocator's lifetime counters into the metrics
+        registry as deltas (called at the poll cadence — host ints
+        only, no device sync)."""
+        if self._alloc is None:
+            return
+        stats = dict(self._alloc.stats)
+        prev, self._page_seen = self._page_seen, stats
+        delta = {k: stats[k] - prev.get(k, 0) for k in stats}
+        monitor.record_paged_cache(
+            allocated=delta["pages_allocated"],
+            freed=delta["pages_freed"],
+            prefix_hits=delta["prefix_hits"],
+            shared_pages=delta["shared_pages"],
+            cow_copies=delta["cow_copies"])
+        monitor.record_page_occupancy(self._alloc.page_occupancy())
 
     # -------------------------------------------------------- front-end
     def _submit_item(self, item) -> Request:
@@ -945,6 +1196,9 @@ class ServingEngine:
                 self._shutdown = True
                 queued, self._queue = \
                     list(self._queue), collections.deque()
+                if self._alloc is not None:
+                    self._blocked_key = None
+                    self._page_blocked = False
                 monitor.record_serve_queue_depth(0)
             if flight_recorder.enabled and not already:
                 flight_recorder.record(
@@ -970,6 +1224,8 @@ class ServingEngine:
                 if req is not None:
                     self._evict(i, req, "shutdown", int(steps[i]))
             monitor.record_serve_slot_occupancy(0.0)
+            if monitor.enabled:
+                self._drain_page_stats()
             if flight_recorder.enabled and not already:
                 flight_recorder.record("serve.drain_end")
 
@@ -1036,18 +1292,41 @@ class ServingEngine:
         with self._qlock:
             depth = len(self._queue)
         busy = sum(s is not None for s in self._slots)
+        paged = self._alloc is not None
+        # what the queue head is actually waiting on: "pages" = the
+        # pool could not cover its plan (MEMORY pressure — more HBM or
+        # fewer/shorter requests would help), "slots" = every decode
+        # lane is busy (ADMISSION capacity — another replica would
+        # help). The distinction is what the multi-replica router
+        # routes on; it also suffixes the 503 reason below.
+        blocked_on = None
+        if depth:
+            if paged and self._page_blocked:
+                blocked_on = "pages"
+            elif busy >= self.max_batch:
+                blocked_on = "slots"
         reasons = []
         if self._shutdown:
             reasons.append("draining")
         if not self._warm:
             reasons.append("warming")
         if depth >= self.max_queue:
-            reasons.append("queue_full")
+            # suffix the blocker only when it is actually known — a
+            # submit burst can fill the queue between scheduler steps
+            # while slots are still free
+            reasons.append("queue_full" if blocked_on is None
+                           else f"queue_full:no_free_{blocked_on}")
         return {
             "ready": not reasons,
             **({"reason": ",".join(reasons)} if reasons else {}),
             "queue_depth": depth, "max_queue": self.max_queue,
+            "queue_blocked_on": blocked_on,
             "slots_busy": busy, "max_batch": self.max_batch,
+            "free_slots": self.max_batch - busy,
+            **({"free_pages": self._alloc.free_pages(),
+                "total_pages": self._alloc.n_pages - 1,
+                "page_occupancy": round(
+                    self._alloc.page_occupancy(), 4)} if paged else {}),
             "warm": self._warm, "draining": self._shutdown,
         }
 
@@ -1082,6 +1361,12 @@ class ServingEngine:
         tok_a, row_cache_a, _, fin_a = \
             reports[("prefill", self.buckets[0])].out_shape
         scalar = sds((), jnp.int32)
+        # the paged admit carries the row's page table + install start
+        # after row_budget; its donation set is the same (the pool
+        # pytree and every lane stay in place across admissions)
+        paged_a = () if self._alloc is None else (
+            sds((self.pages_per_row,), jnp.int32), scalar)
+        spec_buf = self._spec_admit_buf
         if self._spec is None:
             reports["decode"] = _audit(
                 self._step_fn, state, self._tok, self._cache, self._key,
@@ -1092,7 +1377,7 @@ class ServingEngine:
             reports["admit"] = _audit(
                 self._admit_fn, self._cache, self._tok, self._finished,
                 self._steps, self._budget, self._out_buf, scalar,
-                row_cache_a, tok_a, fin_a, scalar,
+                row_cache_a, tok_a, fin_a, scalar, *paged_a,
                 donate=(0, 1, 2, 3, 4, 5, 7), name=f"{base}.admit",
                 **audit_kw)
         else:
@@ -1109,9 +1394,10 @@ class ServingEngine:
             reports["admit"] = _audit(
                 self._admit_fn, self._cache, self._tok, self._finished,
                 self._steps, self._budget, self._out_buf, scalar,
-                row_cache_a, tok_a, fin_a, scalar, self._tok_buf,
-                self._tok_len, sds((self.max_len,), jnp.int32), scalar,
-                donate=(0, 1, 2, 3, 4, 5, 7, 11, 12),
+                row_cache_a, tok_a, fin_a, scalar, *paged_a,
+                self._tok_buf, self._tok_len,
+                sds((self.max_len,), jnp.int32), scalar,
+                donate=(0, 1, 2, 3, 4, 5, 7) + spec_buf,
                 name=f"{base}.admit", **audit_kw)
         reports["free"] = _audit(
             self._free_fn, self._cache, self._finished, scalar,
@@ -1122,7 +1408,10 @@ class ServingEngine:
         occ = sum(s is not None for s in self._slots)
         with self._qlock:
             q = len(self._queue)
+        paged = "" if self._alloc is None else \
+            (f", pages={self._alloc.used_pages()}"
+             f"/{self._alloc.n_pages - 1}x{self.page_size}")
         return (f"ServingEngine(slots={occ}/{self.max_batch}, "
                 f"queued={q}, buckets={self.buckets}, "
-                f"cache_len={self.max_len}, "
+                f"cache_len={self.max_len}{paged}, "
                 f"warm={self._warm}, shutdown={self._shutdown})")

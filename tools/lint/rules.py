@@ -23,6 +23,7 @@ HOST_SYNC_HOT_PATHS = frozenset({
     "paddle_tpu/io/device_prefetch.py",
     "paddle_tpu/generation/api.py",
     "paddle_tpu/generation/kv_cache.py",
+    "paddle_tpu/generation/paged_cache.py",
     "paddle_tpu/generation/attention.py",
     "paddle_tpu/generation/speculative.py",
     "paddle_tpu/hapi/model.py",
