@@ -408,6 +408,14 @@ def bench_moe_block(dev, on_tpu):
     }
 
 
+def _metric_counter(name):
+    """Current value of one registry counter (0 when never recorded) —
+    the delta reader behind every PR-10 counters sub-dict."""
+    from paddle_tpu.profiler import metrics as _metrics
+    snap = _metrics.snapshot().get(name)
+    return int(snap["value"]) if snap else 0
+
+
 def _bench_spec_rows(model, draft, on_tpu, new_tokens):
     """Speculative-decode comparison rows (ISSUE-11): batch-1 greedy
     decode — the latency-bound regime speculation targets — off vs
@@ -417,14 +425,10 @@ def _bench_spec_rows(model, draft, on_tpu, new_tokens):
     decode tokens/sec, accept_rate from the gen.spec.* counters, and
     its own post-warmup retrace counters — the PR-10 sub-dict proving
     the timed pass dispatched warm executables only."""
-    from paddle_tpu.profiler import metrics as _metrics
     rng = np.random.RandomState(0)
     motif = rng.randint(0, model.cfg.vocab_size, 16)
     ids = np.tile(motif, 32)[None, :512].astype(np.int32)  # batch 1
-
-    def counter(name):
-        snap = _metrics.snapshot().get(name)
-        return int(snap["value"]) if snap else 0
+    counter = _metric_counter
 
     def run(label, **kw):
         model.generate(ids, max_new_tokens=new_tokens, **kw)  # warmup
@@ -461,12 +465,79 @@ def _bench_spec_rows(model, draft, on_tpu, new_tokens):
     return rows
 
 
+def _bench_precision_rows(model, on_tpu, ids, new_tokens):
+    """Per-precision decode rows (ISSUE-13): the same prompt batch
+    decoded with the full-width cache, the int8 KV cache (fused
+    in-kernel dequant), and the int8-cache + int4-weight serving
+    engine (the only surface that owns a weight path). Each row
+    carries decode tokens/sec and the PR-10 counters sub-dict proving
+    the timed pass dispatched warm programs only."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config
+    from paddle_tpu.inference.config import PrecisionType
+    from paddle_tpu.serving import RequestParams, ServingEngine
+
+    b = ids.shape[0]
+    counter = _metric_counter
+
+    def timed(fn, tokens):
+        fn()  # warmup (compiles once)
+        before = {k: counter(k) for k in
+                  ("jit.compile.total", "jit.compile{cause=new_shape}")}
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        return {
+            "tokens_per_sec": round(tokens / dt, 1),
+            "counters": {k: counter(k) - before[k] for k in before},
+        }
+
+    wide = "bfloat16" if on_tpu else "float32"
+    rows = {"batch": b, "new_tokens": new_tokens, "wide_dtype": wide}
+    rows[wide] = timed(
+        lambda: model.generate(ids, max_new_tokens=new_tokens),
+        b * new_tokens)
+    rows["int8-kv"] = timed(
+        lambda: model.generate(ids, max_new_tokens=new_tokens,
+                               kv_cache_dtype="int8"),
+        b * new_tokens)
+
+    # int8-kv + int4 weight-only: through the engine (weights pack two
+    # nibbles per byte, dequant in-trace; cache int8, dequant in-kernel)
+    bucket = ids.shape[1]
+    spec = [paddle.to_tensor(np.zeros((b, 64), np.int32))]
+    cfg = (Config().from_layer(model, spec)
+           .enable_generation(max_new_tokens=new_tokens,
+                              prefill_buckets=(bucket,), max_batch=b,
+                              kv_cache_dtype="int8")
+           .enable_serving(max_queue=2 * b, weight_bits=4))
+    cfg.precision = PrecisionType.Int8
+    engine = ServingEngine(cfg, poll_every=4)
+
+    def engine_pass():
+        hs = [engine.submit(ids[i], RequestParams(
+            max_new_tokens=new_tokens)) for i in range(b)]
+        while engine.busy:
+            engine.step()
+        assert all(h.status.value == "completed" for h in hs)
+
+    rows["int8-kv+int4-w"] = timed(engine_pass, b * new_tokens)
+    engine.shutdown()
+    for label in (wide, "int8-kv", "int8-kv+int4-w"):
+        rows[label]["speedup_vs_wide"] = round(
+            rows[label]["tokens_per_sec"] / rows[wide]["tokens_per_sec"],
+            2)
+    return rows
+
+
 def bench_decode(dev, on_tpu):
     """Serving-trajectory bench: prefill 512 + decode 128 on test-tiny
     GPT (ISSUE-6 decode mode). Reports decode tokens/sec (pipelined
     host loop, no per-token sync) plus p50/p95 per-token latency from a
-    second, per-step-synced pass, and the ISSUE-11 speculative rows
-    (off / self-spec / draft-model at batch 1) as the "spec" sub-dict.
+    second, per-step-synced pass, the ISSUE-11 speculative rows
+    (off / self-spec / draft-model at batch 1) as the "spec" sub-dict,
+    and the ISSUE-13 per-precision rows (wide / int8-kv /
+    int8-kv+int4-w) as the "precision" sub-dict.
     vs_baseline is 1.0 by definition — this row DEFINES the decode
     baseline from this revision on."""
     import os
@@ -520,6 +591,8 @@ def bench_decode(dev, on_tpu):
     draft = gpt("test-tiny-draft", max_position_embeddings=1024)
     draft.bfloat16() if on_tpu else None
     spec = _bench_spec_rows(model, draft, on_tpu, new_tokens)
+    precision = _bench_precision_rows(model, on_tpu, ids, new_tokens)
+    wide = precision["wide_dtype"]
     return {
         "metric": f"test-tiny decode tokens/sec/chip (b{b} "
                   f"prefill{prefill_len}+decode{new_tokens}, "
@@ -528,11 +601,14 @@ def bench_decode(dev, on_tpu):
                   f"ngram={spec['ngram']['tokens_per_sec']} "
                   f"({spec['ngram']['speedup_vs_off']}x, accept "
                   f"{spec['ngram'].get('accept_rate', 0)}), "
+                  f"int8-kv {precision['int8-kv']['speedup_vs_wide']}x "
+                  f"vs {wide}, "
                   f"device={dev.device_kind})",
         "value": round(decode_tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": 1.0,
         "spec": spec,
+        "precision": precision,
     }
 
 
@@ -595,21 +671,26 @@ def bench_serve_shared_prefix(dev, on_tpu):
     budgets = rng.randint(max(4, max_new // 2), max_new + 1, size=n_req)
     gaps = rng.exponential(1.0 / rate, size=n_req)
 
-    def run(paged):
+    def run(paged, kv_dtype=None, slots=None, kv_pages=None):
         spec = [paddle.to_tensor(np.zeros((dense_batch, 64), np.int32))]
         cfg = (Config().from_layer(model, spec)
                .enable_generation(max_new_tokens=max_new,
                                   prefill_buckets=(bucket,),
-                                  max_batch=paged_batch if paged
-                                  else dense_batch))
+                                  max_batch=slots if slots else (
+                                      paged_batch if paged
+                                      else dense_batch),
+                                  kv_cache_dtype=kv_dtype))
         if paged:
             # EQUAL cache HBM: the pool holds exactly the dense
             # engine's dense_batch * max_len tokens (plus the reserved
-            # null page); 4x the decode slots share it
+            # null page); 4x the decode slots share it. An int8 run
+            # passes its own kv_pages (the same BYTE budget buys ~2x
+            # bf16 / ~3.6x fp32 the pages) + a wider slot set.
             max_len = _round_up(bucket + max_new)
-            cfg.enable_serving(max_queue=n_req, paged=True,
-                               kv_page_size=page,
-                               kv_pages=dense_batch * max_len // page + 1)
+            cfg.enable_serving(
+                max_queue=n_req, paged=True, kv_page_size=page,
+                kv_pages=kv_pages if kv_pages
+                else dense_batch * max_len // page + 1)
         else:
             cfg.enable_serving(max_queue=n_req)
         engine = ServingEngine(cfg, poll_every=2)
@@ -651,18 +732,43 @@ def bench_serve_shared_prefix(dev, on_tpu):
     assert paged_r["prefix_hits"] > 0, "shared-prefix traffic never hit"
     ratio = paged_r["peak"] / dense["peak"]
     max_len = _round_up(bucket + max_new)
+
+    # ISSUE-13 equal-HBM int8 row: the SAME cache byte budget spent on
+    # int8 pages (values 1 byte + bf16 scale per (position, head))
+    # instead of wide ones buys ~2x (bf16) / ~3.6x (fp32) the pages —
+    # the acceptance gate is >= 1.8x the wide-paged concurrent
+    # capacity. Slots widen with the pages so the page capacity, not
+    # the lane count, is what saturates first.
+    h = model.cfg.num_heads
+    d = model.cfg.hidden_size // h
+    wide_itemsize = 2 if on_tpu else 4
+    tok_wide = 2 * h * d * wide_itemsize          # k+v bytes/token
+    tok_int8 = 2 * (h * d + h * 2)                # + bf16 scales
+    hbm_budget = dense_batch * max_len * tok_wide
+    int8_pages = hbm_budget // (page * tok_int8)
+    int8_r = run(paged=True, kv_dtype="int8", slots=2 * paged_batch,
+                 kv_pages=int(int8_pages) + 1)
+    assert int8_r["prefix_hits"] > 0
+    int8_vs_wide = int8_r["peak"] / paged_r["peak"]
+
     return {
         "metric": f"test-tiny paged-KV capacity at equal HBM "
                   f"({dense_batch * max_len} cache tokens, page {page}, "
                   f"{n_sys} shared {sys_len}-tok system prompts, "
                   f"poisson@{rate:g}/s): peak {paged_r['peak']} vs "
-                  f"{dense['peak']} concurrent (device={dev.device_kind})",
+                  f"{dense['peak']} concurrent; int8 pages "
+                  f"{int8_r['peak']} = {int8_vs_wide:.2f}x wide pages "
+                  f"(device={dev.device_kind})",
         "value": round(ratio, 2),
         "unit": "x concurrent capacity",
         "vs_baseline": round(ratio / 2.0, 2),   # gate: > 2x -> >= 1.0
         "paged": {"dense": dense, "paged": paged_r,
                   "hbm_cache_tokens": dense_batch * max_len,
                   "page_size": page, "conserved": True},
+        "int8": {**int8_r, "pages": int(int8_pages),
+                 "wide_pages": dense_batch * max_len // page,
+                 "vs_wide_pages": round(int8_vs_wide, 2),
+                 "gate_1_8x": round(int8_vs_wide / 1.8, 2)},
     }
 
 
@@ -686,6 +792,8 @@ def bench_serve(dev, on_tpu):
     from paddle_tpu.models.gpt import gpt
     from paddle_tpu.serving import RequestParams, ServingEngine
 
+    from paddle_tpu.inference.config import PrecisionType
+
     n_req = int(os.environ.get("BENCH_SERVE_REQUESTS",
                                96 if on_tpu else 32))
     rate = float(os.environ.get("BENCH_SERVE_RATE", 64.0))  # req/sec
@@ -696,12 +804,6 @@ def bench_serve(dev, on_tpu):
     model = gpt("test-tiny", max_position_embeddings=1024)
     model.bfloat16() if on_tpu else None
     spec = [paddle.to_tensor(np.zeros((max_batch, 64), np.int32))]
-    cfg = (Config().from_layer(model, spec)
-           .enable_generation(max_new_tokens=max_new,
-                              prefill_buckets=(32, 64, 128),
-                              max_batch=max_batch)
-           .enable_serving(max_queue=n_req))
-    engine = ServingEngine(cfg, poll_every=2)  # warmup compiles here
 
     rng = np.random.RandomState(0)
     prompts = [rng.randint(0, model.cfg.vocab_size,
@@ -710,28 +812,69 @@ def bench_serve(dev, on_tpu):
     budgets = rng.randint(max(4, max_new // 4), max_new + 1,
                           size=n_req)
     gaps = rng.exponential(1.0 / rate, size=n_req)
-    handles = []
 
-    def feeder():
-        for p, b, g in zip(prompts, budgets, gaps):
-            time.sleep(g)
-            handles.append(engine.submit(
-                p, RequestParams(max_new_tokens=int(b))))
+    counter = _metric_counter
 
-    t0 = time.perf_counter()
-    th = threading.Thread(target=feeder, daemon=True)
-    th.start()
-    while th.is_alive() or engine.busy:
-        if engine.busy:
-            engine.step()
-        else:
-            time.sleep(0.0002)
-    dt = time.perf_counter() - t0
-    th.join()
+    def traffic(engine):
+        """One Poisson pass of the shared request set; returns
+        (qps, handles, counters-delta)."""
+        handles = []
 
-    assert len(handles) == n_req and \
-        all(h.status.value == "completed" for h in handles)
-    qps = n_req / dt
+        def feeder():
+            for p, b, g in zip(prompts, budgets, gaps):
+                time.sleep(g)
+                handles.append(engine.submit(
+                    p, RequestParams(max_new_tokens=int(b))))
+
+        before = {k: counter(k) for k in
+                  ("jit.compile.total", "jit.compile{cause=new_shape}")}
+        t0 = time.perf_counter()
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        while th.is_alive() or engine.busy:
+            if engine.busy:
+                engine.step()
+            else:
+                time.sleep(0.0002)
+        dt = time.perf_counter() - t0
+        th.join()
+        assert len(handles) == n_req and \
+            all(h.status.value == "completed" for h in handles)
+        return n_req / dt, handles, \
+            {k: counter(k) - before[k] for k in before}
+
+    def build(kv_dtype=None, weight_bits=None):
+        cfg = (Config().from_layer(model, spec)
+               .enable_generation(max_new_tokens=max_new,
+                                  prefill_buckets=(32, 64, 128),
+                                  max_batch=max_batch,
+                                  kv_cache_dtype=kv_dtype)
+               .enable_serving(max_queue=n_req,
+                               weight_bits=weight_bits))
+        if weight_bits:
+            cfg.precision = PrecisionType.Int8
+        return ServingEngine(cfg, poll_every=2)  # warmup compiles here
+
+    engine = build()
+    qps, handles, _ = traffic(engine)
+
+    # ISSUE-13 per-precision rows: the SAME traffic against the int8-KV
+    # engine and the int8-KV + int4-weight engine (counters prove the
+    # timed pass ran warm)
+    wide = "bfloat16" if on_tpu else "float32"
+    precision = {"wide_dtype": wide}
+    for label, kw in ((wide, {}),
+                      ("int8-kv", dict(kv_dtype="int8")),
+                      ("int8-kv+int4-w",
+                       dict(kv_dtype="int8", weight_bits=4))):
+        eng = engine if not kw else build(**kw)
+        q2, _, ctr = traffic(eng)
+        precision[label] = {"qps": round(q2, 1), "counters": ctr}
+        if kw:
+            eng.shutdown()
+    for label in (wide, "int8-kv", "int8-kv+int4-w"):
+        precision[label]["vs_wide"] = round(
+            precision[label]["qps"] / precision[wide]["qps"], 2)
     ttft = np.array([h.ttft for h in handles]) * 1e3        # ms
     per_tok = np.array([h.per_token_latency for h in handles
                         if h.per_token_latency is not None]) * 1e3
@@ -750,11 +893,13 @@ def bench_serve(dev, on_tpu):
                   f"poisson@{rate:g}/s, ttft p50={sla['ttft_ms'][50]}ms "
                   f"p99={sla['ttft_ms'][99]}ms, token p50="
                   f"{sla['token_ms'][50]}ms p99={sla['token_ms'][99]}ms, "
-                  f"device={dev.device_kind})",
+                  f"int8-kv {precision['int8-kv']['vs_wide']}x vs "
+                  f"{wide}, device={dev.device_kind})",
         "value": round(qps, 1),
         "unit": "req/sec",
         "vs_baseline": 1.0,
         "sla": sla,
+        "precision": precision,
     }
 
 

@@ -24,10 +24,11 @@ framework lint: ``python -m tools.lint paddle_tpu tests``.
 """
 from .auditor import (AuditError, AuditReport, Finding, Severity,
                       abstractify, audit, cross_check_collectives)
-from .detectors import AuditContext, DETECTORS, register_detector
+from .detectors import (AuditContext, DETECTORS, register_dequant_site,
+                        register_detector)
 
 __all__ = [
     "AuditContext", "AuditError", "AuditReport", "DETECTORS", "Finding",
     "Severity", "abstractify", "audit", "cross_check_collectives",
-    "register_detector",
+    "register_dequant_site", "register_detector",
 ]

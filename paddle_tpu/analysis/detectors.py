@@ -21,6 +21,15 @@ machine-checked rules instead of one bespoke runtime test each:
   constants     literal consts baked into the program over a byte
                 budget (compile bloat; usually a captured array that
                 should have been an argument)
+  quant_escape  a quantized (int8/uint8/int4) buffer widened to a
+                float dtype OUTSIDE a registered dequant site
+                (WARNING): the int8 KV cache and packed int4 weights
+                are sanctioned low-bit storage whose ONLY legal exit
+                is the fused dequant in the decode kernels /
+                precision.materialize — any other wide consumer is
+                either missing its scales (silently wrong numerics)
+                or re-widening storage the quantization exists to
+                keep narrow
   collectives   per-mesh-axis collective payload bytes, statically
                 accounted for cross-checking against the runtime
                 ``comm.bytes{axis=...}`` counters (PR 2)
@@ -252,6 +261,80 @@ def detect_baked_constants(ctx: AuditContext) -> List[Finding]:
     return findings
 
 
+# ------------------------------------------------------- quant escapes
+
+# integer storage dtypes the low-bit serving paths use; a float value
+# computed FROM one of these is a dequantization
+_QUANT_DTYPE_NAMES = frozenset({"int8", "uint8", "int4", "uint4"})
+
+#: source substrings where int8/int4 -> float widening is sanctioned:
+#: the decode kernels' fused dequant, the serving-precision
+#: materialize, and the quantization package's own dequant helpers.
+#: Project code adding a dequant site registers it here.
+QUANT_DEQUANT_SITES = {
+    "kernels/flash_attention.py", "inference/precision.py",
+    "quantization/int8_compute.py", "quantization/fake_quant.py",
+    "quantization/ptq.py", "generation/kv_cache.py",
+    "generation/paged_cache.py",
+}
+
+
+def register_dequant_site(source_substring: str) -> str:
+    """Sanction a source location (file-path substring matched against
+    each finding's ``file.py:line`` provenance) as a legal
+    quantized-to-wide dequant site; ``dtype.quant_escape`` stops
+    firing there. Returns the substring for decorator-ish use."""
+    QUANT_DEQUANT_SITES.add(str(source_substring))
+    return source_substring
+
+
+def detect_quant_escape(ctx: AuditContext) -> List[Finding]:
+    """A quantized buffer (int8/int4 — the KV cache pools, packed
+    weights) consumed into a FLOAT result outside a registered dequant
+    site. Integer-world ops (scatter writes into the cache, page
+    gathers, nibble shifts, int8 MXU dots accumulating int32) pass
+    freely; the moment a quantized value widens to float anywhere but
+    the sanctioned sites, the scales are almost certainly missing —
+    WARNING, so the audit gate stays meaningful without blocking
+    legitimate new dequant sites (register them)."""
+    findings = []
+    for eqn, _, _ in walk_eqns(ctx.closed_jaxpr):
+        quant_in = False
+        for v in eqn.invars:
+            dt = _np_dtype(getattr(v.aval, "dtype", None))
+            if dt is not None and dt.name in _QUANT_DTYPE_NAMES:
+                quant_in = True
+                break
+        if not quant_in:
+            continue
+        # name-based float check: np.issubdtype(bfloat16, floating) is
+        # FALSE (ml_dtypes extension type), and bf16 is exactly the
+        # wide dtype TPU serving dequantizes into — the same gap
+        # detect_dtype_leaks works around by name
+        out_float = False
+        for v in eqn.outvars:
+            dt = _np_dtype(getattr(v.aval, "dtype", None))
+            if dt is not None and (np.issubdtype(dt, np.floating)
+                                   or dt.name == "bfloat16"):
+                out_float = True
+                break
+        if not out_float:
+            continue
+        src = source_of(eqn) or ""
+        if any(site in src for site in QUANT_DEQUANT_SITES):
+            continue
+        findings.append(Finding(
+            "dtype.quant_escape", Severity.WARNING,
+            f"{eqn.primitive.name} widens a quantized (int8/int4) "
+            "buffer to float outside a registered dequant site — the "
+            "dequant scales are probably missing; route through the "
+            "fused kernel/materialize paths or "
+            "analysis.register_dequant_site() the new site",
+            source=src or None,
+            data={"primitive": eqn.primitive.name}))
+    return findings
+
+
 # ------------------------------------------------- collective accounting
 
 def detect_collectives(ctx: AuditContext) -> List[Finding]:
@@ -295,6 +378,7 @@ DETECTORS: Dict[str, DetectorFn] = {
     "host_sync": detect_host_callbacks,
     "dtype": detect_dtype_leaks,
     "constants": detect_baked_constants,
+    "quant_escape": detect_quant_escape,
     "collectives": detect_collectives,
 }
 

@@ -40,7 +40,10 @@ Metric name scheme (what the summary views group by):
     gen.tokens / gen.prefill_steps / gen.decode_steps   generation loop
     gen.cache_occupancy         gauge: KV cache fraction in use
     gen.cache.pages_allocated / .pages_freed   paged-pool allocator churn
+    gen.cache.quant.bytes_saved HBM bytes the int8 KV cache saved vs wide
+    gen.cache.quant.scale_clips int8 saturations during cache quantization
     serve.cache.page_occupancy  gauge: referenced pages / pool
+    serve.cache.kv_dtype        info gauge: the served cache dtype label
     serve.cache.prefix_hits / .prefix_shared_pages / .cow_copies
                                 shared-prefix reuse at admission
     gen.spec.proposed / .accepted   speculative draft tokens in/out of
@@ -89,10 +92,12 @@ DECLARED_METRICS = frozenset({
     "gen.tokens", "gen.prefill_steps", "gen.decode_steps",
     "gen.cache_occupancy",
     "gen.cache.pages_allocated", "gen.cache.pages_freed",
+    "gen.cache.quant.bytes_saved", "gen.cache.quant.scale_clips",
     "gen.spec.proposed", "gen.spec.accepted", "gen.spec.accept_rate",
     "serve.requests", "serve.queue_depth", "serve.ttft",
     "serve.token_latency", "serve.slot_occupancy", "serve.cancellations",
-    "serve.cache.page_occupancy", "serve.cache.prefix_hits",
+    "serve.cache.page_occupancy", "serve.cache.kv_dtype",
+    "serve.cache.prefix_hits",
     "serve.cache.prefix_shared_pages", "serve.cache.cow_copies",
     "analysis.findings",
     "telemetry.scrapes", "flightrecorder.dumps",
@@ -209,6 +214,18 @@ METRIC_DOC = {
                               "paged-KV pool pages returned to the "
                               "free list (request completion/eviction "
                               "and prefix-registry reclaims)"),
+    "gen.cache.quant.bytes_saved": ("counter", (),
+                                    "HBM bytes the int8 KV cache "
+                                    "avoided holding vs the wide dtype "
+                                    "(values + bf16 scale sidecars "
+                                    "accounted; per cache build)"),
+    "gen.cache.quant.scale_clips": ("counter", (),
+                                    "KV values that saturated the int8 "
+                                    "range during cache quantization — "
+                                    "structurally 0 under the absmax "
+                                    "scale scheme (gated in tier-1); "
+                                    "nonzero means a scale scheme "
+                                    "change started clipping"),
     "gen.spec.proposed": ("counter", (),
                           "draft tokens proposed to speculative verify "
                           "(k per live row per window)"),
@@ -238,6 +255,10 @@ METRIC_DOC = {
                                    "paged-KV pool pressure: pages "
                                    "referenced by live rows / pool "
                                    "size (excl. the null page)"),
+    "serve.cache.kv_dtype": ("gauge", ("dtype",),
+                             "info gauge (value 1): the KV-cache "
+                             "storage dtype this engine serves (int8 "
+                             "| float32 | bfloat16 | ...)"),
     "serve.cache.prefix_hits": ("counter", (),
                                 "admissions whose prompt prefix "
                                 "hash-matched registered pages (shared "
@@ -548,6 +569,32 @@ def record_paged_cache(allocated: int = 0, freed: int = 0,
             int(shared_pages))
     if cow_copies:
         metrics.counter("serve.cache.cow_copies").inc(int(cow_copies))
+
+
+def record_kv_quant(bytes_saved: int = 0, scale_clips: int = 0):
+    """Quantized-KV-cache accounting: HBM bytes the int8 storage saved
+    vs the wide dtype (recorded once per cache build/admission — host
+    arithmetic over shapes), and int8 saturations observed since the
+    last record (the engine drains the in-cache counter at its poll
+    cadence; generate() records once per call)."""
+    if not enabled:
+        return
+    if bytes_saved:
+        metrics.counter("gen.cache.quant.bytes_saved").inc(
+            int(bytes_saved))
+    if scale_clips:
+        metrics.counter("gen.cache.quant.scale_clips").inc(
+            int(scale_clips))
+
+
+def record_kv_dtype(dtype_label: str):
+    """Info gauge naming the KV-cache storage dtype an engine serves
+    (value pinned 1; the label carries the information — the item-1
+    router reads it beside the capacity numbers)."""
+    if not enabled:
+        return
+    metrics.gauge("serve.cache.kv_dtype",
+                  dtype=str(dtype_label)).set(1.0)
 
 
 def record_page_occupancy(frac: float):

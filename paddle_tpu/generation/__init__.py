@@ -5,9 +5,10 @@ flash attention, sampling, and the jitted (prefill, decode) pair behind
 See docs/architecture.md "Generation & KV cache".
 """
 from .api import GenerationConfig, GenerationSession, generate  # noqa: F401
-from .kv_cache import KVCache  # noqa: F401
+from .kv_cache import (KVCache, QuantKVCache,  # noqa: F401
+                       quantize_kv, resolve_cache_dtype)
 from .paged_cache import (AdmissionPlan, PageAllocator,  # noqa: F401
-                          PagedKVCache)
+                          PagedKVCache, QuantPagedKVCache)
 from .sampling import (apply_temperature, apply_top_k,  # noqa: F401
                        apply_top_p, sample)
 from .speculative import (SpeculativeConfig,  # noqa: F401
@@ -15,7 +16,9 @@ from .speculative import (SpeculativeConfig,  # noqa: F401
 
 __all__ = [
     "GenerationConfig", "GenerationSession", "generate", "KVCache",
-    "PagedKVCache", "PageAllocator", "AdmissionPlan",
+    "QuantKVCache", "quantize_kv", "resolve_cache_dtype",
+    "PagedKVCache", "QuantPagedKVCache", "PageAllocator",
+    "AdmissionPlan",
     "sample", "apply_temperature", "apply_top_k", "apply_top_p",
     "SpeculativeConfig", "SpeculativeSession", "ngram_propose",
     "spec_accept",

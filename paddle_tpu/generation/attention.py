@@ -25,6 +25,11 @@ def cached_attention(q, k, v, cache, layer_idx, *, decode: bool,
     if decode:
         s = q.shape[1]
         mask_len = cache.kv_len + s  # includes the new rows
+        # int8 cache (QuantKVCache/QuantPagedKVCache): the layer's
+        # scale sidecars ride as two extra operands — dequant fuses
+        # in-register, the wide cache is never materialized
+        scales = () if getattr(cache, "k_scale", None) is None else \
+            (cache.k_scale[layer_idx], cache.v_scale[layer_idx])
         if getattr(cache, "page_table", None) is not None:
             # paged cache: attend the pooled pages through the row's
             # page table (index-map indirection on TPU, gather+mask
@@ -33,19 +38,23 @@ def cached_attention(q, k, v, cache, layer_idx, *, decode: bool,
                 flash_attention_decode_paged
             out = dispatch(
                 "flash_attention_decode_paged",
-                lambda q_, kp, vp, pt, kl: flash_attention_decode_paged(
-                    q_, kp, vp, pt, kl),
+                lambda q_, kp, vp, pt, kl, *sc:
+                    flash_attention_decode_paged(
+                        q_, kp, vp, pt, kl,
+                        **(dict(k_scale=sc[0], v_scale=sc[1])
+                           if sc else {})),
                 (q, cache.k[layer_idx], cache.v[layer_idx],
-                 cache.page_table, mask_len), {},
+                 cache.page_table, mask_len) + scales, {},
                 differentiable=False)
             return out, cache
         from ..kernels.flash_attention import flash_attention_decode
         out = dispatch(
             "flash_attention_decode",
-            lambda q_, kc, vc, kl: flash_attention_decode(
-                q_, kc, vc, kl),
-            (q, cache.k[layer_idx], cache.v[layer_idx], mask_len), {},
-            differentiable=False)
+            lambda q_, kc, vc, kl, *sc: flash_attention_decode(
+                q_, kc, vc, kl,
+                **(dict(k_scale=sc[0], v_scale=sc[1]) if sc else {})),
+            (q, cache.k[layer_idx], cache.v[layer_idx], mask_len)
+            + scales, {}, differentiable=False)
     else:
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=causal,

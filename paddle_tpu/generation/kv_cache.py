@@ -20,6 +20,20 @@ carry under ``DistributedTrainStep`` — so hybrid-mesh models decode
 without resharding. ``shard(mesh)`` trims the spec to the axes the mesh
 actually has.
 
+Quantized mode (``cache_dtype="int8"``, ROADMAP item 4): at long
+context decode is bandwidth-bound on STREAMING the cache, so
+:class:`QuantKVCache` stores K/V as int8 with a bfloat16 scale per
+(position, head) in small sidecar arrays — half the HBM bytes per
+decode step (and double the rows a fixed pool holds, compounding with
+the paged cache). ``update`` quantizes IN-TRACE at write time (absmax
+over head_dim per appended token), and the decode kernels dequantize
+in-register: the K scale folds into the score-tile columns and the V
+scale into the softmax weights, so a wide cache is never materialized
+anywhere. A tiny ``clips`` counter rides the pytree recording values
+that saturated the int8 range (the bf16 scale rounding can clip a
+token's absmax element by <=0.4%) — drained into
+``gen.cache.quant.scale_clips``.
+
 Reference analog: the fused-multi-transformer decode ops' CacheKV
 tensors (paddle/fluid/operators/fused/fused_multi_transformer_op.cu);
 here the cache is a plain pytree the compiled step updates in place via
@@ -27,14 +41,84 @@ buffer donation.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+#: cache dtypes ``KVCache.create(cache_dtype=)`` accepts (None = the
+#: activation dtype, the full-width mode)
+CACHE_DTYPES = (None, "int8")
 
 
 def _raw(x):
     from ..core.tensor import Tensor
     return x._data if isinstance(x, Tensor) else x
+
+
+def validate_cache_dtype(value):
+    """Reject anything outside CACHE_DTYPES with the one shared error
+    (config knobs, cache constructors, and the resolver all call this
+    — one rule, one message)."""
+    if value not in CACHE_DTYPES:
+        raise ValueError(
+            f"kv_cache_dtype {value!r}: one of "
+            f"{[d for d in CACHE_DTYPES if d]} or None (full width)")
+    return value
+
+
+def resolve_cache_dtype(explicit=None):
+    """The effective KV-cache dtype: an explicit value wins (and is
+    validated — a typo'd config raises, never silently serves wide),
+    else ``PADDLE_KV_CACHE_DTYPE``; garbage in the env is recorded via
+    ``record_swallowed`` and falls back to full width (same contract as
+    PADDLE_KV_PAGE_SIZE)."""
+    if explicit is not None:
+        return validate_cache_dtype(explicit)
+    env = os.environ.get("PADDLE_KV_CACHE_DTYPE", "").strip().lower()
+    if not env or env in ("auto", "none", "off", "wide", "float"):
+        return None
+    if env in CACHE_DTYPES:
+        return env
+    from ..core import monitor
+    monitor.record_swallowed(
+        "generation.kv_cache_dtype",
+        ValueError(f"PADDLE_KV_CACHE_DTYPE={env!r}"))
+    return None
+
+
+def quantize_kv(x):
+    """Quantize fresh K or V values ``[..., heads, head_dim]`` to int8
+    with one bfloat16 scale per (..., head): ``scale = absmax/127``
+    (bf16-rounded — half the sidecar HBM of fp32, and the rounding
+    error is an order below the int8 step), ``q = round(x / scale)``
+    clipped to the int8 range. Returns ``(q int8, scale bf16, clips)``
+    where ``clips`` counts values that saturated past +-127 BEFORE the
+    clip — structurally 0 under round-to-nearest absmax scales (the
+    worst-case ratio is 127 * (1 + 2^-9) < 127.5), so a nonzero count
+    is the alarm that a future scale scheme (calibrated, EMA,
+    coarser-grained) actually saturates."""
+    xf = _raw(x).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)               # [..., heads]
+    scale = (jnp.maximum(absmax, 1e-6) / 127.0).astype(jnp.bfloat16)
+    q = jnp.round(xf / scale.astype(jnp.float32)[..., None])
+    clips = jnp.sum((jnp.abs(q) > 127.0).astype(jnp.int32))
+    q = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+    return q, scale, clips
+
+
+def _axis_trimmer(mesh):
+    """Trim partition-spec axes to the names ``mesh`` actually has."""
+    names = set(mesh.axis_names)
+
+    def trim(axes):
+        if isinstance(axes, tuple):
+            kept = tuple(a for a in axes if a in names)
+            return kept if kept else None
+        return axes if axes in names else None
+
+    return trim
 
 
 @jax.tree_util.register_pytree_node_class
@@ -73,14 +157,28 @@ class KVCache:
     def dtype(self):
         return self.k.dtype
 
+    @property
+    def cache_dtype(self):
+        """The declared low-bit storage mode (None = full width)."""
+        return None
+
     # ---------------------------------------------------------- creation
     @classmethod
     def create(cls, num_layers: int, batch: int, max_len: int,
                num_heads: int, head_dim: int, dtype=jnp.float32,
-               mesh=None) -> "KVCache":
+               mesh=None, cache_dtype=None) -> "KVCache":
         shape = (num_layers, batch, max_len, num_heads, head_dim)
-        cache = cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
-                    jnp.zeros((batch,), jnp.int32))
+        if validate_cache_dtype(cache_dtype) is not None:
+            sshape = (num_layers, batch, max_len, num_heads)
+            cache = QuantKVCache(
+                jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros(sshape, jnp.bfloat16),
+                jnp.zeros(sshape, jnp.bfloat16),
+                jnp.zeros((), jnp.int32))
+        else:
+            cache = cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                        jnp.zeros((batch,), jnp.int32))
         return cache.shard(mesh) if mesh is not None else cache
 
     @staticmethod
@@ -93,14 +191,7 @@ class KVCache:
         """Place the cache on ``mesh`` (spec trimmed to the axes the
         mesh has). Works both eagerly (device_put) and inside a trace
         (sharding constraint)."""
-        names = set(mesh.axis_names)
-
-        def trim(axes):
-            if isinstance(axes, tuple):
-                kept = tuple(a for a in axes if a in names)
-                return kept if kept else None
-            return axes if axes in names else None
-
+        trim = _axis_trimmer(mesh)
         spec = P(*(trim(ax) for ax in self.partition_spec()))
         kv_sh = NamedSharding(mesh, spec)
         len_sh = NamedSharding(mesh, P(trim(("dp", "sharding"))))
@@ -191,3 +282,116 @@ class KVCache:
     def __repr__(self):
         return (f"KVCache(layers={self.num_layers}, batch={self.batch}, "
                 f"max_len={self.max_len}, dtype={self.k.dtype})")
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantKVCache(KVCache):
+    """Int8 ring cache: K/V stored int8 with per-(position, head) bf16
+    scales in sidecar arrays ``k_scale``/``v_scale``
+    ([layers, batch, max_len, heads]) plus a scalar ``clips`` int32
+    counting int8 saturations. Same protocol as :class:`KVCache` —
+    ``update`` quantizes in-trace at write time, and the decode kernels
+    read the scale rows beside ``kv_len`` to dequantize in-register
+    (``kernels.flash_attention_decode(k_scale=, v_scale=)``). Scales
+    are per written position, so an append-only update never needs to
+    requantize earlier entries (a coarser running-absmax scale would),
+    and a row/page copy moves values + scales verbatim — admission
+    installs and COW privatizations stay bitwise."""
+
+    __slots__ = ("k_scale", "v_scale", "clips")
+
+    def __init__(self, k, v, kv_len, k_scale, v_scale, clips):
+        super().__init__(k, v, kv_len)
+        self.k_scale = k_scale
+        self.v_scale = v_scale
+        self.clips = clips
+
+    # ------------------------------------------------------------ pytree
+    def tree_flatten(self):
+        return (self.k, self.v, self.kv_len, self.k_scale, self.v_scale,
+                self.clips), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def cache_dtype(self):
+        return "int8"
+
+    def shard(self, mesh) -> "QuantKVCache":
+        trim = _axis_trimmer(mesh)
+        spec = P(*(trim(ax) for ax in self.partition_spec()))
+        kv_sh = NamedSharding(mesh, spec)
+        # scales: [layers, batch, max_len, heads] — same layout minus
+        # the head_dim axis
+        sc_sh = NamedSharding(mesh, P(*(trim(ax) for ax in
+                                        self.partition_spec()[:-1])))
+        len_sh = NamedSharding(mesh, P(trim(("dp", "sharding"))))
+        rep_sh = NamedSharding(mesh, P())
+        place = jax.lax.with_sharding_constraint \
+            if isinstance(self.k, jax.core.Tracer) else jax.device_put
+        return QuantKVCache(
+            place(self.k, kv_sh), place(self.v, kv_sh),
+            place(self.kv_len, len_sh), place(self.k_scale, sc_sh),
+            place(self.v_scale, sc_sh), place(self.clips, rep_sh))
+
+    # ------------------------------------------------------------ update
+    def update(self, layer: int, k_new, v_new, pos) -> "QuantKVCache":
+        """Quantize the fresh k/v (absmax per appended token x head) and
+        ring-write int8 values + bf16 scales at ``pos``; saturated
+        values bump ``clips``. Same contract as the wide cache."""
+        k_new, v_new = _raw(k_new), _raw(v_new)
+        pos = jnp.asarray(_raw(pos), jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (k_new.shape[0],))
+        steps = jnp.arange(k_new.shape[1], dtype=jnp.int32)
+        kq, ks, kc = quantize_kv(k_new)
+        vq, vs, vc = quantize_kv(v_new)
+
+        def write(buf, new, p):  # [T, ...], [S, ...], scalar
+            idx = (p + steps) % buf.shape[0]
+            return buf.at[idx].set(new.astype(buf.dtype))
+
+        k_l = jax.vmap(write)(self.k[layer], kq, pos)
+        v_l = jax.vmap(write)(self.v[layer], vq, pos)
+        ks_l = jax.vmap(write)(self.k_scale[layer], ks, pos)
+        vs_l = jax.vmap(write)(self.v_scale[layer], vs, pos)
+        return QuantKVCache(
+            self.k.at[layer].set(k_l), self.v.at[layer].set(v_l),
+            self.kv_len, self.k_scale.at[layer].set(ks_l),
+            self.v_scale.at[layer].set(vs_l), self.clips + kc + vc)
+
+    # -------------------------------------------------------- slot reuse
+    def reset_rows(self, rows) -> "QuantKVCache":
+        base = KVCache.reset_rows(self, rows)
+        return QuantKVCache(self.k, self.v, base.kv_len, self.k_scale,
+                            self.v_scale, self.clips)
+
+    def copy_row_from(self, src: "QuantKVCache", src_row,
+                      dst_row) -> "QuantKVCache":
+        """Slot admission: int8 values AND their scales copy verbatim —
+        no requantization, so an installed row decodes bitwise-equal to
+        its batch-1 prefill. ``src.clips`` (the prefill's saturation
+        count) folds into this cache's counter."""
+        src_row = jnp.asarray(_raw(src_row), jnp.int32)
+        dst_row = jnp.asarray(_raw(dst_row), jnp.int32)
+        return QuantKVCache(
+            self.k.at[:, dst_row].set(src.k[:, src_row]),
+            self.v.at[:, dst_row].set(src.v[:, src_row]),
+            self.kv_len.at[dst_row].set(src.kv_len[src_row]),
+            self.k_scale.at[:, dst_row].set(src.k_scale[:, src_row]),
+            self.v_scale.at[:, dst_row].set(src.v_scale[:, src_row]),
+            self.clips + src.clips)
+
+    def with_kv_len(self, kv_len) -> "QuantKVCache":
+        kv_len = jnp.asarray(_raw(kv_len), jnp.int32)
+        if kv_len.ndim == 0:
+            kv_len = jnp.broadcast_to(kv_len, (self.batch,))
+        return QuantKVCache(self.k, self.v, kv_len, self.k_scale,
+                            self.v_scale, self.clips)
+
+    def __repr__(self):
+        return (f"QuantKVCache(layers={self.num_layers}, "
+                f"batch={self.batch}, max_len={self.max_len}, "
+                f"dtype=int8+bf16-scales)")

@@ -54,9 +54,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .kv_cache import KVCache, _raw
+from .kv_cache import KVCache, _raw, quantize_kv, validate_cache_dtype
 
-__all__ = ["PagedKVCache", "PageAllocator", "AdmissionPlan"]
+__all__ = ["PagedKVCache", "QuantPagedKVCache", "PageAllocator",
+           "AdmissionPlan"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -116,12 +117,27 @@ class PagedKVCache:
     def dtype(self):
         return self.k.dtype
 
+    @property
+    def cache_dtype(self):
+        """The declared low-bit storage mode (None = full width)."""
+        return None
+
     # ---------------------------------------------------------- creation
     @classmethod
     def create(cls, num_layers: int, batch: int, n_pages: int,
                page_size: int, pages_per_row: int, num_heads: int,
-               head_dim: int, dtype=jnp.float32) -> "PagedKVCache":
+               head_dim: int, dtype=jnp.float32,
+               cache_dtype=None) -> "PagedKVCache":
         shape = (num_layers, n_pages, page_size, num_heads, head_dim)
+        if validate_cache_dtype(cache_dtype) is not None:
+            sshape = (num_layers, n_pages, page_size, num_heads)
+            return QuantPagedKVCache(
+                jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros((batch, pages_per_row), jnp.int32),
+                jnp.zeros((batch,), jnp.int32),
+                jnp.zeros(sshape, jnp.bfloat16),
+                jnp.zeros(sshape, jnp.bfloat16),
+                jnp.zeros((), jnp.int32))
         return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
                    jnp.zeros((batch, pages_per_row), jnp.int32),
                    jnp.zeros((batch,), jnp.int32))
@@ -236,6 +252,117 @@ class PagedKVCache:
                 f"batch={self.batch}, pages={self.n_pages}x"
                 f"{self.page_size}, per_row={self.pages_per_row}, "
                 f"dtype={self.k.dtype})")
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantPagedKVCache(PagedKVCache):
+    """Int8 page pool: K/V pages stored int8 with per-(slot, head) bf16
+    scales in sidecar pools ``k_scale``/``v_scale``
+    ([layers, n_pages, page_size, heads]) plus the scalar ``clips``
+    saturation counter. The scales live IN the page (one row per
+    position), so everything the allocator does at page granularity —
+    shared-prefix referencing, COW privatization, LRU reclaim — carries
+    the scales with the values for free: a referenced shared page
+    dequantizes identically for every sharer, and a COW private copy
+    rewrites values + scales together at install."""
+
+    __slots__ = ("k_scale", "v_scale", "clips")
+
+    def __init__(self, k, v, page_table, kv_len, k_scale, v_scale,
+                 clips):
+        super().__init__(k, v, page_table, kv_len)
+        self.k_scale = k_scale
+        self.v_scale = v_scale
+        self.clips = clips
+
+    # ------------------------------------------------------------ pytree
+    def tree_flatten(self):
+        return (self.k, self.v, self.page_table, self.kv_len,
+                self.k_scale, self.v_scale, self.clips), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def cache_dtype(self):
+        return "int8"
+
+    # ------------------------------------------------------------ update
+    def update(self, layer: int, k_new, v_new, pos) -> "QuantPagedKVCache":
+        """Quantize the fresh k/v per (token, head) and write int8
+        values + bf16 scales through the page table — same null-page
+        routing for idle/out-of-table positions as the wide pool."""
+        k_new, v_new = _raw(k_new), _raw(v_new)
+        pos = jnp.asarray(_raw(pos), jnp.int32)
+        if pos.ndim == 0:
+            pos = jnp.broadcast_to(pos, (k_new.shape[0],))
+        b, s = k_new.shape[0], k_new.shape[1]
+        positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        page, off = self._write_pages(positions)
+        page_f, off_f = page.reshape(-1), off.reshape(-1)
+        kq, ks, kc = quantize_kv(k_new)
+        vq, vs, vc = quantize_kv(v_new)
+
+        def write(buf, new):
+            flat = new.reshape((b * s,) + new.shape[2:]).astype(buf.dtype)
+            return buf.at[layer, page_f, off_f].set(flat)
+
+        return QuantPagedKVCache(
+            write(self.k, kq), write(self.v, vq), self.page_table,
+            self.kv_len, write(self.k_scale, ks), write(self.v_scale, vs),
+            self.clips + kc + vc)
+
+    def install_row(self, src, slot, table_row,
+                    start) -> "QuantPagedKVCache":
+        """Slot admission from a batch-1 :class:`QuantKVCache` prefill
+        row: int8 values AND scales scatter verbatim through the table
+        (no requantization — the installed pages decode bitwise-equal
+        to the dense row), positions below ``start`` stay covered by
+        the shared prefix pages, masked positions route to null."""
+        slot = jnp.asarray(_raw(slot), jnp.int32)
+        table_row = jnp.asarray(_raw(table_row), jnp.int32)
+        start = jnp.asarray(_raw(start), jnp.int32)
+        length = src.kv_len[0]
+        t = src.max_len
+        pos = jnp.arange(t, dtype=jnp.int32)
+        page_slot = pos // self.page_size
+        page = table_row[jnp.minimum(page_slot, self.pages_per_row - 1)]
+        valid = (pos >= start) & (pos < length) & \
+            (page_slot < self.pages_per_row)
+        page = jnp.where(valid, page, 0)
+        off = pos % self.page_size
+
+        def write(buf, row):  # row: [layers, t, ...]
+            return buf.at[:, page, off].set(row.astype(buf.dtype))
+
+        return QuantPagedKVCache(
+            write(self.k, src.k[:, 0]), write(self.v, src.v[:, 0]),
+            self.page_table.at[slot].set(table_row),
+            self.kv_len.at[slot].set(length),
+            write(self.k_scale, src.k_scale[:, 0]),
+            write(self.v_scale, src.v_scale[:, 0]),
+            self.clips + src.clips)
+
+    # -------------------------------------------------------- slot reuse
+    def reset_rows(self, rows) -> "QuantPagedKVCache":
+        base = PagedKVCache.reset_rows(self, rows)
+        return QuantPagedKVCache(self.k, self.v, base.page_table,
+                                 base.kv_len, self.k_scale, self.v_scale,
+                                 self.clips)
+
+    def with_kv_len(self, kv_len) -> "QuantPagedKVCache":
+        kv_len = jnp.asarray(_raw(kv_len), jnp.int32)
+        if kv_len.ndim == 0:
+            kv_len = jnp.broadcast_to(kv_len, (self.batch,))
+        return QuantPagedKVCache(self.k, self.v, self.page_table, kv_len,
+                                 self.k_scale, self.v_scale, self.clips)
+
+    def __repr__(self):
+        return (f"QuantPagedKVCache(layers={self.num_layers}, "
+                f"batch={self.batch}, pages={self.n_pages}x"
+                f"{self.page_size}, per_row={self.pages_per_row}, "
+                f"dtype=int8+bf16-scales)")
 
 
 class AdmissionPlan:

@@ -99,7 +99,7 @@ class Config:
                           temperature: float = 1.0, top_k: int = 0,
                           top_p: float = 1.0, eos_token_id=None,
                           pad_token_id=None, speculative=None,
-                          draft_model=None):
+                          draft_model=None, kv_cache_dtype=None):
         """Generation serving mode: the predictor AOT-compiles one
         (prefill, decode) executable pair per prompt bucket at build
         time and batches ``Predictor.generate()`` requests at that
@@ -117,9 +117,18 @@ class Config:
         ``generation.SpeculativeConfig`` to set draft-k / n-gram. The
         spec draft+verify pair is AOT-compiled per bucket next to
         prefill/decode; greedy outputs stay bitwise-equal to
-        non-speculative decoding."""
+        non-speculative decoding.
+
+        ``kv_cache_dtype="int8"`` (or ``PADDLE_KV_CACHE_DTYPE``)
+        quantizes the KV cache on every serving surface built from
+        this config: int8 values + per-(position, head) bf16 scales,
+        dequant fused inside the decode kernels — half the cache HBM
+        streamed per token, double the slots/pages a fixed pool
+        holds."""
+        from ..generation.kv_cache import validate_cache_dtype
         from ..generation.speculative import as_spec_config
         as_spec_config(speculative, draft_model)  # validate eagerly
+        validate_cache_dtype(kv_cache_dtype)      # validate eagerly too
         self._generation = dict(
             max_new_tokens=int(max_new_tokens),
             prefill_buckets=tuple(sorted(int(b) for b in prefill_buckets)),
@@ -127,7 +136,7 @@ class Config:
             temperature=float(temperature), top_k=int(top_k),
             top_p=float(top_p), eos_token_id=eos_token_id,
             pad_token_id=pad_token_id, speculative=speculative,
-            draft_model=draft_model)
+            draft_model=draft_model, kv_cache_dtype=kv_cache_dtype)
         return self
 
     def enable_serving(self, max_queue: int = 64, poll_every: int = 4,
@@ -135,7 +144,8 @@ class Config:
                        default_deadline_s=None, cache_max_len=None,
                        trace_sample=None, telemetry_port=None,
                        paged: bool = False, kv_page_size=None,
-                       kv_pages=None):
+                       kv_pages=None, kv_cache_dtype=None,
+                       weight_bits=None):
         """Continuous-batching knobs for ``paddle_tpu.serving.
         ServingEngine`` (which also needs ``enable_generation()`` — the
         engine reuses its prompt-bucket set, fixed decode batch, and
@@ -160,7 +170,20 @@ class Config:
         and identical prompt prefixes share pages copy-on-write —
         prefill once, reference-count many. ``kv_page_size`` (or
         ``PADDLE_KV_PAGE_SIZE``; default 128) must divide the cache
-        length; outputs stay bitwise-equal to the dense cache."""
+        length; outputs stay bitwise-equal to the dense cache.
+
+        ``kv_cache_dtype="int8"`` quantizes the engine's cache (wins
+        over the enable_generation value when both are set);
+        ``weight_bits=4`` additionally packs the served Linear weights
+        two-nibbles-per-int8 with per-channel scales (precision Int8
+        weight-only only; dequant stays in-trace) — the int4 decode
+        weight path."""
+        from ..generation.kv_cache import validate_cache_dtype
+        validate_cache_dtype(kv_cache_dtype)
+        if weight_bits not in (None, 4, 8):
+            raise ValueError(
+                f"weight_bits {weight_bits!r}: 4 (packed int4 "
+                "weight-only), 8 (int8 weight-only), or None")
         self._serving = dict(
             max_queue=int(max_queue), poll_every=int(poll_every),
             drain_timeout_s=float(drain_timeout_s),
@@ -168,7 +191,8 @@ class Config:
             cache_max_len=cache_max_len,
             trace_sample=trace_sample, telemetry_port=telemetry_port,
             paged=bool(paged), kv_page_size=kv_page_size,
-            kv_pages=kv_pages)
+            kv_pages=kv_pages, kv_cache_dtype=kv_cache_dtype,
+            weight_bits=weight_bits)
         return self
 
     def set_compile_cache_dir(self, path: str):

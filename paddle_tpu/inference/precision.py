@@ -12,6 +12,13 @@ slim/quantization/post_training_quantization.py):
   per-channel scales, dequantized INSIDE the compiled program where XLA
   fuses the multiply into the matmul/conv read; remaining floats serve
   bf16;
+- int4 weight-only (``enable_serving(weight_bits=4)`` with precision
+  Int8): Linear weights quantized to 4 bits per value and PACKED two
+  nibbles per stored int8 along the in-features axis — a 2x HBM cut
+  over int8 for the decode matmuls, which at batch<=8 are purely
+  weight-bandwidth-bound. ``materialize`` unpacks (two arithmetic
+  shifts — sign-extending) and dequantizes in-trace; Conv weights stay
+  on the int8 path (their 3x3 reuse isn't bandwidth-bound);
 - int8 compute (``Config.enable_int8_compute``): Linears swapped for
   int8 x int8 -> int32 MXU modules before tracing
   (quantization/int8_compute.py), remaining floats bf16.
@@ -32,30 +39,74 @@ import jax.numpy as jnp
 
 from .config import Config, PrecisionType
 
-__all__ = ["ServingParams", "serving_params"]
+__all__ = ["ServingParams", "serving_params", "quantize_int4",
+           "pack_int4", "unpack_int4"]
+
+
+def quantize_int4(w, axis: int = 1):
+    """Per-channel int4 quantization: ``q = round(w / absmax * 7)``
+    clipped to [-7, 7], returned UNPACKED as int8 values plus the
+    per-channel absmax scales (dequant = q * scale / 7)."""
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=red, keepdims=True),
+                        1e-8)
+    q = jnp.clip(jnp.round(w / scale * 7.0), -7, 7).astype(jnp.int8)
+    return q, scale
+
+
+def pack_int4(q):
+    """Pack int4-range int8 values two-nibbles-per-byte along axis 0
+    (even rows -> low nibble, odd rows -> high): [n, ...] int8 ->
+    [ceil(n/2), ...] int8. Odd row counts pad one zero row."""
+    if q.shape[0] % 2:
+        q = jnp.concatenate(
+            [q, jnp.zeros((1,) + q.shape[1:], jnp.int8)], axis=0)
+    lo = jnp.bitwise_and(q[0::2], jnp.int8(0x0F))
+    hi = jnp.left_shift(q[1::2], 4)
+    return jnp.bitwise_or(lo, hi)
+
+
+def unpack_int4(packed, rows: int):
+    """Invert :func:`pack_int4`: two arithmetic shifts sign-extend the
+    nibbles (<<4 then >>4 for the low one, >>4 for the high), rows
+    re-interleave, the pad row (odd ``rows``) is sliced off. Exact
+    round trip for values in [-7, 7]."""
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    hi = jnp.right_shift(packed, 4)
+    q = jnp.stack([lo, hi], axis=1)
+    return q.reshape((-1,) + packed.shape[1:])[:rows]
 
 
 @dataclasses.dataclass
 class ServingParams:
     """The precision-prepared parameter set a serving program closes
-    over. ``vals`` are the stored arrays (possibly cast or int8);
-    ``materialize`` is the in-trace view the traced forward consumes."""
+    over. ``vals`` are the stored arrays (possibly cast, int8, or
+    packed int4); ``materialize`` is the in-trace view the traced
+    forward consumes."""
 
     layer: object                       # possibly module-swapped
     names: List[str]
     vals: List[jax.Array]
     scales: Dict[str, jax.Array]        # int8 weight-only: name -> s/127
     compute_dtype: Optional[object]     # float feeds cast to this
+    #: int4-packed entries: name -> original axis-0 length (the packed
+    #: array holds two rows per byte; scales[name] carries s/7)
+    int4: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     def materialize(self, param_vals):
-        """In-trace parameter view: dequantize int8 weight-only entries
-        (bf16 * scale — XLA fuses the multiply into the consuming
-        matmul/conv read), pass everything else through unchanged."""
-        if not self.scales:
+        """In-trace parameter view: unpack + dequantize int4 entries,
+        dequantize int8 weight-only entries (bf16 * scale — XLA fuses
+        the multiply into the consuming matmul/conv read), pass
+        everything else through unchanged."""
+        if not self.scales and not self.int4:
             return list(param_vals)
         out = []
         for n, v in zip(self.names, param_vals):
-            if n in self.scales:
+            if n in self.int4:
+                q = unpack_int4(v, self.int4[n])
+                v = q.astype(jnp.bfloat16) * \
+                    self.scales[n].astype(jnp.bfloat16)
+            elif n in self.scales:
                 v = v.astype(jnp.bfloat16) * \
                     self.scales[n].astype(jnp.bfloat16)
             out.append(v)
@@ -80,6 +131,7 @@ def serving_params(layer, config: Config) -> ServingParams:
     prec = config.precision
     compute_dtype = None
     scales: Dict[str, jax.Array] = {}
+    int4: Dict[str, int] = {}
 
     if prec in (PrecisionType.Bfloat16, PrecisionType.Half):
         # mixed-precision convert pass analog
@@ -108,22 +160,36 @@ def serving_params(layer, config: Config) -> ServingParams:
         # weights live in HBM as int8 + per-channel scales; activations
         # run bf16 (weight-only int8 — the practical TPU mode). Works
         # for PTQ-converted models and as dynamic weight-only
-        # quantization for plain models.
+        # quantization for plain models. weight_bits=4
+        # (enable_serving) narrows LINEAR weights one step further:
+        # int4 values packed two per stored byte — the decode-matmul
+        # bandwidth path; Conv weights stay int8.
         from ..nn.layers_common import Conv2D, Linear
         from ..quantization.fake_quant import quantize_int8
+        wb = int((getattr(config, "_serving", None) or {})
+                 .get("weight_bits") or 8)
         axes: Dict[str, int] = {}
+        linear_names = set()
         for lname, sub in layer.named_sublayers():
             if isinstance(sub, Linear):
                 axes[f"{lname}.weight"] = 1
+                linear_names.add(f"{lname}.weight")
             elif isinstance(sub, Conv2D):
                 axes[f"{lname}.weight"] = 0
         new_vals = []
         for n, v in zip(names, vals):
             if n in axes and jnp.issubdtype(v.dtype, jnp.floating):
-                q, s = quantize_int8(v, axis=axes[n])
-                new_vals.append(q)
-                # q = round(x / s * 127)  =>  x ≈ q * (s / 127)
-                scales[n] = jnp.asarray(s, jnp.float32) / 127.0
+                if wb == 4 and n in linear_names:
+                    q, s = quantize_int4(v, axis=axes[n])
+                    new_vals.append(pack_int4(q))
+                    # q = round(x / s * 7)  =>  x ≈ q * (s / 7)
+                    scales[n] = jnp.asarray(s, jnp.float32) / 7.0
+                    int4[n] = int(v.shape[0])
+                else:
+                    q, s = quantize_int8(v, axis=axes[n])
+                    new_vals.append(q)
+                    # q = round(x / s * 127)  =>  x ≈ q * (s / 127)
+                    scales[n] = jnp.asarray(s, jnp.float32) / 127.0
             elif jnp.issubdtype(v.dtype, jnp.floating):
                 new_vals.append(v.astype(jnp.bfloat16))
             else:
@@ -132,4 +198,5 @@ def serving_params(layer, config: Config) -> ServingParams:
         compute_dtype = jnp.bfloat16
 
     return ServingParams(layer=layer, names=names, vals=vals,
-                         scales=scales, compute_dtype=compute_dtype)
+                         scales=scales, compute_dtype=compute_dtype,
+                         int4=int4)

@@ -197,8 +197,15 @@ class Predictor:
         # shapes no executable was built for)
         self._gen_cache_lens = {b: _round_up(b + max_new + overhang)
                                 for b in buckets}
+        # low-bit KV cache (enable_generation(kv_cache_dtype=) /
+        # PADDLE_KV_CACHE_DTYPE): baked into the session, so every AOT
+        # bucket pair below compiles the quantized cache programs
+        from ..generation.kv_cache import resolve_cache_dtype
+        self._gen_cache_dtype = resolve_cache_dtype(
+            opts.get("kv_cache_dtype"))
         self._gen_session = GenerationSession(
-            layer, executable_store=self._exe_store)
+            layer, executable_store=self._exe_store,
+            cache_dtype=self._gen_cache_dtype)
         for b in buckets:
             self._gen_session.aot_compile(opts["max_batch"], b,
                                           self._gen_cache_lens[b],

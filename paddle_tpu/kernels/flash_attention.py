@@ -844,7 +844,7 @@ def _decode_init(m_scr, l_scr, acc_scr):
 
 
 def _decode_accumulate(q, k, v, col_base, kv_len, sq,
-                       m_scr, l_scr, acc_scr):
+                       m_scr, l_scr, acc_scr, ks=None, vs=None):
     """One k-block of the decode online softmax — the ONE copy of the
     accumulate math shared by the dense and paged decode kernels, so
     their numerics can never silently diverge (the paged/dense
@@ -853,10 +853,29 @@ def _decode_accumulate(q, k, v, col_base, kv_len, sq,
     Query row i sits at global position kv_len - sq + i: it may attend
     keys at cols <= kv_len - sq + i (ragged causal; ``col_base`` is
     this block's first logical column). Rows past sq-1 are padding;
-    their outputs are sliced off outside."""
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)          # [qpad, bk] base-2
+    their outputs are sliced off outside.
+
+    ``ks``/``vs`` ([1, bk] per-column dequant scales) switch on the
+    int8-cache mode: k/v arrive int8 and the dequant FUSES into the
+    score tile instead of ever widening the cache block —
+    ``s[i,j] = (q[i] . k_int8[j]) * ks[j]`` (scaling score columns ==
+    scaling K rows) and ``acc += (p * vs) @ v_int8`` (scaling the
+    softmax weights == scaling V rows). Both multiplies ride the
+    [qpad, bk] tile as lane-aligned row-vector broadcasts — no
+    transposes, no materialized wide K/V, HBM traffic stays int8."""
+    quant = ks is not None
+    if quant:
+        # int8 -> f32 in-register is exact (|v| <= 127); the matmul
+        # runs at f32 either way (preferred_element_type)
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [qpad, bk] base-2
+        s = s * ks.astype(jnp.float32)               # fused K dequant
+    else:
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [qpad, bk] base-2
     rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + col_base
     s = jnp.where(cols - rows <= kv_len - sq, s, _NEG_INF)
@@ -866,9 +885,18 @@ def _decode_accumulate(q, k, v, col_base, kv_len, sq,
     alpha = jnp.exp2(m_prev - m_new)
     p = jnp.exp2(s - m_new)
     l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    if quant:
+        # fused V dequant: fold the per-column scale into the softmax
+        # weights (l stays the sum of the UNSCALED p — v's scale
+        # belongs to the values, not the normalizer)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p * vs.astype(jnp.float32), v.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -879,9 +907,16 @@ def _decode_write_out(o_ref, l_scr, acc_scr):
     o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
-def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, sq, block_k, num_kblocks):
-    # q_ref holds q * (scale * log2e); scores are base-2 logits
+def _decode_kernel(q_ref, k_ref, v_ref, *rest, sq, block_k,
+                   num_kblocks, quant=False):
+    # q_ref holds q * (scale * log2e); scores are base-2 logits. In
+    # quant mode two per-column bf16 scale rows ([1, bk], same index
+    # map as k/v) ride between the caches and kv_len, and the shared
+    # accumulate body fuses the dequant into the score tile.
+    if quant:
+        ks_ref, vs_ref, kvlen_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        kvlen_ref, o_ref, m_scr, l_scr, acc_scr = rest
     ik = pl.program_id(1)
 
     @pl.when(ik == 0)
@@ -895,7 +930,9 @@ def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
     @pl.when(ik * block_k < kv_len)
     def _compute():
         _decode_accumulate(q_ref[0], k_ref[0], v_ref[0], ik * block_k,
-                           kv_len, sq, m_scr, l_scr, acc_scr)
+                           kv_len, sq, m_scr, l_scr, acc_scr,
+                           ks=ks_ref[...] if quant else None,
+                           vs=vs_ref[...] if quant else None)
 
     @pl.when(ik == num_kblocks - 1)
     def _finalize():
@@ -903,14 +940,19 @@ def _decode_kernel(q_ref, k_ref, v_ref, kvlen_ref, o_ref,
 
 
 def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
-                   block_k=_DECODE_BLOCK_K, group=1):
+                   block_k=_DECODE_BLOCK_K, group=1,
+                   k_scale=None, v_scale=None):
     """q: [B*Hq, sq<=8, D] (unscaled), caches [B*Hk, T, D], kv_len
     [B*Hk]. GQA/MQA (``group`` = Hq//Hk > 1) maps each query head to
     its kv head via the k/v BlockSpec index maps (grid row b reads
     cache row b // group): the hk-sized caches are streamed as-is, no
-    repeated copy is ever materialized."""
+    repeated copy is ever materialized. ``k_scale``/``v_scale``
+    ([B*Hk, T] bf16) switch on the int8-cache mode — the scale rows
+    stream through the SAME b//group index maps as the caches and the
+    dequant fuses in-register (see ``_decode_accumulate``)."""
     bh, sq, d = q.shape
     t = k_cache.shape[1]
+    quant = k_scale is not None
     qpad = _DECODE_QPAD
     q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     if sq < qpad:
@@ -918,17 +960,26 @@ def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
     bk = _pick_block(t, block_k)
     nk = t // bk
     kvlen2 = kv_len.astype(jnp.int32).reshape(k_cache.shape[0], 1)
+    kv_bytes = k_cache.dtype.itemsize * t * d \
+        + (k_scale.dtype.itemsize * t if quant else 0)
+    in_specs = [
+        pl.BlockSpec((1, qpad, d), lambda b, j: (b, 0, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j: (b // group, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, j: (b // group, j, 0)),
+    ]
+    operands = [q, k_cache, v_cache]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bk), lambda b, j: (b // group, j)),
+                     pl.BlockSpec((1, bk), lambda b, j: (b // group, j))]
+        operands += [k_scale, v_scale]
+    in_specs.append(pl.BlockSpec((1, 1), lambda b, j: (b // group, 0),
+                                 memory_space=pltpu.SMEM))
+    operands.append(kvlen2)
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sq=sq, block_k=bk,
-                          num_kblocks=nk),
+                          num_kblocks=nk, quant=quant),
         grid=(bh, nk),
-        in_specs=[
-            pl.BlockSpec((1, qpad, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b // group, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b // group, j, 0)),
-            pl.BlockSpec((1, 1), lambda b, j: (b // group, 0),
-                         memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, qpad, d), lambda b, j: (b, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, qpad, d), q.dtype),
         scratch_shapes=[
@@ -938,24 +989,32 @@ def _decode_pallas(q, k_cache, v_cache, kv_len, scale,
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * bh * qpad * t * d,
-            bytes_accessed=2 * bh * (qpad + 2 * t) * d,
+            bytes_accessed=bh * (qpad * d * q.dtype.itemsize
+                                 + 2 * kv_bytes),
             transcendentals=bh * qpad * t),
         interpret=_interpret(),
-    )(q, k_cache, v_cache, kvlen2)
+    )(*operands)
     return out[:, :sq]
 
 
-def _decode_xla(q, k_cache, v_cache, kv_len, scale, group=1):
+def _decode_xla(q, k_cache, v_cache, kv_len, scale, group=1,
+                ks=None, vs=None):
     """Fallback decode attention (CPU/interpret, or cache lengths off
     the 128 grid): fp32 masked softmax over [B*Hk, group, sq, T]
     scores — fine at decode sizes, never used for training shapes.
     GQA/MQA query heads fold into the ``group`` dim so the hk-sized
-    caches broadcast in the einsum (head-index mapping, no repeat)."""
+    caches broadcast in the einsum (head-index mapping, no repeat).
+    ``ks``/``vs`` ([B*Hk, T]) run the int8-cache mode with the SAME
+    fused-dequant structure as the Pallas kernel (score columns
+    scaled, softmax weights scaled) — the paged/dense parity contract
+    extends to the quantized path."""
     bhq, sq, d = q.shape
     t = k_cache.shape[1]
     q4 = q.reshape(k_cache.shape[0], group, sq, d)
     s = jnp.einsum("bgqd,bkd->bgqk", q4.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) * scale
+    if ks is not None:
+        s = s * ks.astype(jnp.float32)[:, None, None, :]
     rows = jnp.arange(sq, dtype=jnp.int32)[None, None, :, None]
     cols = jnp.arange(t, dtype=jnp.int32)[None, None, None, :]
     valid = cols - rows <= \
@@ -965,15 +1024,28 @@ def _decode_xla(q, k_cache, v_cache, kv_len, scale, group=1):
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     p = p / jnp.where(l == 0.0, 1.0, l)
-    out = jnp.einsum("bgqk,bkd->bgqd", p.astype(v_cache.dtype),
-                     v_cache).astype(q.dtype)
+    if vs is not None:
+        out = jnp.einsum(
+            "bgqk,bkd->bgqd", p * vs.astype(jnp.float32)[:, None, None, :],
+            v_cache.astype(jnp.float32)).astype(q.dtype)
+    else:
+        out = jnp.einsum("bgqk,bkd->bgqd", p.astype(v_cache.dtype),
+                         v_cache).astype(q.dtype)
     return out.reshape(bhq, sq, d)
 
 
 def flash_attention_decode(query, key_cache, value_cache, kv_len,
-                           scale=None, block_k=_DECODE_BLOCK_K):
+                           scale=None, block_k=_DECODE_BLOCK_K,
+                           k_scale=None, v_scale=None):
     """Decode-shaped attention: 1..8 new query tokens per row against a
     cached K/V with per-row valid lengths.
+
+    Int8 cache mode: with ``key_cache``/``value_cache`` int8 pass
+    ``k_scale``/``v_scale`` ([batch, max_len, num_kv_heads], the
+    ``QuantKVCache`` sidecars) — dequantization fuses INSIDE the
+    kernel (per-column scale on the score tile / softmax weights; see
+    ``_decode_accumulate``), so HBM streams half the bytes and a wide
+    cache is never materialized.
 
     query: [batch, q_len<=8, num_heads, head_dim] (framework layout).
     key_cache/value_cache: [batch, max_len, num_kv_heads, head_dim] —
@@ -1003,21 +1075,32 @@ def flash_attention_decode(query, key_cache, value_cache, kv_len,
         scale = 1.0 / (d ** 0.5)
     assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"
     group = hq // hk
+    quant = key_cache.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "flash_attention_decode: int8 caches need k_scale/v_scale "
+            "([batch, max_len, kv_heads] — the QuantKVCache sidecars); "
+            "an unscaled int8 cache cannot be dequantized")
     # query rows [b, h] flatten so that row i's kv row is i // group
     # (b*hq = (b*hk)*group, batch-major): the group-size broadcast is
     # pure indexing, never a materialized repeat of the caches
     qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
     kt = jnp.swapaxes(key_cache, 1, 2).reshape(b * hk, t, d)
     vt = jnp.swapaxes(value_cache, 1, 2).reshape(b * hk, t, d)
+    kst = vst = None
+    if quant:
+        kst = jnp.swapaxes(k_scale, 1, 2).reshape(b * hk, t)
+        vst = jnp.swapaxes(v_scale, 1, 2).reshape(b * hk, t)
     kv_len = jnp.asarray(kv_len, jnp.int32)
     kl = jnp.repeat(kv_len, hk)                       # [B*Hk] int32
     use_pallas = (jax.default_backend() == "tpu"
                   and t % 128 == 0 and d in (64, 128, 256))
     if use_pallas:
         out = _decode_pallas(qt, kt, vt, kl, float(scale), block_k,
-                             group=group)
+                             group=group, k_scale=kst, v_scale=vst)
     else:
-        out = _decode_xla(qt, kt, vt, kl, float(scale), group=group)
+        out = _decode_xla(qt, kt, vt, kl, float(scale), group=group,
+                          ks=kst, vs=vst)
     return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
 
 
@@ -1037,12 +1120,18 @@ def flash_attention_decode(query, key_cache, value_cache, kv_len,
 # gate between paged and dense serving rests on that.
 
 def _paged_decode_kernel(table_ref, kvlen_ref, q_ref, k_ref, v_ref,
-                         o_ref, m_scr, l_scr, acc_scr, *, sq,
-                         page_size, num_page_slots, heads_q):
+                         *rest, sq, page_size, num_page_slots, heads_q,
+                         quant=False):
     # q_ref holds q * (scale * log2e); scores are base-2 logits. The
     # accumulate body is the SAME _decode_accumulate as the dense
     # kernel — only the k-block addressing differs (pages through the
-    # scalar-prefetched table vs contiguous blocks).
+    # scalar-prefetched table vs contiguous blocks). Quant mode adds
+    # the per-page scale rows ([1, 1, page], same table-resolved index
+    # map as the pools) and fuses the dequant in the shared body.
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     r = pl.program_id(0)           # flattened [batch, q-head] row
     j = pl.program_id(1)           # page slot within the row's table
 
@@ -1059,7 +1148,9 @@ def _paged_decode_kernel(table_ref, kvlen_ref, q_ref, k_ref, v_ref,
     def _compute():
         _decode_accumulate(q_ref[0], k_ref[0, 0], v_ref[0, 0],
                            j * page_size, kv_len, sq,
-                           m_scr, l_scr, acc_scr)
+                           m_scr, l_scr, acc_scr,
+                           ks=ks_ref[0] if quant else None,
+                           vs=vs_ref[0] if quant else None)
 
     @pl.when(j == num_page_slots - 1)
     def _finalize():
@@ -1067,16 +1158,21 @@ def _paged_decode_kernel(table_ref, kvlen_ref, q_ref, k_ref, v_ref,
 
 
 def _paged_decode_pallas(q, k_pool, v_pool, page_table, kv_len, scale,
-                         group=1, interpret=None):
+                         group=1, interpret=None,
+                         k_scale=None, v_scale=None):
     """q: [B*Hq, sq<=8, D] (unscaled), pools [Hk, n_pages, page, D],
     page_table [B, P] int32, kv_len [B]. The k/v BlockSpec index maps
     resolve (kv head, page id) from the grid row and the
     scalar-prefetched table — page indirection rides the same
-    index-map mechanism as the GQA head mapping."""
+    index-map mechanism as the GQA head mapping. ``k_scale``/``v_scale``
+    ([Hk, n_pages, page] bf16) run the int8-pool mode: the scale pages
+    resolve through the SAME table index map, dequant fused in the
+    shared accumulate body."""
     bh, sq, d = q.shape
     hk, n_pages, page, _ = k_pool.shape
     b, num_slots = page_table.shape
     hq = bh // b
+    quant = k_scale is not None
     qpad = _DECODE_QPAD
     q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     if sq < qpad:
@@ -1087,14 +1183,23 @@ def _paged_decode_pallas(q, k_pool, v_pool, page_table, kv_len, scale,
     def k_index(r, j, tbl, kl):
         return ((r % hq) // group, tbl[r // hq, j], 0, 0)
 
+    def s_index(r, j, tbl, kl):
+        return ((r % hq) // group, tbl[r // hq, j], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, qpad, d), lambda r, j, tbl, kl: (r, 0, 0)),
+        pl.BlockSpec((1, 1, page, d), k_index),
+        pl.BlockSpec((1, 1, page, d), k_index),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, page), s_index),
+                     pl.BlockSpec((1, 1, page), s_index)]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(bh, num_slots),
-        in_specs=[
-            pl.BlockSpec((1, qpad, d), lambda r, j, tbl, kl: (r, 0, 0)),
-            pl.BlockSpec((1, 1, page, d), k_index),
-            pl.BlockSpec((1, 1, page, d), k_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, qpad, d),
                                lambda r, j, tbl, kl: (r, 0, 0)),
         scratch_shapes=[
@@ -1103,25 +1208,36 @@ def _paged_decode_pallas(q, k_pool, v_pool, page_table, kv_len, scale,
             pltpu.VMEM((qpad, d), jnp.float32),
         ],
     )
+    kv_bytes = k_pool.dtype.itemsize * num_slots * page * d \
+        + (k_scale.dtype.itemsize * num_slots * page if quant else 0)
     out = pl.pallas_call(
         functools.partial(_paged_decode_kernel, sq=sq, page_size=page,
-                          num_page_slots=num_slots, heads_q=hq),
+                          num_page_slots=num_slots, heads_q=hq,
+                          quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((bh, qpad, d), q.dtype),
         cost_estimate=pl.CostEstimate(
             flops=4 * bh * qpad * num_slots * page * d,
-            bytes_accessed=2 * bh * (qpad + 2 * num_slots * page) * d,
+            bytes_accessed=bh * (qpad * d * q.dtype.itemsize
+                                 + 2 * kv_bytes),
             transcendentals=bh * qpad * num_slots * page),
         interpret=_interpret() if interpret is None else interpret,
-    )(table, kvl, q, k_pool, v_pool)
+    )(table, kvl, *operands)
     return out[:, :sq]
 
 
 def flash_attention_decode_paged(query, key_pool, value_pool,
-                                 page_table, kv_len, scale=None):
+                                 page_table, kv_len, scale=None,
+                                 k_scale=None, v_scale=None):
     """Decode-shaped attention over a PAGED KV cache: 1..8 new query
     tokens per row against K/V stored in a shared page pool addressed
     through per-row page tables.
+
+    Int8 pool mode: with int8 pools pass ``k_scale``/``v_scale``
+    ([n_pages, page_size, num_kv_heads], the ``QuantPagedKVCache``
+    sidecars) — the scale pages resolve through the same
+    scalar-prefetched table and the dequant fuses in-kernel, so the
+    pool streams at half the HBM bytes.
 
     query: [batch, q_len<=8, num_heads, head_dim] (framework layout).
     key_pool/value_pool: [n_pages, page_size, num_kv_heads, head_dim] —
@@ -1149,6 +1265,13 @@ def flash_attention_decode_paged(query, key_pool, value_pool,
         scale = 1.0 / (d ** 0.5)
     assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"
     group = hq // hk
+    quant = key_pool.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "flash_attention_decode_paged: int8 pools need "
+            "k_scale/v_scale ([n_pages, page_size, kv_heads] — the "
+            "QuantPagedKVCache sidecars); an unscaled int8 pool cannot "
+            "be dequantized")
     kv_len = jnp.asarray(kv_len, jnp.int32)
     use_pallas = (jax.default_backend() == "tpu"
                   and ps % 128 == 0 and d in (64, 128, 256))
@@ -1156,8 +1279,13 @@ def flash_attention_decode_paged(query, key_pool, value_pool,
         qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
         kp = jnp.transpose(key_pool, (2, 0, 1, 3))    # [hk, pages, ps, d]
         vp = jnp.transpose(value_pool, (2, 0, 1, 3))
+        ksp = vsp = None
+        if quant:
+            ksp = jnp.transpose(k_scale, (2, 0, 1))   # [hk, pages, ps]
+            vsp = jnp.transpose(v_scale, (2, 0, 1))
         out = _paged_decode_pallas(qt, kp, vp, page_table, kv_len,
-                                   float(scale), group=group)
+                                   float(scale), group=group,
+                                   k_scale=ksp, v_scale=vsp)
         return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
     # XLA fallback: gather the row's pages into the logical
     # [b, pages_per_row * page_size, hk, d] layout and run the exact
@@ -1169,8 +1297,15 @@ def flash_attention_decode_paged(query, key_pool, value_pool,
     qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
     kt = jnp.swapaxes(k_rows, 1, 2).reshape(b * hk, t, d)
     vt = jnp.swapaxes(v_rows, 1, 2).reshape(b * hk, t, d)
+    kst = vst = None
+    if quant:
+        ks_rows = k_scale[page_table].reshape(b, t, hk)
+        vs_rows = v_scale[page_table].reshape(b, t, hk)
+        kst = jnp.swapaxes(ks_rows, 1, 2).reshape(b * hk, t)
+        vst = jnp.swapaxes(vs_rows, 1, 2).reshape(b * hk, t)
     kl = jnp.repeat(kv_len, hk)
-    out = _decode_xla(qt, kt, vt, kl, float(scale), group=group)
+    out = _decode_xla(qt, kt, vt, kl, float(scale), group=group,
+                      ks=kst, vs=vst)
     return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
 
 

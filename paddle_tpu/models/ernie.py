@@ -197,7 +197,7 @@ class ErnieModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, attn_mask=None,
                 cache=None, use_cache=False, prompt_len=None,
-                cache_max_len=None):
+                cache_max_len=None, cache_dtype=None):
         """Returns (sequence_output, pooled_output-or-None) — plus the
         KV cache as a third element under ``use_cache``/``cache``
         (incremental encoding: prefill fills the cache, later calls
@@ -213,7 +213,7 @@ class ErnieModel(Layer):
         if cache is not None or use_cache:
             return self._forward_cached(input_ids, token_type_ids,
                                         attn_mask, cache, prompt_len,
-                                        cache_max_len)
+                                        cache_max_len, cache_dtype)
         x = self.embeddings(input_ids, token_type_ids)
         if self.cfg.use_recompute and self.training:
             from .gpt import _remat_policy
@@ -229,7 +229,8 @@ class ErnieModel(Layer):
         return x, pooled
 
     def _forward_cached(self, input_ids, token_type_ids, attn_mask,
-                        cache, prompt_len, cache_max_len):
+                        cache, prompt_len, cache_max_len,
+                        cache_dtype=None):
         """Incremental-encoding forward (eval only): returns
         (sequence_output, pooled-or-None, cache); ``pooled`` is filled
         on prefill only (decode windows don't contain CLS — it stays
@@ -250,7 +251,7 @@ class ErnieModel(Layer):
             cache = KVCache.create(
                 self.cfg.num_layers, b, max_len, self.cfg.num_heads,
                 self.cfg.hidden_size // self.cfg.num_heads,
-                dtype=x._data.dtype)
+                dtype=x._data.dtype, cache_dtype=cache_dtype)
         for i, layer in enumerate(self.layers):
             x, cache = layer(x, attn_mask, cache=cache, layer_idx=i,
                              decode=decode)

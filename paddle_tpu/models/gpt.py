@@ -277,10 +277,12 @@ class GPTModel(Layer):
         self._moe_aux = None
 
     def forward(self, input_ids, attn_mask=None, cache=None,
-                use_cache=False, prompt_len=None, cache_max_len=None):
+                use_cache=False, prompt_len=None, cache_max_len=None,
+                cache_dtype=None):
         if cache is not None or use_cache:
             return self._forward_cached(input_ids, attn_mask, cache,
-                                        prompt_len, cache_max_len)
+                                        prompt_len, cache_max_len,
+                                        cache_dtype)
         x = self.embed(input_ids)
         self._moe_aux = None
         moe = self.cfg.moe_num_experts > 0
@@ -303,12 +305,15 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
     def _forward_cached(self, input_ids, attn_mask, cache, prompt_len,
-                        cache_max_len):
+                        cache_max_len, cache_dtype=None):
         """Generation forward (eval only): prefill creates + fills the
         KV cache (``cache=None``), decode consumes one. Returns
         (hidden, cache). ``prompt_len`` [b] marks each row's true
         length in a right-padded prompt; kv_len advances to it so the
-        pad tail is invisible to (and overwritten by) decode steps."""
+        pad tail is invisible to (and overwritten by) decode steps.
+        ``cache_dtype="int8"`` creates the quantized cache (values
+        quantize in-trace at every write; decode dequantizes inside
+        the kernel)."""
         from ..generation.kv_cache import KVCache
         b, s = input_ids.shape
         decode = cache is not None
@@ -321,7 +326,7 @@ class GPTModel(Layer):
             cache = KVCache.create(
                 self.cfg.num_layers, b, max_len, self.cfg.num_heads,
                 self.cfg.hidden_size // self.cfg.num_heads,
-                dtype=x._data.dtype)
+                dtype=x._data.dtype, cache_dtype=cache_dtype)
         for i, block in enumerate(self.blocks):
             x, cache = block(x, attn_mask, cache=cache, layer_idx=i,
                              decode=decode)
@@ -346,10 +351,12 @@ class GPTForCausalLM(Layer):
             self.lm_head = None
 
     def forward(self, input_ids, attn_mask=None, cache=None,
-                use_cache=False, prompt_len=None, cache_max_len=None):
+                use_cache=False, prompt_len=None, cache_max_len=None,
+                cache_dtype=None):
         if cache is not None or use_cache:
             return self._forward_cached(input_ids, attn_mask, cache,
-                                        prompt_len, cache_max_len)
+                                        prompt_len, cache_max_len,
+                                        cache_dtype)
         h = self.gpt(input_ids, attn_mask)
         if self.cfg.fused_lm_loss:
             # ship the head weight WITH the output (cloned while any
@@ -364,7 +371,7 @@ class GPTForCausalLM(Layer):
                           self.gpt.embed.wte.weight)
 
     def _forward_cached(self, input_ids, attn_mask, cache, prompt_len,
-                        cache_max_len):
+                        cache_max_len, cache_dtype=None):
         """Generation forward: returns (logits, cache). Prefill returns
         next-token logits only ([b, 1, vocab], gathered at each row's
         last REAL position — the [b, s, vocab] prompt logits are never
@@ -375,7 +382,8 @@ class GPTForCausalLM(Layer):
         decode = cache is not None
         h, cache = self.gpt(input_ids, attn_mask, cache=cache,
                             use_cache=True, prompt_len=prompt_len,
-                            cache_max_len=cache_max_len)
+                            cache_max_len=cache_max_len,
+                            cache_dtype=cache_dtype)
         if not decode:
             from ..core.tensor import dispatch
             b, s = input_ids.shape

@@ -164,12 +164,25 @@ class ServingEngine:
                                      env_default))
 
         # precision: the same serving cast/quant pass the Predictor's
-        # run() path audits (int8-compute may swap modules)
+        # run() path audits (int8-compute may swap modules; int4
+        # weight-only packs Linear weights two-nibbles-per-byte)
         self._sp = serving_params(layer, config)
         layer = self._sp.layer
         layer.eval()
         self.network = layer
         self.config = config
+
+        # low-bit KV cache (ROADMAP item 4): the serving knob wins over
+        # the generation one, PADDLE_KV_CACHE_DTYPE fills the gap. The
+        # dtype is baked into every program below (prefill creates the
+        # quantized cache in-trace; decode dequantizes in-kernel).
+        from ..generation.kv_cache import resolve_cache_dtype
+        explicit_cd = sopts.get("kv_cache_dtype")
+        if explicit_cd is None:
+            explicit_cd = opts.get("kv_cache_dtype")
+        self.cache_dtype = resolve_cache_dtype(explicit_cd)
+        cache_kw = {} if self.cache_dtype is None \
+            else {"cache_dtype": self.cache_dtype}
 
         self._cfg = GenerationConfig(
             do_sample=opts["do_sample"], temperature=opts["temperature"],
@@ -289,7 +302,8 @@ class ServingEngine:
             params = sp.materialize(state_vals)
             out = functional_call(
                 layer, dict(zip(names, params)), Tensor(ids),
-                use_cache=True, prompt_len=plen, cache_max_len=cache_len)
+                use_cache=True, prompt_len=plen, cache_max_len=cache_len,
+                **cache_kw)
             logits, cache = _expect_logits_cache(out)
             logits = _unwrap(logits)[:, -1].astype(jnp.float32)
             k0, k1 = jax.random.split(key)
@@ -495,10 +509,28 @@ class ServingEngine:
         # would compile one tiny broadcast program per shape — dead
         # weight on the warm-relaunch path the executable store keeps
         # otherwise XLA-free
+        quant = getattr(cache_aval, "k_scale", None) is not None
         if self._alloc is None:
             self._cache = jax.tree_util.tree_map(
                 lambda a: jax.device_put(np.zeros(a.shape, a.dtype)),
                 cache_aval)
+        elif quant:
+            # paged int8 pool: value pages + their bf16 scale pages
+            # (the scales live IN the page, so prefix sharing / COW /
+            # reclaim carry them for free) + the saturation counter
+            from ..generation.paged_cache import QuantPagedKVCache
+            L, _, _, H, D = cache_aval.k.shape
+            pool = (L, self._alloc.n_pages, self.page_size, H, D)
+            spool = (L, self._alloc.n_pages, self.page_size, H)
+            self._cache = QuantPagedKVCache(
+                jax.device_put(np.zeros(pool, cache_aval.k.dtype)),
+                jax.device_put(np.zeros(pool, cache_aval.v.dtype)),
+                jax.device_put(np.zeros((B, self.pages_per_row),
+                                        np.int32)),
+                jax.device_put(np.zeros((B,), np.int32)),
+                jax.device_put(np.zeros(spool, jnp.bfloat16)),
+                jax.device_put(np.zeros(spool, jnp.bfloat16)),
+                jax.device_put(np.zeros((), np.int32)))
         else:
             # paged pool: layers/heads/head_dim/dtype from the dense
             # prefill aval, rows replaced by the page pool + tables
@@ -511,6 +543,34 @@ class ServingEngine:
                 jax.device_put(np.zeros((B, self.pages_per_row),
                                         np.int32)),
                 jax.device_put(np.zeros((B,), np.int32)))
+        # the low-bit accounting satellites: the kv_dtype info gauge
+        # (what this engine serves — the router reads it beside the
+        # capacity numbers) and, when quantized, the HBM bytes the int8
+        # storage saved vs the wide dtype (host arithmetic over shapes)
+        self._clips_seen = 0
+        if quant:
+            # the wide dtype the cache WOULD have carried: the serving
+            # compute dtype when a precision mode set one, else the
+            # model's own float param dtype (a model.bfloat16() under
+            # default precision serves a bf16 cache — name check
+            # because np.issubdtype(bfloat16, floating) is False)
+            wide_dt = self._sp.compute_dtype
+            if wide_dt is None:
+                wide_dt = next(
+                    (v.dtype for v in self._sp.vals
+                     if np.issubdtype(np.dtype(v.dtype), np.floating)
+                     or np.dtype(v.dtype).name == "bfloat16"),
+                    np.float32)
+            wide_dt = np.dtype(wide_dt)
+            self._kv_dtype_label = "int8"
+            saved = 2 * int(np.prod(self._cache.k.shape)) \
+                * (wide_dt.itemsize - 1) \
+                - 2 * int(np.prod(self._cache.k_scale.shape)) * 2
+            monitor.record_kv_quant(bytes_saved=max(0, saved))
+        else:
+            # the dtype the cache ACTUALLY carries, from its own aval
+            self._kv_dtype_label = np.dtype(cache_aval.k.dtype).name
+        monitor.record_kv_dtype(self._kv_dtype_label)
         self._tok = jax.device_put(np.zeros((B,), np.int32))
         self._finished = jax.device_put(np.ones((B,), bool))  # empty
         #                                       slots are masked
@@ -605,6 +665,10 @@ class ServingEngine:
             paged=(None if self._alloc is None else
                    (self.page_size, self.pages_per_row,
                     self._alloc.n_pages)),
+            # the quant geometry: cache dtype + weight packing change
+            # every program's operand layout, so they key the manifest
+            kv_cache=self.cache_dtype,
+            weight_bits=sorted(self._sp.int4) if self._sp.int4 else None,
             precision=(self.config.precision,
                        getattr(self.config, "_int8_compute", False)),
             operands=compile_cache.aval_signature(self._state))
@@ -1030,6 +1094,7 @@ class ServingEngine:
         if monitor.enabled:
             monitor.record_cache_occupancy(self._cache.occupancy())
             self._drain_page_stats()
+            self._drain_quant_stats()
 
     def _complete(self, req: Request, toks: np.ndarray):
         eos = self._cfg.eos_token_id
@@ -1109,6 +1174,20 @@ class ServingEngine:
             shared_pages=delta["shared_pages"],
             cow_copies=delta["cow_copies"])
         monitor.record_page_occupancy(self._alloc.page_occupancy())
+
+    def _drain_quant_stats(self):
+        """Drain the quantized cache's in-device saturation counter
+        into ``gen.cache.quant.scale_clips`` (one int32 scalar read at
+        the poll cadence, beside the existing lane reads; the lifetime
+        counter is int32 and may wrap — modular delta, same treatment
+        as the speculation counters)."""
+        if getattr(self._cache, "clips", None) is None:
+            return
+        clips = int(np.asarray(self._cache.clips))  # lint: host-sync-ok (scheduler poll, tiny scalar)
+        d = (clips - self._clips_seen) % (1 << 32)
+        if d:
+            self._clips_seen = clips
+            monitor.record_kv_quant(scale_clips=d)
 
     # -------------------------------------------------------- front-end
     def _submit_item(self, item) -> Request:
@@ -1226,6 +1305,7 @@ class ServingEngine:
             monitor.record_serve_slot_occupancy(0.0)
             if monitor.enabled:
                 self._drain_page_stats()
+                self._drain_quant_stats()
             if flight_recorder.enabled and not already:
                 flight_recorder.record("serve.drain_end")
 
@@ -1316,6 +1396,18 @@ class ServingEngine:
             # while slots are still free
             reasons.append("queue_full" if blocked_on is None
                            else f"queue_full:no_free_{blocked_on}")
+        # effective cache capacity in TOKENS (PR-12's named remainder):
+        # pool pages x page size for the paged cache, slots x max_len
+        # dense — REAL headroom, already adjusted for the cache dtype
+        # because an int8 pool configured at equal HBM holds ~2x the
+        # pages/slots of a bf16 one. The kv_dtype label rides along so
+        # the item-1 router can compare replicas across precisions.
+        if paged:
+            cap_tokens = (self._alloc.n_pages - 1) * self.page_size
+            free_tokens = self._alloc.free_pages() * self.page_size
+        else:
+            cap_tokens = self.max_batch * self.max_len
+            free_tokens = (self.max_batch - busy) * self.max_len
         return {
             "ready": not reasons,
             **({"reason": ",".join(reasons)} if reasons else {}),
@@ -1323,6 +1415,9 @@ class ServingEngine:
             "queue_blocked_on": blocked_on,
             "slots_busy": busy, "max_batch": self.max_batch,
             "free_slots": self.max_batch - busy,
+            "kv_cache_dtype": self._kv_dtype_label,
+            "capacity_tokens": cap_tokens,
+            "free_tokens": free_tokens,
             **({"free_pages": self._alloc.free_pages(),
                 "total_pages": self._alloc.n_pages - 1,
                 "page_occupancy": round(
