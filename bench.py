@@ -416,6 +416,36 @@ def _metric_counter(name):
     return int(snap["value"]) if snap else 0
 
 
+def _tree_bytes(tree):
+    import jax
+    return sum(
+        int(np.prod(l.shape, dtype=np.int64)) * np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "shape"))
+
+
+def _mem_sub_dict(plan, measure_fn, held, pool_bytes):
+    """The ISSUE-14 "mem" row: the static planner's predicted peak vs
+    a MEASURED peak (live-byte delta around exactly one dispatch of the
+    same program, inputs in ``held`` kept referenced) plus the KV pool
+    bytes. The plan upper-bounds the resident set, so
+    predicted_over_measured >= 1.0 is the healthy regime; the tier-1
+    predicted-vs-measured test pins its slack band."""
+    import jax
+    from paddle_tpu import device
+    device.reset_peak_memory_stats()
+    m0 = device.memory_allocated()
+    out = measure_fn()
+    jax.block_until_ready(out)
+    measured = _tree_bytes(held) + max(
+        0, device.max_memory_allocated() - m0)
+    return {
+        "predicted_peak_bytes": int(plan.peak_bytes),
+        "measured_peak_bytes": int(measured),
+        "pool_bytes": int(pool_bytes),
+        "predicted_over_measured": round(plan.peak_bytes / measured, 2),
+    }
+
+
 def _bench_spec_rows(model, draft, on_tpu, new_tokens):
     """Speculative-decode comparison rows (ISSUE-11): batch-1 greedy
     decode — the latency-bound regime speculation targets — off vs
@@ -593,6 +623,19 @@ def bench_decode(dev, on_tpu):
     spec = _bench_spec_rows(model, draft, on_tpu, new_tokens)
     precision = _bench_precision_rows(model, on_tpu, ids, new_tokens)
     wide = precision["wide_dtype"]
+
+    # ISSUE-14 "mem" sub-dict: the decode program's static MemoryPlan
+    # vs one measured dispatch (same donation the backend dispatches)
+    from paddle_tpu import analysis
+    tok, cache, k2, fin = sess.prefill(state, jnp.asarray(ids), plen,
+                                       key, cfg, cache_len)
+    tok.block_until_ready()
+    margs = (state, tok, cache, k2, fin)
+    mem_plan = analysis.plan_memory(
+        sess._decode_fn, *margs, cfg, static_argnums=(5,),
+        donate=sess._decode_donate, name="bench.decode")
+    mem = _mem_sub_dict(mem_plan, lambda: sess.decode(*margs, cfg),
+                        margs, _tree_bytes((cache,)))
     return {
         "metric": f"test-tiny decode tokens/sec/chip (b{b} "
                   f"prefill{prefill_len}+decode{new_tokens}, "
@@ -609,6 +652,7 @@ def bench_decode(dev, on_tpu):
         "vs_baseline": 1.0,
         "spec": spec,
         "precision": precision,
+        "mem": mem,
     }
 
 
@@ -888,6 +932,22 @@ def bench_serve(dev, on_tpu):
         "slots_reused": engine.stats["slots_reused"],
         "decode_steps": engine.stats["decode_steps"],
     }
+    # ISSUE-14 "mem" sub-dict: the engine's static HBM plan vs one
+    # measured slot-decode dispatch, plus the KV pool bytes. Runs LAST:
+    # on TPU the direct _step_jit dispatch donates the engine's state
+    # buffers, so the engine serves no traffic after this.
+    from paddle_tpu import analysis
+    mp = engine.memory_plan()
+    margs = (engine._state, engine._tok, engine._cache, engine._key,
+             engine._finished, engine._steps, engine._budget,
+             engine._out_buf)
+    mem_plan = analysis.plan_memory(
+        engine._step_fn, *margs, engine._cfg, static_argnums=(8,),
+        donate=engine._step_donate, name="bench.serve.decode")
+    mem = _mem_sub_dict(
+        mem_plan, lambda: engine._step_jit(*margs, engine._cfg),
+        margs, mp["kv_cache_bytes"])
+    mem["predicted_engine_peak_bytes"] = mp["predicted_peak_bytes"]
     return {
         "metric": f"test-tiny serving QPS (continuous batching b{max_batch} "
                   f"poisson@{rate:g}/s, ttft p50={sla['ttft_ms'][50]}ms "
@@ -900,6 +960,7 @@ def bench_serve(dev, on_tpu):
         "vs_baseline": 1.0,
         "sla": sla,
         "precision": precision,
+        "mem": mem,
     }
 
 

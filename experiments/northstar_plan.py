@@ -13,6 +13,10 @@ bandwidth + latency, ring factor folded into the constants). The
 roofline derates (mxu_eff/hbm_eff) do NOT enter this prediction —
 they are the tuner's cross-model constants; anchoring on the measured
 row is strictly tighter for a same-workload scaling projection.
+Per-chip HBM rows come from the audited step's MemoryPlan
+(analysis.memory liveness scan, ISSUE 14) — byte counts are read off
+the program, only the partition rule (params replicate, stage-2 opt
+state shards, batch/activations shard) is applied as data.
 Writes experiments/northstar_plan.json consumed by BASELINE.md and
 tests/test_parallel_tuner.py.
 
@@ -45,6 +49,42 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 ICI_BW, ICI_LAT = 180e9, 1e-6
 DCN_BW, DCN_LAT = 12.5e9, 25e-6
 PER_CHIP_B, SEQ = 32, 512
+HBM_PER_CHIP = 16 << 30        # v5e: 16 GiB per chip (plan input)
+
+
+def hbm_plan_row(mem, dp, sharding):
+    """Per-chip HBM prediction from the fleet step's MemoryPlan
+    (ISSUE-14): every byte count is read OFF the audited program —
+    params / optimizer state / batch operand totals and the scan's
+    peak — and only the partition rule is applied as data: params
+    replicate per chip, stage-2 optimizer state shards across the
+    sharding group, batch and activation temporaries shard across the
+    whole dp x sharding mesh. Replaces hand-computed parameter
+    arithmetic: when the step gains a buffer, the row moves with it.
+    NB the CPU trace materializes attention scores the TPU flash
+    kernels never form, so — like the cost-analysis absolutes above —
+    per_chip_bytes upper-bounds the TPU footprint."""
+    n = dp * sharding
+    if mem.arg_bytes is None:  # exotic flattening: no per-arg split
+        return {"peak_bytes_global": mem.peak_bytes,
+                "per_chip_bytes": None}
+    params_b, opt_b = mem.arg_bytes[0], mem.arg_bytes[1]
+    batch_b = sum(mem.arg_bytes[4:])
+    # temporaries at the peak = everything the resident operands and
+    # baked consts don't explain; they scale with the per-chip batch
+    temps_b = max(0, mem.peak_bytes - mem.args_bytes - mem.consts_bytes)
+    per_chip = (params_b + opt_b // sharding + batch_b // n
+                + temps_b // n + mem.consts_bytes)
+    return {
+        "peak_bytes_global": mem.peak_bytes,
+        "params_bytes": params_b,
+        "opt_state_bytes": opt_b,
+        "batch_bytes": batch_b,
+        "temps_bytes_global": temps_b,
+        "per_chip_bytes": int(per_chip),
+        "per_chip_gib": round(per_chip / (1 << 30), 3),
+        "fits_v5e_16gib": bool(per_chip < HBM_PER_CHIP),
+    }
 
 
 def compile_candidate(dp, sharding, n_devices):
@@ -89,9 +129,13 @@ def compile_candidate(dp, sharding, n_devices):
     hbm = float(ca.get("bytes accessed", 0.0))
     txt = comp.as_text()
     ici_b, dcn_b, n_ici, n_dcn = collective_bytes(txt, None)
+    # ISSUE-14: per-chip HBM from the audited step's MemoryPlan (trace
+    # only, memory pass only — the compile above is the slow part)
+    mem = step.audit(ids, y, checks=("memory",)).memory
     return {"dp": dp, "sharding": sharding,
             "flops_per_chip_cpu_fp32": flops, "hbm_per_chip_cpu_fp32": hbm,
             "coll_bytes": ici_b + dcn_b, "n_coll": n_ici + n_dcn,
+            "hbm_plan": hbm_plan_row(mem, dp, sharding),
             "compile_s": round(compile_s, 1)}
 
 

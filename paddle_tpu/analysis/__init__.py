@@ -13,11 +13,19 @@ framework jits can be TRACED WITHOUT EXECUTING and audited as data.
 
 Detector passes (see ``detectors.py``): donation misses, host-callback
 syncs, dtype leaks (fp64 / bf16-region upcasts), over-budget baked
-constants, and per-mesh-axis collective byte accounting (cross-checked
+constants, per-mesh-axis collective byte accounting (cross-checked
 against the runtime ``comm.bytes`` counters via
-``cross_check_collectives``). The flagship programs expose ready-made
-entry points: ``TrainStep.audit()``, ``DistributedTrainStep.audit()``,
-``GenerationSession.audit()``, ``Predictor.audit_generation()``.
+``cross_check_collectives``), and the HBM planner (``memory.py``):
+donation-aware buffer liveness computing peak live bytes per program
+(``report.memory``, a :class:`MemoryPlan`), gated by
+``audit(hbm_budget=)`` / ``PADDLE_HBM_BUDGET`` and cross-checked
+against ``device.max_memory_allocated()`` via ``cross_check_memory``.
+The flagship programs expose ready-made entry points:
+``TrainStep.audit()``, ``DistributedTrainStep.audit()``,
+``GenerationSession.audit()``, ``Predictor.audit_generation()``,
+``ServingEngine.audit()``. The ledger (``ledger.py``) freezes the
+flagship audits into a committed ``docs/programs.json`` manifest with
+a tier-1 drift gate (refresh: ``python -m tools.ledger --update``).
 
 The sibling static layer for *Python* (not traced programs) is the
 framework lint: ``python -m tools.lint paddle_tpu tests``.
@@ -26,9 +34,13 @@ from .auditor import (AuditError, AuditReport, Finding, Severity,
                       abstractify, audit, cross_check_collectives)
 from .detectors import (AuditContext, DETECTORS, register_dequant_site,
                         register_detector)
+from .memory import (MemoryPlan, cross_check_memory, parse_bytes,
+                     plan_memory, resolve_hbm_budget)
 
 __all__ = [
     "AuditContext", "AuditError", "AuditReport", "DETECTORS", "Finding",
-    "Severity", "abstractify", "audit", "cross_check_collectives",
-    "register_dequant_site", "register_detector",
+    "MemoryPlan", "Severity", "abstractify", "audit",
+    "cross_check_collectives", "cross_check_memory", "parse_bytes",
+    "plan_memory", "register_dequant_site", "register_detector",
+    "resolve_hbm_budget",
 ]

@@ -66,7 +66,8 @@ def audit(fn, *args, donate=(), static_argnums=(), name: Optional[str] = None,
           allow: Sequence[str] = (),
           min_donation_bytes: int = 1024,
           const_budget_bytes: int = 1 << 20,
-          bf16_compute: bool = False) -> AuditReport:
+          bf16_compute: bool = False,
+          hbm_budget=None, mem_top_k: int = 8) -> AuditReport:
     """Trace ``fn`` on abstract inputs and run the detector passes.
 
     args: example inputs — real arrays, Tensors, or
@@ -80,6 +81,11 @@ def audit(fn, *args, donate=(), static_argnums=(), name: Optional[str] = None,
     selects a subset of detector passes; ``allow`` suppresses findings
     (entries: check id, optionally ``@source-substring``) — suppressed
     findings stay in the report at INFO with ``data['allowed']``.
+    ``hbm_budget`` declares the program's peak-HBM budget (bytes, or a
+    suffixed string like ``"16GiB"``; default the ``PADDLE_HBM_BUDGET``
+    env) — the memory pass then emits a ``mem.budget`` ERROR when the
+    planned peak exceeds it, and the plan itself lands on
+    ``report.memory`` (``mem_top_k`` sizes its top-live-buffers list).
 
     Returns an :class:`AuditReport`; call ``.raise_on_error()`` to turn
     ERROR findings into a failing assertion (the tier-1 gate pattern).
@@ -97,22 +103,29 @@ def audit(fn, *args, donate=(), static_argnums=(), name: Optional[str] = None,
         *abstract_args)
 
     # flatten the dynamic inputs in invar order with the donation mask
+    # (and the per-argument leaf grouping the memory plan reports
+    # per-operand byte totals through)
     in_avals = list(closed.in_avals)
     donated = []
+    arg_groups = []
     for i, a in enumerate(abstract_args):
         if i in static:
             continue
         n = len(jax.tree_util.tree_leaves(a))
         donated.extend([i in donate] * n)
+        arg_groups.append(n)
     if len(donated) != len(in_avals):
         # tracing-order mismatch (exotic pytree): fail safe — donation
         # analysis would misattribute buffers, so skip it loudly
         donated = None
+        arg_groups = None
 
     name = name or getattr(fn, "__name__", "program")
     options = {"min_donation_bytes": min_donation_bytes,
                "const_budget_bytes": const_budget_bytes,
-               "bf16_compute": bf16_compute}
+               "bf16_compute": bf16_compute,
+               "hbm_budget": hbm_budget, "mem_top_k": mem_top_k,
+               "_arg_groups": arg_groups}
     ctx = AuditContext(
         closed_jaxpr=closed, name=name, in_avals=in_avals,
         donated=donated if donated is not None else [False] * len(in_avals),
@@ -144,14 +157,33 @@ def audit(fn, *args, donate=(), static_argnums=(), name: Optional[str] = None,
 
     report = AuditReport(
         name, findings, donation=options.get("_donation"),
-        collectives=options.get("_collectives"))
+        collectives=options.get("_collectives"),
+        memory=options.get("_memory"))
     report.out_shape = out_shape
     # distinguish "pass ran and found nothing" from "pass never ran":
     # cross_check_collectives refuses an unchecked report instead of
-    # reporting a spurious 0-vs-measured mismatch, and
-    # donation_coverage raises instead of reading a vacuous 1.0
+    # reporting a spurious 0-vs-measured mismatch, donation_coverage
+    # raises instead of reading a vacuous 1.0, and cross_check_memory
+    # refuses a report whose plan was never built
     report.collectives_checked = "_collectives" in options
     report.donation_checked = "_donation" in options
+    report.memory_checked = "_memory" in options
+    # stable structural identity for the program ledger: operand/result
+    # avals + the primitive histogram (at every nesting level) + the
+    # donation signature. Source lines deliberately do NOT enter — a
+    # comment-only refactor must not churn docs/programs.json.
+    import hashlib
+
+    from .jaxpr_utils import walk_eqns
+    hist: dict = {}
+    for eqn, _, _ in walk_eqns(closed):
+        hist[eqn.primitive.name] = hist.get(eqn.primitive.name, 0) + 1
+    h = hashlib.blake2b(digest_size=8)
+    h.update(repr([str(a) for a in in_avals]).encode())
+    h.update(repr([str(a) for a in closed.out_avals]).encode())
+    h.update(repr(sorted(hist.items())).encode())
+    h.update(repr(donate).encode())
+    report.fingerprint = h.hexdigest()
     from ..core import monitor
     if monitor.enabled:
         report.record()

@@ -33,6 +33,11 @@ machine-checked rules instead of one bespoke runtime test each:
   collectives   per-mesh-axis collective payload bytes, statically
                 accounted for cross-checking against the runtime
                 ``comm.bytes{axis=...}`` counters (PR 2)
+  memory        donation-aware buffer liveness (memory.py): peak live
+                HBM bytes per program as a MemoryPlan on
+                ``report.memory``, and a ``mem.budget`` ERROR when the
+                peak exceeds the declared budget
+                (``audit(hbm_budget=)`` / ``PADDLE_HBM_BUDGET``)
 """
 from __future__ import annotations
 
@@ -371,6 +376,10 @@ def detect_collectives(ctx: AuditContext) -> List[Finding]:
 
 # -------------------------------------------------------------- registry
 
+# the buffer-liveness pass lives in its own module (memory.py) — it is
+# a planner with its own result type (MemoryPlan), not just findings
+from .memory import detect_memory  # noqa: E402
+
 DetectorFn = Callable[[AuditContext], List[Finding]]
 
 DETECTORS: Dict[str, DetectorFn] = {
@@ -380,6 +389,7 @@ DETECTORS: Dict[str, DetectorFn] = {
     "constants": detect_baked_constants,
     "quant_escape": detect_quant_escape,
     "collectives": detect_collectives,
+    "memory": detect_memory,
 }
 
 

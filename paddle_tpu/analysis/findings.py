@@ -51,7 +51,8 @@ class AuditReport:
 
     def __init__(self, name: str, findings: List[Finding],
                  donation: Optional[dict] = None,
-                 collectives: Optional[Dict[str, int]] = None):
+                 collectives: Optional[Dict[str, int]] = None,
+                 memory=None):
         self.name = name
         self.findings = list(findings)
         #: {'donated_bytes', 'missed_bytes', 'unused_bytes', 'coverage'}
@@ -60,6 +61,10 @@ class AuditReport:
             "coverage": 1.0}
         #: static per-mesh-axis collective payload bytes
         self.collectives = dict(collectives or {})
+        #: the program's :class:`analysis.memory.MemoryPlan` (peak live
+        #: HBM bytes, top live set at the peak, per-phase breakdown) —
+        #: None when the memory pass did not run
+        self.memory = memory
         #: the audited function's outputs as ShapeDtypeStructs in their
         #: original pytree structure (set by audit(); = eval_shape of
         #: the program, recovered from the same trace) — lets callers
@@ -74,6 +79,12 @@ class AuditReport:
         #: donation_coverage then RAISES instead of reading a vacuous
         #: 1.0 through a tier-1 gate
         self.donation_checked = True
+        #: False when the memory pass did not run (checks= excluded
+        #: it): cross_check_memory refuses such a report
+        self.memory_checked = memory is not None
+        #: structural program identity (set by audit(): aval + primitive
+        #: histogram + donation hash) — the ledger's drift key
+        self.fingerprint: Optional[str] = None
 
     # ------------------------------------------------------------ slicing
     @property
@@ -122,6 +133,11 @@ class AuditReport:
             lines.append(f"  {f}")
         for axis, nbytes in sorted(self.collectives.items()):
             lines.append(f"  collective[{axis}]: {nbytes} bytes/step")
+        if self.memory is not None:
+            head = (f" (headroom {self.memory.headroom_bytes})"
+                    if self.memory.budget is not None else "")
+            lines.append(f"  memory: peak {self.memory.peak_bytes} "
+                         f"bytes at {self.memory.peak_source}{head}")
         return "\n".join(lines)
 
     def record(self):
@@ -132,6 +148,13 @@ class AuditReport:
         from ..core import monitor
         for f in self.findings:
             monitor.record_analysis_finding(f.check, f.severity.name)
+        if self.memory is not None:
+            monitor.record_memory_plan(self.name,
+                                       self.memory.peak_bytes)
+            over = [f for f in self.findings if f.check == "mem.budget"
+                    and f.severity == Severity.ERROR]
+            if over:
+                monitor.record_budget_violation(self.name, len(over))
         return self
 
     def __str__(self):

@@ -57,6 +57,8 @@ Metric name scheme (what the summary views group by):
     serve.slot_occupancy        gauge: busy decode slots / max_batch
     serve.cancellations{reason=...}   deadline/shutdown cancellations
     analysis.findings{check=,severity=}   static-audit findings
+    analysis.mem.peak_bytes     gauge: planned peak HBM per program
+    analysis.mem.budget_violations   programs over their HBM budget
     telemetry.scrapes{endpoint=...}   telemetry-server HTTP requests
     flightrecorder.dumps{reason=...}  flight-recorder dump files written
 """
@@ -100,6 +102,7 @@ DECLARED_METRICS = frozenset({
     "serve.cache.prefix_hits",
     "serve.cache.prefix_shared_pages", "serve.cache.cow_copies",
     "analysis.findings",
+    "analysis.mem.peak_bytes", "analysis.mem.budget_violations",
     "telemetry.scrapes", "flightrecorder.dumps",
 })
 
@@ -275,6 +278,15 @@ METRIC_DOC = {
     "analysis.findings": ("counter", ("check", "severity"),
                           "static-audit findings by detector and "
                           "severity"),
+    "analysis.mem.peak_bytes": ("gauge", ("program",),
+                                "statically planned peak live HBM "
+                                "bytes of one audited program "
+                                "(MemoryPlan.peak_bytes)"),
+    "analysis.mem.budget_violations": ("counter", ("program",),
+                                       "audited programs whose "
+                                       "planned peak exceeded the "
+                                       "declared HBM budget "
+                                       "(mem.budget ERROR findings)"),
     "telemetry.scrapes": ("counter", ("endpoint",),
                           "telemetry-server HTTP requests by endpoint "
                           "(metrics | healthz | readyz | "
@@ -675,6 +687,28 @@ def record_analysis_finding(check: str, severity: str, n: int = 1):
     metrics.counter("analysis.findings", check=check,
                     severity=severity).inc(int(n))
     metrics.counter("analysis.findings").inc(int(n))
+
+
+def record_memory_plan(program: str, peak_bytes: int):
+    """One program's statically planned peak HBM (the memory pass of
+    the auditor) — a gauge per program name so dashboards trend the
+    footprint of each flagship program across deploys."""
+    if not enabled:
+        return
+    # labeled series only: gauges don't aggregate — an unlabeled
+    # last-writer-wins series would flap between unrelated programs
+    metrics.gauge("analysis.mem.peak_bytes",
+                  program=program).set(int(peak_bytes))
+
+
+def record_budget_violation(program: str, n: int = 1):
+    """Audited programs whose planned peak exceeded the declared HBM
+    budget (``mem.budget`` ERROR findings)."""
+    if not enabled:
+        return
+    metrics.counter("analysis.mem.budget_violations",
+                    program=program).inc(int(n))
+    metrics.counter("analysis.mem.budget_violations").inc(int(n))
 
 
 # ------------------------------------------------------- telemetry layer

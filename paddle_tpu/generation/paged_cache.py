@@ -537,14 +537,13 @@ class PageAllocator:
                     self._maybe_release(p)
             self.version += 1
 
-    def _maybe_release(self, page: int):
-        # caller holds self._lock
+    def _maybe_release(self, page: int):  # lint: lock-discipline-ok (caller holds self._lock)
         if page in self._ref or page in self._page_key:
             return
         self._free.append(page)
         self.stats["pages_freed"] += 1
 
-    def _reclaim(self, need: int, protect=frozenset()):
+    def _reclaim(self, need: int, protect=frozenset()):  # lint: lock-discipline-ok (caller holds self._lock)
         """Evict cached (refcount-0, registered) prefix pages LRU-first
         until ``need`` pages were freed or nothing reclaimable is
         left. Caller holds self._lock."""

@@ -145,7 +145,7 @@ class Config:
                        trace_sample=None, telemetry_port=None,
                        paged: bool = False, kv_page_size=None,
                        kv_pages=None, kv_cache_dtype=None,
-                       weight_bits=None):
+                       weight_bits=None, hbm_budget=None):
         """Continuous-batching knobs for ``paddle_tpu.serving.
         ServingEngine`` (which also needs ``enable_generation()`` — the
         engine reuses its prompt-bucket set, fixed decode batch, and
@@ -177,7 +177,15 @@ class Config:
         ``weight_bits=4`` additionally packs the served Linear weights
         two-nibbles-per-int8 with per-channel scales (precision Int8
         weight-only only; dequant stays in-trace) — the int4 decode
-        weight path."""
+        weight path.
+
+        ``hbm_budget`` (bytes, or ``"16GiB"``-style; also
+        ``PADDLE_HBM_BUDGET``) declares the engine's peak-HBM budget:
+        the constructor runs the static planner (``analysis.memory``)
+        over the decode/admission programs and FAILS FAST when
+        weights + kv pool + program peak cannot fit — an OOM caught
+        before a single buffer compiles; ``health()`` then exports the
+        predicted headroom for the router."""
         from ..generation.kv_cache import validate_cache_dtype
         validate_cache_dtype(kv_cache_dtype)
         if weight_bits not in (None, 4, 8):
@@ -192,7 +200,7 @@ class Config:
             trace_sample=trace_sample, telemetry_port=telemetry_port,
             paged=bool(paged), kv_page_size=kv_page_size,
             kv_pages=kv_pages, kv_cache_dtype=kv_cache_dtype,
-            weight_bits=weight_bits)
+            weight_bits=weight_bits, hbm_budget=hbm_budget)
         return self
 
     def set_compile_cache_dir(self, path: str):

@@ -116,7 +116,8 @@ class ServingEngine:
                  telemetry_port: Optional[int] = None,
                  paged: Optional[bool] = None,
                  kv_page_size: Optional[int] = None,
-                 kv_pages: Optional[int] = None):
+                 kv_pages: Optional[int] = None,
+                 hbm_budget=None):
         from ..inference.precision import serving_params
         from ..jit.api import _unwrap, functional_call
 
@@ -465,9 +466,12 @@ class ServingEngine:
         # donation set must be the set the production program uses.
         self._spec_admit_buf = (11, 12) if self._alloc is None \
             else (13, 14)
+        # the _intent tuples are the TPU donation design regardless of
+        # the running backend — audit() and memory_plan() gate against
+        # THEM, the jit wiring applies them only where donation works
         if spec is None:
-            self._step_donate = (1, 2, 3, 4, 5, 6, 7) if tpu else ()
-            self._admit_donate = (0, 1, 2, 3, 4, 5, 7) if tpu else ()
+            self._step_donate_intent = (1, 2, 3, 4, 5, 6, 7)
+            self._admit_donate_intent = (0, 1, 2, 3, 4, 5, 7)
             step_static = (8,)
         else:
             # the spec step additionally carries the drafter's token
@@ -475,11 +479,14 @@ class ServingEngine:
             # all donated (in-place across polls, audited as intent).
             # The paged spec admit's tok_buf/tok_len sit two positions
             # later (after table_row/start).
-            self._step_donate = tuple(range(1, 12)) if tpu else ()
-            self._admit_donate = (0, 1, 2, 3, 4, 5, 7) \
-                + self._spec_admit_buf if tpu else ()
+            self._step_donate_intent = tuple(range(1, 12))
+            self._admit_donate_intent = (0, 1, 2, 3, 4, 5, 7) \
+                + self._spec_admit_buf
             step_static = (12, 13)
-        self._free_donate = (0, 1) if tpu else ()
+        self._free_donate_intent = (0, 1)
+        self._step_donate = self._step_donate_intent if tpu else ()
+        self._admit_donate = self._admit_donate_intent if tpu else ()
+        self._free_donate = self._free_donate_intent if tpu else ()
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
         self._step_jit = jax.jit(
             self._step_fn, static_argnums=step_static,
@@ -604,6 +611,44 @@ class ServingEngine:
                           cancelled=0, rejected=0, slots_reused=0,
                           decode_steps=0, prefills=0,
                           spec_proposed=0, spec_accepted=0)
+        # ------------------------------------------------ HBM planning
+        # admission control for MEMORY, before a single buffer compiles:
+        # with a budget declared (kwarg > enable_serving > env), the
+        # static planner (analysis.memory) predicts the engine's peak —
+        # weights + kv pool + lanes resident, plus the decode/admission
+        # transients — and a config that cannot fit fails HERE, not as
+        # an on-device OOM under traffic (the kv_pages-too-small
+        # fail-fast contract). health() reports the headroom.
+        self._mem_summary = None
+        self.hbm_budget = None
+        from ..analysis.memory import resolve_hbm_budget
+        explicit_budget = _opt(hbm_budget, "hbm_budget", None)
+        if explicit_budget is not None:
+            # an explicit (kwarg / enable_serving) garbage budget
+            # RAISES: the operator asked for a gate and must get one
+            self.hbm_budget = resolve_hbm_budget(explicit_budget)
+        else:
+            try:
+                self.hbm_budget = resolve_hbm_budget()
+            except ValueError as e:
+                # a garbage ENV budget must not crash (or silently
+                # gate) the engine: swallow observably, serve ungated
+                monitor.record_swallowed("serving.hbm_budget", e)
+        if self.hbm_budget is not None:
+            mp = self.memory_plan()
+            if mp["predicted_peak_bytes"] > self.hbm_budget:
+                raise ValueError(
+                    f"predicted peak HBM {mp['predicted_peak_bytes']} "
+                    f"bytes exceeds hbm_budget {self.hbm_budget} "
+                    f"(weights {mp['weights_bytes']}, kv cache "
+                    f"{mp['kv_cache_bytes']}, lanes "
+                    f"{mp['lanes_bytes']}, decode peak "
+                    f"{mp['decode_peak_bytes']}, admission prefill "
+                    f"peak {mp['prefill_peak_bytes']}); shrink "
+                    "max_batch/cache_max_len/kv_pages or quantize the "
+                    "cache (kv_cache_dtype='int8'), or raise the "
+                    "budget (PADDLE_HBM_BUDGET / "
+                    "enable_serving(hbm_budget=...))")
         # live export surface: opt-in via telemetry_port= (here or in
         # Config.enable_serving) or PADDLE_TELEMETRY_PORT. Started
         # BEFORE warmup so /healthz answers while the replica warms
@@ -999,8 +1044,8 @@ class ServingEngine:
             self._row_pages[slot] = pages
         if self._slot_used[slot]:
             self.stats["slots_reused"] += 1
-        self._slot_used[slot] = True
-        self._slots[slot] = req
+        self._slot_used[slot] = True  # lint: lock-discipline-ok (admission runs under the caller's pump lock)
+        self._slots[slot] = req  # lint: lock-discipline-ok (admission runs under the caller's pump lock)
         req.status = RequestStatus.RUNNING
         self.stats["admitted"] += 1
         monitor.record_serve_slot_occupancy(
@@ -1070,8 +1115,8 @@ class ServingEngine:
             if fin[i]:
                 toks = np.asarray(self._out_buf[i])[:int(steps[i])]  # lint: host-sync-ok (one row read per completion)
                 self._complete(req, toks)
-                self._slots[i] = None   # freed in place; next admission
-                #                         overwrites the row
+                # freed in place; the next admission overwrites the row
+                self._slots[i] = None  # lint: lock-discipline-ok (poll runs under the caller's pump lock)
                 self._free_slot_pages(i)
             elif req.deadline is not None and now > req.deadline:
                 self._evict(i, req, "deadline", int(steps[i]))
@@ -1135,7 +1180,7 @@ class ServingEngine:
             row = np.asarray(self._out_buf[slot])  # lint: host-sync-ok (partial row on eviction)
             req.tokens = row[:n_done].astype(np.int32)
             req.n_emitted = n_done
-        self._slots[slot] = None
+        self._slots[slot] = None  # lint: lock-discipline-ok (eviction runs under the caller's pump lock)
         self._free_slot_pages(slot)
         self._cancel(req, reason)
 
@@ -1422,8 +1467,85 @@ class ServingEngine:
                 "total_pages": self._alloc.n_pages - 1,
                 "page_occupancy": round(
                     self._alloc.page_occupancy(), 4)} if paged else {}),
+            # static HBM plan (computed when a budget gates the engine,
+            # or on the first memory_plan() call): the router can admit
+            # on PREDICTED headroom instead of discovering an OOM
+            **({"predicted_peak_bytes":
+                    self._mem_summary["predicted_peak_bytes"],
+                **({"hbm_budget": self.hbm_budget,
+                    "predicted_headroom_bytes":
+                        self.hbm_budget
+                        - self._mem_summary["predicted_peak_bytes"]}
+                   if self.hbm_budget is not None else {})}
+               if self._mem_summary is not None else {}),
             "warm": self._warm, "draining": self._shutdown,
         }
+
+    # ---------------------------------------------------- memory plan
+    def memory_plan(self) -> Dict:
+        """Predicted HBM footprint of this engine, from the static
+        planner (``analysis.plan_memory`` — trace-only, nothing
+        executes): the decode program's peak at the TPU donation
+        intent (weights + kv cache + lanes resident, in-place via
+        donation) and the admission transient (a batch-1 prefill at
+        the largest bucket runs WHILE the engine state is resident —
+        its peak minus the shared weights rides on top). Returns the
+        byte breakdown plus the two :class:`analysis.MemoryPlan`\\ s;
+        cached after the first call. The constructor validates this
+        against ``hbm_budget`` and ``health()`` exports the headroom."""
+        if self._mem_summary is not None:
+            return self._mem_summary
+        from ..analysis import plan_memory
+        self._ensure_eval()
+        sds = jax.ShapeDtypeStruct
+        state = tuple(sds(tuple(v.shape), v.dtype) for v in self._state)
+        key = sds((2,), jnp.uint32)
+        if self._spec is None:
+            decode = plan_memory(
+                self._step_fn, state, self._tok, self._cache, key,
+                self._finished, self._steps, self._budget,
+                self._out_buf, self._cfg, static_argnums=(8,),
+                donate=self._step_donate_intent,
+                name="serving.decode")
+        else:
+            decode = plan_memory(
+                self._step_fn, state, self._tok, self._cache, key,
+                self._finished, self._steps, self._budget,
+                self._out_buf, self._tok_buf, self._tok_len,
+                self._proposed, self._accepted, self._cfg, self._spec,
+                static_argnums=(12, 13),
+                donate=self._step_donate_intent,
+                name="serving.decode")
+        prefill = plan_memory(
+            self._prefill_fn, state, sds((1, self.buckets[-1]),
+                                         jnp.int32),
+            sds((1,), jnp.int32), key, self._cfg, self.max_len,
+            static_argnums=(4, 5),
+            name=f"serving.prefill.{self.buckets[-1]}")
+        if decode.arg_bytes is not None:
+            weights = decode.arg_bytes[0]
+            kv = decode.arg_bytes[2]
+            lanes = sum(decode.arg_bytes) - weights - kv
+            resident = sum(decode.arg_bytes)
+            predicted = max(decode.peak_bytes,
+                            resident + prefill.peak_bytes - weights)
+        else:
+            # exotic-pytree fail-safe (audit couldn't line leaves up
+            # with positional args): no per-operand breakdown, and the
+            # prefill transient can't subtract the shared weights —
+            # predict CONSERVATIVELY rather than crash or under-gate
+            weights = kv = lanes = None
+            predicted = max(decode.peak_bytes,
+                            decode.args_bytes + prefill.peak_bytes)
+        self._mem_summary = {
+            "weights_bytes": weights, "kv_cache_bytes": kv,
+            "lanes_bytes": lanes,
+            "decode_peak_bytes": decode.peak_bytes,
+            "prefill_peak_bytes": prefill.peak_bytes,
+            "predicted_peak_bytes": predicted,
+            "plans": {"decode": decode, "prefill": prefill},
+        }
+        return self._mem_summary
 
     # ------------------------------------------------------------ audit
     def audit(self, **audit_kw) -> Dict:
@@ -1461,20 +1583,19 @@ class ServingEngine:
         # pytree and every lane stay in place across admissions)
         paged_a = () if self._alloc is None else (
             sds((self.pages_per_row,), jnp.int32), scalar)
-        spec_buf = self._spec_admit_buf
         if self._spec is None:
             reports["decode"] = _audit(
                 self._step_fn, state, self._tok, self._cache, self._key,
                 self._finished, self._steps, self._budget, self._out_buf,
                 self._cfg, static_argnums=(8,),
-                donate=(1, 2, 3, 4, 5, 6, 7), name=f"{base}.decode",
-                **audit_kw)
+                donate=self._step_donate_intent,
+                name=f"{base}.decode", **audit_kw)
             reports["admit"] = _audit(
                 self._admit_fn, self._cache, self._tok, self._finished,
                 self._steps, self._budget, self._out_buf, scalar,
                 row_cache_a, tok_a, fin_a, scalar, *paged_a,
-                donate=(0, 1, 2, 3, 4, 5, 7), name=f"{base}.admit",
-                **audit_kw)
+                donate=self._admit_donate_intent,
+                name=f"{base}.admit", **audit_kw)
         else:
             # the speculative step IS the decode program the scheduler
             # dispatches: fused ngram draft + single-dispatch verify,
@@ -1484,7 +1605,8 @@ class ServingEngine:
                 self._finished, self._steps, self._budget, self._out_buf,
                 self._tok_buf, self._tok_len, self._proposed,
                 self._accepted, self._cfg, self._spec,
-                static_argnums=(12, 13), donate=tuple(range(1, 12)),
+                static_argnums=(12, 13),
+                donate=self._step_donate_intent,
                 name=f"{base}.decode", **audit_kw)
             reports["admit"] = _audit(
                 self._admit_fn, self._cache, self._tok, self._finished,
@@ -1492,11 +1614,12 @@ class ServingEngine:
                 row_cache_a, tok_a, fin_a, scalar, *paged_a,
                 self._tok_buf, self._tok_len,
                 sds((self.max_len,), jnp.int32), scalar,
-                donate=(0, 1, 2, 3, 4, 5, 7) + spec_buf,
+                donate=self._admit_donate_intent,
                 name=f"{base}.admit", **audit_kw)
         reports["free"] = _audit(
             self._free_fn, self._cache, self._finished, scalar,
-            donate=(0, 1), name=f"{base}.free", **audit_kw)
+            donate=self._free_donate_intent, name=f"{base}.free",
+            **audit_kw)
         return reports
 
     def __repr__(self):

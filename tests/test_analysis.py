@@ -318,6 +318,9 @@ class TestFlagshipGates:
         assert not report.by_check("donation.miss")
         # params + optimizer state fully donated: in-place HBM updates
         assert report.donation_coverage == 1.0
+        # ISSUE-14: every flagship audit carries a memory plan
+        assert report.memory is not None
+        assert report.memory.peak_bytes > 0
 
     def test_distributed_step_audit_clean(self):
         from paddle_tpu.distributed import fleet, topology
@@ -346,6 +349,7 @@ class TestFlagshipGates:
         report.raise_on_error()
         assert not report.by_check("donation.miss")
         assert report.donation_coverage == 1.0
+        assert report.memory is not None           # ISSUE-14 threading
 
     def test_generation_pair_audit_clean(self):
         from paddle_tpu.generation.api import GenerationSession
@@ -366,6 +370,10 @@ class TestFlagshipGates:
         # the TPU intent even on the CPU test backend)
         assert decode.donation_coverage == 1.0
         assert not decode.by_check("donation.miss")
+        # ISSUE-14: the pair carries memory plans, and donation keeps
+        # the decode peak below two cache copies' worth of growth
+        assert prefill.memory is not None and decode.memory is not None
+        assert decode.memory.donated_bytes > 0
 
     def test_predictor_bucket_audit_clean(self):
         from paddle_tpu.inference import Config, create_predictor
@@ -385,6 +393,7 @@ class TestFlagshipGates:
             rep.raise_on_error()
             if key[0] == "decode":
                 assert rep.donation_coverage == 1.0
+            assert rep.memory is not None          # ISSUE-14 threading
         pred.audit_forward().raise_on_error()
 
     def test_predictor_audit_mirrors_serving_precision(self):

@@ -233,6 +233,108 @@ class TestCompileCacheDirRule:
         assert _lint_snippet(tmp_path, src, "bench.py")
 
 
+class TestLockDisciplineRule:
+    ALLOC = "paddle_tpu/generation/paged_cache.py"
+    ENGINE = "paddle_tpu/serving/engine.py"
+
+    BAD_ALLOC = """
+        import threading
+
+        class PageAllocator:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._free = [1, 2, 3]
+                self._ref = {}
+
+            def free_row(self, pages):
+                for p in pages:
+                    n = self._ref.get(p, 0) - 1
+                    if n <= 0:
+                        self._ref.pop(p, None)
+                        self._free.append(p)
+
+            def forget(self, key):
+                del self._page_key[key]
+        """
+
+    def test_flags_unlocked_allocator_writes(self, tmp_path):
+        found = _lint_snippet(tmp_path, self.BAD_ALLOC, self.ALLOC)
+        assert _rules_of(found) == ["lock-discipline"]
+        # _ref.pop + _free.append + the del-statement mutation form
+        assert len(found) == 3
+        # __init__ construction is exempt (no second thread exists yet)
+        assert all(f.line > 10 for f in found)
+
+    def test_locked_writes_and_markers_pass(self, tmp_path):
+        src = """
+            import threading
+
+            class PageAllocator:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._free = []
+                    self._ref = {}
+
+                def free_row(self, pages):
+                    with self._lock:
+                        for p in pages:
+                            self._free.append(p)
+                            self._ref.pop(p, None)
+
+                def _maybe_release(self, page):  # lint: lock-discipline-ok (caller holds self._lock)
+                    self._free.append(page)
+
+                def reads_are_free(self):
+                    return len(self._free)
+            """
+        assert not _lint_snippet(tmp_path, src, self.ALLOC)
+        # same writes in a module OUTSIDE the scoped set: fine
+        assert not _lint_snippet(tmp_path, self.BAD_ALLOC,
+                                 "paddle_tpu/vision/ops.py")
+
+    def test_flags_engine_slot_and_queue_writes(self, tmp_path):
+        src = """
+            import threading
+
+            class ServingEngine:
+                def __init__(self):
+                    self._qlock = threading.Lock()
+                    self._pump_lock = threading.RLock()
+                    self._queue = []
+                    self._slots = [None] * 4
+
+                def submit(self, req):
+                    self._queue.append(req)
+
+                def finish(self, slot):
+                    self._slots[slot] = None
+
+                def locked_ok(self, req, slot):
+                    with self._qlock:
+                        self._queue.append(req)
+                    with self._pump_lock:
+                        self._slots[slot] = req
+            """
+        found = _lint_snippet(tmp_path, src, self.ENGINE)
+        assert _rules_of(found) == ["lock-discipline"]
+        assert len(found) == 2
+        assert {f.line for f in found} == {12, 15}
+
+    def test_line_marker_escapes_with_reason(self, tmp_path):
+        src = """
+            import threading
+
+            class ServingEngine:
+                def __init__(self):
+                    self._pump_lock = threading.RLock()
+                    self._slots = [None] * 4
+
+                def _evict(self, slot):
+                    self._slots[slot] = None  # lint: lock-discipline-ok (caller holds pump lock)
+            """
+        assert not _lint_snippet(tmp_path, src, self.ENGINE)
+
+
 class TestChaosMarkerRule:
     def test_flags_unmarked_import(self, tmp_path):
         found = _lint_snippet(tmp_path, """
@@ -269,7 +371,8 @@ class TestEngine:
     def test_all_rules_registered(self):
         assert set(RULES) == {"host-sync", "jit-random", "bare-except",
                               "metric-name", "chaos-marker",
-                              "compile-cache-dir", "dead-metric"}
+                              "compile-cache-dir", "dead-metric",
+                              "lock-discipline"}
 
     def test_syntax_error_is_reported_not_raised(self, tmp_path):
         found = _lint_snippet(tmp_path, "def broken(:\n",

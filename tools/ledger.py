@@ -1,0 +1,61 @@
+"""Refresh / check the committed program ledger (docs/programs.json).
+
+The ledger freezes every flagship program's audited signature —
+structural fingerprint, donation coverage, planned peak HBM bytes,
+per-axis collective bytes, finding counts — so capacity-relevant drift
+fails CI as a JSON diff (see ``paddle_tpu/analysis/ledger.py``).
+
+    python -m tools.ledger --update    # rewrite docs/programs.json
+    python -m tools.ledger --check     # exit 1 on drift (CI form)
+
+The manifest is defined on the CPU backend (kernel selection differs
+on TPU), so this entry point pins ``JAX_PLATFORMS=cpu`` before any jax
+import — run it anywhere, the bytes come out the same.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    mode = argv[0] if argv else "--check"
+    if mode not in ("--check", "--update"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    # must precede the jax import chain: the committed ledger is the
+    # CPU-traced program set whatever machine regenerates it, at the
+    # tier-1 virtual device count (tests/conftest.py pins 8 — the
+    # fleet step's mesh, and therefore its fingerprint, depend on it).
+    # FORCED, not defaulted: a shell-exported device count or program
+    # knob would commit a manifest CI can never reproduce.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "").split()
+    flags = [f for f in flags
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=8")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from paddle_tpu.analysis import ledger
+    for knob in ledger.SCRUB_ENV:
+        os.environ.pop(knob, None)
+
+    if mode == "--update":
+        path = ledger.update()
+        print(f"wrote {path}", file=sys.stderr)
+        return 0
+    diffs = ledger.check()
+    if diffs:
+        print("docs/programs.json drift (run `python -m tools.ledger "
+              "--update` if deliberate):", file=sys.stderr)
+        for d in diffs:
+            print(f"  {d}", file=sys.stderr)
+        return 1
+    print("ledger green", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

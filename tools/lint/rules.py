@@ -404,6 +404,147 @@ def check_compile_cache_dir(ctx: FileContext) -> List[LintFinding]:
     return findings
 
 
+# ------------------------------------------------------- lock-discipline
+
+# Shared mutable state that MUST be written under a lock: the scheduler
+# thread, the telemetry HTTP thread, and Future.result() pumps all
+# touch these concurrently (the PR-12 telemetry-thread race class).
+# relpath -> {class name -> protected attribute names}. Writes are
+# legal (a) lexically inside a ``with self.<...lock...>:`` block, (b)
+# in ``__init__`` (single-threaded construction), or (c) on a line /
+# in a method whose def line carries ``# lint: lock-discipline-ok
+# (reason)`` — the "caller holds the lock" helpers.
+LOCK_DISCIPLINE = {
+    "paddle_tpu/generation/paged_cache.py": {
+        "PageAllocator": frozenset({
+            "_free", "_ref", "_prefix", "_page_key"}),
+    },
+    "paddle_tpu/serving/engine.py": {
+        "ServingEngine": frozenset({
+            "_queue", "_slots", "_slot_used"}),
+    },
+}
+
+# deque/list/dict/OrderedDict methods that mutate their receiver
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault",
+    "move_to_end", "sort", "reverse", "add", "discard",
+})
+
+
+def _protected_attr(node: ast.AST, attrs) -> Optional[str]:
+    """The protected ``self.X`` attribute a node writes/mutates, if
+    any: plain/aug/subscript assignment targets and mutator-method
+    calls on ``self.X``."""
+    def self_attr(n):
+        if isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name) and n.value.id == "self" \
+                and n.attr in attrs:
+            return n.attr
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+        targets = [node.target] if isinstance(node, ast.AugAssign) \
+            else node.targets
+        for t in targets:
+            for el in ast.walk(t):
+                if isinstance(el, ast.Subscript):
+                    hit = self_attr(el.value)
+                    if hit:
+                        return hit
+                hit = self_attr(el)
+                if hit:
+                    return hit
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATOR_METHODS:
+        recv = node.func.value
+        if isinstance(recv, ast.Subscript):
+            recv = recv.value
+        return self_attr(recv)
+    return None
+
+
+def _lock_with_items(with_node: ast.With) -> bool:
+    """True when the with-statement enters ``self.<something lock>``
+    (``self._lock``, ``self._qlock``, ``self._pump_lock``, including
+    ``.acquire()``-less RLock reentry)."""
+    for item in with_node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and "lock" in n.attr \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self":
+                return True
+    return False
+
+
+def _def_line_marked(ctx: FileContext, fn: ast.AST, rule_name: str) -> bool:
+    """Marker on the method's def line (or a decorator line): the
+    whole body is exempt — the 'caller holds self._lock' helpers."""
+    token = f"lint: {rule_name}-ok"
+    lines = [fn.lineno] + [d.lineno for d in
+                           getattr(fn, "decorator_list", [])]
+    return any(token in ctx.lines[ln - 1] for ln in lines
+               if 0 < ln <= len(ctx.lines))
+
+
+@rule("lock-discipline")
+def check_lock_discipline(ctx: FileContext) -> List[LintFinding]:
+    """Writes to the allocator free-list/refcount maps and the engine
+    queue/slot tables outside a ``with self._lock``-style block: the
+    statically-catchable form of the PR-12 telemetry-thread race (an
+    HTTP scrape iterating ``self._free`` mid-mutation). Helpers whose
+    caller holds the lock mark their def line ``# lint:
+    lock-discipline-ok (caller holds self._lock)``."""
+    scopes = LOCK_DISCIPLINE.get(ctx.relpath)
+    if not scopes:
+        return []
+    findings = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef) or cls.name not in scopes:
+            continue
+        attrs = scopes[cls.name]
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__" or \
+                    _def_line_marked(ctx, fn, "lock-discipline"):
+                continue
+
+            def walk_fn(node, locked):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                        continue  # nested defs run elsewhere
+                    child_locked = locked or (
+                        isinstance(child, ast.With)
+                        and _lock_with_items(child))
+                    if not child_locked:
+                        hit = _protected_attr(child, attrs)
+                        if hit and not ctx.allowed(
+                                child, "lock-discipline"):
+                            findings.append(LintFinding(
+                                ctx.relpath, child.lineno,
+                                child.col_offset, "lock-discipline",
+                                f"write to self.{hit} outside a 'with "
+                                "self._lock' block: another thread "
+                                "(telemetry scrape, Future.result "
+                                "pump) can observe it mid-mutation; "
+                                "take the lock, or mark the line/def "
+                                "'# lint: lock-discipline-ok (reason)'"
+                                " if the caller holds it"))
+                    walk_fn(child, child_locked)
+
+            walk_fn(fn, False)
+    return findings
+
+
 # ---------------------------------------------------------- chaos-marker
 
 def _has_chaos_marker(nodes: List[ast.AST]) -> bool:
