@@ -901,6 +901,16 @@ def bench_serve(dev, on_tpu):
 
     engine = build()
     qps, handles, _ = traffic(engine)
+    # ISSUE-15 "goodput" sub-dict: the serve-side wall-time ledger
+    # after the first (flagship) pass — buckets sum to wall, compute
+    # fraction is the replica's goodput under this traffic shape
+    gp = engine.goodput()
+    goodput_row = {
+        "wall_s": round(gp["wall_s"], 3),
+        "goodput_fraction": round(gp["goodput_fraction"], 4),
+        "buckets_s": {k: round(v, 3)
+                      for k, v in gp["buckets"].items() if v > 0},
+    }
 
     # ISSUE-13 per-precision rows: the SAME traffic against the int8-KV
     # engine and the int8-KV + int4-weight engine (counters prove the
@@ -961,6 +971,7 @@ def bench_serve(dev, on_tpu):
         "sla": sla,
         "precision": precision,
         "mem": mem,
+        "goodput": goodput_row,
     }
 
 

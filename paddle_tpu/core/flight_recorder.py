@@ -46,11 +46,69 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
-    "FlightRecorder", "auto_dump", "capacity", "clear", "configure",
-    "disable", "dump", "dump_dict", "enable", "enabled", "events",
-    "is_enabled", "now_ns", "record", "record_span", "spans_between",
-    "tail",
+    "DECLARED_EVENTS", "EVENT_DOC", "FlightRecorder", "auto_dump",
+    "capacity", "clear", "clock_offset_ns", "configure", "disable",
+    "dump", "dump_dict", "enable", "enabled", "events", "identity",
+    "is_enabled", "now_ns", "record", "record_span",
+    "set_clock_offset_ns", "spans_between", "tail",
 ]
+
+# The declared event-name families. Every point event recorded through
+# this module from inside paddle_tpu/ must use a name from this set —
+# the tools/lint rule `event-name` parses this literal (the
+# DECLARED_METRICS precedent) and rejects undeclared literals, so a
+# typo'd event name can't silently record a stream nobody greps for in
+# a post-mortem. Span names (request traces) are dynamic per request
+# and exempt. docs/events.md is generated from EVENT_DOC below.
+DECLARED_EVENTS = frozenset({
+    "jit.compile", "comm.dispatch",
+    "train.step_begin", "train.step_end",
+    "train.anomaly", "train.anomaly_restore",
+    "fit.crash",
+    "serve.submit", "serve.admit", "serve.evict", "serve.finish",
+    "serve.preempted", "serve.crash",
+    "serve.drain_begin", "serve.drain_end",
+    "watchdog.timeout",
+    "resilience.preemption",
+    "checkpoint.commit",
+    "fleet.clock_sync", "fleet.rank_stale",
+})
+
+# name -> one-line description; `python -m tools.metrics_doc` renders
+# docs/events.md from this table and a tier-1 drift test keeps the
+# committed doc in sync (keys must == DECLARED_EVENTS).
+EVENT_DOC = {
+    "jit.compile": "a jax.jit cache miss (retrace), with cause/target",
+    "comm.dispatch": "an eager collective/p2p dispatch (op, axis, "
+                     "bytes)",
+    "train.step_begin": "fit() dispatched a train step (step, epoch)",
+    "train.step_end": "a loss matured out of the async window (step, "
+                      "loss)",
+    "train.anomaly": "non-finite loss skipped by the anomaly guard",
+    "train.anomaly_restore": "anomaly guard restored the last good "
+                             "snapshot",
+    "fit.crash": "uncaught exception aborted Model.fit (error)",
+    "serve.submit": "a request entered the serving queue (req)",
+    "serve.admit": "a request was admitted to a decode slot (req, "
+                   "slot, bucket)",
+    "serve.evict": "an in-flight request was evicted (req, slot, "
+                   "reason, tokens)",
+    "serve.finish": "a request reached a terminal status (req, "
+                    "status, tokens)",
+    "serve.preempted": "preemption observed mid-serve (in_flight)",
+    "serve.crash": "uncaught exception in serve_forever (error)",
+    "serve.drain_begin": "graceful drain started (queued, in_flight)",
+    "serve.drain_end": "graceful drain finished",
+    "watchdog.timeout": "a hang watchdog expired (label, timeout_s)",
+    "resilience.preemption": "preemption landed at a step boundary "
+                             "(step, source=signal|store)",
+    "checkpoint.commit": "a checkpoint step's commit marker was "
+                         "written (step)",
+    "fleet.clock_sync": "fleet clock handshake result (offset_ns, "
+                        "rtt_ns vs the TCPStore master clock)",
+    "fleet.rank_stale": "the fleet aggregator marked a rank stale "
+                        "(rank, incarnation, age_s)",
+}
 
 DEFAULT_CAPACITY = 4096
 # auto-dumps are capped per process: a watchdog storm must not write
@@ -71,6 +129,38 @@ def now_ns() -> int:
 
 def _wall_ns(t_ns: int) -> int:
     return _ANCHOR_WALL_NS + (t_ns - _ANCHOR_PERF_NS)
+
+
+# this process's measured wall-clock offset vs the fleet's shared
+# reference clock (the TCPStore master), in ns — set once by the fleet
+# telemetry clock handshake; rides in every dump's metadata so
+# tools/trace_merge can align N ranks' timelines
+_clock_offset_ns = 0
+
+
+def set_clock_offset_ns(ns: int) -> None:
+    global _clock_offset_ns
+    _clock_offset_ns = int(ns)
+
+
+def clock_offset_ns() -> int:
+    return _clock_offset_ns
+
+
+def identity():
+    """This process's fleet identity ``(rank, restart_count, pid)``,
+    read from the launcher env contract (both 0 outside a launched
+    job). Stamped on dumps — filenames and metadata — NOT on every
+    event: identity is constant per process, so per-event stamping
+    would only spend ring bytes repeating it (and the disabled-record
+    sub-µs gate stays untouched)."""
+    def _int(name):
+        try:
+            return int(os.environ.get(name, "0").strip() or 0)
+        except ValueError:
+            return 0
+    return (_int("PADDLE_TRAINER_ID"), _int("PADDLE_RESTART_COUNT"),
+            os.getpid())
 
 
 class FlightRecorder:
@@ -148,10 +238,11 @@ class FlightRecorder:
         events become ``"ph": "i"`` instants, spans become ``"ph": "X"``
         slices, all under this process's real pid (multi-host dumps stay
         mergeable, the PR-2 exporter contract)."""
-        pid = os.getpid()
+        rank, restart, pid = identity()
         trace_events = [
             {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-             "args": {"name": f"flightrecorder_{pid}"}}]
+             "args": {"name": f"rank{rank}.{restart} "
+                              f"flightrecorder_{pid}"}}]
         for t, kind, f in self.events():
             if kind == "span" and f is not None:
                 args = {k: v for k, v in f.items()
@@ -169,7 +260,14 @@ class FlightRecorder:
                      **({"args": f} if f else {})})
         return {"traceEvents": trace_events,
                 "metadata": {"dropped_events": self._dropped,
-                             "capacity": self.capacity}}
+                             "capacity": self.capacity,
+                             # fleet identity + clock mapping: what
+                             # tools/trace_merge keys tracks on and
+                             # uses to convert perf ts -> aligned wall
+                             "rank": rank, "restart_count": restart,
+                             "clock_offset_ns": _clock_offset_ns,
+                             "anchor_wall_ns": _ANCHOR_WALL_NS,
+                             "anchor_perf_ns": _ANCHOR_PERF_NS}}
 
     def tail(self, n: int = 64) -> str:
         """Plaintext rendering of the last ``n`` events — the part of a
@@ -213,16 +311,25 @@ class FlightRecorder:
             d = os.environ.get("PADDLE_FLIGHT_RECORDER_DIR", "").strip() \
                 or os.path.join(tempfile_dir(),
                                 f"paddle_flightrecorder_{os.getpid()}")
+            # (rank, restart_count, pid) in the name: N processes
+            # sharing one PADDLE_FLIGHT_RECORDER_DIR (the fleet
+            # post-mortem layout trace_merge consumes) never clobber
+            # each other's dumps, and a relaunched incarnation never
+            # clobbers its predecessor's
+            rank, restart, pid = identity()
             path_prefix = os.path.join(
-                d, f"flightrecorder_{reason}_{time.time_ns()}")
+                d, f"flightrecorder_{reason}_r{rank}i{restart}"
+                   f"_p{pid}_{time.time_ns()}")
         os.makedirs(os.path.dirname(os.path.abspath(path_prefix)),
                     exist_ok=True)
         json_path = path_prefix + ".json"
         with open(json_path, "w") as f:
             json.dump(self.dump_dict(reason), f)
         with open(path_prefix + ".txt", "w") as f:
+            rank, restart, pid = identity()
             f.write(f"flight recorder dump — reason: {reason}, "
-                    f"pid: {os.getpid()}, "
+                    f"rank: {rank}, incarnation: {restart}, "
+                    f"pid: {pid}, "
                     f"dropped: {self._dropped}\n")
             f.write(self.tail())
             f.write("\n")

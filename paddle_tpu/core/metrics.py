@@ -398,6 +398,135 @@ def snapshot() -> Dict[str, dict]:
     return out
 
 
+def _metric_state(m) -> dict:
+    """One metric's mergeable wire state (what ``snapshot_delta``
+    diffs and the fleet aggregator applies): histograms carry raw
+    bounds/counts arrays, not the display-shaped ``buckets()`` dict."""
+    if isinstance(m, Counter):
+        return {"kind": "counter", "value": m.value}
+    if isinstance(m, Gauge):
+        return {"kind": "gauge", "value": m.value, "peak": m.peak}
+    bounds, counts, count, total = m.raw()
+    return {"kind": "histogram", "bounds": list(bounds),
+            "counts": counts, "count": count, "sum": total}
+
+
+def snapshot_delta(prev: Optional[Dict[str, dict]] = None):
+    """Delta-encoded registry snapshot for cross-process publishing:
+    returns ``(state, delta)`` where ``state`` is the full mergeable
+    view (feed it back as ``prev`` next time) and ``delta`` is the
+    wire payload — ``{"full": bool, "metrics": {...}}``.
+
+    With ``prev=None`` the delta IS the full state (a new subscriber's
+    baseline). Otherwise each entry carries only what changed since
+    ``prev``: counters a ``{"d": increment}``, histograms per-bucket
+    count increments + ``d_count``/``d_sum``, gauges their absolute
+    ``value``/``peak`` (gauges don't accumulate). Unchanged metrics
+    are omitted — the steady-state payload of a quiet process is near
+    empty. A metric that went BACKWARDS (an explicit ``reset()``, or
+    a histogram re-bound) is re-sent absolute, so an aggregator
+    applying the delta can never drift negative."""
+    state = {key: _metric_state(m) for key, m in all_metrics().items()}
+    if prev is None:
+        return state, {"full": True, "metrics": state}
+    out: Dict[str, dict] = {}
+    for key, cur in state.items():
+        old = prev.get(key)
+        if old is None or old.get("kind") != cur["kind"]:
+            out[key] = cur
+            continue
+        kind = cur["kind"]
+        if kind == "counter":
+            d = cur["value"] - old["value"]
+            if d < 0:
+                out[key] = cur          # reset: re-baseline absolute
+            elif d:
+                out[key] = {"kind": "counter", "d": d}
+        elif kind == "gauge":
+            if cur["value"] != old["value"] or cur["peak"] != old["peak"]:
+                out[key] = cur          # gauges publish absolute
+        else:
+            if cur["bounds"] != old["bounds"]:
+                out[key] = cur          # re-bound: absolute
+                continue
+            d_counts = [c - o for c, o in zip(cur["counts"],
+                                              old["counts"])]
+            d_count = cur["count"] - old["count"]
+            if d_count < 0 or any(d < 0 for d in d_counts):
+                out[key] = cur          # reset: absolute
+            elif d_count or cur["sum"] != old["sum"]:
+                out[key] = {"kind": "histogram",
+                            "d_counts": d_counts, "d_count": d_count,
+                            "d_sum": cur["sum"] - old["sum"]}
+    return state, {"full": False, "metrics": out}
+
+
+def apply_delta(state: Dict[str, dict], delta: dict) -> Dict[str, dict]:
+    """Apply one ``snapshot_delta`` wire payload to a mergeable state
+    dict (the aggregator side). A ``full`` payload replaces the state
+    outright; absolute per-metric records replace their entry; ``d``/
+    ``d_counts`` records accumulate. Returns the updated state (the
+    input dict, mutated)."""
+    if delta.get("full"):
+        state.clear()
+        state.update({k: dict(v) for k, v in delta["metrics"].items()})
+        return state
+    for key, rec in delta["metrics"].items():
+        cur = state.get(key)
+        if "d" not in rec and "d_counts" not in rec:
+            state[key] = dict(rec)      # absolute record replaces
+        elif cur is None:
+            # a delta for a metric we never saw absolute: a payload
+            # was missed — drop it; the caller requests a resync and
+            # the next full publish re-baselines this key
+            continue
+        elif rec["kind"] == "counter":
+            cur["value"] += rec["d"]
+        else:
+            cur["counts"] = [c + d for c, d in zip(cur["counts"],
+                                                   rec["d_counts"])]
+            cur["count"] += rec["d_count"]
+            cur["sum"] += rec["d_sum"]
+    return state
+
+
+def state_metric(key: str, rec: dict) -> _Metric:
+    """Materialize one mergeable-state record back into a metric
+    instance (what ``prometheus_text`` renders) — the fleet
+    aggregator's bridge from wire state to the exposition format."""
+    if rec["kind"] == "counter":
+        m = Counter(key)
+        m._value = rec["value"]
+    elif rec["kind"] == "gauge":
+        m = Gauge(key)
+        m._value = float(rec["value"])
+        m._peak = float(rec.get("peak", rec["value"]))
+    else:
+        m = Histogram(key, bounds=tuple(rec["bounds"]))
+        m._counts = list(rec["counts"])
+        m._count = int(rec["count"])
+        m._sum = float(rec["sum"])
+    return m
+
+
+class Registry:
+    """Facade object over the module-global registry — the handle the
+    fleet-telemetry publisher holds (``snapshot_delta`` with its own
+    ``prev`` state per publisher, reads through the same module
+    functions everything else uses)."""
+
+    counter = staticmethod(counter)
+    gauge = staticmethod(gauge)
+    histogram = staticmethod(histogram)
+    all_metrics = staticmethod(all_metrics)
+    snapshot = staticmethod(snapshot)
+    snapshot_delta = staticmethod(snapshot_delta)
+    apply_delta = staticmethod(apply_delta)
+
+
+REGISTRY = Registry()
+
+
 def report(prefix: str = "") -> str:
     """Plain-text dump of the registry (one line per metric), optionally
     filtered by name prefix."""

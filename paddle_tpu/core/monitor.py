@@ -61,6 +61,14 @@ Metric name scheme (what the summary views group by):
     analysis.mem.budget_violations   programs over their HBM budget
     telemetry.scrapes{endpoint=...}   telemetry-server HTTP requests
     flightrecorder.dumps{reason=...}  flight-recorder dump files written
+    fleet.publishes             metric snapshots published to the store
+    fleet.ranks_total / fleet.ranks_stale   aggregator's rank census
+    fleet.clock_skew_ns{rank=...}   per-rank clock offset vs the store
+    train.goodput.seconds{bucket=...} / serve.goodput.seconds{bucket=...}
+                                step-time ledger buckets (compute |
+                                compile | data_stall | checkpoint |
+                                preemption_recovery | idle)
+    train.goodput.fraction / serve.goodput.fraction   compute/wall
 """
 from __future__ import annotations
 
@@ -104,6 +112,10 @@ DECLARED_METRICS = frozenset({
     "analysis.findings",
     "analysis.mem.peak_bytes", "analysis.mem.budget_violations",
     "telemetry.scrapes", "flightrecorder.dumps",
+    "fleet.publishes", "fleet.ranks_total", "fleet.ranks_stale",
+    "fleet.rank_up", "fleet.clock_skew_ns",
+    "train.goodput.seconds", "train.goodput.fraction",
+    "serve.goodput.seconds", "serve.goodput.fraction",
 })
 
 # The human-facing schema behind DECLARED_METRICS: name -> (kind,
@@ -290,11 +302,49 @@ METRIC_DOC = {
     "telemetry.scrapes": ("counter", ("endpoint",),
                           "telemetry-server HTTP requests by endpoint "
                           "(metrics | healthz | readyz | "
-                          "flightrecorder)"),
+                          "flightrecorder | fleet_metrics | "
+                          "fleet_healthz)"),
     "flightrecorder.dumps": ("counter", ("reason",),
                              "flight-recorder dump files written "
                              "(watchdog | preemption | anomaly_restore "
                              "| serve_crash | fit_crash | manual)"),
+    "fleet.publishes": ("counter", (),
+                        "metric snapshots this process published to "
+                        "the fleet TCPStore (delta-encoded)"),
+    "fleet.ranks_total": ("gauge", (),
+                          "ranks the fleet aggregator has ever seen "
+                          "publish (stale ranks stay counted — never "
+                          "silently dropped)"),
+    "fleet.ranks_stale": ("gauge", (),
+                          "ranks past the publish deadline at the "
+                          "last aggregator poll"),
+    "fleet.rank_up": ("gauge", ("rank", "incarnation"),
+                      "1 while the rank publishes within the "
+                      "deadline, 0 once stale (the per-rank face of "
+                      "fleet.ranks_stale)"),
+    "fleet.clock_skew_ns": ("gauge", ("rank",),
+                            "per-rank wall-clock offset vs the fleet "
+                            "store's master clock (the trace-merge "
+                            "alignment term), from the ping "
+                            "handshake"),
+    "train.goodput.seconds": ("counter", ("bucket",),
+                              "train wall time by ledger bucket: "
+                              "compute | compile | data_stall | "
+                              "checkpoint | preemption_recovery | "
+                              "idle (buckets sum to wall time)"),
+    "train.goodput.fraction": ("gauge", (),
+                               "train goodput over the last ledger "
+                               "flush window: compute seconds / wall "
+                               "seconds"),
+    "serve.goodput.seconds": ("counter", ("bucket",),
+                              "serve wall time by ledger bucket: "
+                              "compute | compile | data_stall | "
+                              "checkpoint | preemption_recovery | "
+                              "idle (buckets sum to wall time)"),
+    "serve.goodput.fraction": ("gauge", (),
+                               "serve goodput over the last ledger "
+                               "flush window: compute seconds / wall "
+                               "seconds"),
 }
 
 enabled = False  # mirrored from metrics.enable()/disable()
@@ -313,11 +363,27 @@ disable = metrics.disable
 
 # ------------------------------------------------------------ jit layer
 
+# always-on retrace census (plain int += under the GIL): the goodput
+# ledger attributes a dispatch's wall time to the `compile` bucket by
+# diffing this around the call — it must advance whether or not the
+# registry is enabled, the same reason retraces feed the flight
+# recorder unconditionally
+_retraces_seen = 0
+
+
+def retrace_count() -> int:
+    """Monotonic count of every retrace this process observed,
+    independent of the registry's enabled state."""
+    return _retraces_seen
+
+
 def record_retrace(cause: str, target: str = "jit"):
     """One jax.jit cache miss. cause: first | new_shape | new_dtype |
     new_structure | donation_miss. Also lands in the flight recorder
     (its own enable flag): a post-mortem must show what compiled in the
     seconds before death even when nobody enabled the registry."""
+    global _retraces_seen
+    _retraces_seen += 1
     if flight_recorder.enabled:
         flight_recorder.record("jit.compile", cause=cause, target=target)
     if not enabled:
@@ -729,6 +795,62 @@ def record_flight_dump(reason: str):
         return
     metrics.counter("flightrecorder.dumps", reason=reason).inc()
     metrics.counter("flightrecorder.dumps").inc()
+
+
+# ----------------------------------------------------------- fleet layer
+
+def record_fleet_publish():
+    """One delta-encoded snapshot published to the fleet store."""
+    if not enabled:
+        return
+    metrics.counter("fleet.publishes").inc()
+
+
+def record_fleet_ranks(total: int, stale: int):
+    """The aggregator's rank census at one poll: every rank it has
+    ever seen publish, and how many are past the publish deadline
+    (stale ranks are MARKED, never dropped — the count is the alarm a
+    fleet dashboard pages on)."""
+    if not enabled:
+        return
+    metrics.gauge("fleet.ranks_total").set(float(total))
+    metrics.gauge("fleet.ranks_stale").set(float(stale))
+
+
+def record_fleet_rank_up(rank: int, incarnation: int, up: bool):
+    """Per-rank liveness at the aggregator's last poll (the labeled
+    face of the ``fleet.ranks_stale`` census)."""
+    if not enabled:
+        return
+    metrics.gauge("fleet.rank_up", rank=str(rank),
+                  incarnation=str(incarnation)).set(1.0 if up else 0.0)
+
+
+def record_clock_skew(rank: int, offset_ns: int):
+    """One rank's measured wall-clock offset vs the fleet store's
+    master clock (the trace-merge alignment term)."""
+    if not enabled:
+        return
+    metrics.gauge("fleet.clock_skew_ns", rank=str(rank)).set(
+        float(offset_ns))
+
+
+# --------------------------------------------------------- goodput layer
+
+def record_goodput(family: str, buckets, wall_s: float):
+    """One goodput-ledger flush window: per-bucket wall seconds
+    (family: train | serve) accumulated into the
+    ``{family}.goodput.seconds{bucket=...}`` counters, plus the window
+    fraction gauge (compute / wall)."""
+    if not enabled:
+        return
+    for bucket, seconds in buckets.items():
+        if seconds:
+            metrics.counter(f"{family}.goodput.seconds",
+                            bucket=bucket).inc(float(seconds))
+    if wall_s > 0:
+        metrics.gauge(f"{family}.goodput.fraction").set(
+            float(buckets.get("compute", 0.0)) / float(wall_s))
 
 
 # ---------------------------------------------------------- device layer

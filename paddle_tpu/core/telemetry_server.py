@@ -18,6 +18,17 @@ exposing:
                       the queue starts rejecting
     /flightrecorder   the flight recorder's dump (Perfetto JSON +
                       plaintext tail) on demand, no file writes
+    /fleet/metrics    the FLEET registry — every rank's series merged
+                      with ``rank=``/``replica=``/``incarnation=``
+                      labels by an attached ``FleetAggregator`` (404
+                      when this process is not the aggregator)
+    /fleet/healthz    per-replica ready/reason/headroom rollup — the
+                      multi-replica router's admission document
+
+Every ``/metrics``-style render also carries two scrape-hygiene
+series: a ``paddle_build_info`` info-gauge (version, jax/jaxlib,
+backend platform as labels, value pinned 1 — what dashboards key
+deploy markers on) and ``process_uptime_seconds``.
 
 Opt-in: ``PADDLE_TELEMETRY_PORT`` (the ServingEngine reads it, any
 other process can call ``start_from_env()``/``TelemetryServer``
@@ -36,6 +47,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -86,6 +98,53 @@ def _finite(v: float) -> float:
     return v if v - v == 0.0 else 0.0
 
 
+# ------------------------------------------------- scrape hygiene lines
+
+_START_MONOTONIC = time.monotonic()   # ≈ process start (core imports
+#                                       run before any serving loop)
+_BUILD_INFO_LINE: Optional[str] = None
+
+
+def _build_info_line() -> str:
+    """The ``paddle_build_info`` info-gauge sample line (computed once:
+    versions don't change mid-process). Value pinned 1 — the labels
+    carry the information, the standard Prometheus *_info idiom."""
+    global _BUILD_INFO_LINE
+    if _BUILD_INFO_LINE is None:
+        labels = {}
+        try:
+            from .. import __version__
+            labels["version"] = str(__version__)
+        except Exception:
+            labels["version"] = "unknown"
+        try:
+            import jax
+            import jaxlib
+            labels["jax"] = getattr(jax, "__version__", "unknown")
+            labels["jaxlib"] = getattr(jaxlib, "__version__", "unknown")
+            labels["platform"] = jax.default_backend()
+        except Exception:
+            labels.setdefault("jax", "unavailable")
+            labels.setdefault("jaxlib", "unavailable")
+            labels.setdefault("platform", "unknown")
+        tail = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+        _BUILD_INFO_LINE = f"paddle_build_info{_prom_labels(tail)} 1"
+    return _BUILD_INFO_LINE
+
+
+def _hygiene_lines() -> list:
+    """Appended to EVERY /metrics-style render (process and fleet):
+    the build-info gauge and the process uptime — standard scrape
+    hygiene a router keys dashboards on."""
+    return [
+        "# TYPE paddle_build_info gauge",
+        _build_info_line(),
+        "# TYPE process_uptime_seconds gauge",
+        f"process_uptime_seconds "
+        f"{_finite(time.monotonic() - _START_MONOTONIC)!r}",
+    ]
+
+
 def prometheus_text(registry: Optional[dict] = None) -> str:
     """Render the metrics registry in the Prometheus text exposition
     format (version 0.0.4): one ``# TYPE`` line per metric family, then
@@ -131,7 +190,8 @@ def prometheus_text(registry: Optional[dict] = None) -> str:
             for labels, m in gauges:
                 lines.append(f"{name}_peak{_prom_labels(labels)} "
                              f"{_finite(m.peak)!r}")
-    return "\n".join(lines) + "\n" if lines else ""
+    lines.extend(_hygiene_lines())
+    return "\n".join(lines) + "\n"
 
 
 # ------------------------------------------------------------- handlers
@@ -170,6 +230,33 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(
                     flight_recorder.dump_dict("http")).encode()
                 self._send(200, body, "application/json")
+            elif path == "/fleet/metrics":
+                monitor.record_scrape("fleet_metrics")
+                agg = owner.aggregator
+                if agg is None:
+                    self._send(404, b'{"error": "no fleet aggregator '
+                                    b'attached"}', "application/json")
+                else:
+                    agg.refresh()
+                    self._send(
+                        200,
+                        prometheus_text(agg.fleet_registry()).encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/fleet/healthz":
+                monitor.record_scrape("fleet_healthz")
+                agg = owner.aggregator
+                if agg is None:
+                    self._send(404, b'{"error": "no fleet aggregator '
+                                    b'attached"}', "application/json")
+                else:
+                    agg.refresh()
+                    roll = agg.healthz()
+                    # 200 even when not ready: the rollup is a
+                    # DOCUMENT the router reads per-replica fields
+                    # from (unlike the process /readyz probe, whose
+                    # consumer is a binary load balancer check)
+                    self._send(200, json.dumps(roll).encode(),
+                               "application/json")
             else:
                 self._send(404, b'{"error": "not found"}',
                            "application/json")
@@ -199,6 +286,7 @@ class TelemetryServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._engine_ref = None
+        self.aggregator = None   # FleetAggregator serving /fleet/*
 
     # ------------------------------------------------------ lifecycle
     @property
@@ -243,6 +331,15 @@ class TelemetryServer:
         health, and a collected engine reads as not-ready (the replica
         should be rotated out, not probed forever)."""
         self._engine_ref = weakref.ref(engine)
+        return self
+
+    def attach_aggregator(self, aggregator) -> "TelemetryServer":
+        """Wire a ``fleet_telemetry.FleetAggregator`` to
+        ``/fleet/metrics`` + ``/fleet/healthz`` — this process becomes
+        the fleet's pane of glass (held strongly: the aggregator owns
+        only a store client, and the fleet endpoints must outlive a
+        drained local engine)."""
+        self.aggregator = aggregator
         return self
 
     def readiness(self) -> Tuple[bool, dict]:

@@ -49,6 +49,15 @@ def _parser() -> argparse.ArgumentParser:
                         "recovery")
     p.add_argument("--devices", type=str, default=None,
                    help="visible device ids for this node (TPU chips)")
+    p.add_argument("--fleet_store", type=str,
+                   default=os.environ.get("PADDLE_FLEET_STORE", ""),
+                   help="host:port of the fleet-telemetry TCPStore: "
+                        "every worker publishes its metrics registry "
+                        "+ health there (PADDLE_FLEET_METRICS_PERIOD_S"
+                        " cadence) and rank 0 aggregates them into "
+                        "/fleet/metrics + /fleet/healthz on its "
+                        "telemetry server — one pane of glass for the "
+                        "whole job")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
@@ -65,6 +74,8 @@ def launch(argv: Optional[List[str]] = None) -> int:
     envs = {}
     if args.devices is not None:
         envs["TPU_VISIBLE_DEVICES"] = args.devices
+    if args.fleet_store:
+        envs["PADDLE_FLEET_STORE"] = args.fleet_store
     spec = JobSpec(script=args.script, script_args=args.script_args,
                    nnodes=args.nnodes, node_rank=args.node_rank,
                    nproc_per_node=args.nproc_per_node, master=master,

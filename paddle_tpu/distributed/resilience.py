@@ -373,6 +373,8 @@ class GracefulShutdown:
         self.store_poll_interval = float(store_poll_interval)
         self._last_store_poll = float("-inf")
         self._signaled = threading.Event()
+        self._via_store = False   # detected via the store broadcast,
+        #                           not a local signal (peer, not victim)
         self._prev_handlers = {}
         self._installed = False
 
@@ -426,6 +428,8 @@ class GracefulShutdown:
             self._last_store_poll = now
             try:
                 if self.store.keys(self.key):
+                    if not self._signaled.is_set():
+                        self._via_store = True
                     self._signaled.set()
                     return True
             except (TimeoutError, RuntimeError, OSError) as e:
@@ -442,11 +446,19 @@ class GracefulShutdown:
         on preemption: broadcast flag → emergency save → exit."""
         if not self.preempted:
             return False
+        from ..core import goodput
+        t_recover = time.perf_counter()
         monitor.record_preemption()
         # the preemption dump happens BEFORE the emergency saves: if a
         # save wedges, the black box already shows the step the process
-        # reached and everything it was doing when the signal landed
-        flight_recorder.record("resilience.preemption", step=int(step))
+        # reached and everything it was doing when the signal landed.
+        # source distinguishes the VICTIM (the signal landed here) from
+        # peers that detected it through the store broadcast — the
+        # merged fleet trace orders the SIGTERM instant before the
+        # detections
+        flight_recorder.record("resilience.preemption", step=int(step),
+                               source="store" if self._via_store
+                               else "signal")
         flight_recorder.auto_dump("preemption")
         save_step = int(step)
         if self.store is not None:
@@ -463,6 +475,11 @@ class GracefulShutdown:
             except (TimeoutError, RuntimeError, OSError) as e:
                 monitor.record_swallowed("graceful_shutdown.broadcast", e)
         _run_emergency_saves(save_step)
+        # the whole detection->broadcast->emergency-save window is
+        # preemption recovery in the goodput ledger (ambient no-op
+        # outside a ledgered loop)
+        goodput.charge("preemption_recovery",
+                       time.perf_counter() - t_recover)
         if self.exit_on_save:
             sys.exit(self.exit_code)
         return True

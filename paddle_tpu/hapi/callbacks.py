@@ -170,12 +170,18 @@ class ModelCheckpoint(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.save_dir and epoch % self.save_freq == 0:
+            from ..core import goodput
             path = os.path.join(self.save_dir, str(epoch))
-            self.model.save(path)
+            # periodic save time is the goodput ledger's checkpoint
+            # bucket (ambient: no-op outside a fit with a ledger)
+            with goodput.timed("checkpoint"):
+                self.model.save(path)
 
     def on_train_end(self, logs=None):
         if self.save_dir:
-            self.model.save(os.path.join(self.save_dir, "final"))
+            from ..core import goodput
+            with goodput.timed("checkpoint"):
+                self.model.save(os.path.join(self.save_dir, "final"))
         if self._unregister is not None:
             self._unregister()
             self._unregister = None
@@ -479,6 +485,11 @@ class MetricsCallback(Callback):
             val = self._gauge(gauge_name)
             if val is not None:
                 stats[label] = val
+        # the goodput ledger's last flush window (the fit loop flushes
+        # right before epoch-end callbacks): compute seconds / wall
+        goodput_frac = self._gauge("train.goodput.fraction")
+        if goodput_frac is not None:
+            stats["goodput"] = goodput_frac
         try:
             stats["peak_memory_bytes"] = device.max_memory_allocated()
         except Exception:

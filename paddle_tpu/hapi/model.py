@@ -10,13 +10,14 @@ from __future__ import annotations
 import collections
 import os
 import pickle
+import time
 from typing import Callable, List, Optional
 
 import jax
 import numpy as np
 
 from .. import framework_io
-from ..core import flight_recorder, monitor
+from ..core import flight_recorder, goodput, monitor
 from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
 from ..io.dataset import Dataset
@@ -309,9 +310,20 @@ class Model:
         mid-epoch preemption replays only the remaining batches of the
         interrupted epoch (at most one step redone). Missing files mean
         a fresh start, so first launch and relaunch share one call."""
+        # the goodput ledger: every wall second of this fit lands in
+        # exactly one bucket (compute/compile/data_stall/checkpoint/
+        # preemption_recovery/idle — the train.goodput.* family).
+        # Started FIRST — before even the resilience import, whose
+        # first-use cost is real fit wall time — so the wall it
+        # decomposes is the fit the caller measured: loader
+        # construction (worker spawn, first io imports) is
+        # input-pipeline setup — data_stall — and the resume restore
+        # is preemption recovery
+        ledger = goodput.GoodputLedger("train").start()
         from ..distributed import resilience
-        loader = self._loader(train_data, batch_size, shuffle)
-        eval_loader = self._loader(eval_data, batch_size, False)
+        with ledger.timed("data_stall"):
+            loader = self._loader(train_data, batch_size, shuffle)
+            eval_loader = self._loader(eval_data, batch_size, False)
         self._save_dir = save_dir
         start_epoch = 0
         if resume:
@@ -320,7 +332,8 @@ class Model:
             if prefix is None:
                 raise ValueError("resume=True requires save_dir "
                                  "(or pass an explicit prefix)")
-            start_epoch = self._load_resume(prefix, loader)
+            with ledger.timed("preemption_recovery"):
+                start_epoch = self._load_resume(prefix, loader)
 
         guard = self._resolve_anomaly_guard(anomaly_guard, resilience)
         if resume and self._train_step is not None:
@@ -351,8 +364,11 @@ class Model:
         if guard is not None:
             self._take_good_snapshot()
         try:
-            self._fit_loop(loader, eval_loader, epochs, eval_freq, cbs,
-                           guard, resilience, start_epoch)
+            with ledger:   # ambient: deep saves charge checkpoint/
+                #            preemption_recovery without plumbing
+                self._fit_loop(loader, eval_loader, epochs, eval_freq,
+                               cbs, guard, resilience, start_epoch,
+                               ledger)
         except BaseException as abort:
             # uncaught exception in fit(): leave the black box before
             # anything else — the last steps, compiles, anomalies and
@@ -374,6 +390,9 @@ class Model:
                 from ..core import monitor
                 monitor.record_swallowed("fit.on_train_abort", e)
             raise
+        # the closed ledger's final decomposition (buckets sum to wall
+        # — the tier-1 invariant), for callers without the registry on
+        self.goodput_summary = ledger.snapshot()
         return self
 
     def _consume_loss(self, step, loss, guard, cbs, losses):
@@ -395,9 +414,11 @@ class Model:
             cbs.on_train_batch_end(step, {"loss": loss})
 
     def _fit_loop(self, loader, eval_loader, epochs, eval_freq, cbs,
-                  guard, resilience, start_epoch=0):
+                  guard, resilience, start_epoch=0, ledger=None):
         stop = False
         global_step = 0
+        if ledger is None:   # direct callers (tests) get a live one
+            ledger = goodput.GoodputLedger("train").start()
         # the lagged loss window: train_batch returns the on-device
         # scalar, the fetcher reads it back K steps later so the host
         # never drains the device dispatch queue mid-epoch
@@ -415,7 +436,22 @@ class Model:
             progress["epoch"] = epoch
             cbs.on_epoch_begin(epoch)
             losses = []
-            for step, batch in enumerate(loader):
+            batches = iter(loader)
+            step = -1
+            while True:
+                # input-pipeline wait is the data_stall bucket: with a
+                # prefetching loader this is near zero; a slow disk or
+                # a dead worker shows up HERE, not as fake compute
+                t_fetch = time.perf_counter()
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    ledger.charge("data_stall",
+                                  time.perf_counter() - t_fetch)
+                    break
+                ledger.charge("data_stall",
+                              time.perf_counter() - t_fetch)
+                step += 1
                 cbs.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
                 if flight_recorder.enabled:
@@ -424,11 +460,20 @@ class Model:
                     flight_recorder.record("train.step_begin",
                                            step=global_step + 1,
                                            epoch=epoch)
+                retraces0 = monitor.retrace_count()
+                t_step = time.perf_counter()
                 loss = self.train_batch(inputs, labels)
                 global_step += 1
                 progress["step"] = global_step
                 for s, val in fetcher.push(step, loss):
                     self._consume_loss(s, val, guard, cbs, losses)
+                # a dispatch during which a retrace happened spent its
+                # wall time tracing + XLA-compiling, not computing:
+                # that window is the compile bucket (the always-on
+                # retrace census works with the registry disabled)
+                ledger.charge(
+                    "compile" if monitor.retrace_count() > retraces0
+                    else "compute", time.perf_counter() - t_step)
                 # preemption lands here: emergency save + exit(101)
                 resilience.poll(global_step)
                 if any(getattr(cb, "stopped", False)
@@ -448,6 +493,9 @@ class Model:
                 break
             logs = {"loss": float(np.mean(losses))  # lint: host-sync-ok (host floats)
                     if losses else None}
+            # flush the ledger window BEFORE the epoch-end callbacks so
+            # MetricsCallback reads this epoch's goodput, not last's
+            ledger.flush()
             cbs.on_epoch_end(epoch, logs)
             if guard is not None:
                 self._take_good_snapshot()

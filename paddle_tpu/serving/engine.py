@@ -611,6 +611,16 @@ class ServingEngine:
                           cancelled=0, rejected=0, slots_reused=0,
                           decode_steps=0, prefills=0,
                           spec_proposed=0, spec_accepted=0)
+        # goodput ledger (serve.goodput.* family): dispatch windows and
+        # admissions charge compute (or compile when a retrace happened
+        # inside the window), serve_forever's empty-queue sleeps charge
+        # idle, preemption drains charge preemption_recovery; the
+        # unattributed residual folds into idle — an un-pumped engine
+        # is waiting, not computing. Started after warmup so the
+        # one-time compile storm doesn't poison steady-state goodput.
+        from ..core import goodput as goodput_mod
+        self._goodput = goodput_mod.GoodputLedger(
+            "serve", default_bucket="idle")
         # ------------------------------------------------ HBM planning
         # admission control for MEMORY, before a single buffer compiles:
         # with a budget declared (kwarg > enable_serving > env), the
@@ -667,6 +677,23 @@ class ServingEngine:
                 self.telemetry = telemetry_server.start_from_env(self)
         except OSError as e:
             monitor.record_swallowed("serving.telemetry_bind", e)
+        # fleet plane opt-in (PADDLE_FLEET_STORE=host:port, exported by
+        # the launcher's --fleet_store): publish this replica's metrics
+        # + health to the shared TCPStore; on the elected rank the
+        # member also aggregates, and the aggregator rides this
+        # process's telemetry server at /fleet/*. A bad address or an
+        # unreachable store must never take the replica down.
+        self.fleet = None
+        try:
+            from ..distributed import fleet_telemetry
+            self.fleet = fleet_telemetry.start_from_env(
+                health_fn=self.health)
+            if self.fleet is not None and \
+                    self.fleet.aggregator is not None and \
+                    self.telemetry is not None:
+                self.telemetry.attach_aggregator(self.fleet.aggregator)
+        except Exception as e:
+            monitor.record_swallowed("serving.fleet_start", e)
         if warmup:
             try:
                 self.warmup()
@@ -678,7 +705,11 @@ class ServingEngine:
                 if self.telemetry is not None:
                     self.telemetry.stop()
                     self.telemetry = None
+                if self.fleet is not None:
+                    self.fleet.stop()
+                    self.fleet = None
                 raise
+        self._goodput.start()
 
     # ------------------------------------------------------ compilation
     def _ensure_eval(self):
@@ -972,6 +1003,19 @@ class ServingEngine:
                 monitor.record_swallowed("serving.admit", e)
 
     def _admit(self, req: Request, slot: int):
+        # admission wall time is compute in the goodput ledger — or
+        # compile, when the dispatch retraced (a cold bucket slipping
+        # past warmup spends the window tracing, not prefilling)
+        retraces0 = monitor.retrace_count()
+        t_admit = time.perf_counter()
+        try:
+            self._admit_inner(req, slot)
+        finally:
+            self._goodput.charge(
+                "compile" if monitor.retrace_count() > retraces0
+                else "compute", time.perf_counter() - t_admit)
+
+    def _admit_inner(self, req: Request, slot: int):
         bucket = next(b for b in self.buckets if b >= req.prompt.size)
         ids = np.full((1, bucket), self._cfg.pad_value, np.int32)
         ids[0, :req.prompt.size] = req.prompt
@@ -1107,6 +1151,9 @@ class ServingEngine:
         if self._window_t0 is not None and self._window_steps:
             monitor.record_serve_token_latency(
                 (now - self._window_t0) / self._window_steps)
+            # the dispatch window (host dispatches + the device wait
+            # the lane reads above just paid) is goodput compute
+            self._goodput.charge("compute", now - self._window_t0)
         self._window_steps = 0   # next dispatch re-anchors _window_t0
         t_poll_ns = flight_recorder.now_ns()
         for i, req in enumerate(self._slots):
@@ -1140,6 +1187,7 @@ class ServingEngine:
             monitor.record_cache_occupancy(self._cache.occupancy())
             self._drain_page_stats()
             self._drain_quant_stats()
+            self._goodput.flush()
 
     def _complete(self, req: Request, toks: np.ndarray):
         eos = self._cfg.eos_token_id
@@ -1267,8 +1315,9 @@ class ServingEngine:
                 gs = shutdown if shutdown is not None \
                     else resilience.active()
                 if self._shutdown or (gs is not None and gs.preempted):
-                    if gs is not None and gs.preempted and \
-                            not self._shutdown:
+                    preempted_drain = gs is not None and \
+                        gs.preempted and not self._shutdown
+                    if preempted_drain:
                         # preemption landed mid-serve: leave the black
                         # box BEFORE draining, while the in-flight
                         # requests' spans still show what was running
@@ -1277,7 +1326,19 @@ class ServingEngine:
                             in_flight=sum(s is not None
                                           for s in self._slots))
                         flight_recorder.auto_dump("preemption")
+                    compute0 = self._goodput.bucket_total("compute")
+                    t_drain = time.perf_counter()
                     self.drain()
+                    if preempted_drain:
+                        # the preemption-recovery bucket gets the drain
+                        # wall MINUS the decode windows that already
+                        # charged compute inside it (no second count)
+                        dc = self._goodput.bucket_total("compute") \
+                            - compute0
+                        self._goodput.charge(
+                            "preemption_recovery",
+                            max(time.perf_counter() - t_drain - dc,
+                                0.0))
                     break
                 while it is not None and not exhausted and \
                         self._queue_room():
@@ -1351,8 +1412,17 @@ class ServingEngine:
             if monitor.enabled:
                 self._drain_page_stats()
                 self._drain_quant_stats()
+                self._goodput.flush()
             if flight_recorder.enabled and not already:
                 flight_recorder.record("serve.drain_end")
+            if self.fleet is not None and not already:
+                # push the final counters so the aggregator's last view
+                # of this replica is the drained one (thread keeps
+                # running — /fleet staleness only starts at shutdown)
+                try:
+                    self.fleet.publisher.publish_now()
+                except Exception as e:
+                    monitor.record_swallowed("serving.fleet_drain", e)
 
     shutdown_now = drain
 
@@ -1386,6 +1456,10 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=self.drain_timeout_s + 5.0)
             self._thread = None
+        self._goodput.close()
+        if self.fleet is not None:
+            self.fleet.stop()   # final publish rides in stop()
+            self.fleet = None
         if self.telemetry is not None:
             self.telemetry.stop()
             self.telemetry = None
@@ -1404,6 +1478,13 @@ class ServingEngine:
             return False
         finally:
             self._pump_lock.release()
+
+    def goodput(self) -> Dict:
+        """The serve-side goodput decomposition right now:
+        ``{"wall_s", "buckets", "goodput_fraction"}`` with every
+        bucket summing to wall time (bench's ``"goodput"`` sub-dict,
+        and the tier-1 ledger-invariant gate)."""
+        return self._goodput.snapshot()
 
     # ----------------------------------------------------------- health
     def health(self) -> Dict:

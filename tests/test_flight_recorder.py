@@ -139,6 +139,55 @@ class TestDumps:
         assert fr.auto_dump("off") is None
         assert not list(tmp_path.iterdir())
 
+    def test_dump_identity_and_clock_metadata(self, tmp_path,
+                                              monkeypatch):
+        """ISSUE-15: dumps carry (rank, restart_count, pid) in the
+        default FILENAME (N processes share one dump dir without
+        clobbering) and the clock mapping (anchors + fleet offset) in
+        the metadata (what tools/trace_merge aligns on)."""
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        monkeypatch.setenv("PADDLE_RESTART_COUNT", "2")
+        fr.enable()
+        fr.set_clock_offset_ns(12345)
+        try:
+            fr.record("checkpoint.commit", step=1)
+            path = fr.dump(reason="unit")
+            name = os.path.basename(path)
+            assert name.startswith(
+                f"flightrecorder_unit_r3i2_p{os.getpid()}_")
+            with open(path) as f:
+                d = json.load(f)
+            md = d["metadata"]
+            assert md["rank"] == 3 and md["restart_count"] == 2
+            assert md["clock_offset_ns"] == 12345
+            assert isinstance(md["anchor_wall_ns"], int)
+            assert isinstance(md["anchor_perf_ns"], int)
+            proc = next(e for e in d["traceEvents"]
+                        if e["name"] == "process_name")
+            assert proc["args"]["name"].startswith("rank3.2 ")
+            assert "rank: 3, incarnation: 2" in \
+                open(path[:-5] + ".txt").read()
+        finally:
+            fr.set_clock_offset_ns(0)
+
+
+class TestEventSchema:
+    def test_event_doc_covers_declared_events(self):
+        assert set(fr.EVENT_DOC) == set(fr.DECLARED_EVENTS)
+        for name, desc in fr.EVENT_DOC.items():
+            assert desc and "\n" not in desc, name
+
+    def test_generated_events_doc_is_fresh(self):
+        """Tier-1 drift gate: docs/events.md must match what
+        tools.metrics_doc renders from the live event schema."""
+        from tools.metrics_doc import events_doc_path, render_events
+        with open(events_doc_path(), "r", encoding="utf-8") as f:
+            committed = f.read()
+        assert committed == render_events(), (
+            "docs/events.md is stale — regenerate with "
+            "`python -m tools.metrics_doc`")
+
 
 # --------------------------------------------------------------- wiring
 

@@ -146,6 +146,40 @@ class TestMetricNameRule:
                                  "paddle_tpu/core/monitor.py")
 
 
+class TestEventNameRule:
+    """Flight-recorder event names in the framework must come from
+    core/flight_recorder.DECLARED_EVENTS (the metric-name contract
+    applied to the black box)."""
+
+    def test_flags_undeclared_literal(self, tmp_path):
+        found = _lint_snippet(tmp_path, """
+            from ..core import flight_recorder
+            def f(kind):
+                flight_recorder.record("serve.typo_event", req=1)
+                flight_recorder.record("serve.admit", req=1)  # declared
+                flight_recorder.record(kind, req=1)   # dynamic: fine
+                flight_recorder.record_span("req3.decode", 0, 1)  # span
+            """, "paddle_tpu/serving/whatever.py")
+        assert _rules_of(found) == ["event-name"]
+        assert len(found) == 1 and "serve.typo_event" in found[0].message
+
+    def test_exemptions_and_marker(self, tmp_path):
+        src = """
+            from . import flight_recorder
+            flight_recorder.record("anything.at.all")
+            """
+        # the declaring module and tests name events freely
+        assert not _lint_snippet(tmp_path, src,
+                                 "paddle_tpu/core/flight_recorder.py")
+        assert not _lint_snippet(tmp_path, src, "tests/test_x.py")
+        marked = """
+            from ..core import flight_recorder
+            flight_recorder.record("x.y")  # lint: event-name-ok (test hook)
+            """
+        assert not _lint_snippet(tmp_path, marked,
+                                 "paddle_tpu/nn/whatever.py")
+
+
 class TestDeadMetricRule:
     """The metric-name rule pointed the other way: a DECLARED name no
     ``metrics.counter/gauge/histogram`` call under paddle_tpu/ ever
@@ -372,7 +406,7 @@ class TestEngine:
         assert set(RULES) == {"host-sync", "jit-random", "bare-except",
                               "metric-name", "chaos-marker",
                               "compile-cache-dir", "dead-metric",
-                              "lock-discipline"}
+                              "event-name", "lock-discipline"}
 
     def test_syntax_error_is_reported_not_raised(self, tmp_path):
         found = _lint_snippet(tmp_path, "def broken(:\n",

@@ -254,6 +254,80 @@ def check_metric_names(ctx: FileContext) -> List[LintFinding]:
     return findings
 
 
+# ------------------------------------------------------------- event-name
+
+# the module that declares the event schema (and implements the ring):
+# free to name events as it likes
+_EVENT_NAME_EXEMPT = frozenset({"paddle_tpu/core/flight_recorder.py"})
+
+_DECLARED_EVENTS_CACHE: Optional[Set[str]] = None
+
+
+def _declared_events() -> Set[str]:
+    """The DECLARED_EVENTS literal parsed out of core/flight_recorder.py
+    (AST only, the _declared_metrics precedent)."""
+    global _DECLARED_EVENTS_CACHE
+    if _DECLARED_EVENTS_CACHE is not None:
+        return _DECLARED_EVENTS_CACHE
+    from . import repo_root
+    fr_path = os.path.join(repo_root(), "paddle_tpu", "core",
+                           "flight_recorder.py")
+    declared: Set[str] = set()
+    try:
+        with open(fr_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "DECLARED_EVENTS"
+                    for t in node.targets):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        declared.add(sub.value)
+    except OSError:
+        pass
+    _DECLARED_EVENTS_CACHE = declared
+    return _DECLARED_EVENTS_CACHE
+
+
+@rule("event-name")
+def check_event_names(ctx: FileContext) -> List[LintFinding]:
+    """Literal event names passed to ``flight_recorder.record(...)``
+    in the framework must be declared in
+    ``core/flight_recorder.DECLARED_EVENTS``: an undeclared name is a
+    stream no post-mortem tooling greps for and no docs/events.md row
+    explains (the DECLARED_METRICS contract, applied to the black
+    box). Span names (``record_span`` / ``Request.span``) are
+    per-request dynamic and exempt; dynamic ``record(kind_var)``
+    names are the recorders' business, same as metric-name."""
+    if not ctx.relpath.startswith("paddle_tpu/") \
+            or ctx.relpath in _EVENT_NAME_EXEMPT or ctx.is_test_file:
+        return []
+    declared = _declared_events()
+    if not declared:
+        return []  # flight_recorder.py unreadable: no bogus cascade
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and _dotted(node.func.value).split(".")[-1]
+                == "flight_recorder"):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        if name in declared or ctx.allowed(node, "event-name"):
+            continue
+        findings.append(LintFinding(
+            ctx.relpath, node.lineno, node.col_offset, "event-name",
+            f"flight-recorder event {name!r} is not declared in "
+            "core/flight_recorder.DECLARED_EVENTS; declare it there "
+            "(with an EVENT_DOC entry) or fix the typo"))
+    return findings
+
+
 # ------------------------------------------------------------ dead-metric
 
 _RECORDED_NAMES_CACHE = None  # (literals: Set[str], patterns: List[regex])

@@ -1,17 +1,19 @@
-"""Generate docs/metrics.md from core/monitor's declared metric schema.
+"""Generate docs/metrics.md AND docs/events.md from the declared
+schemas.
 
 The registry's schema lives in ``core/monitor.py`` twice: the
 ``DECLARED_METRICS`` frozenset the framework lint enforces (an
 undeclared name recorded anywhere in ``paddle_tpu/`` fails CI) and the
 ``METRIC_DOC`` table carrying each name's kind, labels and description.
-This tool renders the table as a markdown reference, and the tier-1
-drift test (``tests/test_telemetry.py``) regenerates it on every run —
-a schema change that forgets the doc (or a doc edit that drifts from
-the schema) fails CI, the same contract the lint's ``dead-metric`` rule
-applies to the recording side.
+The flight recorder's event schema lives the same way in
+``core/flight_recorder.py`` (``DECLARED_EVENTS`` enforced by the
+lint's ``event-name`` rule, ``EVENT_DOC`` for descriptions). This tool
+renders both tables as markdown references, and the tier-1 drift tests
+regenerate them on every run — a schema change that forgets the doc
+(or a doc edit that drifts from the schema) fails CI.
 
-    python -m tools.metrics_doc            # rewrite docs/metrics.md
-    python -m tools.metrics_doc --check    # exit 1 if stale
+    python -m tools.metrics_doc            # rewrite both docs
+    python -m tools.metrics_doc --check    # exit 1 if either is stale
 """
 from __future__ import annotations
 
@@ -55,31 +57,75 @@ def render() -> str:
     return _HEADER + "\n".join(rows) + "\n"
 
 
-def doc_path() -> str:
+_EVENTS_HEADER = """\
+# Flight-recorder events reference
+
+<!-- GENERATED FILE — do not edit by hand.
+     Regenerate with `python -m tools.metrics_doc`; the schema lives in
+     `paddle_tpu/core/flight_recorder.py` (EVENT_DOC /
+     DECLARED_EVENTS). -->
+
+Every structured point event the framework records into the flight
+recorder's ring, as declared in `core/flight_recorder.DECLARED_EVENTS`
+(enforced by the `event-name` lint rule). Events surface in auto-dumps
+(Perfetto JSON + plaintext tail), `/flightrecorder`, and — merged
+across ranks by `tools/trace_merge.py` — the fleet post-mortem
+timeline. Request-trace SPANS carry dynamic per-request names and are
+not listed here.
+
+| Event | Description |
+|---|---|
+"""
+
+
+def render_events() -> str:
+    from paddle_tpu.core.flight_recorder import (DECLARED_EVENTS,
+                                                 EVENT_DOC)
+    missing = DECLARED_EVENTS - set(EVENT_DOC)
+    extra = set(EVENT_DOC) - DECLARED_EVENTS
+    if missing or extra:
+        raise SystemExit(
+            f"EVENT_DOC out of sync with DECLARED_EVENTS: "
+            f"missing={sorted(missing)} extra={sorted(extra)}")
+    rows = [f"| `{name}` | {EVENT_DOC[name]} |"
+            for name in sorted(EVENT_DOC)]
+    return _EVENTS_HEADER + "\n".join(rows) + "\n"
+
+
+def _docs_dir() -> str:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    return os.path.join(root, "docs", "metrics.md")
+    return os.path.join(root, "docs")
+
+
+def doc_path() -> str:
+    return os.path.join(_docs_dir(), "metrics.md")
+
+
+def events_doc_path() -> str:
+    return os.path.join(_docs_dir(), "events.md")
 
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    text = render()
-    path = doc_path()
-    if "--check" in argv:
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                current = f.read()
-        except OSError:
-            current = ""
-        if current != text:
-            sys.stderr.write(
-                f"{path} is stale; regenerate with "
-                "`python -m tools.metrics_doc`\n")
-            return 1
-        return 0
-    with open(path, "w", encoding="utf-8") as f:
-        f.write(text)
-    sys.stderr.write(f"wrote {path}\n")
-    return 0
+    rc = 0
+    for path, text in ((doc_path(), render()),
+                       (events_doc_path(), render_events())):
+        if "--check" in argv:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    current = f.read()
+            except OSError:
+                current = ""
+            if current != text:
+                sys.stderr.write(
+                    f"{path} is stale; regenerate with "
+                    "`python -m tools.metrics_doc`\n")
+                rc = 1
+            continue
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+        sys.stderr.write(f"wrote {path}\n")
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,193 @@
+"""Merge N per-rank flight-recorder dumps into ONE clock-aligned
+Perfetto JSON — the fleet post-mortem viewer.
+
+Each rank of a killed job leaves its own dump under the shared
+``PADDLE_FLIGHT_RECORDER_DIR`` (filenames embed ``(rank, restart,
+pid)`` so they never clobber). Every dump's metadata carries the
+per-process clock mapping the recorder stamps at dump time:
+
+    anchor_wall_ns / anchor_perf_ns   perf_counter -> wall clock
+    clock_offset_ns                   this host's wall clock vs the
+                                      fleet store's master clock (the
+                                      fleet-telemetry ping handshake)
+    rank / restart_count / pid        the track identity
+
+This tool maps every event's monotonic timestamp through those three
+terms onto one shared timeline (the store master's clock), rebases at
+the earliest event, and emits a single trace with ONE named process
+track per ``(rank, incarnation)`` — so a kill-one-worker chaos run
+renders as SIGTERM on rank k beside the detection/recovery spans on
+its peers, correctly ordered even when the hosts' clocks disagree.
+
+    python -m tools.trace_merge -o merged.json dump_a.json dump_b.json
+    python -m tools.trace_merge -o merged.json /path/to/dump/dir
+
+A directory argument globs its ``flightrecorder_*.json`` dumps. Dumps
+from before the clock-mapping metadata existed are merged with offset
+0 and a warning in the output metadata (ordering across such ranks is
+best-effort).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["merge", "merge_paths", "main"]
+
+
+def _collect_paths(args: List[str]) -> List[str]:
+    paths: List[str] = []
+    for a in args:
+        if os.path.isdir(a):
+            found = sorted(glob.glob(
+                os.path.join(a, "flightrecorder_*.json")))
+            if not found:
+                raise FileNotFoundError(
+                    f"no flightrecorder_*.json dumps under {a}")
+            paths.extend(found)
+        else:
+            paths.append(a)
+    if not paths:
+        raise ValueError("no dump paths given")
+    return paths
+
+
+def _track_key(md: dict) -> Tuple[int, int]:
+    return int(md.get("rank", 0)), int(md.get("restart_count", 0))
+
+
+def _aligned_wall_ns(ts_us: float, md: dict) -> Optional[float]:
+    """One event's Perfetto ``ts`` (µs of perf_counter) -> ns on the
+    shared master clock; None when the dump predates the anchors."""
+    aw = md.get("anchor_wall_ns")
+    ap = md.get("anchor_perf_ns")
+    if aw is None or ap is None:
+        return None
+    wall = aw + (ts_us * 1000.0 - ap)
+    return wall - md.get("clock_offset_ns", 0)
+
+
+def merge(dumps: List[dict]) -> dict:
+    """Merge loaded dump dicts (``flight_recorder.dump_dict`` /
+    ``.json`` file contents) into one Perfetto trace dict."""
+    if not dumps:
+        raise ValueError("no dumps to merge")
+    tracks: Dict[Tuple[int, int], dict] = {}
+    staged = []   # (track, pid, aligned_ns_or_None, raw_ts_us, event)
+    unaligned_tracks = set()
+    seen: Dict[Tuple[int, int], set] = {}
+    for d in dumps:
+        md = d.get("metadata", {})
+        key = _track_key(md)
+        pid = int(md.get("pid", 0))
+        if key in tracks and tracks[key]["pid"] != pid:
+            # same (rank, incarnation) from two different processes:
+            # two jobs' dumps were mixed into one merge call
+            raise ValueError(
+                f"duplicate track rank{key[0]}.{key[1]} from pids "
+                f"{tracks[key]['pid']} and {pid}: merging dumps of "
+                "two different jobs?")
+        if key in tracks:
+            # a SECOND dump from the same process (auto_dump at
+            # preemption + a later crash/manual dump): merge the
+            # union of both rings — overlapping events dedupe below
+            tracks[key]["dropped"] = max(tracks[key]["dropped"],
+                                         md.get("dropped_events", 0))
+            reason = md.get("reason", "?")
+            if reason not in tracks[key]["reason"].split("+"):
+                tracks[key]["reason"] += f"+{reason}"
+        else:
+            tracks[key] = {
+                "pid": pid,
+                "offset_ns": md.get("clock_offset_ns", 0),
+                "events": 0,
+                "dropped": md.get("dropped_events", 0),
+                "reason": md.get("reason", "?"),
+            }
+            seen[key] = set()
+        for ev in d.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                continue   # per-process metadata rebuilt below
+            # dumps of one process share the ring (and its anchors):
+            # identical events from overlapping dumps render ONCE
+            fp = json.dumps(ev, sort_keys=True, default=str)
+            if fp in seen[key]:
+                continue
+            seen[key].add(fp)
+            aligned = _aligned_wall_ns(float(ev.get("ts", 0.0)), md)
+            if aligned is None:
+                unaligned_tracks.add(key)
+            staged.append((key, pid, aligned, float(ev.get("ts", 0.0)),
+                           ev))
+            tracks[key]["events"] += 1
+    aligned_vals = [a for _, _, a, _, _ in staged if a is not None]
+    base_ns = min(aligned_vals) if aligned_vals else 0.0
+    out_events = []
+    for rank, restart in sorted(tracks):
+        t = tracks[(rank, restart)]
+        out_events.append({
+            "name": "process_name", "ph": "M", "pid": t["pid"],
+            "tid": 0,
+            "args": {"name": f"rank{rank}.{restart} "
+                             f"(pid {t['pid']}, {t['reason']})"}})
+        out_events.append({
+            "name": "process_sort_index", "ph": "M", "pid": t["pid"],
+            "tid": 0, "args": {"sort_index": rank}})
+    for key, pid, aligned, raw_us, ev in staged:
+        e = dict(ev)
+        e["pid"] = pid
+        # unaligned legacy dumps keep their raw timeline (offset 0)
+        e["ts"] = (aligned - base_ns) / 1000.0 \
+            if aligned is not None else raw_us
+        out_events.append(e)
+    return {
+        "traceEvents": out_events,
+        "metadata": {
+            "merged_tracks": {
+                f"rank{r}.{i}": tracks[(r, i)]
+                for r, i in sorted(tracks)},
+            "base_wall_ns": base_ns,
+            "clock_aligned": not unaligned_tracks,
+            **({"unaligned_tracks":
+                sorted(f"rank{r}.{i}" for r, i in unaligned_tracks)}
+               if unaligned_tracks else {}),
+        },
+    }
+
+
+def merge_paths(paths: List[str]) -> dict:
+    dumps = []
+    for p in _collect_paths(paths):
+        with open(p, "r", encoding="utf-8") as f:
+            dumps.append(json.load(f))
+    return merge(dumps)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.trace_merge",
+        description="Merge per-rank flight-recorder dumps into one "
+                    "clock-aligned Perfetto JSON.")
+    p.add_argument("-o", "--output", required=True,
+                   help="merged Perfetto JSON output path")
+    p.add_argument("dumps", nargs="+",
+                   help="dump .json files, or directories to glob "
+                        "flightrecorder_*.json from")
+    args = p.parse_args(argv)
+    merged = merge_paths(args.dumps)
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    md = merged["metadata"]
+    sys.stderr.write(
+        f"merged {len(md['merged_tracks'])} track(s), "
+        f"{len(merged['traceEvents'])} events -> {args.output}"
+        f"{'' if md['clock_aligned'] else ' (NOT clock-aligned)'}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
