@@ -900,7 +900,18 @@ def bench_serve(dev, on_tpu):
         return ServingEngine(cfg, poll_every=2)  # warmup compiles here
 
     engine = build()
+    # ISSUE-17 "slo" sub-dict scaffolding: bracket the flagship pass
+    # with two snapshots in a PRIVATE time-series ring, so the default
+    # TTFT SLO can be evaluated over exactly that window (the later
+    # precision passes re-drive the same metrics and must not leak in)
+    from paddle_tpu.core import slo as slo_mod
+    from paddle_tpu.core import timeseries as ts_mod
+    slo_ring = ts_mod.TimeSeriesRing(period_s=1.0, retention=4)
+    slo_ring.sample(now=0.0)
+    t_slo0 = time.perf_counter()
     qps, handles, _ = traffic(engine)
+    slo_span = time.perf_counter() - t_slo0
+    slo_ring.sample(now=slo_span)
     # ISSUE-15 "goodput" sub-dict: the serve-side wall-time ledger
     # after the first (flagship) pass — buckets sum to wall, compute
     # fraction is the replica's goodput under this traffic shape
@@ -942,6 +953,25 @@ def bench_serve(dev, on_tpu):
         "slots_reused": engine.stats["slots_reused"],
         "decode_steps": engine.stats["decode_steps"],
     }
+    # ISSUE-17 "slo" sub-dict: the default serve TTFT SLO evaluated
+    # over the flagship pass — objective, measured p99 off the ring's
+    # histogram delta, and the burn rate at end of run (burn > 1 means
+    # this traffic shape would eat error budget in production)
+    ttft_slo = next((s for s in slo_mod.default_slos()
+                     if s.name == "serve-ttft-p99"), None)
+    if ttft_slo is None:   # PADDLE_SLO_TTFT_P99=off
+        ttft_slo = slo_mod.SLO("serve-ttft-p99", "latency",
+                               "serve.ttft", 0.5)
+    measured = ttft_slo.measure(slo_ring, slo_span)
+    slo_row = {"slo": ttft_slo.name,
+               "objective_s": ttft_slo.objective,
+               "percentile": ttft_slo.percentile,
+               "window_s": round(slo_span, 3)}
+    if measured is not None:
+        m, bad = measured
+        slo_row["measured_s"] = round(m, 4)
+        slo_row["burn_rate"] = round(ttft_slo.burn(bad), 3)
+        slo_row["within_objective"] = bool(m <= ttft_slo.objective)
     # ISSUE-14 "mem" sub-dict: the engine's static HBM plan vs one
     # measured slot-decode dispatch, plus the KV pool bytes. Runs LAST:
     # on TPU the direct _step_jit dispatch donates the engine's state
@@ -969,6 +999,7 @@ def bench_serve(dev, on_tpu):
         "unit": "req/sec",
         "vs_baseline": 1.0,
         "sla": sla,
+        "slo": slo_row,
         "precision": precision,
         "mem": mem,
         "goodput": goodput_row,

@@ -72,6 +72,8 @@ DECLARED_EVENTS = frozenset({
     "resilience.preemption",
     "checkpoint.commit",
     "fleet.clock_sync", "fleet.rank_stale",
+    "slo.pending", "slo.firing", "slo.resolved",
+    "train.straggler",
 })
 
 # name -> one-line description; `python -m tools.metrics_doc` renders
@@ -108,6 +110,16 @@ EVENT_DOC = {
                         "rtt_ns vs the TCPStore master clock)",
     "fleet.rank_stale": "the fleet aggregator marked a rank stale "
                         "(rank, incarnation, age_s)",
+    "slo.pending": "an SLO's fast-window burn rate crossed 1.0 (slo, "
+                   "scope, burn_fast, burn_slow, measured)",
+    "slo.firing": "an SLO's fast AND slow burn rates crossed 1.0 — "
+                  "the alert pages (slo, scope, burn_fast, burn_slow, "
+                  "measured)",
+    "slo.resolved": "a firing SLO's fast window went clean (slo, "
+                    "scope, firing_s)",
+    "train.straggler": "the robust z-score straggler detector flagged "
+                       "or cleared a rank (rank, phase, z, mean_s, "
+                       "median_s)",
 }
 
 DEFAULT_CAPACITY = 4096

@@ -69,6 +69,12 @@ Metric name scheme (what the summary views group by):
                                 compile | data_stall | checkpoint |
                                 preemption_recovery | idle)
     train.goodput.fraction / serve.goodput.fraction   compute/wall
+    train.step_time             per-step wall-time histogram (s)
+    train.straggler{rank=...}   straggler detections per rank
+    serve.cost.*                per-request cost attribution (prefill
+                                ms, decode-window share ms, page*s)
+    slo.state / slo.burn_rate / slo.transitions   watchtower SLO
+                                evaluation (per scope+slo)
 """
 from __future__ import annotations
 
@@ -116,6 +122,9 @@ DECLARED_METRICS = frozenset({
     "fleet.rank_up", "fleet.clock_skew_ns",
     "train.goodput.seconds", "train.goodput.fraction",
     "serve.goodput.seconds", "serve.goodput.fraction",
+    "train.step_time", "train.straggler",
+    "serve.cost.prefill_ms", "serve.cost.decode_ms", "serve.cost.page_s",
+    "slo.state", "slo.burn_rate", "slo.transitions",
 })
 
 # The human-facing schema behind DECLARED_METRICS: name -> (kind,
@@ -303,7 +312,7 @@ METRIC_DOC = {
                           "telemetry-server HTTP requests by endpoint "
                           "(metrics | healthz | readyz | "
                           "flightrecorder | fleet_metrics | "
-                          "fleet_healthz)"),
+                          "fleet_healthz | slo)"),
     "flightrecorder.dumps": ("counter", ("reason",),
                              "flight-recorder dump files written "
                              "(watchdog | preemption | anomaly_restore "
@@ -345,6 +354,39 @@ METRIC_DOC = {
                                "serve goodput over the last ledger "
                                "flush window: compute seconds / wall "
                                "seconds"),
+    "train.step_time": ("histogram", (),
+                        "per-step wall time (s) measured around the "
+                        "dispatched train step — the series the fleet "
+                        "straggler detector and the step-time SLO "
+                        "evaluate"),
+    "train.straggler": ("counter", ("rank",),
+                        "straggler detections: a rank's windowed mean "
+                        "step time crossed the robust (median/MAD) "
+                        "z-score threshold vs its peers"),
+    "serve.cost.prefill_ms": ("histogram", (),
+                              "per-request attributed prefill wall "
+                              "time (ms), recorded at the request's "
+                              "terminal status"),
+    "serve.cost.decode_ms": ("histogram", (),
+                             "per-request attributed decode time "
+                             "(ms): the request's share of every poll "
+                             "window it was live in (window wall / "
+                             "live slots), recorded at terminal "
+                             "status"),
+    "serve.cost.page_s": ("histogram", (),
+                          "per-request KV page*seconds held (paged "
+                          "pool): pages resident x window wall, "
+                          "recorded at terminal status"),
+    "slo.state": ("gauge", ("scope", "slo"),
+                  "alert state per SLO (0 ok/resolved | 1 pending | "
+                  "2 firing); scope: process | fleet"),
+    "slo.burn_rate": ("gauge", ("scope", "slo", "window"),
+                      "error-budget burn rate over the fast/slow "
+                      "evaluation window (1.0 = burning exactly the "
+                      "budget)"),
+    "slo.transitions": ("counter", ("scope", "slo", "to"),
+                        "alert state-machine transitions (to: pending "
+                        "| firing | resolved | ok)"),
 }
 
 enabled = False  # mirrored from metrics.enable()/disable()
@@ -686,11 +728,24 @@ def record_page_occupancy(frac: float):
 
 # --------------------------------------------------------- serving layer
 
-# Latency-scaled histogram bounds (seconds): 100µs .. ~74s in sqrt(2)
-# steps, so percentile estimates stay within ~±20% across the whole
-# serving range (the default power-of-4 byte bounds would collapse every
-# sub-second latency into two buckets).
-_SERVE_LATENCY_BOUNDS = tuple(1e-4 * 2 ** (i / 2.0) for i in range(40))
+# Latency-scaled histogram bounds (seconds): 100µs .. ~88s in 2^(1/4)
+# (~19%) steps. The SLO watchtower gates burn rates on p99 of these
+# histograms, so the interpolation error of a percentile estimate must
+# be smaller than any objective worth alerting on: with quarter-power
+# spacing the estimate is off by at most one bucket width, i.e. a
+# worst-case relative error of 2^(1/4)-1 ~= 19% (vs ~41% for the old
+# sqrt(2) spacing) — tier-1 gates this against exact quantiles.
+_SERVE_LATENCY_BOUNDS = tuple(1e-4 * 2 ** (i / 4.0) for i in range(80))
+
+# Step times live on a coarser scale (ms .. minutes); same quarter-power
+# spacing so the fleet straggler detector's per-rank means interpolate
+# tightly.
+_STEP_TIME_BOUNDS = tuple(1e-3 * 2 ** (i / 4.0) for i in range(80))
+
+# Cost histograms are capacity-planning aggregates, not SLO gates:
+# sqrt(2) spacing over a wide range is enough.
+_COST_MS_BOUNDS = tuple(1e-1 * 2 ** (i / 2.0) for i in range(40))
+_COST_PAGE_S_BOUNDS = tuple(1e-3 * 2 ** (i / 2.0) for i in range(48))
 
 
 def record_serve_request(status: str):
@@ -740,6 +795,69 @@ def record_serve_cancellation(reason: str):
         return
     metrics.counter("serve.cancellations", reason=reason).inc()
     metrics.counter("serve.cancellations").inc()
+
+
+def record_request_cost(prefill_s: float, decode_s: float, page_s: float):
+    """One request's attributed cost at its terminal status: prefill
+    wall, its share of every decode poll window it was live in, and
+    KV page*seconds held (paged pool; 0.0 for contiguous caches)."""
+    if not enabled:
+        return
+    metrics.histogram("serve.cost.prefill_ms",
+                      bounds=_COST_MS_BOUNDS).observe(prefill_s * 1e3)
+    metrics.histogram("serve.cost.decode_ms",
+                      bounds=_COST_MS_BOUNDS).observe(decode_s * 1e3)
+    metrics.histogram("serve.cost.page_s",
+                      bounds=_COST_PAGE_S_BOUNDS).observe(float(page_s))
+
+
+# ------------------------------------------------------- training layer
+
+def record_train_step_time(seconds: float):
+    """One dispatched train step's wall time — the cumulative series
+    the fleet straggler detector diffs per rank and the step-time SLO
+    evaluates."""
+    if not enabled:
+        return
+    metrics.histogram("train.step_time",
+                      bounds=_STEP_TIME_BOUNDS).observe(float(seconds))
+
+
+def record_straggler(rank: int):
+    """One straggler detection: ``rank``'s windowed mean step time
+    crossed the robust z-score threshold vs its peers."""
+    if not enabled:
+        return
+    metrics.counter("train.straggler", rank=str(rank)).inc()
+    metrics.counter("train.straggler").inc()
+
+
+# ------------------------------------------------------ watchtower layer
+
+def record_slo_state(scope: str, slo: str, state_code: int):
+    """Current alert state of one SLO (0 ok/resolved | 1 pending |
+    2 firing); scope: process | fleet."""
+    if not enabled:
+        return
+    metrics.gauge("slo.state", scope=scope, slo=slo).set(float(state_code))
+
+
+def record_slo_burn_rate(scope: str, slo: str, window: str, burn: float):
+    """Error-budget burn rate measured over one evaluation window
+    (window: fast | slow)."""
+    if not enabled:
+        return
+    metrics.gauge("slo.burn_rate", scope=scope, slo=slo,
+                  window=window).set(float(burn))
+
+
+def record_slo_transition(scope: str, slo: str, to: str):
+    """One alert state-machine transition (to: pending | firing |
+    resolved | ok)."""
+    if not enabled:
+        return
+    metrics.counter("slo.transitions", scope=scope, slo=slo, to=to).inc()
+    metrics.counter("slo.transitions").inc()
 
 
 # ------------------------------------------------------- analysis layer

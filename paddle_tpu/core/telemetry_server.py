@@ -24,6 +24,12 @@ exposing:
                       when this process is not the aggregator)
     /fleet/healthz    per-replica ready/reason/headroom rollup — the
                       multi-replica router's admission document
+    /slo              the SLO watchtower document: every objective's
+                      alert state + burn rates, the bounded alert
+                      history, the top-K most expensive requests
+                      (``Request.cost()`` attribution), and — when a
+                      fleet aggregator is attached — the fleet-scope
+                      evaluation + straggler ranks
 
 Every ``/metrics``-style render also carries two scrape-hygiene
 series: a ``paddle_build_info`` info-gauge (version, jax/jaxlib,
@@ -242,6 +248,10 @@ class _Handler(BaseHTTPRequestHandler):
                         200,
                         prometheus_text(agg.fleet_registry()).encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/slo":
+                monitor.record_scrape("slo")
+                self._send(200, json.dumps(owner.slo_document()).encode(),
+                           "application/json")
             elif path == "/fleet/healthz":
                 monitor.record_scrape("fleet_healthz")
                 agg = owner.aggregator
@@ -273,6 +283,50 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 # --------------------------------------------------------------- server
+
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can JOIN its in-flight handlers.
+
+    The stock mixin only tracks handler threads when they are
+    non-daemon (``block_on_close`` path); with ``daemon_threads = True``
+    — which this server needs so a wedged scrape can't block process
+    exit — ``server_close()`` joins nothing, so ``stop()`` could return
+    while a handler was still mid-response and the scrape raced
+    whatever teardown followed (``ServingEngine.shutdown()`` closing
+    the registry's producers). Track the threads explicitly and let
+    ``stop()`` wait them out with a bound."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        self._handler_threads: set = set()
+        self._handler_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request_thread(self, request, client_address):
+        t = threading.current_thread()
+        with self._handler_lock:
+            self._handler_threads.add(t)
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            with self._handler_lock:
+                self._handler_threads.discard(t)
+
+    def join_handlers(self, timeout: float) -> bool:
+        """Wait (bounded) for every in-flight handler to finish;
+        True if none remain."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._handler_lock:
+                live = [t for t in self._handler_threads if t.is_alive()]
+            if not live:
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            live[0].join(timeout=min(0.05, remaining))
+
 
 class TelemetryServer:
     """The export surface. ``start()`` binds and serves on a daemon
@@ -306,9 +360,8 @@ class TelemetryServer:
         # clears history; disable() later stops recording, and the
         # server keeps serving the last recorded values.)
         metrics.enable()
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _TrackingHTTPServer(
             (self.host, self._requested_port), _Handler)
-        self._httpd.daemon_threads = True
         self._httpd.telemetry = self  # type: ignore[attr-defined]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
@@ -322,6 +375,12 @@ class TelemetryServer:
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()
+            # drain in-flight handlers BEFORE returning: callers tear
+            # down the things handlers read (engine slots, fleet
+            # aggregator, registry producers) the moment stop()
+            # returns, and a daemon handler thread still writing its
+            # response would race that teardown
+            httpd.join_handlers(timeout=5.0)
         if thread is not None:
             thread.join(timeout=5.0)
 
@@ -341,6 +400,27 @@ class TelemetryServer:
         drained local engine)."""
         self.aggregator = aggregator
         return self
+
+    def slo_document(self) -> dict:
+        """The ``/slo`` body: process-scope watchtower report, the
+        attached engine's top-K request-cost table, and the fleet-scope
+        evaluation when this process runs the aggregator."""
+        from . import slo as slo_mod
+        doc = slo_mod.report()
+        engine = self._engine_ref() if self._engine_ref is not None \
+            else None
+        if engine is not None and hasattr(engine, "cost_table"):
+            try:
+                doc["top_cost"] = engine.cost_table()
+            except Exception as e:
+                monitor.record_swallowed("telemetry.cost_table", e)
+        agg = self.aggregator
+        if agg is not None and hasattr(agg, "slo_report"):
+            try:
+                doc["fleet"] = agg.slo_report()
+            except Exception as e:
+                monitor.record_swallowed("telemetry.fleet_slo", e)
+        return doc
 
     def readiness(self) -> Tuple[bool, dict]:
         from ..distributed import resilience  # lazy: core below distributed

@@ -49,7 +49,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
-from ..core import flight_recorder, metrics, monitor
+from ..core import flight_recorder, metrics, monitor, slo, timeseries
 
 __all__ = [
     "FleetAggregator", "FleetIdentity", "FleetMember",
@@ -334,6 +334,15 @@ class FleetAggregator:
         self.expected_ranks = expected_ranks
         self._ns = _namespace(namespace)
         self._ranks: Dict[int, _RankState] = {}
+        # fleet-scope SLO watchtower: every poll appends the merged
+        # (relabeled, deep-copied) per-rank state to a private
+        # time-series ring and evaluates the same default specs over
+        # it — the fleet face of core.slo; the straggler detector
+        # diffs each rank's cumulative train.step_time between polls
+        self._slo_ring = timeseries.TimeSeriesRing(period_s=self.period_s)
+        self.slo_evaluator = slo.SLOEvaluator(self._slo_ring,
+                                              scope="fleet")
+        self.straggler = slo.StragglerDetector()
         # _lock guards only the in-memory merged view (held for
         # microseconds); _poll_lock serializes store I/O rounds.
         # Separate so a store outage mid-poll can NEVER block
@@ -408,6 +417,11 @@ class FleetAggregator:
                                              not st.stale)
                 monitor.record_clock_skew(rank, st.clock_offset_ns)
             monitor.record_fleet_ranks(len(self._ranks), stale)
+            fleet_state, step_totals = self._fleet_snapshot_locked()
+        # ---- watchtower phase: own locks only, store lock released
+        self.straggler.observe(step_totals)
+        self._slo_ring.sample_state(fleet_state)
+        self.slo_evaluator.evaluate()
         # ---- resync writes: store I/O again, lock released
         for rank, st in resyncs:
             try:
@@ -416,6 +430,28 @@ class FleetAggregator:
                 with self._lock:
                     st.resync_pending = False
                 monitor.record_swallowed("fleet.resync", e)
+
+    def _fleet_snapshot_locked(self):
+        """(relabeled deep-copied mergeable state of every rank's
+        series, per-rank cumulative ``train.step_time`` (count, sum))
+        — the fleet SLO ring sample and the straggler detector input.
+        Caller holds ``self._lock``; records are copied because
+        ``apply_delta`` mutates the rank states in place."""
+        state: Dict[str, dict] = {}
+        totals: Dict[int, tuple] = {}
+        for rank, st in self._ranks.items():
+            extra = {"rank": str(rank), "replica": st.replica,
+                     "incarnation": str(st.incarnation)}
+            for key, rec in st.metrics.items():
+                out = dict(rec)
+                if "counts" in out:
+                    out["counts"] = list(out["counts"])
+                state[_merge_labels(key, extra)] = out
+            rec = st.metrics.get("train.step_time")
+            if rec is not None and rec.get("kind") == "histogram":
+                totals[rank] = (float(rec.get("count", 0)),
+                                float(rec.get("sum", 0.0)))
+        return state, totals
 
     def _apply(self, rank: int, payload: dict, resyncs: list):
         # caller holds self._lock
@@ -529,6 +565,8 @@ class FleetAggregator:
         headroom plus the fleet verdict — ready iff every known rank
         is ready, none is stale, and (when the world size is known)
         everyone has reported."""
+        straggler_ranks = set(self.straggler.straggler_ranks())
+        slo_states = self.slo_evaluator.states()
         with self._lock:
             ranks = {}
             stale = 0
@@ -547,6 +585,10 @@ class FleetAggregator:
                     "replica": st.replica,
                     "age_s": round(st.age_s, 3)
                     if st.age_s is not None else None,
+                    # marked, never dropped: a straggler stays ready
+                    # (it IS serving/stepping) but the router/operator
+                    # sees the flag
+                    "straggler": rank in straggler_ranks,
                 }
                 for k in ("predicted_headroom_bytes",
                           "predicted_peak_bytes", "free_tokens",
@@ -565,8 +607,18 @@ class FleetAggregator:
                 "ranks_expected": self.expected_ranks,
                 "ranks_missing": missing,
                 "stale_after_s": self.stale_after_s,
+                "stragglers": sorted(straggler_ranks),
+                "slo": slo_states,
                 "ranks": ranks,
             }
+
+    def slo_report(self) -> Dict:
+        """The fleet section of the telemetry server's ``/slo`` body:
+        fleet-scope SLO states + alert history + straggler flags."""
+        doc = self.slo_evaluator.report()
+        doc["stragglers"] = self.straggler.straggler_ranks()
+        doc["straggler_flags"] = self.straggler.flags()
+        return doc
 
     # --------------------------------------------------------- lifecycle
     def start(self) -> "FleetAggregator":

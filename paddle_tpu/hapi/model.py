@@ -17,7 +17,7 @@ import jax
 import numpy as np
 
 from .. import framework_io
-from ..core import flight_recorder, goodput, monitor
+from ..core import flight_recorder, goodput, monitor, slo
 from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
 from ..io.dataset import Dataset
@@ -471,9 +471,16 @@ class Model:
                 # wall time tracing + XLA-compiling, not computing:
                 # that window is the compile bucket (the always-on
                 # retrace census works with the registry disabled)
+                dt_step = time.perf_counter() - t_step
                 ledger.charge(
                     "compile" if monitor.retrace_count() > retraces0
-                    else "compute", time.perf_counter() - t_step)
+                    else "compute", dt_step)
+                # the per-step wall series the fleet straggler detector
+                # diffs per rank and the step-time SLO evaluates; the
+                # watchtower tick samples/evaluates at most once per
+                # ring period (fast path: one float compare)
+                monitor.record_train_step_time(dt_step)
+                slo.tick()
                 # preemption lands here: emergency save + exit(101)
                 resilience.poll(global_step)
                 if any(getattr(cb, "stopped", False)

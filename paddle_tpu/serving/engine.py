@@ -64,6 +64,7 @@ completion — the decode hot loop itself dispatches without waiting.
 from __future__ import annotations
 
 import collections
+import heapq
 import os
 import threading
 import time
@@ -74,6 +75,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import flight_recorder, monitor
+from ..core import slo as slo_mod
 from ..core.tensor import Tensor
 from ..generation.api import (GenerationConfig, _expect_logits_cache,
                               _round_up, _sample_cfg)
@@ -611,6 +613,10 @@ class ServingEngine:
                           cancelled=0, rejected=0, slots_reused=0,
                           decode_steps=0, prefills=0,
                           spec_proposed=0, spec_accepted=0)
+        # top-K most expensive terminal requests (heap of
+        # (total_s, req id, cost dict)) — the /slo cost table
+        self._cost_top: List[tuple] = []
+        self._cost_topk = 10
         # goodput ledger (serve.goodput.* family): dispatch windows and
         # admissions charge compute (or compile when a retrace happened
         # inside the window), serve_forever's empty-queue sleeps charge
@@ -1011,9 +1017,14 @@ class ServingEngine:
         try:
             self._admit_inner(req, slot)
         finally:
+            dt = time.perf_counter() - t_admit
+            # cost attribution mirrors the ledger charge: the request
+            # owns exactly the admission wall the ledger books, so
+            # per-request costs reconcile against the compute bucket
+            req._cost_prefill_s += dt
             self._goodput.charge(
                 "compile" if monitor.retrace_count() > retraces0
-                else "compute", time.perf_counter() - t_admit)
+                else "compute", dt)
 
     def _admit_inner(self, req: Request, slot: int):
         bucket = next(b for b in self.buckets if b >= req.prompt.size)
@@ -1148,13 +1159,33 @@ class ServingEngine:
                 self.stats["spec_accepted"] += da
                 monitor.record_speculative(dp, da)
         now = time.monotonic()
+        window_dt = 0.0
         if self._window_t0 is not None and self._window_steps:
+            window_dt = now - self._window_t0
             monitor.record_serve_token_latency(
-                (now - self._window_t0) / self._window_steps)
+                window_dt / self._window_steps)
             # the dispatch window (host dispatches + the device wait
             # the lane reads above just paid) is goodput compute
-            self._goodput.charge("compute", now - self._window_t0)
+            self._goodput.charge("compute", window_dt)
         self._window_steps = 0   # next dispatch re-anchors _window_t0
+        if window_dt > 0.0:
+            # cost attribution: every live request owns an equal share
+            # of the window the ledger just booked as compute (shares
+            # sum to the window — Request.cost() reconciles against
+            # the compute bucket), plus page*seconds for its resident
+            # KV pages. Charged BEFORE completions below, so a request
+            # finishing this window still pays for it.
+            live = sum(r is not None for r in self._slots)
+            if live:
+                share = window_dt / live
+                for i, r in enumerate(self._slots):
+                    if r is None:
+                        continue
+                    r._cost_decode_s += share
+                    if self._alloc is not None:
+                        pages = self._row_pages[i]
+                        if pages:
+                            r._cost_page_s += len(pages) * window_dt
         t_poll_ns = flight_recorder.now_ns()
         for i, req in enumerate(self._slots):
             if req is None:
@@ -1188,6 +1219,10 @@ class ServingEngine:
             self._drain_page_stats()
             self._drain_quant_stats()
             self._goodput.flush()
+            # SLO watchtower: sample the time-series ring + evaluate
+            # burn rates at most once per ring period (fast path is a
+            # float compare — gated in test_overhead_gate)
+            slo_mod.tick()
 
     def _complete(self, req: Request, toks: np.ndarray):
         eos = self._cfg.eos_token_id
@@ -1203,6 +1238,7 @@ class ServingEngine:
         req._finish(RequestStatus.COMPLETED)
         self.stats["completed"] += 1
         monitor.record_serve_request("completed")
+        self._note_cost(req)
 
     def _cancel(self, req: Request, reason: str,
                 label: Optional[str] = None):
@@ -1231,6 +1267,27 @@ class ServingEngine:
         self._slots[slot] = None  # lint: lock-discipline-ok (eviction runs under the caller's pump lock)
         self._free_slot_pages(slot)
         self._cancel(req, reason)
+        self._note_cost(req)
+
+    def _note_cost(self, req: Request):
+        """Terminal cost attribution: land the request's accumulated
+        cost in the serve.cost.* histograms and keep the top-K most
+        expensive requests for the /slo table."""
+        c = req.cost()
+        monitor.record_request_cost(c["prefill_s"], c["decode_s"],
+                                    c["page_s"])
+        with self._qlock:
+            heapq.heappush(self._cost_top, (c["total_s"], req.id, c))
+            while len(self._cost_top) > self._cost_topk:
+                heapq.heappop(self._cost_top)
+
+    def cost_table(self) -> List[dict]:
+        """The top-K most expensive terminal requests, costliest
+        first — the /slo endpoint's per-request attribution table."""
+        with self._qlock:
+            top = sorted(self._cost_top, reverse=True)
+        return [dict(req=rid, **{k: round(v, 6) for k, v in c.items()})
+                for _, rid, c in top]
 
     # ------------------------------------------------- page bookkeeping
     def _free_slot_pages(self, slot: int):

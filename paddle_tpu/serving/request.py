@@ -105,6 +105,13 @@ class Request:
         self.traced = False
         self._t_submit_ns = 0   # set by the engine when traced
         self._t_seg_ns = 0      # rolling decode-segment anchor
+        # ---- cost attribution (SLO watchtower): the engine charges
+        # prefill wall at admission, this request's share of every poll
+        # window it was live in, and page*seconds held in the paged
+        # pool; read back via cost() and the /slo top-K table
+        self._cost_prefill_s = 0.0
+        self._cost_decode_s = 0.0
+        self._cost_page_s = 0.0
 
     def span(self, name: str, start_ns: int, end_ns: int, **fields):
         """Record one trace span for this request (no-op unless the
@@ -187,6 +194,20 @@ class Request:
             return None
         return (self.finished_at - self.first_token_at) / \
             (self.n_emitted - 1)
+
+    def cost(self) -> dict:
+        """Attributed resource cost so far: prefill wall seconds, this
+        request's share of every decode poll window it was live in
+        (window wall / live slots — the shares of one window sum to the
+        window, so fleet-wide costs reconcile against the goodput
+        ledger's compute bucket), and KV page*seconds held in the
+        paged pool (0.0 on contiguous caches)."""
+        return {
+            "prefill_s": self._cost_prefill_s,
+            "decode_s": self._cost_decode_s,
+            "page_s": self._cost_page_s,
+            "total_s": self._cost_prefill_s + self._cost_decode_s,
+        }
 
     def __repr__(self):
         return (f"Request(id={self.id}, status={self.status.value}, "

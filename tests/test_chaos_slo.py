@@ -1,0 +1,189 @@
+"""Chaos tier for the SLO watchtower: a 4-rank fleet with one slowed
+rank whose store is fault-injected mid-poll — the straggler alert must
+fire EXACTLY once, stay latched while the rank is slow, and resolve
+after the slowdown ends; then a SIGTERM landing while an SLO alert is
+firing must leave a flight-recorder dump that contains the firing
+alert's spans (the ISSUE-17 post-mortem contract: the black box a dying
+process leaves behind is enough to reconstruct the alert)."""
+import glob
+import json
+import os
+import signal
+
+import pytest
+
+import paddle_tpu.utils.fault_injection as fi
+from paddle_tpu.core import flight_recorder, monitor, slo, timeseries
+from paddle_tpu.distributed import fleet_telemetry as ft
+from paddle_tpu.distributed.resilience import GracefulShutdown
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import metrics
+
+pytestmark = pytest.mark.chaos
+
+NS = "__fleet/chaos-slo"
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    metrics.disable()
+    metrics.reset()
+    timeseries._reset_for_tests()
+    slo._reset_for_tests()
+    flight_recorder.clear()
+    yield
+    metrics.disable()
+    metrics.reset()
+    timeseries._reset_for_tests()
+    slo._reset_for_tests()
+    flight_recorder.clear()
+
+
+@pytest.fixture()
+def store():
+    s = TCPStore("127.0.0.1", 0, is_master=True)
+    yield s
+    s.shutdown_server()
+
+
+def _publish(store, rank, seq, count, total_s):
+    """One hand-rolled rank payload: an absolute train.step_time
+    histogram (what MetricsPublisher's full publish carries)."""
+    store.set(f"{NS}/m/{rank}", {
+        "seq": seq, "rank": rank, "incarnation": 0,
+        "replica": str(rank), "pid": 1000 + rank, "clock_offset_ns": 0,
+        "delta": {"full": True, "metrics": {
+            "train.step_time": {"kind": "histogram", "bounds": [10.0],
+                                "counts": [count, 0], "count": count,
+                                "sum": total_s}}},
+        "health": {"ready": True},
+    })
+    store.set_timestamp(f"{NS}/ts/{rank}")
+
+
+class TestStragglerUnderStoreFaults:
+    def test_slow_rank_fires_once_and_resolves(self, store):
+        metrics.enable()
+        agg = ft.FleetAggregator(store, period_s=1000.0,
+                                 stale_after_s=60.0, expected_ranks=4,
+                                 namespace=NS)
+        means = {0: 0.1, 1: 0.1, 2: 0.1, 3: 0.1}
+        totals = {r: 0.0 for r in means}
+        counts = {r: 0 for r in means}
+
+        def advance(seq, rank_means):
+            for r, m in rank_means.items():
+                counts[r] += 10
+                totals[r] += m * 10
+                _publish(store, r, seq, counts[r], totals[r])
+
+        # poll 1: everyone healthy
+        advance(1, means)
+        agg.poll()
+        assert agg.straggler.straggler_ranks() == []
+        # poll 2: rank 2 turns 10x slow, AND the store delays every
+        # payload read — the detector must still see the poll through
+        advance(2, {**means, 2: 1.0})
+        with fi.StoreFaults(delay=0.05, ops=("get",), count=4):
+            agg.poll()
+        assert agg.straggler.straggler_ranks() == [2]
+        hz = agg.healthz()
+        assert hz["stragglers"] == [2]
+        assert hz["ranks"]["2"]["straggler"] is True
+        assert hz["ranks"]["2"]["ready"] is True  # marked, not dropped
+        assert hz["ranks"]["0"]["straggler"] is False
+        # poll 3: still slow — the alert is LATCHED, no re-fire
+        advance(3, {**means, 2: 1.0})
+        agg.poll()
+        assert agg.straggler.straggler_ranks() == [2]
+        # poll 4: back to normal — resolves
+        advance(4, means)
+        agg.poll()
+        assert agg.straggler.straggler_ranks() == []
+        assert agg.healthz()["stragglers"] == []
+        # exactly one detected + one resolved event, both for rank 2
+        evs = [f for _, k, f in flight_recorder.events()
+               if k == "train.straggler"]
+        assert [(e["rank"], e["phase"]) for e in evs] == \
+            [(2, "detected"), (2, "resolved")]
+        assert evs[0]["z"] > 3.5
+        snap = metrics.snapshot()
+        assert snap["train.straggler{rank=2}"]["value"] == 1
+        # the fleet /slo section carries the flags
+        rep = agg.slo_report()
+        assert rep["stragglers"] == []
+        assert rep["scope"] == "fleet"
+
+
+class TestSigtermMidFire:
+    def _drive_slo_to_firing(self):
+        """ok -> pending -> firing on a 2s/10s chaos spec: good TTFTs
+        t=1..10, all-bad from t=11; fast trips at t=12 (pending), slow
+        at t=16 (firing) — the pending->firing escalation becomes the
+        span the post-mortem dump must contain."""
+        spec = slo.SLO("chaos-ttft", "latency", "serve.ttft", 0.05,
+                       window_s=10, fast_window_s=2, percentile=50)
+        ring = timeseries.TimeSeriesRing(period_s=1.0, retention=50)
+        ev = slo.SLOEvaluator(ring, slos=[spec], scope="process")
+        ring.sample(now=0.0)
+        states = {}
+        for t in range(1, 17):
+            monitor.record_serve_ttft(0.01 if t <= 10 else 1.0)
+            ring.sample(now=float(t))
+            states[t] = ev.evaluate(now=float(t))["chaos-ttft"]
+        assert states[11] == "ok"        # fast burn exactly 1.0
+        assert states[12] == "pending"
+        assert states[15] == "pending"
+        assert states[16] == "firing"
+        return ev
+
+    def test_dump_contains_firing_alert_and_straggler(
+            self, tmp_path, monkeypatch):
+        metrics.enable()
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        ev = self._drive_slo_to_firing()
+        assert ev.states()["chaos-ttft"] == "firing"
+        # a straggler flagged at SIGTERM time rides in the same dump
+        det = slo.StragglerDetector(min_ranks=3)
+        det.observe({0: (10, 1.0), 1: (10, 1.0), 2: (10, 1.0),
+                     3: (10, 1.0)})
+        det.observe({0: (20, 2.0), 1: (20, 2.0), 2: (20, 11.0),
+                     3: (20, 2.0)})
+        assert det.straggler_ranks() == [2]
+        # clear the per-reason rate limit + per-process cap so THIS
+        # dump is never swallowed by earlier chaos tests' dumps
+        flight_recorder._recorder._last_auto.pop("preemption", None)
+        flight_recorder._recorder._auto_dumps = 0
+        with GracefulShutdown(store=None, exit_on_save=False) as gs:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert gs.check(step=5)      # dump, no exit
+        dumps = glob.glob(
+            str(tmp_path / "flightrecorder_preemption_*.json"))
+        assert len(dumps) == 1
+        with open(dumps[0]) as f:
+            doc = json.load(f)
+        tev = doc["traceEvents"]
+        # the escalation span (pending -> firing build-up window)
+        spans = [e for e in tev if e.get("ph") == "X"
+                 and e.get("name") == "slo:chaos-ttft"]
+        assert [s["args"]["phase"] for s in spans] == ["escalation"]
+        # the firing instant with its burn rates
+        firing = [e for e in tev if e.get("name") == "slo.firing"]
+        assert len(firing) == 1
+        assert firing[0]["args"]["slo"] == "chaos-ttft"
+        assert firing[0]["args"]["burn_fast"] > 1.0
+        assert firing[0]["args"]["burn_slow"] > 1.0
+        # the straggler instant for the slowed rank
+        strag = [e for e in tev if e.get("name") == "train.straggler"]
+        assert [(s["args"]["rank"], s["args"]["phase"])
+                for s in strag] == [(2, "detected")]
+        # the preemption instant itself (the dump's trigger)
+        assert any(e.get("name") == "resilience.preemption"
+                   for e in tev)
+        assert doc["metadata"]["reason"] == "preemption"
+        # and the post-mortem CLI reconstructs the alert from it
+        from tools import slo_report
+        text = slo_report.report(slo_report.load_paths([dumps[0]]))
+        assert "chaos-ttft" in text
+        assert "firing" in text and "escalation" in text
+        assert "detected" in text

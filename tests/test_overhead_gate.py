@@ -169,3 +169,52 @@ def test_ambient_goodput_disabled_under_budget():
         f"ambient goodput charge with no active ledger costs "
         f"{best:.2f}µs/step (budget {PIPELINE_BUDGET_US}µs) — the "
         "fleet/goodput off path must stay a truthiness check")
+
+
+# ----------------------------------------------------- SLO watchtower
+# slo.tick() sits inside the serving poll loop and the fit loop's step
+# section. Its not-due path must stay one clock read + compare (ring
+# not due) and its registry-off path one bool check, or the watchtower
+# taxes every step it is supposed to be observing.
+
+
+def _measure_maybe_sample(ring) -> float:
+    t0 = time.perf_counter()
+    for _ in range(N):
+        ring.maybe_sample()
+    return (time.perf_counter() - t0) / N * 1e6
+
+
+def test_timeseries_not_due_under_budget():
+    from paddle_tpu.core import timeseries
+    metrics.disable()
+    ring = timeseries.TimeSeriesRing(period_s=3600.0, retention=4)
+    ring.sample()  # arms _next_due an hour out: every call is not-due
+    _measure_maybe_sample(ring)  # warm up
+    best = min(_measure_maybe_sample(ring) for _ in range(3))
+    assert len(ring) == 1  # truly not due
+    assert best < BUDGET_US, (
+        f"not-due TimeSeriesRing.maybe_sample costs {best:.2f}µs/op "
+        f"(budget {BUDGET_US}µs) — the record path must stay a clock "
+        "read + compare")
+
+
+def _measure_slo_tick() -> float:
+    from paddle_tpu.core import slo
+    t0 = time.perf_counter()
+    for _ in range(N):
+        slo.tick()
+    return (time.perf_counter() - t0) / N * 1e6
+
+
+def test_slo_tick_disabled_under_budget():
+    from paddle_tpu.core import slo
+    metrics.disable()
+    assert not monitor.enabled
+    assert slo.tick() is False  # registry off: nothing evaluated
+    _measure_slo_tick()  # warm up
+    best = min(_measure_slo_tick() for _ in range(3))
+    assert best < BUDGET_US, (
+        f"registry-off slo.tick costs {best:.2f}µs/op "
+        f"(budget {BUDGET_US}µs) — the off path must stay a bool "
+        "check")

@@ -1,0 +1,539 @@
+"""SLO watchtower (ISSUE 17): the time-series ring's windowed queries
+and delta sharing, burn-rate math on raw bucket arrays, the re-bound
+serve latency histograms' resolution vs exact quantiles, the
+deterministic pending->firing->resolved burn-rate state machine on a
+replayed synthetic burst trace (reflected live at /slo and
+/fleet/healthz), per-request cost attribution reconciling against the
+goodput ledger's compute bucket, the straggler detector's robust
+z-score latch, and the tools/slo_report.py post-mortem CLI."""
+import glob
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flight_recorder, monitor, slo, timeseries
+from paddle_tpu.core.telemetry_server import TelemetryServer
+from paddle_tpu.profiler import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    metrics.disable()
+    metrics.reset()
+    timeseries._reset_for_tests()
+    slo._reset_for_tests()
+    yield
+    metrics.disable()
+    metrics.reset()
+    timeseries._reset_for_tests()
+    slo._reset_for_tests()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return json.loads(r.read().decode())
+
+
+# ------------------------------------------------------ time-series ring
+
+
+class TestTimeSeriesRing:
+    def test_counter_delta_and_rate(self):
+        metrics.enable()
+        c = metrics.counter("t.slo.count")
+        ring = timeseries.TimeSeriesRing(period_s=1.0, retention=10)
+        ring.sample(now=0.0)
+        c.inc(5)
+        ring.sample(now=1.0)
+        c.inc(15)
+        ring.sample(now=2.0)
+        assert ring.delta("t.slo.count", 2.0) == 20
+        assert ring.delta("t.slo.count", 1.0) == 15
+        assert ring.rate("t.slo.count", 2.0) == pytest.approx(10.0)
+        assert ring.latest("t.slo.count") == 20
+        # unknown metric: no evidence, not zero
+        assert ring.delta("t.slo.nope", 2.0) is None
+
+    def test_unchanged_records_shared_by_reference(self):
+        """The delta encoding applied in-memory: a metric that did not
+        move between samples costs a POINTER in the next snapshot, not
+        a copy — the idle-ring memory bound."""
+        metrics.enable()
+        a = metrics.counter("t.share.a")
+        b = metrics.counter("t.share.b")
+        ring = timeseries.TimeSeriesRing(period_s=1.0, retention=10)
+        a.inc()
+        b.inc()
+        ring.sample(now=0.0)
+        b.inc()  # only b moves
+        ring.sample(now=1.0)
+        s0 = ring._entries[0][1]
+        s1 = ring._entries[1][1]
+        assert s1["t.share.a"] is s0["t.share.a"]
+        assert s1["t.share.b"] is not s0["t.share.b"]
+
+    def test_labeled_subset_matching_and_double_count_trap(self):
+        """Label-subset queries sum matching series; the bare
+        serve.requests name also matches the UNLABELED parent the
+        recorder bumps alongside each status — which is exactly why
+        the error-rate SLO enumerates labeled statuses for its total."""
+        metrics.enable()
+        ring = timeseries.TimeSeriesRing(period_s=1.0, retention=10)
+        ring.sample(now=0.0)
+        for _ in range(3):
+            monitor.record_serve_request("completed")
+        monitor.record_serve_request("cancelled")
+        ring.sample(now=1.0)
+        assert ring.delta("serve.requests{status=completed}", 1.0) == 3
+        assert ring.delta("serve.requests{status=cancelled}", 1.0) == 1
+        # bare name = labeled series + unlabeled parent = 2x the truth
+        assert ring.delta("serve.requests", 1.0) == 8
+        spec = next(s for s in slo.default_slos()
+                    if s.name == "serve-error-rate")
+        measured, bad = spec.measure(ring, 1.0)
+        assert measured == pytest.approx(0.25)
+        assert bad == pytest.approx(0.25)
+
+    def test_retention_bound_and_disabled(self):
+        metrics.enable()
+        ring = timeseries.TimeSeriesRing(period_s=1.0, retention=3)
+        for t in range(6):
+            ring.sample(now=float(t))
+        assert len(ring) == 3
+        assert ring.span() == (3.0, 5.0)
+        off = timeseries.TimeSeriesRing(period_s=0.0, retention=3)
+        assert off.disabled
+        assert not off.maybe_sample()
+        assert len(off) == 0
+
+    def test_maybe_sample_period_gate(self):
+        metrics.enable()
+        ring = timeseries.TimeSeriesRing(period_s=1.0, retention=10)
+        assert ring.maybe_sample(now=0.0)
+        assert not ring.maybe_sample(now=0.5)   # not due
+        assert ring.maybe_sample(now=1.0)
+        assert len(ring) == 2
+
+    def test_hist_window_queries(self):
+        metrics.enable()
+        h = metrics.histogram("t.slo.lat",
+                              bounds=(0.1, 0.2, 0.4, 0.8))
+        h.observe(0.15)   # before the window: must not count
+        ring = timeseries.TimeSeriesRing(period_s=1.0, retention=10)
+        ring.sample(now=0.0)
+        for v in (0.15, 0.15, 0.3, 0.7):
+            h.observe(v)
+        ring.sample(now=1.0)
+        bounds, d_counts, d_count, d_sum = ring.hist_delta(
+            "t.slo.lat", 1.0)
+        assert d_count == 4
+        assert d_sum == pytest.approx(1.3)
+        assert sum(d_counts) == 4
+        frac = ring.hist_fraction_above("t.slo.lat", 0.2, 1.0)
+        assert frac == pytest.approx(0.5)  # 0.3 and 0.7 of the four
+        p100 = ring.hist_percentile_over("t.slo.lat", 100.0, 1.0)
+        assert 0.4 < p100 <= 0.8
+
+
+class TestPercentileMath:
+    def test_percentile_of_matches_histogram_object(self):
+        metrics.enable()
+        h = metrics.histogram("t.pct", bounds=(1.0, 2.0, 4.0, 8.0))
+        for v in (0.5, 1.5, 1.5, 3.0, 6.0, 20.0):
+            h.observe(v)
+        bounds, counts, count, _ = h.raw()
+        for q in (0, 10, 50, 90, 99, 100):
+            assert timeseries.percentile_of(bounds, counts, count, q) \
+                == h.percentile(q)
+        assert timeseries.percentile_of(bounds, counts, 0, 50) == 0.0
+
+    def test_fraction_above_interpolates(self):
+        bounds = (1.0, 2.0, 4.0)
+        counts = [2, 2, 2, 0]       # uniform-ish, none in overflow
+        # threshold on a bucket edge: exactly the upper buckets
+        assert timeseries.fraction_above(bounds, counts, 6, 2.0) == \
+            pytest.approx(2 / 6)
+        # mid-bucket: half of the (1,2] bucket counts as above
+        assert timeseries.fraction_above(bounds, counts, 6, 1.5) == \
+            pytest.approx(0.5)
+        assert timeseries.fraction_above(bounds, counts, 6, 0.0) == 1.0
+        assert timeseries.fraction_above(bounds, counts, 6, 100.0) == \
+            pytest.approx(0.0)
+        assert timeseries.fraction_above(bounds, counts, 0, 1.0) == 0.0
+
+
+# ------------------------------------- satellite: re-bound serve latency
+
+
+class TestServeLatencyBounds:
+    def test_quarter_octave_spacing_and_coverage(self):
+        """The SLO-gateable contract: consecutive bounds 2^0.25 apart
+        (worst-case relative quantile error ~19%, vs ~41% before the
+        re-bound), covering 100us through >60s."""
+        b = monitor._SERVE_LATENCY_BOUNDS
+        assert b[0] == pytest.approx(1e-4)
+        assert b[-1] > 60.0
+        for lo, hi in zip(b, b[1:]):
+            assert hi / lo == pytest.approx(2 ** 0.25)
+
+    def test_p99_resolution_vs_exact_quantiles(self):
+        """Seeded latency sample through the real serve.ttft recorder:
+        the bucket-interpolated percentile must sit within one bucket
+        of the exact empirical quantile — relative error <= 2^0.25-1."""
+        metrics.enable()
+        rng = np.random.RandomState(7)
+        vals = np.exp(rng.normal(np.log(0.05), 1.0, size=2000))
+        for v in vals:
+            monitor.record_serve_ttft(float(v))
+        h = metrics.histogram("serve.ttft",
+                              bounds=monitor._SERVE_LATENCY_BOUNDS)
+        assert h.count == len(vals)
+        tol = 2 ** 0.25 - 1
+        for q in (50, 95, 99):
+            exact = float(np.percentile(vals, q))
+            est = h.percentile(q)
+            assert abs(est - exact) / exact <= tol + 1e-9, (
+                f"p{q}: est {est:.5f} vs exact {exact:.5f}")
+
+
+# ----------------------------------------------------------- SLO specs
+
+
+class TestDefaultSlos:
+    def test_env_objective_and_windows(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_SLO_TTFT_P99", "0.25")
+        monkeypatch.setenv("PADDLE_SLO_TOKEN_P99", "off")
+        monkeypatch.setenv("PADDLE_SLO_ERROR_RATE", "garbage")
+        monkeypatch.setenv("PADDLE_SLO_WINDOW_S", "120")
+        monkeypatch.setenv("PADDLE_SLO_FAST_WINDOW_S", "15")
+        specs = {s.name: s for s in slo.default_slos()}
+        assert "serve-token-p99" not in specs
+        assert specs["serve-ttft-p99"].objective == 0.25
+        assert specs["serve-error-rate"].objective == 0.01  # fallback
+        assert all(s.window_s == 120.0 and s.fast_window_s == 15.0
+                   for s in specs.values())
+
+    def test_budgets(self):
+        lat = slo.SLO("l", "latency", "m", 0.5, percentile=99.0)
+        assert lat.budget == pytest.approx(0.01)
+        err = slo.SLO("e", "error_rate", "m", 0.02)
+        assert err.budget == pytest.approx(0.02)
+        frac = slo.SLO("f", "fraction_min", "m", 0.2, good_metric="g")
+        assert frac.budget == pytest.approx(0.8)
+        assert lat.burn(0.03) == pytest.approx(3.0)
+
+
+# -------------------------------------------------- straggler detection
+
+
+class TestStragglerDetector:
+    def _totals(self, means, steps=10, polls=1):
+        return {r: (steps * polls, m * steps * polls)
+                for r, m in means.items()}
+
+    def test_latched_detect_and_hysteresis_clear(self):
+        flight_recorder.clear()
+        metrics.enable()
+        det = slo.StragglerDetector(z_threshold=3.5, min_ranks=3)
+        base = {0: 0.1, 1: 0.1, 2: 0.1, 3: 0.1}
+        assert det.observe(self._totals(base)) == []
+        # rank 3 turns 10x slow: detected exactly once (latched)
+        slow = dict(base)
+        slow[3] = 1.0
+        t2 = {r: (20, base[r] * 10 + slow[r] * 10) for r in base}
+        ev = det.observe(t2)
+        assert [(e["rank"], e["phase"]) for e in ev] == \
+            [(3, "detected")]
+        t3 = {r: (30, t2[r][1] + slow[r] * 10) for r in base}
+        assert det.observe(t3) == []          # still slow: no re-fire
+        assert det.straggler_ranks() == [3]
+        assert 3 in det.flags()
+        # back to normal: resolves with hysteresis
+        t4 = {r: (40, t3[r][1] + base[r] * 10) for r in base}
+        ev = det.observe(t4)
+        assert [(e["rank"], e["phase"]) for e in ev] == \
+            [(3, "resolved")]
+        assert det.straggler_ranks() == []
+        # events + counter landed
+        names = [(k, f) for _, k, f in flight_recorder.events()
+                 if k == "train.straggler"]
+        assert [f["phase"] for _, f in names] == ["detected",
+                                                  "resolved"]
+        snap = metrics.snapshot()
+        assert snap["train.straggler"]["value"] == 1
+        assert snap["train.straggler{rank=3}"]["value"] == 1
+
+    def test_min_ranks_guard(self):
+        det = slo.StragglerDetector(min_ranks=3)
+        assert det.observe({0: (10, 1.0), 1: (10, 9.0)}) == []
+        assert det.straggler_ranks() == []
+
+    def test_restarted_rank_counter_reset(self):
+        det = slo.StragglerDetector(min_ranks=3)
+        det.observe({0: (100, 10.0), 1: (100, 10.0), 2: (100, 10.0)})
+        # rank 2 relaunched: totals below the last seen -> treat as
+        # fresh absolutes, not a negative window
+        ev = det.observe({0: (110, 11.0), 1: (110, 11.0),
+                          2: (10, 1.0)})
+        assert ev == []
+        assert det.straggler_ranks() == []
+
+
+# ------------------------- THE acceptance test: deterministic burn rates
+
+
+class TestBurnRateStateMachine:
+    """Replay a synthetic partial-burst TTFT trace through the ring:
+    the serve-ttft-p99 SLO must transition ok -> pending -> firing ->
+    resolved at exactly the predicted snapshots, and /slo plus
+    /fleet/healthz must reflect each state as it happens.
+
+    Numbers (objective 0.5s, p99 -> budget 1%; fast window 10s, slow
+    100s; 100 obs/s): baseline t=1..100 all good; burst t=101..140 has
+    3 bad obs/s (0.3%/s of the fast window's 1000 obs); good again
+    t=141..150.
+
+      pending  at t=104: fast window holds 4 burst seconds -> 12/1000
+               = 1.2% > 1% budget (t=103: 9/1000 = 0.9%, still ok)
+      firing   at t=134: slow window holds 34 burst seconds ->
+               102/10000 = 1.02% > 1% (t=133: 99/10000, not yet)
+      resolved at t=147: fast window down to 3 burst seconds ->
+               9/1000 = 0.9% <= 1% (t=146: 12/1000, still firing)
+    """
+
+    GOOD, BAD = 0.01, 1.0
+
+    @staticmethod
+    def _expected(t):
+        if t < 104:
+            return "ok"
+        if t < 134:
+            return "pending"
+        if t < 147:
+            return "firing"
+        return "resolved"
+
+    def test_replayed_burst_transitions_and_endpoints(self, monkeypatch):
+        from paddle_tpu.core.metrics import snapshot_delta
+        from paddle_tpu.distributed import fleet_telemetry as ft
+        from paddle_tpu.distributed.store import TCPStore
+        monkeypatch.setenv("PADDLE_TS_PERIOD_S", "1.0")
+        monkeypatch.setenv("PADDLE_TS_RETENTION", "200")
+        monkeypatch.setenv("PADDLE_SLO_WINDOW_S", "100")
+        monkeypatch.setenv("PADDLE_SLO_FAST_WINDOW_S", "10")
+        timeseries._reset_for_tests()
+        slo._reset_for_tests()
+        flight_recorder.clear()
+        metrics.enable()
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        server = TelemetryServer(port=0).start()
+        try:
+            # fleet mode: the SAME specs over sample_state()-fed merged
+            # snapshots (the aggregator's poll loop is driven by hand)
+            agg = ft.FleetAggregator(store, period_s=1.0,
+                                     expected_ranks=1,
+                                     namespace="__fleet/slo-accept")
+            server.attach_aggregator(agg)
+            # the timeline below drives the aggregator's ring by hand
+            # with synthetic timestamps; park the scrape-triggered
+            # refresh so real-clock samples can't interleave
+            agg._last_poll = float("inf")
+            base = f"http://127.0.0.1:{server.port}"
+            assert slo.tick(now=0.0)         # baseline snapshot
+            checkpoints = {}
+            for t in range(1, 151):
+                bad = 3 if 101 <= t <= 140 else 0
+                for _ in range(100 - bad):
+                    monitor.record_serve_ttft(self.GOOD)
+                for _ in range(bad):
+                    monitor.record_serve_ttft(self.BAD)
+                assert slo.tick(now=float(t))
+                states = slo.watchtower().states()
+                assert states["serve-ttft-p99"] == self._expected(t), \
+                    f"t={t}"
+                fleet_state, _ = snapshot_delta(None)
+                agg._slo_ring.sample_state(fleet_state, now=float(t))
+                fstates = agg.slo_evaluator.evaluate(now=float(t))
+                assert fstates["serve-ttft-p99"] == self._expected(t), \
+                    f"fleet t={t}"
+                if t in (103, 104, 133, 134, 146, 147):
+                    doc = _get_json(base + "/slo")
+                    row = next(s for s in doc["slos"]
+                               if s["name"] == "serve-ttft-p99")
+                    assert row["state"] == self._expected(t), f"t={t}"
+                    hz = _get_json(base + "/fleet/healthz")
+                    assert hz["slo"]["serve-ttft-p99"] == \
+                        self._expected(t), f"t={t}"
+                    checkpoints[t] = row
+            # the firing-time measurement shows the burst's p99 over
+            # the fast window breaching the objective
+            assert checkpoints[134]["measured"] > 0.5
+            assert checkpoints[134]["burn_fast"] > 1.0
+            assert checkpoints[134]["burn_slow"] > 1.0
+            assert checkpoints[147]["burn_fast"] <= 1.0
+            # alert history carries the exact transition timeline
+            doc = _get_json(base + "/slo")
+            ttft_alerts = [(a["to"], a["t"]) for a in doc["alerts"]
+                           if a["slo"] == "serve-ttft-p99"]
+            assert ttft_alerts == [("pending", 104.0),
+                                   ("firing", 134.0),
+                                   ("resolved", 147.0)]
+            assert doc["fleet"]["scope"] == "fleet"
+            # flight recorder: one event per transition per scope, and
+            # the escalation + firing spans for the post-mortem dump
+            evs = [(k, f) for _, k, f in flight_recorder.events()
+                   if k in ("slo.pending", "slo.firing",
+                            "slo.resolved")]
+            for scope in ("process", "fleet"):
+                seq = [k for k, f in evs if f["scope"] == scope]
+                assert seq == ["slo.pending", "slo.firing",
+                               "slo.resolved"], scope
+            spans = [f for _, k, f in flight_recorder.events()
+                     if k == "span" and
+                     f["name"] == "slo:serve-ttft-p99"]
+            phases = sorted(s["phase"] for s in spans
+                            if s["scope"] == "process")
+            assert phases == ["escalation", "firing"]
+            # resolved event reports how long the alert was firing
+            resolved = next(f for k, f in evs
+                            if k == "slo.resolved"
+                            and f["scope"] == "process")
+            assert resolved["firing_s"] == pytest.approx(13.0)
+            # slo.* metrics landed (state gauge back at 0 == resolved)
+            snap = metrics.snapshot()
+            assert snap["slo.state{scope=process,slo=serve-ttft-p99}"][
+                "value"] == 0
+            assert snap["slo.transitions{scope=process,"
+                        "slo=serve-ttft-p99,to=firing}"]["value"] == 1
+        finally:
+            server.stop()
+            store.shutdown_server()
+
+
+# ----------------------------------------- per-request cost attribution
+
+
+class TestCostAttribution:
+    def test_costs_reconcile_with_goodput_compute(self):
+        """The acceptance contract: Request.cost() summed across all
+        requests matches the goodput ledger's compute bucket within 1%
+        — every admission second and every decode-window second is
+        attributed to exactly one request (warm engine: nothing lands
+        in the compile bucket)."""
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.serving import ServingEngine
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+        cfg = (Config().from_layer(m, spec)
+               .enable_generation(max_new_tokens=8,
+                                  prefill_buckets=(16,), max_batch=2)
+               .enable_serving(telemetry_port=0))
+        eng = ServingEngine(cfg, poll_every=1)
+        try:
+            handles = [eng.submit(
+                np.arange(1, 5 + (i % 3), dtype=np.int32))
+                for i in range(6)]
+            for h in handles:
+                h.result(timeout=120)
+            total_cost = sum(h.cost()["total_s"] for h in handles)
+            compute = eng.goodput()["buckets"].get("compute", 0.0)
+            assert compute > 0
+            assert abs(total_cost - compute) <= 0.01 * compute, (
+                f"sum(cost)={total_cost:.6f} vs compute="
+                f"{compute:.6f}")
+            # component sanity: every request paid a prefill and at
+            # least one decode window
+            for h in handles:
+                c = h.cost()
+                assert c["prefill_s"] > 0
+                assert c["decode_s"] > 0
+                assert c["total_s"] == pytest.approx(
+                    c["prefill_s"] + c["decode_s"])
+            # the top-K table is costliest-first and on /slo
+            table = eng.cost_table()
+            assert len(table) == 6
+            totals = [row["total_s"] for row in table]
+            assert totals == sorted(totals, reverse=True)
+            assert eng.telemetry is not None
+            doc = _get_json(
+                f"http://127.0.0.1:{eng.telemetry.port}/slo")
+            assert len(doc["top_cost"]) == 6
+            # serve.cost.* histograms populated
+            snap = metrics.snapshot()
+            assert snap["serve.cost.prefill_ms"]["count"] == 6
+            assert snap["serve.cost.decode_ms"]["count"] == 6
+        finally:
+            eng.shutdown()
+
+
+# ------------------------------------------------ post-mortem CLI tool
+
+
+class TestSloReportCLI:
+    def _make_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        flight_recorder.clear()
+        t0 = flight_recorder.now_ns()
+        flight_recorder.record("slo.pending", slo="serve-ttft-p99",
+                               scope="process", burn_fast=1.4,
+                               burn_slow=0.6, measured=0.61)
+        flight_recorder.record("slo.firing", slo="serve-ttft-p99",
+                               scope="process", burn_fast=2.5,
+                               burn_slow=1.1, measured=0.9)
+        flight_recorder.record_span("slo:serve-ttft-p99", t0,
+                                    flight_recorder.now_ns(),
+                                    scope="process", phase="escalation")
+        flight_recorder.record("train.straggler", rank=3,
+                               phase="detected", z=5.1, mean_s=0.91,
+                               median_s=0.3)
+        flight_recorder.record("slo.resolved", slo="serve-ttft-p99",
+                               scope="process", burn_fast=0.4,
+                               burn_slow=1.0, firing_s=12.5)
+        return flight_recorder.dump(reason="test")
+
+    def test_render_and_cli_smoke(self, tmp_path, monkeypatch, capsys):
+        from tools import slo_report
+        path = self._make_dump(tmp_path, monkeypatch)
+        assert slo_report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "serve-ttft-p99" in out
+        for word in ("pending", "firing", "resolved", "escalation"):
+            assert word in out
+        # straggler table: rank + z + phase
+        assert "Stragglers" in out and "detected" in out
+        assert "5.100" in out
+        # firing duration surfaced from the resolved event
+        assert "firing_s=12.500" in out
+
+    def test_directory_glob_and_output_file(self, tmp_path,
+                                            monkeypatch, capsys):
+        from tools import slo_report
+        self._make_dump(tmp_path, monkeypatch)
+        assert glob.glob(str(tmp_path / "flightrecorder_*.json"))
+        out_path = tmp_path / "postmortem.txt"
+        assert slo_report.main(
+            ["-o", str(out_path), str(tmp_path)]) == 0
+        text = out_path.read_text()
+        assert "serve-ttft-p99" in text and "Alert timeline" in text
+        capsys.readouterr()
+
+    def test_empty_dump_renders_placeholders(self, tmp_path,
+                                             monkeypatch, capsys):
+        from tools import slo_report
+        monkeypatch.setenv("PADDLE_FLIGHT_RECORDER_DIR", str(tmp_path))
+        flight_recorder.clear()
+        flight_recorder.record("serve.finish", req=1,
+                               status="completed", tokens=2)
+        path = flight_recorder.dump(reason="quiet")
+        assert slo_report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "no slo.* transitions" in out
+        assert "no train.straggler events" in out

@@ -279,6 +279,65 @@ class TestServerEndpoints:
             third.telemetry.port == port
         third.shutdown()
 
+    def test_scrape_racing_shutdown_never_truncates(self):
+        """Satellite (ISSUE 17): hammer /metrics from several threads
+        while the engine shuts down mid-scrape. Every response that
+        completes must be a FULL 200 (parseable exposition text, never
+        a truncated body): stop() now joins in-flight handler threads
+        after closing the listener. Post-stop connects are refused."""
+        import socket
+        import threading
+        from paddle_tpu.inference import Config
+        from paddle_tpu.models.gpt import gpt
+        from paddle_tpu.serving import ServingEngine
+        paddle.seed(0)
+        m = gpt("test-tiny")
+        m.eval()
+        spec = [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+        cfg = (Config().from_layer(m, spec)
+               .enable_generation(max_new_tokens=2,
+                                  prefill_buckets=(16,), max_batch=1))
+        eng = ServingEngine(cfg, warmup=False, telemetry_port=0)
+        assert eng.telemetry is not None
+        port = eng.telemetry.port
+        base = f"http://127.0.0.1:{port}"
+        stop_scraping = threading.Event()
+        failures, completed = [], []
+
+        def scraper():
+            while not stop_scraping.is_set():
+                try:
+                    code, body = _get(base + "/metrics")
+                except (urllib.error.URLError, OSError):
+                    continue   # refused/reset once the listener closed
+                if code != 200:
+                    failures.append(f"status {code}")
+                    continue
+                try:
+                    parse_prometheus(body)   # truncation fails here
+                except AssertionError as e:
+                    failures.append(f"unparseable scrape: {e}")
+                completed.append(code)
+
+        threads = [threading.Thread(target=scraper, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        # let the hammer land a few scrapes, then shut down under it
+        deadline = threading.Event()
+        deadline.wait(0.2)
+        eng.shutdown()
+        stop_scraping.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads)
+        assert not failures, failures[:5]
+        assert completed, "hammer never completed a scrape"
+        # the port is really released (stop joined the handlers too)
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=1).close()
+
     def test_warmup_failure_releases_telemetry_port(self):
         """A constructor abort (warmup raises) must stop the telemetry
         server it just started — the caller never gets a handle, so

@@ -1,0 +1,212 @@
+"""Render the SLO story out of flight-recorder dumps as a plaintext
+post-mortem — the ops-facing sibling of ``tools.trace_merge``.
+
+A killed or misbehaving job leaves per-rank dumps under
+``PADDLE_FLIGHT_RECORDER_DIR``; the watchtower (``core.slo``) has been
+writing its alert transitions into the same ring the whole time:
+
+    slo.pending / slo.firing / slo.resolved   instant events with the
+                                              burn rates + measured
+                                              value at transition time
+    slo:<name> spans                          escalation (pending ->
+                                              firing) and firing
+                                              (firing -> resolved)
+                                              periods
+    train.straggler                           detected/resolved per
+                                              rank, with the robust
+                                              z-score that tripped it
+
+This tool collects those events across one or many dumps, aligns them
+on the shared master clock when the dumps carry the PR-14 clock
+anchors (same mapping ``tools.trace_merge`` uses), and prints three
+tables: the alert timeline, the alert periods with durations, and the
+straggler history. The point is a ``less``-able answer to "what was
+firing when the job died" without opening Perfetto.
+
+    python -m tools.slo_report dump_a.json dump_b.json
+    python -m tools.slo_report /path/to/dump/dir
+    python -m tools.slo_report -o postmortem.txt dumps/
+
+A directory argument globs its ``flightrecorder_*.json`` dumps.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["load_paths", "report", "main"]
+
+_ALERT_EVENTS = ("slo.pending", "slo.firing", "slo.resolved")
+
+
+def _collect_paths(args: List[str]) -> List[str]:
+    paths: List[str] = []
+    for a in args:
+        if os.path.isdir(a):
+            found = sorted(glob.glob(
+                os.path.join(a, "flightrecorder_*.json")))
+            if not found:
+                raise FileNotFoundError(
+                    f"no flightrecorder_*.json dumps under {a}")
+            paths.extend(found)
+        else:
+            paths.append(a)
+    if not paths:
+        raise ValueError("no dump paths given")
+    return paths
+
+
+def load_paths(paths: List[str]) -> List[dict]:
+    dumps = []
+    for p in _collect_paths(paths):
+        with open(p, "r", encoding="utf-8") as f:
+            dumps.append(json.load(f))
+    return dumps
+
+
+def _aligned_wall_ns(ts_us: float, md: dict) -> Optional[float]:
+    aw = md.get("anchor_wall_ns")
+    ap = md.get("anchor_perf_ns")
+    if aw is None or ap is None:
+        return None
+    return aw + (ts_us * 1000.0 - ap) - md.get("clock_offset_ns", 0)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
+
+
+def _table(header: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [len(h) for h in header]
+    for r in rows:
+        for i, c in enumerate(r):
+            widths[i] = max(widths[i], len(c))
+    out = ["  ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip(),
+           "  ".join("-" * w for w in widths)]
+    for r in rows:
+        out.append("  ".join(c.ljust(w)
+                             for c, w in zip(r, widths)).rstrip())
+    return out
+
+
+def report(dumps: List[dict]) -> str:
+    """Plaintext SLO post-mortem for loaded dump dicts
+    (``flight_recorder.dump_dict`` / ``.json`` file contents)."""
+    if not dumps:
+        raise ValueError("no dumps to report on")
+    alerts = []     # (t_ns, track, slo, transition, args)
+    spans = []      # (t_ns, track, slo, phase, dur_s)
+    stragglers = [] # (t_ns, track, rank, phase, args)
+    tracks = []
+    seen = set()
+    for d in dumps:
+        md = d.get("metadata", {})
+        track = f"rank{md.get('rank', 0)}.{md.get('restart_count', 0)}"
+        tracks.append(f"{track} (pid {md.get('pid', '?')}, "
+                      f"{md.get('reason', '?')}, "
+                      f"{md.get('events', '?')} events)")
+        for ev in d.get("traceEvents", []):
+            name = ev.get("name", "")
+            ph = ev.get("ph")
+            interesting = (
+                (ph == "i" and (name in _ALERT_EVENTS
+                                or name == "train.straggler"))
+                or (ph == "X" and name.startswith("slo:")))
+            if not interesting:
+                continue
+            # overlapping dumps of one ring render each event once
+            fp = json.dumps(ev, sort_keys=True, default=str)
+            if fp in seen:
+                continue
+            seen.add(fp)
+            ts_us = float(ev.get("ts", 0.0))
+            t_ns = _aligned_wall_ns(ts_us, md)
+            if t_ns is None:
+                t_ns = ts_us * 1000.0   # legacy dump: raw timeline
+            args = ev.get("args", {}) or {}
+            if ph == "X":
+                spans.append((t_ns, track, name[len("slo:"):],
+                              args.get("phase", "?"),
+                              float(ev.get("dur", 0.0)) / 1e6))
+            elif name == "train.straggler":
+                stragglers.append((t_ns, track, args.get("rank", "?"),
+                                   args.get("phase", "?"), args))
+            else:
+                alerts.append((t_ns, track, args.get("slo", "?"),
+                               name.split(".", 1)[1], args))
+    base_ns = min((t for t, *_ in alerts + spans + stragglers),
+                  default=0.0)
+
+    def rel(t_ns: float) -> str:
+        return f"{(t_ns - base_ns) / 1e9:+.3f}s"
+
+    lines = ["SLO post-mortem over " + str(len(dumps)) + " dump(s):"]
+    lines += [f"  {t}" for t in sorted(tracks)]
+    lines.append("")
+    lines.append("Alert timeline")
+    if alerts:
+        rows = []
+        for t, track, slo, to, a in sorted(alerts):
+            extra = f"firing_s={_fmt(a['firing_s'])}" \
+                if "firing_s" in a else ""
+            rows.append([rel(t), track, slo, to,
+                         _fmt(a.get("burn_fast", "?")),
+                         _fmt(a.get("burn_slow", "?")),
+                         _fmt(a.get("measured", "?")), extra])
+        lines += _table(["time", "track", "slo", "->", "burn_fast",
+                         "burn_slow", "measured", ""], rows)
+    else:
+        lines.append("  (no slo.* transitions in these dumps)")
+    lines.append("")
+    lines.append("Alert periods")
+    if spans:
+        rows = [[rel(t), track, slo, phase, f"{dur:.3f}s"]
+                for t, track, slo, phase, dur in sorted(spans)]
+        lines += _table(["start", "track", "slo", "phase", "duration"],
+                        rows)
+    else:
+        lines.append("  (no slo:* spans in these dumps)")
+    lines.append("")
+    lines.append("Stragglers")
+    if stragglers:
+        rows = [[rel(t), track, str(rank), phase,
+                 _fmt(a.get("z", "?")), _fmt(a.get("mean_s", "?")),
+                 _fmt(a.get("median_s", "?"))]
+                for t, track, rank, phase, a in sorted(
+                    stragglers, key=lambda r: (r[0], str(r[2])))]
+        lines += _table(["time", "track", "rank", "phase", "z",
+                         "mean_s", "median_s"], rows)
+    else:
+        lines.append("  (no train.straggler events in these dumps)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.slo_report",
+        description="Render SLO alert + straggler history from "
+                    "flight-recorder dumps as a plaintext post-mortem.")
+    p.add_argument("-o", "--output", default=None,
+                   help="write the report here instead of stdout")
+    p.add_argument("dumps", nargs="+",
+                   help="dump .json files, or directories to glob "
+                        "flightrecorder_*.json from")
+    args = p.parse_args(argv)
+    text = report(load_paths(args.dumps))
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text)
+        sys.stderr.write(f"wrote {args.output}\n")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
