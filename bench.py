@@ -1006,6 +1006,126 @@ def bench_serve(dev, on_tpu):
     }
 
 
+def bench_serve_router(dev, on_tpu):
+    """Fleet-router bench (ISSUE-19 `serve --router` mode): the SAME
+    Poisson traffic shape as the serve row, but fanned over a 3-replica
+    in-process fleet behind the FleetRouter — with a zero-drop rolling
+    deploy of one replica MID-RUN. Reports routed QPS (the headline:
+    what the fleet sustains while losing and regaining a replica),
+    the router's re-route/re-home accounting, and the rejoin's
+    ExecutableStore counters (hits == program count, misses == 0: the
+    relaunch paid zero XLA compiles). vs_baseline is 1.0 — this row
+    defines the routed-serving baseline."""
+    import os
+    import tempfile
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config
+    from paddle_tpu.jit.compile_cache import ExecutableStore
+    from paddle_tpu.models.gpt import gpt
+    from paddle_tpu.serving import InProcessFleet, RequestParams
+
+    n_req = int(os.environ.get("BENCH_ROUTER_REQUESTS",
+                               96 if on_tpu else 24))
+    rate = float(os.environ.get("BENCH_ROUTER_RATE", 64.0))  # req/sec
+    n_rep = int(os.environ.get("BENCH_ROUTER_REPLICAS", 3))
+    max_batch = int(os.environ.get("BENCH_SERVE_BATCH",
+                                   8 if on_tpu else 2))
+    max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", 32))
+    paddle.seed(0)
+    model = gpt("test-tiny", max_position_embeddings=1024)
+    model.bfloat16() if on_tpu else None
+    spec = [paddle.to_tensor(np.zeros((max_batch, 64), np.int32))]
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, model.cfg.vocab_size,
+                           rng.randint(4, 128)).astype(np.int32)
+               for _ in range(n_req)]
+    budgets = rng.randint(max(4, max_new // 4), max_new + 1,
+                          size=n_req)
+    gaps = rng.exponential(1.0 / rate, size=n_req)
+
+    store = ExecutableStore(tempfile.mkdtemp(prefix="bench_router_"))
+
+    def factory(name):
+        from paddle_tpu.serving import ServingEngine
+        cfg = (Config().from_layer(model, spec)
+               .enable_generation(max_new_tokens=max_new,
+                                  prefill_buckets=(32, 64, 128),
+                                  max_batch=max_batch)
+               .enable_serving(max_queue=n_req, drain_timeout_s=120.0))
+        return ServingEngine(cfg, poll_every=2, executable_store=store)
+
+    fleet = InProcessFleet(factory, n=n_rep)   # warmup compiles here
+    router = fleet.router
+    handles = []
+
+    def feeder():
+        for p, b, g in zip(prompts, budgets, gaps):
+            time.sleep(g)
+            handles.append(router.submit(
+                p, RequestParams(max_new_tokens=int(b))))
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=feeder, daemon=True)
+    th.start()
+    deployed = rejoin = None
+    while True:
+        engines = router.engines()
+        busy = [e for e in engines.values() if e.busy]
+        if deployed is None and len(handles) >= n_req // 2:
+            # the gate move: drain + relaunch one replica while the
+            # fleet's queues are live (its queued work re-homes)
+            victim = sorted(engines)[-1]
+            h0, m0 = store.stats["hits"], store.stats["misses"]
+            fresh = fleet.rolling_deploy(victim)
+            deployed = victim
+            rejoin = {"replica": victim,
+                      "programs": len(fresh._exes),
+                      "store_hits": store.stats["hits"] - h0,
+                      "store_misses": store.stats["misses"] - m0}
+            continue
+        if not busy and not th.is_alive():
+            break
+        for e in busy:
+            e.step()
+        if not busy:
+            time.sleep(0.0002)
+    outs = [h.result(timeout=600) for h in handles]
+    dt = time.perf_counter() - t0
+    th.join()
+    assert len(outs) == n_req and \
+        all(h.status.value == "completed" for h in handles)
+    assert rejoin is not None and rejoin["store_misses"] == 0
+    qps = n_req / dt
+    stats = router.stats
+    homes = {}
+    for h in handles:
+        homes[h.replica] = homes.get(h.replica, 0) + 1
+    fleet.shutdown()
+    return {
+        "metric": f"test-tiny ROUTED serving QPS ({n_rep} replicas b"
+                  f"{max_batch} poisson@{rate:g}/s, rolling deploy of "
+                  f"{deployed} mid-run: {stats['rehomed']} re-homed, "
+                  f"rejoin {rejoin['store_hits']}/{rejoin['programs']} "
+                  f"programs warm, device={dev.device_kind})",
+        "value": round(qps, 1),
+        "unit": "req/sec",
+        "vs_baseline": 1.0,
+        "router": {
+            "replicas": n_rep,
+            "requests": n_req,
+            "admissions": stats["admissions"],
+            "reroutes": stats["reroutes"],
+            "rehomed": stats["rehomed"],
+            "rejected": stats["rejected"],
+            "breaker_trips": stats["breaker_trips"],
+            "placements": homes,
+        },
+        "deploy": rejoin,
+    }
+
+
 def bench_warmstart(dev, on_tpu):
     """Warm-restart bench (ISSUE-9 warmstart mode): relaunch-to-first-
     token (serving engine build + warmup + one request) and relaunch-
@@ -1167,6 +1287,7 @@ BENCHES = {
     "decode": bench_decode,
     "serve": bench_serve,
     "serve-prefix": bench_serve_shared_prefix,
+    "serve-router": bench_serve_router,
     "warmstart": bench_warmstart,
     "moe-block": bench_moe_block,
     "resnet50": bench_resnet50,
@@ -1183,6 +1304,10 @@ def main():
     # (ISSUE-12) instead of the PR-8 SLA row
     if which == "serve" and "--shared-prefix" in sys.argv[2:]:
         which = "serve-prefix"
+    # `bench.py serve --router`: the ISSUE-19 fleet-router row (3
+    # replicas + mid-run rolling deploy) instead of the PR-8 SLA row
+    if which == "serve" and "--router" in sys.argv[2:]:
+        which = "serve-router"
     # warmstart measures COLD compiles: it must not inherit a populated
     # process-global cache (it anchors its own fresh store per phase)
     dev, on_tpu = _setup(configure_cache=(which != "warmstart"))

@@ -68,6 +68,9 @@ DECLARED_EVENTS = frozenset({
     "serve.submit", "serve.admit", "serve.evict", "serve.finish",
     "serve.preempted", "serve.crash",
     "serve.drain_begin", "serve.drain_end",
+    "serve.router.reroute", "serve.router.breaker_open",
+    "serve.router.breaker_probe", "serve.router.breaker_close",
+    "serve.router.drain", "serve.router.rejoin",
     "watchdog.timeout",
     "resilience.preemption",
     "checkpoint.commit",
@@ -101,6 +104,20 @@ EVENT_DOC = {
     "serve.crash": "uncaught exception in serve_forever (error)",
     "serve.drain_begin": "graceful drain started (queued, in_flight)",
     "serve.drain_end": "graceful drain finished",
+    "serve.router.reroute": "the router re-routed a request to the "
+                            "next-best replica (rid, src, dst, reason)",
+    "serve.router.breaker_open": "a replica's circuit breaker tripped "
+                                 "OPEN (replica, cause, backoff_s, "
+                                 "trips)",
+    "serve.router.breaker_probe": "a half-open breaker admitted its "
+                                  "single probe request (replica, rid)",
+    "serve.router.breaker_close": "a probe succeeded; the breaker "
+                                  "closed and the replica rejoined "
+                                  "rotation (replica)",
+    "serve.router.drain": "the router drained a replica for a rolling "
+                          "deploy (replica, queued, in_flight)",
+    "serve.router.rejoin": "a replica (re)joined the router's rotation "
+                           "(replica, replicas)",
     "watchdog.timeout": "a hang watchdog expired (label, timeout_s)",
     "resilience.preemption": "preemption landed at a step boundary "
                              "(step, source=signal|store)",

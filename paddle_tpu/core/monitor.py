@@ -115,6 +115,9 @@ DECLARED_METRICS = frozenset({
     "serve.cache.page_occupancy", "serve.cache.kv_dtype",
     "serve.cache.prefix_hits",
     "serve.cache.prefix_shared_pages", "serve.cache.cow_copies",
+    "serve.router.admissions", "serve.router.reroutes",
+    "serve.router.rejected", "serve.router.breaker.trips",
+    "serve.router.breaker.state", "serve.router.replicas",
     "analysis.findings",
     "analysis.mem.peak_bytes", "analysis.mem.budget_violations",
     "telemetry.scrapes", "flightrecorder.dumps",
@@ -296,6 +299,31 @@ METRIC_DOC = {
                                "copy-on-write page privatizations: a "
                                "prompt diverged inside a shared page "
                                "and got a private copy at admission"),
+    "serve.router.admissions": ("counter", ("replica",),
+                                "requests the FleetRouter placed, by "
+                                "replica — the rebalance evidence when "
+                                "a replica is drained or broken"),
+    "serve.router.reroutes": ("counter", ("reason",),
+                              "re-route attempts after a rejected or "
+                              "failed placement, by trigger: "
+                              "queue_full[:no_free_{pages,slots}] | "
+                              "shutdown | admission_error | error"),
+    "serve.router.rejected": ("counter", (),
+                              "requests the router could place on NO "
+                              "replica (every candidate draining, "
+                              "broken, or at bound)"),
+    "serve.router.breaker.trips": ("counter", ("replica",),
+                                   "circuit-breaker OPEN transitions "
+                                   "by replica (consecutive failures "
+                                   "reached the threshold, or a "
+                                   "half-open probe failed)"),
+    "serve.router.breaker.state": ("gauge", ("replica",),
+                                   "per-replica breaker state: "
+                                   "0=closed 1=half_open 2=open"),
+    "serve.router.replicas": ("gauge", (),
+                              "replicas currently in the router's "
+                              "rotation (drained/removed ones "
+                              "excluded)"),
     "analysis.findings": ("counter", ("check", "severity"),
                           "static-audit findings by detector and "
                           "severity"),
@@ -809,6 +837,59 @@ def record_request_cost(prefill_s: float, decode_s: float, page_s: float):
                       bounds=_COST_MS_BOUNDS).observe(decode_s * 1e3)
     metrics.histogram("serve.cost.page_s",
                       bounds=_COST_PAGE_S_BOUNDS).observe(float(page_s))
+
+
+# --------------------------------------------------------- router layer
+
+def record_router_admission(replica: str):
+    """The FleetRouter placed one request on ``replica`` (its rate per
+    replica is the routed-QPS split; a drained or OPEN replica's series
+    going flat while the survivors' rise is the rebalance proof)."""
+    if not enabled:
+        return
+    metrics.counter("serve.router.admissions", replica=replica).inc()
+    metrics.counter("serve.router.admissions").inc()
+
+
+def record_router_reroute(reason: str):
+    """One bounded re-route: a placement was rejected (queue_full*,
+    shutdown) or failed (admission_error, error) and the router tried
+    the next-best replica."""
+    if not enabled:
+        return
+    metrics.counter("serve.router.reroutes", reason=reason).inc()
+    metrics.counter("serve.router.reroutes").inc()
+
+
+def record_router_rejected():
+    """A request the router could place on no replica at all."""
+    if not enabled:
+        return
+    metrics.counter("serve.router.rejected").inc()
+
+
+def record_router_breaker_trip(replica: str):
+    """One circuit-breaker OPEN transition on ``replica``."""
+    if not enabled:
+        return
+    metrics.counter("serve.router.breaker.trips", replica=replica).inc()
+    metrics.counter("serve.router.breaker.trips").inc()
+
+
+def record_router_breaker_state(replica: str, state_code: int):
+    """Current breaker state of one replica (0 closed | 1 half_open |
+    2 open)."""
+    if not enabled:
+        return
+    metrics.gauge("serve.router.breaker.state",
+                  replica=replica).set(float(state_code))
+
+
+def record_router_replicas(n: int):
+    """Replicas currently in the router's rotation."""
+    if not enabled:
+        return
+    metrics.gauge("serve.router.replicas").set(float(n))
 
 
 # ------------------------------------------------------- training layer

@@ -24,6 +24,10 @@ exposing:
                       when this process is not the aggregator)
     /fleet/healthz    per-replica ready/reason/headroom rollup — the
                       multi-replica router's admission document
+    /router           the fleet router's ``describe()`` document — the
+                      live replica table (breaker state, drain flag,
+                      health summary, admission score) plus routing
+                      totals (404 when no router attached)
     /slo              the SLO watchtower document: every objective's
                       alert state + burn rates, the bounded alert
                       history, the top-K most expensive requests
@@ -252,6 +256,15 @@ class _Handler(BaseHTTPRequestHandler):
                 monitor.record_scrape("slo")
                 self._send(200, json.dumps(owner.slo_document()).encode(),
                            "application/json")
+            elif path == "/router":
+                monitor.record_scrape("router")
+                router = owner.router
+                if router is None:
+                    self._send(404, b'{"error": "no router attached"}',
+                               "application/json")
+                else:
+                    self._send(200, json.dumps(router.describe()).encode(),
+                               "application/json")
             elif path == "/fleet/healthz":
                 monitor.record_scrape("fleet_healthz")
                 agg = owner.aggregator
@@ -340,6 +353,7 @@ class TelemetryServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._engine_ref = None
+        self._router_ref = None
         self.aggregator = None   # FleetAggregator serving /fleet/*
 
     # ------------------------------------------------------ lifecycle
@@ -391,6 +405,19 @@ class TelemetryServer:
         should be rotated out, not probed forever)."""
         self._engine_ref = weakref.ref(engine)
         return self
+
+    def attach_router(self, router) -> "TelemetryServer":
+        """Weakly reference a ``serving.FleetRouter``: ``/router``
+        serves its ``describe()`` document (weak for the same reason
+        as the engine — a collected router must read as absent, not
+        pin the whole replica table alive)."""
+        self._router_ref = weakref.ref(router)
+        return self
+
+    @property
+    def router(self):
+        return self._router_ref() if self._router_ref is not None \
+            else None
 
     def attach_aggregator(self, aggregator) -> "TelemetryServer":
         """Wire a ``fleet_telemetry.FleetAggregator`` to

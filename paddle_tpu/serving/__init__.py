@@ -1,4 +1,4 @@
-"""paddle_tpu.serving — continuous-batching serving engine.
+"""paddle_tpu.serving — continuous-batching serving engine + fleet router.
 
 Slot-scheduled decode over one shared donated KV cache: requests queue
 through a Future-style front-end, prefill at a small fixed set of
@@ -7,13 +7,21 @@ free their slot in place for the next admission — XLA never retraces
 under live traffic (``jit.compile{cause=new_shape}`` == 0 at steady
 state) and the decode loop never drains.
 
-See docs/architecture.md "Serving engine".
+``FleetRouter`` fronts N replicas with health-scored admission,
+per-replica circuit breakers, bounded re-routing, and zero-drop
+rolling deploys; ``InProcessFleet`` is its deterministic one-process
+test harness.
+
+See docs/architecture.md "Serving engine" and "Fleet serving router".
 """
 from .engine import ServingEngine  # noqa: F401
 from .request import (QueueFull, Request, RequestFailed,  # noqa: F401
                       RequestParams, RequestStatus)
+from .router import (CircuitBreaker, FleetRouter,  # noqa: F401
+                     InProcessFleet, RouterRequest)
 
 __all__ = [
-    "QueueFull", "Request", "RequestFailed", "RequestParams",
-    "RequestStatus", "ServingEngine",
+    "CircuitBreaker", "FleetRouter", "InProcessFleet", "QueueFull",
+    "Request", "RequestFailed", "RequestParams", "RequestStatus",
+    "RouterRequest", "ServingEngine",
 ]

@@ -891,11 +891,13 @@ class ServingEngine:
                 raise RuntimeError(
                     "serving engine is shut down; no new requests")
             if len(self._queue) >= self.max_queue:
-                req._finish(RequestStatus.REJECTED, "queue_full")
+                reason = self._rejection_reason()
+                req._finish(RequestStatus.REJECTED, reason)
                 self.stats["rejected"] += 1
                 monitor.record_serve_request("rejected")
                 raise QueueFull(
-                    f"request queue at bound ({self.max_queue})")
+                    f"request queue at bound ({self.max_queue}): "
+                    f"{reason}", reason=reason, request=req)
             if self.trace_sample and req.id % self.trace_sample == 0:
                 req.traced = True
                 req._t_submit_ns = flight_recorder.now_ns()
@@ -908,6 +910,21 @@ class ServingEngine:
                                    prompt_len=int(ids.size),
                                    budget=budget, queue_depth=qdepth)
         return req
+
+    def _rejection_reason(self) -> str:
+        """The structured health reason a queue-bound rejection carries
+        on BOTH the handle and the QueueFull (callers hold ``_qlock``):
+        the same no_free_pages/no_free_slots distinction ``health()``
+        suffixes onto its 503 reason, observable per-request — a
+        router re-routes memory pressure and slot pressure to a
+        different survivor set. Bare ``queue_full`` means the blocker
+        is not yet known (a submit burst filled the queue between
+        scheduler steps while slots were still free)."""
+        if self._alloc is not None and self._page_blocked:
+            return "queue_full:no_free_pages"
+        if sum(s is not None for s in self._slots) >= self.max_batch:
+            return "queue_full:no_free_slots"
+        return "queue_full"
 
     def _queue_room(self) -> bool:
         with self._qlock:

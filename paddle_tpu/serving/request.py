@@ -60,7 +60,22 @@ class RequestParams:
 
 
 class QueueFull(RuntimeError):
-    """Admission control: the request queue is at its depth bound."""
+    """Admission control: the request queue is at its depth bound.
+
+    ``reason`` carries the structured health reason — ``queue_full``
+    (blocker not yet known: a submit burst between scheduler steps),
+    ``queue_full:no_free_slots`` (admission capacity — another replica
+    would help), ``queue_full:no_free_pages`` (KV memory pressure —
+    only a replica with pool headroom helps) — and ``request`` the
+    already-terminal REJECTED handle, so a router or external LB can
+    tell retryable pressure from a terminal drain without parsing the
+    message."""
+
+    def __init__(self, msg: str = "", *, reason: str = "queue_full",
+                 request: Optional["Request"] = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.request = request
 
 
 class RequestFailed(RuntimeError):

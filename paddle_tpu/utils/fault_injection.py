@@ -38,6 +38,16 @@ Injectable faults:
 - ``FlakySamples(ds, ...)``     — dataset wrapper raising / returning
                                   NaN samples at exact indices (drives
                                   error attribution and quarantine).
+- ``wedge_replica(engine)``     — suspend a ServingEngine's scheduler
+                                  loop until released (wedged replica:
+                                  alive, answers health(), makes zero
+                                  progress — the serving-side twin of
+                                  ``suspend_worker``).
+- ``fail_admission(engine, n)`` — inject ``n`` consecutive admission
+                                  failures into a ServingEngine
+                                  (pre-prefill, so the failed request
+                                  is re-routable; drives the router's
+                                  circuit breaker).
 """
 from __future__ import annotations
 
@@ -55,6 +65,7 @@ __all__ = [
     "corrupt_executable",
     "dataloader_workers",
     "executable_entries",
+    "fail_admission",
     "kill_worker",
     "poison_batch",
     "remove_commit_marker",
@@ -62,6 +73,7 @@ __all__ = [
     "suspend_worker",
     "truncate_checkpoint",
     "truncate_executable",
+    "wedge_replica",
 ]
 
 
@@ -357,6 +369,91 @@ class FlakySamples:
         if int(idx) in self.nan_at:
             return poison_batch(sample)
         return sample
+
+
+# -------------------------------------------- serving replica faults
+
+class wedge_replica:
+    """Suspend a ServingEngine's scheduler until released — the
+    deterministic 'wedged replica' fault (the serving-side twin of
+    ``suspend_worker``): the engine stays alive and keeps answering
+    ``submit()``/``health()``, but ``step()`` and the inline
+    ``result()`` pump make zero progress, so its queue only grows. A
+    multi-replica router must observe the mounting backpressure
+    (``queue_full`` health reasons, falling score) and steer traffic to
+    survivors. Context manager, or ``release()`` explicitly::
+
+        with wedge_replica(engine):
+            ...                      # engine frozen, deterministically
+        # scheduler restored; queued work resumes
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._saved = None
+
+    def wedge(self) -> "wedge_replica":
+        if self._saved is None:
+            self._saved = (self.engine.step, self.engine._try_pump)
+            self.engine.step = lambda: None
+            self.engine._try_pump = lambda: False
+        return self
+
+    def release(self):
+        if self._saved is not None:
+            self.engine.step, self.engine._try_pump = self._saved
+            self._saved = None
+
+    def __enter__(self) -> "wedge_replica":
+        return self.wedge()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+
+class fail_admission:
+    """Inject ``n`` consecutive admission failures into a
+    ServingEngine: the next ``n`` requests popped for admission raise
+    at the prefill-executable fetch — BEFORE any prefill dispatch or KV
+    write, so the failed admission is idempotent and a router may
+    re-route the request to another replica. The engine's own handling
+    cancels each doomed handle with an ``admission error: ...`` detail
+    (its Future never hangs); ``triggered`` counts faults actually
+    fired. Composes with ``KillAfter``/``StoreFaults``::
+
+        with fail_admission(engine, n=3):
+            ...   # the next 3 admissions on this engine fail
+    """
+
+    def __init__(self, engine, n: int = 1):
+        if n < 1:
+            raise ValueError("fail_admission fires on n >= 1 admissions")
+        self.engine = engine
+        self.n = int(n)
+        self.triggered = 0
+        self._orig = None
+
+    def __enter__(self) -> "fail_admission":
+        orig = self.engine._exe_prefill
+
+        def flaky(bucket):
+            if self.triggered < self.n:
+                self.triggered += 1
+                raise RuntimeError(
+                    f"fail_admission: injected admission failure "
+                    f"{self.triggered}/{self.n}")
+            return orig(bucket)
+
+        self._orig = orig
+        self.engine._exe_prefill = flaky
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._orig is not None:
+            self.engine._exe_prefill = self._orig
+            self._orig = None
+        return False
 
 
 class NaNLoss:

@@ -1,0 +1,246 @@
+"""Chaos tier for the fleet router (ISSUE 19): the two acceptance
+gates — a zero-drop rolling deploy under live Poisson traffic with a
+SIGTERM mid-stream (every handle terminal, completed results bitwise-
+equal to the sequential reference, the relaunched replica rejoining
+with ExecutableStore hits == program count and ZERO recompiles) and
+the breaker gate (injected consecutive admission failures trip a
+replica OPEN within the threshold while traffic completes on the
+survivors with zero caller-visible errors, then the half-open probe
+restores it) — plus the wedged-replica faults composing with the
+router's pressure signals."""
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flight_recorder
+from paddle_tpu.distributed.resilience import GracefulShutdown
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.jit.compile_cache import ExecutableStore
+from paddle_tpu.models.gpt import gpt
+from paddle_tpu.serving import (FleetRouter, InProcessFleet,
+                                RequestStatus, ServingEngine)
+from paddle_tpu.serving.router import BREAKER_CLOSED, BREAKER_OPEN
+from paddle_tpu.utils import fault_injection
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    paddle.seed(0)
+    m = gpt("test-tiny")
+    m.eval()
+    return m
+
+
+def _spec():
+    return [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+
+
+def _config(m, **serving_kw):
+    cfg = (Config().from_layer(m, _spec())
+           .enable_generation(max_new_tokens=8, prefill_buckets=(16,),
+                              max_batch=1))
+    cfg.enable_serving(**serving_kw)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    """ONE ExecutableStore shared by every engine and every relaunch in
+    this module: the first build compiles the program set, siblings and
+    rejoins deserialize — the warm-rejoin gate diffs its stats."""
+    return ExecutableStore(str(tmp_path_factory.mktemp("chaos_exe")))
+
+
+@pytest.fixture(scope="module")
+def reference(tiny_gpt):
+    pred = create_predictor(_config(tiny_gpt))
+    return lambda p: pred.generate([p], max_new_tokens=8)[0]
+
+
+def _factory(tiny_gpt, store, **kw):
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("drain_timeout_s", 60.0)
+    def build(name):
+        return ServingEngine(_config(tiny_gpt, **kw), poll_every=1,
+                             executable_store=store)
+    return build
+
+
+# ------------------------------------------------ rolling-deploy gate
+
+
+def test_rolling_deploy_zero_drop(tiny_gpt, store, reference):
+    """THE deploy gate: 3 in-process replicas, Poisson-bursty arrivals,
+    SIGTERM mid-stream, one replica drained + relaunched under the
+    live queue — zero dropped requests, every handle terminal, results
+    bitwise-equal to the sequential reference, and the rejoin pays
+    hits == program count / misses == 0 against the shared store."""
+    fleet = InProcessFleet(_factory(tiny_gpt, store), n=3,
+                           router_kw=dict(seed=0))
+    flight_recorder.configure(capacity=512, on=True)
+    try:
+        rng = np.random.RandomState(19)
+        prompts = [rng.randint(0, 512, 3 + int(rng.poisson(3.0)))
+                   .astype(np.int32) for i in range(6)]
+        killer = fault_injection.KillAfter(4, signal.SIGTERM)
+        with GracefulShutdown(exit_on_save=False) as gs:
+            handles = []
+            for p in prompts:               # Poisson burst arrival: the
+                handles.append(fleet.router.submit(p))   # queue is LIVE
+                killer.step()               # SIGTERM mid-stream
+            assert killer.fired and gs.preempted
+            # the deploy rides the preemption: drain the replica the
+            # signal doomed WHILE its queue holds work, relaunch it
+            victim = handles[0].replica
+            assert any(h.replica == victim and not h.done()
+                       for h in handles)
+            h0, m0 = store.stats["hits"], store.stats["misses"]
+            fresh = fleet.rolling_deploy(victim)
+            # warm rejoin: every program deserialized, ZERO compiles
+            assert store.stats["hits"] - h0 == len(fresh._exes)
+            assert store.stats["misses"] - m0 == 0
+            assert len(fresh._exes) >= 3
+            # the fleet keeps admitting after the deploy — including
+            # onto the relaunched replica
+            prompts += [rng.randint(0, 512, 4 + i).astype(np.int32)
+                        for i in range(3)]
+            handles += [fleet.router.submit(p) for p in prompts[6:]]
+        # zero-drop: EVERY handle terminal and COMPLETED, bitwise equal
+        for h, p in zip(handles, prompts):
+            out = h.result(timeout=180)
+            np.testing.assert_array_equal(out, reference(p))
+            assert h.status is RequestStatus.COMPLETED
+        stats = fleet.router.stats
+        assert stats["rehomed"] >= 1        # the drain re-homed work
+        assert stats["rejected"] == 0       # ...and nobody saw it
+        kinds = [k for _, k, _ in flight_recorder.events()]
+        assert "serve.router.drain" in kinds
+        assert "serve.router.rejoin" in kinds
+        assert "serve.router.reroute" in kinds
+        assert fresh.stats["completed"] >= 0  # rejoined and serviceable
+        probe = fleet.router.submit([7, 7, 7])
+        assert probe.result(timeout=120).size == 8
+    finally:
+        flight_recorder.configure(
+            capacity=flight_recorder.DEFAULT_CAPACITY, on=True)
+        fleet.shutdown()
+
+
+# ------------------------------------------------------- breaker gate
+
+
+def test_breaker_gate_survives_admission_failures(tiny_gpt, store,
+                                                  reference):
+    """THE breaker gate: consecutive injected admission failures trip
+    the victim OPEN within the threshold, every request completes on
+    the survivor with zero caller-visible errors, and after the
+    backoff the half-open probe restores the replica."""
+    # base_s huge on purpose: the breaker must stay provably OPEN for
+    # the whole survivor phase (a realistic 10ms backoff expires inside
+    # one CPU decode and the replica self-heals before we can assert)
+    fleet = InProcessFleet(_factory(tiny_gpt, store), n=2,
+                           router_kw=dict(breaker_threshold=2,
+                                          breaker_base_s=30.0,
+                                          breaker_cap_s=60.0, seed=7))
+    flight_recorder.configure(capacity=512, on=True)
+    try:
+        router = fleet.router
+        victim = fleet["r0"]
+        rec = router._replicas["r0"]
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 512, 3 + i).astype(np.int32)
+                   for i in range(6)]
+        with fault_injection.fail_admission(victim, n=2) as fault:
+            h0 = router.submit(prompts[0])
+            # both injected failures burn on r0 (re-placed once while
+            # the breaker is still counting), the second trips OPEN,
+            # and the request completes on the survivor
+            np.testing.assert_array_equal(h0.result(timeout=120),
+                                          reference(prompts[0]))
+            assert fault.triggered == 2
+        assert rec.breaker.state == BREAKER_OPEN
+        assert rec.breaker.trips == 1              # within threshold
+        assert h0.replica == "r1" and h0.reroutes == 2
+        # traffic keeps completing on the survivor: the OPEN replica
+        # is provably out of rotation, zero caller-visible errors
+        handles = [router.submit(p) for p in prompts[1:]]
+        assert all(h.replica == "r1" for h in handles)
+        for h, p in zip(handles, prompts[1:]):
+            np.testing.assert_array_equal(h.result(timeout=120),
+                                          reference(p))
+        assert rec.breaker.state == BREAKER_OPEN   # still out
+        stats = router.stats
+        assert stats["breaker_trips"] == 1
+        assert stats["rejected"] == 0
+        reroutes = [f for _, k, f in flight_recorder.events()
+                    if k == "serve.router.reroute"]
+        assert len([f for f in reroutes
+                    if f["reason"] == "admission_error"]) == 2
+        assert all(h.status is RequestStatus.COMPLETED for h in handles)
+        # serve the backoff (rewind it: no 30s sleep in CI), then the
+        # single half-open probe lands on r0 and closes the breaker
+        rec.breaker.open_until = time.monotonic() - 0.001
+        probe = router.submit([9, 9])
+        assert probe.replica == "r0"
+        assert probe.result(timeout=120).size == 8
+        assert rec.breaker.state == BREAKER_CLOSED
+        kinds = [k for _, k, _ in flight_recorder.events()]
+        assert "serve.router.breaker_open" in kinds
+        assert "serve.router.breaker_probe" in kinds
+        assert "serve.router.breaker_close" in kinds
+    finally:
+        flight_recorder.configure(
+            capacity=flight_recorder.DEFAULT_CAPACITY, on=True)
+        fleet.shutdown()
+
+
+# ------------------------------------------------------ wedged replica
+
+
+def test_wedge_replica_standalone(tiny_gpt, store):
+    """wedge_replica suspends the poll loop: the handle's inline pump
+    goes inert (result() times out instead of hanging forever), and
+    release() restores service with no state lost."""
+    eng = ServingEngine(_config(tiny_gpt), poll_every=1,
+                        executable_store=store)
+    try:
+        h = eng.submit([1, 2, 3])
+        with fault_injection.wedge_replica(eng):
+            with pytest.raises(TimeoutError):
+                h.result(timeout=0.3)
+            assert not h.done()
+        assert h.result(timeout=120).size == 8     # released: completes
+    finally:
+        eng.shutdown()
+
+
+def test_wedge_replica_router_routes_around(tiny_gpt, store):
+    """A wedged replica stops consuming its queue; once the queue hits
+    its bound the health document flips not-ready and the router sends
+    new traffic to the survivor — no new work lands on the wedge."""
+    a = ServingEngine(_config(tiny_gpt, max_queue=1), poll_every=1,
+                      executable_store=store)
+    b = ServingEngine(_config(tiny_gpt, max_queue=4), poll_every=1,
+                      executable_store=store)
+    router = FleetRouter({"a": a, "b": b}, seed=0)
+    try:
+        wedge = fault_injection.wedge_replica(a)
+        wedge.wedge()
+        stuck = a.submit([1, 2, 3])     # fills a's queue at its bound
+        assert not a.health()["ready"]
+        routed = [router.submit([4, 5]), router.submit([6, 7, 8])]
+        assert all(rr.replica == "b" for rr in routed)
+        for rr in routed:
+            assert rr.result(timeout=120).size == 8
+        assert a.health()["queue_depth"] == 1      # untouched wedge
+        wedge.release()
+        assert stuck.result(timeout=120).size == 8
+    finally:
+        router.shutdown()
+        a.shutdown()
+        b.shutdown()

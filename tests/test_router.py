@@ -1,0 +1,388 @@
+"""Fleet serving router (ISSUE 19): the circuit-breaker state machine
+(threshold trip, full-jitter backoff bounds, half-open single-probe
+semantics, close-on-success), health-scored admission over live
+engines, zero-drop drain re-homing, bounded re-routes, deadline
+propagation across placements, the ``serve.router.*`` metrics/events,
+the ``PADDLE_ROUTER_*`` env knobs, and the ``/router`` telemetry
+endpoint. Chaos-grade fault injection (wedged replicas, injected
+admission failures, SIGTERM rolling deploys) lives in
+test_chaos_router.py."""
+import json
+import random
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flight_recorder, monitor
+from paddle_tpu.core.telemetry_server import TelemetryServer
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.jit.compile_cache import ExecutableStore
+from paddle_tpu.models.gpt import gpt
+from paddle_tpu.profiler import metrics
+from paddle_tpu.serving import (CircuitBreaker, FleetRouter, QueueFull,
+                                RequestFailed, RequestParams,
+                                RequestStatus, ServingEngine)
+from paddle_tpu.serving.router import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                       BREAKER_OPEN)
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    paddle.seed(0)
+    m = gpt("test-tiny")
+    m.eval()
+    return m
+
+
+def _spec():
+    return [paddle.to_tensor(np.zeros((2, 12), np.int32))]
+
+
+def _config(m, *, max_new=8, buckets=(16,), max_batch=2, **serving_kw):
+    cfg = (Config().from_layer(m, _spec())
+           .enable_generation(max_new_tokens=max_new,
+                              prefill_buckets=buckets,
+                              max_batch=max_batch))
+    cfg.enable_serving(**serving_kw)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    """One ExecutableStore for every engine this module builds: the
+    first engine compiles the program set, every sibling deserializes."""
+    return ExecutableStore(str(tmp_path_factory.mktemp("router_exe")))
+
+
+@pytest.fixture(scope="module")
+def reference(tiny_gpt):
+    pred = create_predictor(_config(tiny_gpt, max_batch=1))
+    return lambda p: pred.generate([p], max_new_tokens=8)[0]
+
+
+def _engine(tiny_gpt, store, **kw):
+    kw.setdefault("max_batch", 1)
+    kw.setdefault("max_queue", 4)
+    return ServingEngine(_config(tiny_gpt, **kw), poll_every=1,
+                         executable_store=store)
+
+
+def _counter(name):
+    snap = metrics.snapshot().get(name)
+    return int(snap["value"]) if snap else 0
+
+
+# --------------------------------------------- breaker state machine
+
+
+class TestCircuitBreaker:
+    def _mk(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("rng", random.Random(7))
+        return CircuitBreaker(clock=lambda: self.now[0], **kw)
+
+    def test_opens_at_threshold_only_on_consecutive(self):
+        b = self._mk(threshold=3)
+        assert b.record_failure() is None
+        assert b.record_failure() is None
+        assert b.record_success() is False     # streak broken
+        assert b.failures == 0
+        assert b.record_failure() is None
+        assert b.record_failure() is None
+        back = b.record_failure()              # third consecutive
+        assert back is not None and b.state == BREAKER_OPEN
+        assert not b.admissible()
+
+    def test_backoff_full_jitter_bounds(self):
+        # every trip draws uniform[0, min(cap, base * 2^trips)): the
+        # store-client idiom, so N routers don't re-stampede in step
+        base, cap = 0.05, 2.0
+        draws = []
+        for seed in range(40):
+            b = self._mk(threshold=1, base_s=base, cap_s=cap,
+                         rng=random.Random(seed))
+            trips = 0
+            for _ in range(8):
+                bound = min(cap, base * (2 ** trips))
+                assert b.backoff_bound() == pytest.approx(bound)
+                back = b.record_failure()      # closed->open...
+                assert 0.0 <= back < bound or (bound == 0 and back == 0)
+                draws.append(back)
+                trips += 1
+                self.now[0] = b.open_until     # serve the backoff
+                assert b.admissible()          # ...half-open
+                b.begin()                      # probe fails again
+        assert len(set(draws)) > 20            # jitter actually varies
+
+    def test_half_open_admits_exactly_one_probe(self):
+        b = self._mk(threshold=1, base_s=0.5, cap_s=0.5)
+        b.record_failure()
+        assert b.state == BREAKER_OPEN
+        assert not b.admissible()              # backoff not served
+        self.now[0] = b.open_until + 0.001
+        assert b.admissible() and b.state == BREAKER_HALF_OPEN
+        b.begin()
+        assert not b.admissible()              # ONE probe in flight
+        assert b.record_success() is True      # the close transition
+        assert b.state == BREAKER_CLOSED and b.trips == 0
+        assert b.admissible()
+
+    def test_probe_failure_reopens_with_longer_bound(self):
+        b = self._mk(threshold=2, base_s=0.1, cap_s=10.0)
+        b.record_failure(), b.record_failure()
+        assert b.state == BREAKER_OPEN and b.trips == 1
+        self.now[0] = b.open_until
+        assert b.admissible()
+        b.begin()
+        back = b.record_failure()              # probe failure: no grace
+        assert back is not None
+        assert b.state == BREAKER_OPEN and b.trips == 2
+        assert b.backoff_bound() == pytest.approx(0.4)  # 0.1 * 2^2
+
+    def test_threshold_validated(self):
+        with pytest.raises(ValueError, match="threshold"):
+            CircuitBreaker(threshold=0)
+
+
+# ----------------------------------------------------- env knobs
+
+
+def test_env_knobs(tiny_gpt, store, monkeypatch):
+    eng = _engine(tiny_gpt, store)
+    monkeypatch.setenv("PADDLE_ROUTER_MAX_REROUTES", "5")
+    monkeypatch.setenv("PADDLE_ROUTER_BREAKER_THRESHOLD", "not-a-number")
+    monkeypatch.setenv("PADDLE_ROUTER_BREAKER_BASE_S", "0.25")
+    r = FleetRouter([eng])
+    assert r.max_reroutes == 5
+    assert r.breaker_threshold == 3        # garbage -> default, recorded
+    assert r.breaker_base_s == 0.25
+    # explicit kwargs beat the environment
+    r2 = FleetRouter([eng], max_reroutes=1, breaker_base_s=0.5)
+    assert r2.max_reroutes == 1 and r2.breaker_base_s == 0.5
+    eng.shutdown()
+
+
+# ------------------------------------------------- routing over engines
+
+
+def test_routes_complete_bitwise(tiny_gpt, store, reference):
+    """Traffic through the router completes bitwise-equal to the
+    sequential predictor; admissions land on BOTH replicas (the
+    queue-depth divisor spreads score ties)."""
+    engines = {"a": _engine(tiny_gpt, store), "b": _engine(tiny_gpt, store)}
+    router = FleetRouter(engines, seed=0)
+    monitor.enable()
+    try:
+        a0 = _counter("serve.router.admissions")
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 512, 3 + i).astype(np.int32)
+                   for i in range(4)]
+        handles = [router.submit(p) for p in prompts]
+        homes = {h.replica for h in handles}
+        assert homes == {"a", "b"}
+        for h, p in zip(handles, prompts):
+            np.testing.assert_array_equal(h.result(timeout=120),
+                                          reference(p))
+            assert h.status is RequestStatus.COMPLETED
+            assert h.done()
+        assert router.stats["admissions"] == 4
+        assert router.stats["reroutes"] == 0
+        assert _counter("serve.router.admissions") - a0 == 4
+        assert _counter("serve.router.admissions{replica=a}") > 0
+        assert _counter("serve.router.admissions{replica=b}") > 0
+    finally:
+        monitor.disable()
+        router.shutdown()
+        for e in engines.values():
+            e.shutdown()
+
+
+def test_drain_rehomes_queued_work(tiny_gpt, store, reference):
+    """The zero-drop core: draining a replica REJECTS its queued work
+    with the structured "shutdown" reason, and the handles re-home onto
+    the survivor — no caller ever sees the drain."""
+    engines = {"a": _engine(tiny_gpt, store, max_queue=8),
+               "b": _engine(tiny_gpt, store, max_queue=8)}
+    router = FleetRouter(engines, seed=0)
+    flight_recorder.configure(capacity=256, on=True)
+    try:
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 512, 4 + i).astype(np.int32)
+                   for i in range(4)]
+        handles = [router.submit(p) for p in prompts]
+        victim = "a" if any(h.replica == "a" for h in handles) else "b"
+        doomed = [h for h in handles if h.replica == victim]
+        assert doomed
+        router.drain_replica(victim)
+        for h, p in zip(handles, prompts):
+            np.testing.assert_array_equal(h.result(timeout=120),
+                                          reference(p))
+        survivor = "b" if victim == "a" else "a"
+        for h in doomed:
+            assert h.replica == survivor
+            assert h.hops and h.hops[-1] == (victim, "shutdown")
+        assert router.stats["rehomed"] == len(doomed)
+        assert router.stats["reroutes"] >= len(doomed)
+        kinds = [(k, f) for _, k, f in flight_recorder.events()
+                 if k.startswith("serve.router.")]
+        assert any(k == "serve.router.drain" and f["replica"] == victim
+                   for k, f in kinds)
+        reroutes = [f for k, f in kinds if k == "serve.router.reroute"]
+        assert len(reroutes) == len(doomed)
+        assert all(f["src"] == victim and f["dst"] == survivor
+                   and f["reason"] == "shutdown" for f in reroutes)
+    finally:
+        flight_recorder.configure(
+            capacity=flight_recorder.DEFAULT_CAPACITY, on=True)
+        router.shutdown()
+        for e in engines.values():
+            e.shutdown()
+
+
+def test_all_replicas_saturated_rejects(tiny_gpt, store):
+    """When NO replica can admit, submit() raises QueueFull carrying
+    the aggregated reason and the already-terminal handle — the same
+    contract the single-engine front door gives its callers."""
+    eng = _engine(tiny_gpt, store, max_queue=1)
+    router = FleetRouter({"only": eng}, seed=0)
+    monitor.enable()
+    try:
+        first = router.submit([1, 2, 3])       # queue now at its bound
+        with pytest.raises(QueueFull) as ei:
+            router.submit([4, 5])
+        rr = ei.value.request
+        assert rr is not None and rr.done()
+        assert rr.status is RequestStatus.REJECTED
+        with pytest.raises(RequestFailed):
+            rr.result(timeout=1)
+        assert router.stats["rejected"] == 1
+        assert _counter("serve.router.rejected") >= 1
+        assert first.result(timeout=120).size == 8
+    finally:
+        monitor.disable()
+        router.shutdown()
+        eng.shutdown()
+
+
+def test_reroute_budget_bounds_rehoming(tiny_gpt, store):
+    """max_reroutes=0: a drain rejection surfaces to the caller instead
+    of re-homing — the budget is a hard bound."""
+    engines = {"a": _engine(tiny_gpt, store), "b": _engine(tiny_gpt, store)}
+    router = FleetRouter(engines, max_reroutes=0, seed=0)
+    try:
+        h = router.submit([1, 2, 3])
+        router.drain_replica(h.replica)
+        with pytest.raises(RequestFailed, match="shutdown"):
+            h.result(timeout=30)
+        assert router.stats["rehomed"] == 0
+    finally:
+        router.shutdown()
+        for e in engines.values():
+            e.shutdown()
+
+
+def test_deadline_propagates_remaining_budget(tiny_gpt, store):
+    """A re-routed request's deadline is the REMAINING budget from the
+    original submit, never a fresh window."""
+    eng = _engine(tiny_gpt, store)
+    now = [1000.0]
+    router = FleetRouter({"a": eng}, clock=lambda: now[0], seed=0)
+    try:
+        h = router.submit([1, 2, 3], RequestParams(deadline_s=30.0))
+        assert h.deadline == pytest.approx(1030.0)
+        now[0] += 12.5
+        p = router._params_for(h)
+        assert p.deadline_s == pytest.approx(17.5)
+        now[0] += 40.0                          # budget exhausted
+        assert router._params_for(h).deadline_s == 0.0
+        assert not router._reroutable(h)        # never re-placed late
+        h.result(timeout=120)
+    finally:
+        router.shutdown()
+        eng.shutdown()
+
+
+def test_half_open_probe_routes_to_recovering_replica(tiny_gpt, store):
+    """A half-open replica gets the NEXT request as its single probe
+    even when a healthy peer outscores it; the probe's success closes
+    the breaker (event + gauge asserted)."""
+    engines = {"a": _engine(tiny_gpt, store), "b": _engine(tiny_gpt, store)}
+    router = FleetRouter(engines, breaker_threshold=1,
+                         breaker_base_s=0.0, breaker_cap_s=0.0, seed=0)
+    monitor.enable()
+    flight_recorder.configure(capacity=256, on=True)
+    try:
+        rec = router._replicas["a"]
+        with router._lock:
+            router._note_failure(rec, "test")
+        assert rec.breaker.state == BREAKER_OPEN
+        assert router.stats["breaker_trips"] == 1
+        assert _counter("serve.router.breaker.trips{replica=a}") == 1
+        # zero backoff: immediately admissible as HALF_OPEN probe
+        h = router.submit([1, 2, 3])
+        assert h.replica == "a"                # probe outranks score
+        assert rec.breaker.probe_in_flight
+        assert h.result(timeout=120).size == 8
+        assert rec.breaker.state == BREAKER_CLOSED
+        kinds = [k for _, k, _ in flight_recorder.events()]
+        assert "serve.router.breaker_open" in kinds
+        assert "serve.router.breaker_probe" in kinds
+        assert "serve.router.breaker_close" in kinds
+    finally:
+        flight_recorder.configure(
+            capacity=flight_recorder.DEFAULT_CAPACITY, on=True)
+        monitor.disable()
+        router.shutdown()
+        for e in engines.values():
+            e.shutdown()
+
+
+def test_client_error_not_rerouted(tiny_gpt, store):
+    """A prompt no compiled bucket holds is a CLIENT error — identical
+    on every replica, so it surfaces immediately instead of burning
+    re-routes against a homogeneous fleet."""
+    engines = [_engine(tiny_gpt, store), _engine(tiny_gpt, store)]
+    router = FleetRouter(engines, seed=0)
+    try:
+        with pytest.raises(ValueError):
+            router.submit(np.arange(100, dtype=np.int32))  # > bucket 16
+        assert router.stats["reroutes"] == 0
+    finally:
+        router.shutdown()
+        for e in engines:
+            e.shutdown()
+
+
+# --------------------------------------------------- telemetry surface
+
+
+def test_router_endpoint(tiny_gpt, store):
+    eng = _engine(tiny_gpt, store)
+    router = FleetRouter({"a": eng}, seed=0)
+    server = TelemetryServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/router", timeout=10) as r:
+            assert r.status == 404             # nothing attached yet
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    try:
+        server.attach_router(router)
+        h = router.submit([1, 2, 3])
+        with urllib.request.urlopen(f"http://127.0.0.1:{server.port}"
+                                    "/router", timeout=10) as r:
+            assert r.status == 200
+            doc = json.loads(r.read().decode())
+        assert doc["submitted"] == 1 and doc["admissions"] == 1
+        (row,) = doc["replicas"]
+        assert row["name"] == "a"
+        assert row["breaker"] == BREAKER_CLOSED
+        assert "score" in row and "ready" in row["health"]
+        assert doc["breaker"]["threshold"] == router.breaker_threshold
+        h.result(timeout=120)
+    finally:
+        server.stop()
+        router.shutdown()
+        eng.shutdown()

@@ -180,6 +180,67 @@ def test_queue_bound_rejects(tiny_gpt):
     assert nxt.result(timeout=60).size == 8
 
 
+def test_rejection_reason_dense(tiny_gpt):
+    """ISSUE-19 satellite: a queue-bound rejection carries the
+    STRUCTURED health reason on both the QueueFull and the
+    already-terminal handle — the router's re-route classifier reads
+    it, so it must distinguish lanes from pool memory from capacity."""
+    # both decode lanes busy -> queue_full:no_free_slots
+    eng = ServingEngine(_config(tiny_gpt, max_new=8, buckets=(16,),
+                                max_batch=1, max_queue=1), poll_every=1)
+    running = eng.submit([1, 2, 3])
+    eng.step()
+    queued = eng.submit([4, 5])
+    with pytest.raises(QueueFull) as ei:
+        eng.submit([6, 7])
+    assert ei.value.reason == "queue_full:no_free_slots"
+    handle = ei.value.request
+    assert handle is not None and handle.done()
+    assert handle.status is RequestStatus.REJECTED
+    assert handle.detail == "queue_full:no_free_slots"
+    with pytest.raises(RequestFailed, match="no_free_slots"):
+        handle.result(timeout=1)
+    assert running.result(timeout=60).size == 8
+    assert queued.result(timeout=60).size == 8
+    eng.shutdown()
+
+    # queue at bound with lanes still free -> bare queue_full
+    eng2 = ServingEngine(_config(tiny_gpt, max_new=8, buckets=(16,),
+                                 max_batch=2, max_queue=1), poll_every=1)
+    first = eng2.submit([1, 2, 3])    # queued, no step yet
+    with pytest.raises(QueueFull) as ei2:
+        eng2.submit([4, 5])
+    assert ei2.value.reason == "queue_full"
+    assert ei2.value.request.detail == "queue_full"
+    assert first.result(timeout=60).size == 8
+    eng2.shutdown()
+
+
+def test_rejection_reason_paged(tiny_gpt):
+    """Paged twin: a queue blocked on POOL MEMORY stamps its rejections
+    queue_full:no_free_pages (the retryable-pressure signal, distinct
+    from the dense lane bound)."""
+    eng = ServingEngine(_config(tiny_gpt, max_batch=2, paged=True,
+                                kv_page_size=16, kv_pages=3,
+                                max_queue=1), poll_every=1)
+    a = eng.submit(np.arange(1, 16, dtype=np.int32))   # 2 pages
+    eng.step()                                         # admit a
+    b = eng.submit(np.arange(2, 17, dtype=np.int32))   # blocked on pages
+    eng.step()                                         # marks _page_blocked
+    assert eng.health()["queue_blocked_on"] == "pages"
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(np.arange(3, 10, dtype=np.int32))
+    assert ei.value.reason == "queue_full:no_free_pages"
+    assert ei.value.request.status is RequestStatus.REJECTED
+    assert ei.value.request.detail == "queue_full:no_free_pages"
+    while eng.busy:
+        eng.step()
+    assert a.status is RequestStatus.COMPLETED
+    assert b.status is RequestStatus.COMPLETED
+    eng._alloc.assert_conserved()
+    eng.shutdown()
+
+
 def test_eos_frees_slot_and_trims(tiny_gpt):
     """A row finishing on eos ends early; its result is trimmed before
     the eos, matching the Predictor's contract."""

@@ -28,6 +28,7 @@ HOST_SYNC_HOT_PATHS = frozenset({
     "paddle_tpu/generation/speculative.py",
     "paddle_tpu/hapi/model.py",
     "paddle_tpu/serving/engine.py",
+    "paddle_tpu/serving/router.py",
 })
 
 # Files allowed to name metrics freely (the schema itself + the
@@ -496,6 +497,10 @@ LOCK_DISCIPLINE = {
     "paddle_tpu/serving/engine.py": {
         "ServingEngine": frozenset({
             "_queue", "_slots", "_slot_used"}),
+    },
+    "paddle_tpu/serving/router.py": {
+        "FleetRouter": frozenset({
+            "_replicas", "_stats"}),
     },
 }
 
