@@ -1006,6 +1006,119 @@ def bench_serve(dev, on_tpu):
     }
 
 
+def bench_serve_adversarial(dev, on_tpu):
+    """Head-of-line-blocking bench (ISSUE-20 `serve --adversarial`
+    mode): Poisson traffic of SHORT, TTFT-sensitive requests with a
+    long prompt injected every few arrivals — the adversarial pattern
+    where an inline long prefill parks the device for a whole
+    monolithic dispatch while every short request behind it eats that
+    wall into its TTFT. The same schedule runs twice at equal engine
+    HBM (identical buckets/batch/cache; the only delta is the
+    ``prefill_chunk_tokens`` knob): INLINE (chunking off) vs CHUNKED
+    (page-aligned chunks interleaved with decode). Reports short-
+    request TTFT p50/p95/p99 per mode plus each pass's serve.goodput
+    compute fraction; the headline value is the p99 ratio
+    (inline/chunked — higher is better), vs_baseline = ratio / 3 (the
+    ISSUE-20 acceptance floor is 3x, so >= 1.0 means the gate holds)."""
+    import os
+    import threading
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config
+    from paddle_tpu.models.gpt import gpt
+    from paddle_tpu.serving import RequestParams, ServingEngine
+
+    n_req = int(os.environ.get("BENCH_ADV_REQUESTS",
+                               80 if on_tpu else 40))
+    rate = float(os.environ.get("BENCH_ADV_RATE", 64.0))   # req/sec
+    every = int(os.environ.get("BENCH_ADV_LONG_EVERY", 4))
+    max_batch = int(os.environ.get("BENCH_SERVE_BATCH",
+                                   8 if on_tpu else 4))
+    max_new = int(os.environ.get("BENCH_ADV_NEW_TOKENS", 16))
+    chunk = int(os.environ.get("BENCH_ADV_CHUNK_TOKENS", 32))
+    paddle.seed(0)
+    model = gpt("test-tiny", max_position_embeddings=1024)
+    model.bfloat16() if on_tpu else None
+    spec = [paddle.to_tensor(np.zeros((max_batch, 64), np.int32))]
+
+    rng = np.random.RandomState(0)
+    is_long = np.array([(i % every) == every - 1 for i in range(n_req)])
+    prompts = [rng.randint(0, model.cfg.vocab_size,
+                           rng.randint(400, 512) if lng
+                           else rng.randint(4, 24)).astype(np.int32)
+               for lng in is_long]
+    budgets = rng.randint(4, max_new + 1, size=n_req)
+    gaps = rng.exponential(1.0 / rate, size=n_req)
+
+    counter = _metric_counter
+
+    def run(prefill_chunk_tokens):
+        cfg = (Config().from_layer(model, spec)
+               .enable_generation(max_new_tokens=max_new,
+                                  prefill_buckets=(32, 512),
+                                  max_batch=max_batch)
+               .enable_serving(max_queue=n_req,
+                               prefill_chunk_tokens=prefill_chunk_tokens))
+        engine = ServingEngine(cfg, poll_every=2)  # warmup compiles here
+        before = {k: counter(k) for k in
+                  ("jit.compile.total", "jit.compile{cause=new_shape}")}
+        handles = []
+
+        def feeder():
+            for p, b, g in zip(prompts, budgets, gaps):
+                time.sleep(g)
+                handles.append(engine.submit(
+                    p, RequestParams(max_new_tokens=int(b))))
+
+        t0 = time.perf_counter()
+        th = threading.Thread(target=feeder, daemon=True)
+        th.start()
+        while th.is_alive() or engine.busy:
+            if engine.busy:
+                engine.step()
+            else:
+                time.sleep(0.0002)
+        dt = time.perf_counter() - t0
+        th.join()
+        assert len(handles) == n_req and \
+            all(h.status.value == "completed" for h in handles)
+        short_ttft = np.array([h.ttft for h, lng in zip(handles, is_long)
+                               if not lng]) * 1e3           # ms
+        gp = engine.goodput()
+        row = {
+            "qps": round(n_req / dt, 1),
+            "short_ttft_ms": {q: round(float(np.percentile(short_ttft,
+                                                           q)), 2)
+                              for q in (50, 95, 99)},
+            "long_requests": int(is_long.sum()),
+            "goodput_fraction": round(gp["goodput_fraction"], 4),
+            "counters": {k: counter(k) - before[k] for k in before},
+        }
+        if prefill_chunk_tokens:
+            row["prefill_chunks"] = engine.stats["prefill_chunks"]
+        engine.shutdown()
+        return row
+
+    inline = run(None)
+    chunked = run(chunk)
+    ratio = inline["short_ttft_ms"][99] / \
+        max(chunked["short_ttft_ms"][99], 1e-9)
+    return {
+        "metric": f"test-tiny adversarial serving: short-request TTFT "
+                  f"p99 {inline['short_ttft_ms'][99]}ms inline vs "
+                  f"{chunked['short_ttft_ms'][99]}ms chunked@{chunk} "
+                  f"(1 long per {every} arrivals, poisson@{rate:g}/s "
+                  f"b{max_batch}, goodput {inline['goodput_fraction']} "
+                  f"vs {chunked['goodput_fraction']}, "
+                  f"device={dev.device_kind})",
+        "value": round(ratio, 2),
+        "unit": "x short-request TTFT p99 (inline/chunked)",
+        "vs_baseline": round(ratio / 3.0, 2),   # gate: >= 3x -> >= 1.0
+        "inline": inline,
+        "chunked": chunked,
+        "chunk_tokens": chunk,
+    }
+
+
 def bench_serve_router(dev, on_tpu):
     """Fleet-router bench (ISSUE-19 `serve --router` mode): the SAME
     Poisson traffic shape as the serve row, but fanned over a 3-replica
@@ -1288,6 +1401,7 @@ BENCHES = {
     "serve": bench_serve,
     "serve-prefix": bench_serve_shared_prefix,
     "serve-router": bench_serve_router,
+    "serve-adversarial": bench_serve_adversarial,
     "warmstart": bench_warmstart,
     "moe-block": bench_moe_block,
     "resnet50": bench_resnet50,
@@ -1308,6 +1422,11 @@ def main():
     # replicas + mid-run rolling deploy) instead of the PR-8 SLA row
     if which == "serve" and "--router" in sys.argv[2:]:
         which = "serve-router"
+    # `bench.py serve --adversarial`: the ISSUE-20 head-of-line row
+    # (short Poisson traffic + long-prompt injections, inline vs
+    # chunked prefill at equal HBM) instead of the PR-8 SLA row
+    if which == "serve" and "--adversarial" in sys.argv[2:]:
+        which = "serve-adversarial"
     # warmstart measures COLD compiles: it must not inherit a populated
     # process-global cache (it anchors its own fresh store per phase)
     dev, on_tpu = _setup(configure_cache=(which != "warmstart"))

@@ -140,12 +140,20 @@ def flagship_reports() -> Dict[str, object]:
                 .enable_generation(max_new_tokens=8,
                                    prefill_buckets=(16, 32),
                                    max_batch=2, eos_token_id=None)
-                .enable_serving(max_queue=8, **serving_kw))
+                .enable_serving(max_queue=8, prefill_chunk_tokens=16,
+                                **serving_kw))
         eng = ServingEngine(ecfg, warmup=False)
         rs = eng.audit()
         reports[f"{tag}.prefill.32"] = rs[("prefill", 32)]
         for prog in ("decode", "admit", "free"):
             reports[f"{tag}.{prog}"] = rs[prog]
+        # chunked-prefill programs (enabled on every flagship engine so
+        # the ledger pins their geometry): the chunk/final pair always,
+        # the span install only where a page table exists
+        reports[f"{tag}.prefill_chunk.16"] = rs[("chunk", 16)]
+        reports[f"{tag}.prefill_chunk_final.16"] = rs[("chunk_final", 16)]
+        if ("install_span",) in rs:
+            reports[f"{tag}.install_span"] = rs[("install_span",)]
 
     engine_reports("serve")
     engine_reports("serve_paged", paged=True, kv_page_size=16)

@@ -66,6 +66,7 @@ DECLARED_EVENTS = frozenset({
     "train.anomaly", "train.anomaly_restore",
     "fit.crash",
     "serve.submit", "serve.admit", "serve.evict", "serve.finish",
+    "serve.prefill_chunk",
     "serve.preempted", "serve.crash",
     "serve.drain_begin", "serve.drain_end",
     "serve.router.reroute", "serve.router.breaker_open",
@@ -100,6 +101,9 @@ EVENT_DOC = {
                    "reason, tokens)",
     "serve.finish": "a request reached a terminal status (req, "
                     "status, tokens)",
+    "serve.prefill_chunk": "one chunked-prefill chunk landed in the KV "
+                           "cache (req, slot, chunk, start, tokens, "
+                           "remaining)",
     "serve.preempted": "preemption observed mid-serve (in_flight)",
     "serve.crash": "uncaught exception in serve_forever (error)",
     "serve.drain_begin": "graceful drain started (queued, in_flight)",

@@ -112,6 +112,8 @@ DECLARED_METRICS = frozenset({
     "gen.spec.proposed", "gen.spec.accepted", "gen.spec.accept_rate",
     "serve.requests", "serve.queue_depth", "serve.ttft",
     "serve.token_latency", "serve.slot_occupancy", "serve.cancellations",
+    "serve.prefill.chunks", "serve.prefill.chunk_tokens",
+    "serve.prefill.interleave_ratio",
     "serve.cache.page_occupancy", "serve.cache.kv_dtype",
     "serve.cache.prefix_hits",
     "serve.cache.prefix_shared_pages", "serve.cache.cow_copies",
@@ -278,6 +280,20 @@ METRIC_DOC = {
     "serve.cancellations": ("counter", ("reason",),
                             "requests cancelled before completing: "
                             "deadline | shutdown | error"),
+    "serve.prefill.chunks": ("counter", (),
+                             "chunked-prefill chunks dispatched (one "
+                             "per scheduler iteration a long prompt "
+                             "filled its KV incrementally)"),
+    "serve.prefill.chunk_tokens": ("counter", (),
+                                   "prompt tokens written via chunked "
+                                   "prefill (rate vs gen.tokens shows "
+                                   "the prefill/decode interleave mix)"),
+    "serve.prefill.interleave_ratio": ("gauge", (),
+                                       "decode steps dispatched per "
+                                       "prefill chunk over the last "
+                                       "chunked admission (0 = the "
+                                       "chunks ran back-to-back, i.e. "
+                                       "no decode traffic to protect)"),
     "serve.cache.page_occupancy": ("gauge", (),
                                    "paged-KV pool pressure: pages "
                                    "referenced by live rows / pool "
@@ -823,6 +839,25 @@ def record_serve_cancellation(reason: str):
         return
     metrics.counter("serve.cancellations", reason=reason).inc()
     metrics.counter("serve.cancellations").inc()
+
+
+def record_prefill_chunk(tokens: int):
+    """One chunked-prefill chunk dispatched (``tokens`` = prompt tokens
+    it wrote, excluding pad; the final, right-padded chunk reports its
+    real token count)."""
+    if not enabled:
+        return
+    metrics.counter("serve.prefill.chunks").inc()
+    metrics.counter("serve.prefill.chunk_tokens").inc(int(tokens))
+
+
+def record_prefill_interleave(ratio: float):
+    """Decode steps dispatched per prefill chunk across the chunked
+    admission that just completed — the interleaving evidence (0 means
+    no decode ran between chunks)."""
+    if not enabled:
+        return
+    metrics.gauge("serve.prefill.interleave_ratio").set(float(ratio))
 
 
 def record_request_cost(prefill_s: float, decode_s: float, page_s: float):

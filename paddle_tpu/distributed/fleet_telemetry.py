@@ -592,7 +592,9 @@ class FleetAggregator:
                 }
                 for k in ("predicted_headroom_bytes",
                           "predicted_peak_bytes", "free_tokens",
-                          "capacity_tokens", "queue_depth"):
+                          "capacity_tokens", "queue_depth",
+                          "pending_prefill_tokens",
+                          "prefill_chunks_queued"):
                     if k in h:
                         entry[k] = h[k]
                 ranks[str(rank)] = entry

@@ -47,7 +47,24 @@ def cached_attention(q, k, v, cache, layer_idx, *, decode: bool,
                  cache.page_table, mask_len) + scales, {},
                 differentiable=False)
             return out, cache
-        from ..kernels.flash_attention import flash_attention_decode
+        from ..kernels.flash_attention import (
+            MAX_DECODE_QLEN, flash_attention_chunk,
+            flash_attention_decode)
+        if s > MAX_DECODE_QLEN:
+            # chunk-prefill window (serving's chunked admission): a
+            # C-token slice of a long prompt attends the cache written
+            # by the earlier chunks — decode-shaped ragged masking,
+            # q-tiled kernel (dense cache only; the engine's chunk
+            # side-cache is never paged)
+            out = dispatch(
+                "flash_attention_chunk",
+                lambda q_, kc, vc, kl, *sc: flash_attention_chunk(
+                    q_, kc, vc, kl,
+                    **(dict(k_scale=sc[0], v_scale=sc[1])
+                       if sc else {})),
+                (q, cache.k[layer_idx], cache.v[layer_idx], mask_len)
+                + scales, {}, differentiable=False)
+            return out, cache
         out = dispatch(
             "flash_attention_decode",
             lambda q_, kc, vc, kl, *sc: flash_attention_decode(

@@ -210,6 +210,37 @@ class PagedKVCache:
             self.page_table.at[slot].set(table_row),
             self.kv_len.at[slot].set(length))
 
+    def install_span(self, src: KVCache, table_row,
+                     start) -> "PagedKVCache":
+        """Chunked-prefill incremental install: scatter positions
+        ``[start, src.kv_len[0])`` of the batch-1 dense chunk cache
+        ``src`` into the pool pages named by ``table_row`` WITHOUT
+        installing the table row or ``kv_len`` — the slot stays parked
+        (kv_len 0, null table) so decode steps keep routing its lane's
+        writes to the null page until the final chunk's admission
+        installs the pointers atomically. The same program runs after
+        every non-final chunk; the admission-time :meth:`install_row`
+        then writes only the final span (``start`` = last chunk
+        boundary)."""
+        table_row = jnp.asarray(_raw(table_row), jnp.int32)
+        start = jnp.asarray(_raw(start), jnp.int32)
+        length = src.kv_len[0]
+        t = src.max_len
+        pos = jnp.arange(t, dtype=jnp.int32)
+        page_slot = pos // self.page_size
+        page = table_row[jnp.minimum(page_slot, self.pages_per_row - 1)]
+        valid = (pos >= start) & (pos < length) & \
+            (page_slot < self.pages_per_row)
+        page = jnp.where(valid, page, 0)
+        off = pos % self.page_size
+
+        def write(buf, row):  # row: [layers, t, heads, head_dim]
+            return buf.at[:, page, off].set(row.astype(buf.dtype))
+
+        return PagedKVCache(
+            write(self.k, src.k[:, 0]), write(self.v, src.v[:, 0]),
+            self.page_table, self.kv_len)
+
     def positions(self, s: int):
         """Absolute positions of ``s`` appended tokens per row — the
         decode position-embedding offsets (dense-cache contract)."""
@@ -343,6 +374,37 @@ class QuantPagedKVCache(PagedKVCache):
             write(self.k_scale, src.k_scale[:, 0]),
             write(self.v_scale, src.v_scale[:, 0]),
             self.clips + src.clips)
+
+    def install_span(self, src, table_row,
+                     start) -> "QuantPagedKVCache":
+        """Chunked-prefill incremental install from a batch-1
+        :class:`QuantKVCache` chunk row: int8 values + scales scatter
+        for ``[start, src.kv_len[0])`` only, table row and ``kv_len``
+        untouched (see the wide-pool docstring). ``clips`` is NOT
+        accumulated here — the admission-time ``install_row`` adds the
+        source cache's counter once; adding it per span would
+        multiply-count every earlier chunk's clips."""
+        table_row = jnp.asarray(_raw(table_row), jnp.int32)
+        start = jnp.asarray(_raw(start), jnp.int32)
+        length = src.kv_len[0]
+        t = src.max_len
+        pos = jnp.arange(t, dtype=jnp.int32)
+        page_slot = pos // self.page_size
+        page = table_row[jnp.minimum(page_slot, self.pages_per_row - 1)]
+        valid = (pos >= start) & (pos < length) & \
+            (page_slot < self.pages_per_row)
+        page = jnp.where(valid, page, 0)
+        off = pos % self.page_size
+
+        def write(buf, row):  # row: [layers, t, ...]
+            return buf.at[:, page, off].set(row.astype(buf.dtype))
+
+        return QuantPagedKVCache(
+            write(self.k, src.k[:, 0]), write(self.v, src.v[:, 0]),
+            self.page_table, self.kv_len,
+            write(self.k_scale, src.k_scale[:, 0]),
+            write(self.v_scale, src.v_scale[:, 0]),
+            self.clips)
 
     # -------------------------------------------------------- slot reuse
     def reset_rows(self, rows) -> "QuantPagedKVCache":

@@ -145,7 +145,8 @@ class Config:
                        trace_sample=None, telemetry_port=None,
                        paged: bool = False, kv_page_size=None,
                        kv_pages=None, kv_cache_dtype=None,
-                       weight_bits=None, hbm_budget=None):
+                       weight_bits=None, prefill_chunk_tokens=None,
+                       hbm_budget=None):
         """Continuous-batching knobs for ``paddle_tpu.serving.
         ServingEngine`` (which also needs ``enable_generation()`` — the
         engine reuses its prompt-bucket set, fixed decode batch, and
@@ -179,6 +180,15 @@ class Config:
         weight-only only; dequant stays in-trace) — the int4 decode
         weight path.
 
+        ``prefill_chunk_tokens`` (or ``PADDLE_PREFILL_CHUNK_TOKENS``)
+        enables CHUNKED PREFILL: prompts longer than this are admitted
+        that many tokens at a time, one chunk per scheduler iteration,
+        interleaved with the decode dispatch — in-flight streams keep
+        producing tokens while a long prompt fills its KV
+        incrementally (the head-of-line TTFT fix). Must be a multiple
+        of ``kv_page_size`` on paged engines; outputs stay equal to
+        inline admission. Default off.
+
         ``hbm_budget`` (bytes, or ``"16GiB"``-style; also
         ``PADDLE_HBM_BUDGET``) declares the engine's peak-HBM budget:
         the constructor runs the static planner (``analysis.memory``)
@@ -200,7 +210,9 @@ class Config:
             trace_sample=trace_sample, telemetry_port=telemetry_port,
             paged=bool(paged), kv_page_size=kv_page_size,
             kv_pages=kv_pages, kv_cache_dtype=kv_cache_dtype,
-            weight_bits=weight_bits, hbm_budget=hbm_budget)
+            weight_bits=weight_bits,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            hbm_budget=hbm_budget)
         return self
 
     def set_compile_cache_dir(self, path: str):

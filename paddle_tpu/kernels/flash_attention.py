@@ -1104,6 +1104,169 @@ def flash_attention_decode(query, key_cache, value_cache, kv_len,
     return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
 
 
+# ------------------------------------------------ chunk prefill forward
+#
+# "Chunk-shaped" attention: a WINDOW of new query tokens (tens to
+# hundreds — a prefill chunk) per row against the same cached K/V the
+# decode kernel reads, with the same per-row ragged valid length. This
+# is decode attention generalized along the query axis: query row i of
+# the window sits at global position kv_len - sq + i and attends cache
+# columns <= that position, so the serving engine can fill a long
+# prompt's cache C tokens at a time between decode polls instead of
+# monopolizing the device with one inline prefill. The kernel q-tiles
+# the decode kernel rather than forking it: each q-tile re-enters
+# _decode_accumulate with an ADJUSTED sq (sq_total - iq*block_q), which
+# shifts the shared ``cols - rows <= kv_len - sq`` mask to exactly the
+# tile's causal window — the accumulate math stays the single shared
+# copy, so chunked numerics can never drift from decode numerics.
+
+_CHUNK_BLOCK_Q = 128
+
+
+def _chunk_kernel(q_ref, k_ref, v_ref, *rest, sq_total, block_q,
+                  block_k, num_kblocks, quant=False):
+    # q_ref holds q * (scale * log2e); scores are base-2 logits.
+    if quant:
+        ks_ref, vs_ref, kvlen_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        kvlen_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        _decode_init(m_scr, l_scr, acc_scr)
+
+    kv_len = kvlen_ref[0, 0]  # valid cache length incl. the sq_total
+    #                           new positions (already written)
+    # local row r of q-tile iq is global query iq*block_q + r, so the
+    # shared mask with sq := sq_total - iq*block_q is exactly this
+    # tile's causal window
+    sq_tile = sq_total - iq * block_q
+    # skip k-blocks entirely past the LAST row of this q-tile's window
+    # (col limit kv_len - sq_tile + block_q - 1, also capped by kv_len
+    # for padded tail tiles whose rows overhang sq_total)
+    limit = jnp.minimum(kv_len, kv_len - sq_tile + block_q)
+
+    @pl.when(ik * block_k < limit)
+    def _compute():
+        _decode_accumulate(q_ref[0], k_ref[0], v_ref[0], ik * block_k,
+                           kv_len, sq_tile, m_scr, l_scr, acc_scr,
+                           ks=ks_ref[...] if quant else None,
+                           vs=vs_ref[...] if quant else None)
+
+    @pl.when(ik == num_kblocks - 1)
+    def _finalize():
+        _decode_write_out(o_ref, l_scr, acc_scr)
+
+
+def _chunk_pallas(q, k_cache, v_cache, kv_len, scale,
+                  block_k=_DECODE_BLOCK_K, group=1,
+                  k_scale=None, v_scale=None):
+    """q: [B*Hq, sq, D] (unscaled, sq arbitrary), caches [B*Hk, T, D],
+    kv_len [B*Hk]. Same GQA head-index streaming and fused int8
+    dequant as ``_decode_pallas``; the grid gains a q-tile axis."""
+    bh, sq, d = q.shape
+    t = k_cache.shape[1]
+    quant = k_scale is not None
+    sq_pad = -(-sq // _DECODE_QPAD) * _DECODE_QPAD
+    bq = _pick_block(sq_pad, _CHUNK_BLOCK_Q)
+    q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    if sq < sq_pad:
+        q = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
+    nq = sq_pad // bq
+    bk = _pick_block(t, block_k)
+    nk = t // bk
+    kvlen2 = kv_len.astype(jnp.int32).reshape(k_cache.shape[0], 1)
+    kv_bytes = k_cache.dtype.itemsize * t * d \
+        + (k_scale.dtype.itemsize * t if quant else 0)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
+        pl.BlockSpec((1, bk, d), lambda b, i, j: (b // group, j, 0)),
+    ]
+    operands = [q, k_cache, v_cache]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, bk), lambda b, i, j: (b // group, j)),
+            pl.BlockSpec((1, bk), lambda b, i, j: (b // group, j))]
+        operands += [k_scale, v_scale]
+    in_specs.append(pl.BlockSpec((1, 1), lambda b, i, j: (b // group, 0),
+                                 memory_space=pltpu.SMEM))
+    operands.append(kvlen2)
+    out = pl.pallas_call(
+        functools.partial(_chunk_kernel, sq_total=sq, block_q=bq,
+                          block_k=bk, num_kblocks=nk, quant=quant),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * sq_pad * t * d,
+            bytes_accessed=bh * (sq_pad * d * q.dtype.itemsize
+                                 + 2 * kv_bytes),
+            transcendentals=bh * sq_pad * t),
+        interpret=_interpret(),
+    )(*operands)
+    return out[:, :sq]
+
+
+def flash_attention_chunk(query, key_cache, value_cache, kv_len,
+                          scale=None, block_k=_DECODE_BLOCK_K,
+                          k_scale=None, v_scale=None):
+    """Chunk-prefill attention: an arbitrary-length window of new query
+    tokens per row against a cached K/V with per-row valid lengths —
+    ``flash_attention_decode`` without the 8-row cap, for the serving
+    engine's chunked prefill (a C-token slice of a long prompt attends
+    the cache the earlier chunks wrote).
+
+    Same contract as ``flash_attention_decode``: query [batch, q_len,
+    num_heads, head_dim]; caches [batch, max_len, num_kv_heads,
+    head_dim] with the new tokens already written; kv_len [batch] int32
+    INCLUDING the q_len new positions (query row i attends columns
+    ``<= kv_len - q_len + i``); int8 caches take the QuantKVCache
+    ``k_scale``/``v_scale`` sidecars with the dequant fused in-kernel;
+    GQA attends by head-index mapping. TPU runs the q-tiled Pallas
+    kernel; other backends (and off-grid cache lengths) take the same
+    XLA fallback as decode, which is already generic in q_len.
+    """
+    b, sq, hq, d = query.shape
+    t, hk = key_cache.shape[1], key_cache.shape[2]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    assert hq % hk == 0, f"q heads {hq} not divisible by kv heads {hk}"
+    group = hq // hk
+    quant = key_cache.dtype == jnp.int8
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError(
+            "flash_attention_chunk: int8 caches need k_scale/v_scale "
+            "([batch, max_len, kv_heads] — the QuantKVCache sidecars); "
+            "an unscaled int8 cache cannot be dequantized")
+    qt = jnp.swapaxes(query, 1, 2).reshape(b * hq, sq, d)
+    kt = jnp.swapaxes(key_cache, 1, 2).reshape(b * hk, t, d)
+    vt = jnp.swapaxes(value_cache, 1, 2).reshape(b * hk, t, d)
+    kst = vst = None
+    if quant:
+        kst = jnp.swapaxes(k_scale, 1, 2).reshape(b * hk, t)
+        vst = jnp.swapaxes(v_scale, 1, 2).reshape(b * hk, t)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    kl = jnp.repeat(kv_len, hk)                       # [B*Hk] int32
+    use_pallas = (jax.default_backend() == "tpu"
+                  and t % 128 == 0 and d in (64, 128, 256))
+    if use_pallas:
+        out = _chunk_pallas(qt, kt, vt, kl, float(scale), block_k,
+                            group=group, k_scale=kst, v_scale=vst)
+    else:
+        out = _decode_xla(qt, kt, vt, kl, float(scale), group=group,
+                          ks=kst, vs=vst)
+    return jnp.swapaxes(out.reshape(b, hq, sq, d), 1, 2)
+
+
 # ------------------------------------------------ paged decode forward
 #
 # Decode attention over the block-table paged KV cache

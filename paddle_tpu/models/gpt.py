@@ -313,8 +313,14 @@ class GPTModel(Layer):
         pad tail is invisible to (and overwritten by) decode steps.
         ``cache_dtype="int8"`` creates the quantized cache (values
         quantize in-trace at every write; decode dequantizes inside
-        the kernel)."""
+        the kernel). Decode + ``prompt_len`` is the chunked-prefill
+        window: s cache-writing positions whose tail may overhang the
+        row's true length (the final padded chunk), so kv_len clamps
+        to ``prompt_len`` — the overhang stays invisible to (and is
+        overwritten by) later decode steps, exactly like prefill's pad
+        tail."""
         from ..generation.kv_cache import KVCache
+        import jax.numpy as jnp
         b, s = input_ids.shape
         decode = cache is not None
         if decode:
@@ -331,7 +337,13 @@ class GPTModel(Layer):
             x, cache = block(x, attn_mask, cache=cache, layer_idx=i,
                              decode=decode)
         if decode:
-            cache = cache.with_kv_len(cache.kv_len + s)
+            new_len = cache.kv_len + s
+            if prompt_len is not None:
+                plen = jnp.asarray(
+                    prompt_len._data if isinstance(prompt_len, Tensor)
+                    else prompt_len, jnp.int32)
+                new_len = jnp.minimum(new_len, plen)
+            cache = cache.with_kv_len(new_len)
         else:
             cache = cache.with_kv_len(
                 s if prompt_len is None else prompt_len)
@@ -380,11 +392,28 @@ class GPTForCausalLM(Layer):
         (generation samples from logits, not a loss)."""
         import jax.numpy as jnp
         decode = cache is not None
+        kv0 = cache.kv_len if decode else None
         h, cache = self.gpt(input_ids, attn_mask, cache=cache,
                             use_cache=True, prompt_len=prompt_len,
                             cache_max_len=cache_max_len,
                             cache_dtype=cache_dtype)
-        if not decode:
+        if decode and prompt_len is not None:
+            # chunked-prefill final window: gather each row's hidden at
+            # its last REAL prompt position (global prompt_len - 1 ==
+            # window-local prompt_len - 1 - kv_len-at-entry; the padded
+            # tail past it is never sampled) → [b, 1, vocab], same
+            # shape as a decode step's single-token logits
+            from ..core.tensor import dispatch
+            plen = jnp.asarray(
+                prompt_len._data if isinstance(prompt_len, Tensor)
+                else prompt_len, jnp.int32)
+            idx = plen - 1 - kv0.astype(jnp.int32)
+            h = dispatch(
+                "gather_last_hidden",
+                lambda hr, ir: jnp.take_along_axis(
+                    hr, ir[:, None, None], axis=1),
+                (h, idx), {}, differentiable=False)
+        elif not decode:
             from ..core.tensor import dispatch
             b, s = input_ids.shape
             if prompt_len is None:

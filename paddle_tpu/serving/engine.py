@@ -119,6 +119,7 @@ class ServingEngine:
                  paged: Optional[bool] = None,
                  kv_page_size: Optional[int] = None,
                  kv_pages: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
                  hbm_budget=None):
         from ..inference.precision import serving_params
         from ..jit.api import _unwrap, functional_call
@@ -297,6 +298,63 @@ class ServingEngine:
             # registry on every iteration
             self._blocked_key = None
 
+        # ---------------------------------------------- chunked prefill
+        # head-of-line fix (ROADMAP item 2a): prompts longer than
+        # prefill_chunk_tokens are admitted C tokens at a time, ONE
+        # chunk per scheduler iteration, interleaved with the decode
+        # dispatch — in-flight streams keep producing tokens while the
+        # long prompt fills a persistent batch-1 SIDE cache that the
+        # ordinary admit program installs at the final chunk. Opt-in
+        # (kwarg > enable_serving > PADDLE_PREFILL_CHUNK_TOKENS); paged
+        # engines require page alignment so every completed chunk ends
+        # on a page boundary the span-install can commit.
+        env_ct = os.environ.get("PADDLE_PREFILL_CHUNK_TOKENS",
+                                "").strip()
+        if env_ct and not env_ct.isdigit():
+            # garbage must not silently enable/resize chunking (same
+            # contract as PADDLE_TRACE_SAMPLE / PADDLE_KV_PAGE_SIZE)
+            monitor.record_swallowed(
+                "serving.prefill_chunk_tokens",
+                ValueError(f"PADDLE_PREFILL_CHUNK_TOKENS={env_ct!r}"))
+        ct = _opt(prefill_chunk_tokens, "prefill_chunk_tokens",
+                  int(env_ct) if env_ct.isdigit() else None)
+        self.prefill_chunk_tokens = None
+        if ct is not None:
+            ct = int(ct)
+            if ct < 1:
+                raise ValueError(
+                    f"prefill_chunk_tokens {ct} must be >= 1 "
+                    "(PADDLE_PREFILL_CHUNK_TOKENS / "
+                    "enable_serving(prefill_chunk_tokens=...))")
+            if self._alloc is not None and ct % self.page_size:
+                raise ValueError(
+                    f"prefill_chunk_tokens {ct} must be a multiple of "
+                    f"kv_page_size {self.page_size}: every completed "
+                    "chunk must end on a page boundary so its span "
+                    "installs into whole committed pages")
+            # the final chunk pads to the chunk width, so the side
+            # cache writes up to ceil(bucket/C)*C positions — past
+            # max_len the ring modulo would WRAP the write onto the
+            # prompt's own prefix (silent corruption, not an error)
+            padded_top = -(-buckets[-1] // ct) * ct
+            if ct < buckets[-1] and padded_top > self.max_len:
+                raise ValueError(
+                    f"prefill_chunk_tokens {ct}: the largest bucket "
+                    f"{buckets[-1]} pads to {padded_top} chunked "
+                    f"tokens, past the cache length {self.max_len} — "
+                    "the final padded chunk would wrap the ring onto "
+                    "the prompt prefix; raise prefill_chunk_tokens or "
+                    "cache_max_len")
+            self.prefill_chunk_tokens = ct
+        # chunking can only ever trigger for prompts LONGER than one
+        # chunk; with every bucket at or under C the programs would be
+        # dead weight in warmup
+        self._chunk_enabled = (self.prefill_chunk_tokens is not None
+                               and self.prefill_chunk_tokens
+                               < buckets[-1])
+        self._chunking = None   # the (single) in-flight chunked
+        #                         admission's scheduler state
+
         names = self._sp.names
         sp = self._sp
         cfg = self._cfg
@@ -448,7 +506,52 @@ class ServingEngine:
             return (cache, tok, finished, steps, budget, out_buf,
                     tok_buf, tok_len)
 
+        def chunk_fn(state_vals, ids, row_cache):
+            # one NON-final prefill chunk: decode-mode forward over the
+            # persistent batch-1 side cache — attention masks at
+            # kv_len + C with queries at offset kv_len (the chunk
+            # kernel), the C new KV rows land in the ring, kv_len
+            # advances. The logits are never read, so the LM head DCEs
+            # out of the compiled program.
+            params = sp.materialize(state_vals)
+            out = functional_call(layer, dict(zip(names, params)),
+                                  Tensor(ids), cache=row_cache)
+            _, row_cache = _expect_logits_cache(out)
+            return row_cache
+
+        def chunk_final_fn(state_vals, ids, plen, key, row_cache, cfg):
+            # the FINAL (pad-to-C) chunk: kv_len clamps to the true
+            # prompt length, the hidden state is gathered at the last
+            # REAL position, and the first token is sampled — the same
+            # (tok, row_cache, key, finished) contract as prefill_fn,
+            # so the EXISTING admit program installs the result
+            # unchanged.
+            params = sp.materialize(state_vals)
+            out = functional_call(layer, dict(zip(names, params)),
+                                  Tensor(ids), cache=row_cache,
+                                  prompt_len=plen)
+            logits, row_cache = _expect_logits_cache(out)
+            logits = _unwrap(logits)[:, -1].astype(jnp.float32)
+            k0, k1 = jax.random.split(key)
+            tok = sample(logits, k0, **_sample_cfg(cfg))
+            if cfg.eos_token_id is not None:
+                finished = tok == cfg.eos_token_id
+            else:
+                finished = jnp.zeros(tok.shape, bool)
+            return tok, row_cache, k1, finished
+
+        def install_span_fn(cache, row_cache, table_row, start):
+            # commit one completed chunk's positions into the pool
+            # pages the admission planner already committed — table row
+            # and kv_len stay untouched, so the slot's lane stays
+            # parked (null-page routed) until the final admit installs
+            # the pointers atomically
+            return cache.install_span(row_cache, table_row, start)
+
         self._prefill_fn, self._free_fn = prefill_fn, free_fn
+        self._chunk_fn = chunk_fn
+        self._chunk_final_fn = chunk_final_fn
+        self._span_fn = install_span_fn
         self._step_fn = step_fn if spec is None else spec_step_fn
         if self._alloc is None:
             self._admit_fn = admit_fn if spec is None else spec_admit_fn
@@ -486,9 +589,21 @@ class ServingEngine:
                 + self._spec_admit_buf
             step_static = (12, 13)
         self._free_donate_intent = (0, 1)
+        # chunk programs: the side cache is the ONLY donated operand —
+        # it round-trips in place every chunk (chunk_fn arg 2,
+        # chunk_final_fn arg 4); the span install donates the pool
+        # pytree (arg 0) but NOT the source side cache, which the next
+        # chunk still reads
+        self._chunk_donate_intent = (2,)
+        self._chunk_final_donate_intent = (4,)
+        self._span_donate_intent = (0,)
         self._step_donate = self._step_donate_intent if tpu else ()
         self._admit_donate = self._admit_donate_intent if tpu else ()
         self._free_donate = self._free_donate_intent if tpu else ()
+        self._chunk_donate = self._chunk_donate_intent if tpu else ()
+        self._chunk_final_donate = \
+            self._chunk_final_donate_intent if tpu else ()
+        self._span_donate = self._span_donate_intent if tpu else ()
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(4, 5))
         self._step_jit = jax.jit(
             self._step_fn, static_argnums=step_static,
@@ -497,6 +612,13 @@ class ServingEngine:
             self._admit_fn, donate_argnums=self._admit_donate)
         self._free_jit = jax.jit(
             free_fn, donate_argnums=self._free_donate)
+        self._chunk_jit = jax.jit(
+            chunk_fn, donate_argnums=self._chunk_donate)
+        self._chunk_final_jit = jax.jit(
+            chunk_final_fn, static_argnums=(5,),
+            donate_argnums=self._chunk_final_donate)
+        self._span_jit = jax.jit(
+            install_span_fn, donate_argnums=self._span_donate)
 
         # ------------------------------------------------------- state
         self._state = tuple(self._sp.vals)
@@ -597,6 +719,20 @@ class ServingEngine:
             self._accepted = jax.device_put(np.zeros((), np.int32))
             self._spec_seen = (0, 0)   # host mirror for poll deltas
 
+        # chunked prefill's persistent batch-1 SIDE cache: the same
+        # dense row cache a bucket prefill would produce (max_len long,
+        # quant sidecars included), host-built zeros like the lanes
+        # above. Rebuilt from host zeros after every chunked admission
+        # or abort — the admit program DONATES it (arg 7), so the
+        # buffer is gone either way, and the rebuild is also what
+        # resets kv_len to 0 and zeroes the quant clip counter between
+        # requests.
+        self._row_cache = None
+        self._row_cache_aval = None
+        if self._chunk_enabled:
+            self._row_cache_aval = self._row_avals()[1]
+            self._row_cache = self._fresh_row_cache()
+
         self._slots: List[Optional[Request]] = [None] * B
         self._slot_used = [False] * B          # reuse detection
         self._queue = collections.deque()
@@ -611,7 +747,7 @@ class ServingEngine:
         self._window_steps = 0
         self.stats = dict(submitted=0, admitted=0, completed=0,
                           cancelled=0, rejected=0, slots_reused=0,
-                          decode_steps=0, prefills=0,
+                          decode_steps=0, prefills=0, prefill_chunks=0,
                           spec_proposed=0, spec_accepted=0)
         # top-K most expensive terminal requests (heap of
         # (total_s, req id, cost dict)) — the /slo cost table
@@ -841,16 +977,59 @@ class ServingEngine:
             self._cache, self._finished,
             jnp.asarray(0, jnp.int32)), donation=self._free_donate)
 
+    def _fresh_row_cache(self):
+        """A zeroed chunk side cache (host-built + device_put, same
+        XLA-free contract as the lane buffers): kv_len 0, quant clips
+        0 — the state every chunked admission must start from."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.zeros(a.shape, a.dtype)),
+            self._row_cache_aval)
+
+    def _exe_chunk(self):
+        sds = jax.ShapeDtypeStruct
+        C = self.prefill_chunk_tokens
+        return self._compiled(
+            ("chunk", C), lambda: self._chunk_jit.lower(
+                self._state, sds((1, C), jnp.int32),
+                self._row_cache_aval),
+            donation=self._chunk_donate)
+
+    def _exe_chunk_final(self):
+        sds = jax.ShapeDtypeStruct
+        C = self.prefill_chunk_tokens
+        return self._compiled(
+            ("chunk_final", C), lambda: self._chunk_final_jit.lower(
+                self._state, sds((1, C), jnp.int32),
+                sds((1,), jnp.int32), sds((2,), jnp.uint32),
+                self._row_cache_aval, self._cfg),
+            donation=self._chunk_final_donate)
+
+    def _exe_span(self):
+        sds = jax.ShapeDtypeStruct
+        return self._compiled(
+            ("install_span",), lambda: self._span_jit.lower(
+                self._cache, self._row_cache_aval,
+                sds((self.pages_per_row,), jnp.int32),
+                jnp.asarray(0, jnp.int32)),
+            donation=self._span_donate)
+
     def warmup(self):
         """Compile every program the scheduler can dispatch (one
-        prefill per bucket + the decode/admit/free trio). After this,
-        live traffic only ever hits warm executables; any later compile
-        is recorded as ``jit.compile{cause=new_shape}``."""
+        prefill per bucket + the decode/admit/free trio, plus the
+        chunk-prefill pair — and the paged span install — when chunked
+        prefill is enabled). After this, live traffic only ever hits
+        warm executables; any later compile is recorded as
+        ``jit.compile{cause=new_shape}``."""
         for b in self.buckets:
             self._exe_prefill(b)
         self._exe_step()
         self._exe_admit()
         self._exe_free()
+        if self._chunk_enabled:
+            self._exe_chunk()
+            self._exe_chunk_final()
+            if self._alloc is not None:
+                self._exe_span()
         self._warm = True
         return self
 
@@ -941,14 +1120,22 @@ class ServingEngine:
     # -------------------------------------------------------- scheduler
     def step(self):
         """One scheduler iteration: admit queued requests into free
-        slots, dispatch one fixed-batch decode step, poll completions
-        every ``poll_every`` steps."""
+        slots (short prompts inline, long ones one CHUNK per iteration
+        when chunked prefill is on), dispatch one fixed-batch decode
+        step for the running slots, advance the in-flight chunked
+        prefill, poll completions every ``poll_every`` steps. Decode
+        dispatches BEFORE the chunk's blocking sync, so in-flight
+        streams overlap the chunk's device time instead of stalling
+        behind a whole long prefill — the head-of-line fix."""
         with self._pump_lock:
             self._admit_ready()
-            if any(s is not None for s in self._slots):
+            if any(s is not None
+                   and s.status is RequestStatus.RUNNING
+                   for s in self._slots):
                 self._dispatch_decode()
-                if self._steps_since_poll >= self.poll_every:
-                    self._poll()
+            self._advance_chunked()
+            if self._steps_since_poll >= self.poll_every:
+                self._poll()
 
     def _unblock_if(self, req: Request):
         """Clear the page-pressure flag when the request it was
@@ -971,6 +1158,12 @@ class ServingEngine:
                     self._unblock_if(req)
                     self._cancel(req, "deadline")
                     continue
+                if self._needs_chunk(req) and self._chunking is not None:
+                    # ONE chunked prefill at a time, strict FIFO: the
+                    # long head waits (un-popped, pages uncommitted)
+                    # until the active chunked admission finishes —
+                    # admitting a later request past it would starve it
+                    return None
                 if self._alloc is not None:
                     # admission counts FREE PAGES, not just free slots:
                     # the head request's page plan (its prompt prefix
@@ -1005,6 +1198,12 @@ class ServingEngine:
                 return req
         return None
 
+    def _needs_chunk(self, req: Request) -> bool:
+        """Chunked admission applies to prompts LONGER than one chunk
+        (shorter ones inline-prefill in a single dispatch, as before)."""
+        return self._chunk_enabled and \
+            req.prompt.size > self.prefill_chunk_tokens
+
     def _admit_ready(self):
         for slot, occupant in enumerate(self._slots):
             if occupant is not None:
@@ -1013,12 +1212,19 @@ class ServingEngine:
             if req is None:
                 break
             try:
-                self._admit(req, slot)
+                if self._needs_chunk(req):
+                    self._begin_chunked(req, slot)
+                else:
+                    self._admit(req, slot)
             except Exception as e:
                 # the request left the queue but reached no slot: it
                 # MUST still go terminal or its Future would hang
                 # forever (and its committed pages must return to the
                 # free list); the engine keeps serving the others
+                if self._chunking is not None and \
+                        self._chunking["req"] is req:
+                    self._chunking = None
+                    self._slots[slot] = None  # lint: lock-discipline-ok (admission runs under the caller's pump lock)
                 self._release_pending(req)
                 self._cancel(req, f"admission error: "
                                   f"{type(e).__name__}: {e}",
@@ -1127,6 +1333,221 @@ class ServingEngine:
         # dispatch re-anchors it (same artifact class as idle gaps)
         self._window_steps = 0
 
+    # ------------------------------------------------- chunked prefill
+    def _begin_chunked(self, req: Request, slot: int):
+        """Reserve ``slot`` for a long prompt and park it in
+        PENDING_PREFILL: the device lane stays masked (finished True,
+        kv_len 0, null page table) while ``_advance_chunked`` feeds the
+        prompt into the side cache one chunk per scheduler iteration.
+        Host bookkeeping only — no dispatch happens here."""
+        C = self.prefill_chunk_tokens
+        plen = int(req.prompt.size)
+        n = -(-plen // C)
+        ids = np.full((1, n * C), self._cfg.pad_value, np.int32)
+        ids[0, :plen] = req.prompt
+        shared = 0
+        if self._alloc is not None:
+            shared = int(self._pending_pages[req.id][1].shared_len)
+        t_ns = flight_recorder.now_ns() if req.traced else 0
+        if req.traced:
+            req.span("queue_wait", req._t_submit_ns, t_ns)
+        self._chunking = dict(req=req, slot=slot, plen=plen, n=n,
+                              next=0, ids=ids, shared=shared,
+                              decode_steps=0, t_ns=t_ns)
+        self._slots[slot] = req  # lint: lock-discipline-ok (admission runs under the caller's pump lock)
+        req.status = RequestStatus.PENDING_PREFILL
+        monitor.record_serve_slot_occupancy(
+            sum(s is not None for s in self._slots) / self.max_batch)
+
+    def _advance_chunked(self):
+        """Run AT MOST ONE chunk of the in-flight chunked prefill: the
+        chunk program over the side cache (plus the paged span install),
+        one blocking sync, then hand the device back to decode. The
+        final chunk samples the first token and runs the ordinary admit
+        program — TTFT lands there. Deadline/abort semantics live here
+        because ``_poll`` skips PENDING_PREFILL slots entirely."""
+        st = self._chunking
+        if st is None:
+            return
+        req = st["req"]
+        if req.deadline is not None and \
+                time.monotonic() > req.deadline:
+            self._abort_chunked("deadline")
+            return
+        # same goodput/cost contract as _admit: each chunk's dispatch
+        # wall is compute (or compile, when it retraced), charged to
+        # the request's prefill cost — chunked admissions sum their
+        # per-chunk walls instead of under-charging one instant
+        retraces0 = monitor.retrace_count()
+        t0 = time.perf_counter()
+        try:
+            if st["next"] < st["n"] - 1:
+                self._chunk_step(st)
+            else:
+                self._finish_chunked(st)
+        except Exception as e:
+            self._abort_chunked(
+                f"admission error: {type(e).__name__}: {e}",
+                label="error")
+            monitor.record_swallowed("serving.admit", e)
+        finally:
+            dt = time.perf_counter() - t0
+            req._cost_prefill_s += dt
+            self._goodput.charge(
+                "compile" if monitor.retrace_count() > retraces0
+                else "compute", dt)
+            # the blocking chunk sync must not be attributed to
+            # per-token decode latency: re-anchor the poll window
+            # (the same artifact class as inline admission)
+            self._window_steps = 0
+
+    def _chunk_step(self, st: dict):
+        """One non-final chunk: side-cache forward, paged span install,
+        blocking sync, telemetry."""
+        req, slot, k = st["req"], st["slot"], st["next"]
+        C = self.prefill_chunk_tokens
+        t_ns = flight_recorder.now_ns() if req.traced else 0
+        ids = jnp.asarray(st["ids"][:, k * C:(k + 1) * C])
+        self._row_cache = self._exe_chunk()(
+            self._state, ids, self._row_cache)
+        if self._alloc is not None:
+            # commit the chunk's positions into the planned pages now —
+            # only the span at/past the shared prefix (and past already
+            # installed chunks) is written; the table/kv_len install
+            # waits for the final admit
+            start = max(k * C, st["shared"])
+            if (k + 1) * C > start:
+                pages = self._pending_pages[req.id][0]
+                table_np = np.zeros((self.pages_per_row,), np.int32)
+                table_np[:len(pages)] = pages
+                self._cache = self._exe_span()(
+                    self._cache, self._row_cache,
+                    jnp.asarray(table_np),
+                    jnp.asarray(start, jnp.int32))
+        # the chunk must LAND before the host moves on: the sync point
+        # is what bounds how long a chunk can monopolize the device
+        # between decode dispatches
+        self._row_cache.kv_len.block_until_ready()  # lint: host-sync-ok (one sync per prefill chunk, the interleave cadence)
+        st["next"] = k + 1
+        tokens = min(C, st["plen"] - k * C)
+        self.stats["prefill_chunks"] += 1
+        monitor.record_prefill_chunk(tokens)
+        if flight_recorder.enabled:
+            flight_recorder.record(
+                "serve.prefill_chunk", req=req.id, slot=slot, chunk=k,
+                start=k * C, tokens=tokens, remaining=st["n"] - k - 1)
+        if req.traced:
+            req.span("prefill_chunk", t_ns, flight_recorder.now_ns(),
+                     chunk=k, slot=slot, tokens=tokens)
+
+    def _finish_chunked(self, st: dict):
+        """The final (padded) chunk + admission: sample the first token
+        (TTFT), install the side cache into the slot through the
+        ordinary admit program, flip the request to RUNNING, rebuild
+        the (donated) side cache for the next chunked admission."""
+        req, slot, k = st["req"], st["slot"], st["n"] - 1
+        C = self.prefill_chunk_tokens
+        t_ns = flight_recorder.now_ns() if req.traced else 0
+        ids = jnp.asarray(st["ids"][:, k * C:(k + 1) * C])
+        plen = jnp.asarray(np.array([st["plen"]], np.int32))
+        tok, row_cache, self._key, fin = self._exe_chunk_final()(
+            self._state, ids, plen, self._key, self._row_cache)
+        self._row_cache = row_cache
+        # TTFT measurement point — same contract as inline admission
+        tok.block_until_ready()  # lint: host-sync-ok (TTFT measurement point, one per admission)
+        now = time.monotonic()
+        req.admitted_at = req.first_token_at = now
+        monitor.record_serve_ttft(now - req.submitted_at)
+        tokens = st["plen"] - k * C
+        self.stats["prefill_chunks"] += 1
+        monitor.record_prefill_chunk(tokens)
+        monitor.record_prefill_interleave(
+            st["decode_steps"] / st["n"])
+        if flight_recorder.enabled:
+            flight_recorder.record(
+                "serve.prefill_chunk", req=req.id, slot=slot, chunk=k,
+                start=k * C, tokens=tokens, remaining=0)
+            flight_recorder.record("serve.admit", req=req.id, slot=slot,
+                                   bucket=st["n"] * C, chunks=st["n"])
+        if req.traced:
+            t1 = flight_recorder.now_ns()
+            req.span("prefill_chunk", t_ns, t1, chunk=k, slot=slot,
+                     tokens=tokens)
+            req._t_seg_ns = t1
+        monitor.record_generation(prefill_steps=1)
+        self.stats["prefills"] += 1
+        admit = self._exe_admit()
+        paged_args, pages, plan = (), None, None
+        if self._alloc is not None:
+            # every span below the last chunk boundary is already
+            # installed: the admit's install_row writes only the final
+            # span (start = the later of shared prefix end and the
+            # final chunk's base)
+            pages, plan = self._pending_pages[req.id]
+            table_np = np.zeros((self.pages_per_row,), np.int32)
+            table_np[:len(pages)] = pages
+            start = max(int(plan.shared_len), k * C)
+            paged_args = (jnp.asarray(table_np),
+                          jnp.asarray(start, jnp.int32))
+        if self._spec is None:
+            (self._cache, self._tok, self._finished, self._steps,
+             self._budget, self._out_buf) = admit(
+                self._cache, self._tok, self._finished, self._steps,
+                self._budget, self._out_buf,
+                jnp.asarray(slot, jnp.int32), self._row_cache, tok, fin,
+                jnp.asarray(req.budget, jnp.int32), *paged_args)
+        else:
+            ids_row = np.full((self.max_len,), self._cfg.pad_value,
+                              np.int32)
+            ids_row[:req.prompt.size] = req.prompt
+            (self._cache, self._tok, self._finished, self._steps,
+             self._budget, self._out_buf, self._tok_buf,
+             self._tok_len) = admit(
+                self._cache, self._tok, self._finished, self._steps,
+                self._budget, self._out_buf,
+                jnp.asarray(slot, jnp.int32), self._row_cache, tok, fin,
+                jnp.asarray(req.budget, jnp.int32), *paged_args,
+                self._tok_buf, self._tok_len, jnp.asarray(ids_row),
+                jnp.asarray(req.prompt.size, jnp.int32))
+        if self._alloc is not None:
+            self._pending_pages.pop(req.id)
+            self._alloc.register(plan, pages)
+            self._row_pages[slot] = pages
+        if self._slot_used[slot]:
+            self.stats["slots_reused"] += 1
+        self._slot_used[slot] = True  # lint: lock-discipline-ok (admission runs under the caller's pump lock)
+        req.status = RequestStatus.RUNNING
+        self.stats["admitted"] += 1
+        self._chunking = None
+        # the admit program donated the side cache: rebuild it zeroed
+        # (kv_len 0, clips 0) so the next chunked admission starts
+        # clean — this rebuild IS the between-requests reset
+        self._row_cache = self._fresh_row_cache()
+        monitor.record_serve_slot_occupancy(
+            sum(s is not None for s in self._slots) / self.max_batch)
+
+    def _abort_chunked(self, reason: str, label: Optional[str] = None):
+        """Terminal exit for a mid-prefill request (deadline, drain,
+        dispatch error): release its committed pages, clear the slot,
+        rebuild the side cache. No free-program dispatch — the device
+        lane was never installed (finished True, kv_len 0, null
+        table), so there is nothing to reset."""
+        st, self._chunking = self._chunking, None
+        if st is None:
+            return
+        req, slot = st["req"], st["slot"]
+        if flight_recorder.enabled:
+            flight_recorder.record(
+                "serve.evict", req=req.id, slot=slot, reason=reason,
+                tokens=0, chunks_done=st["next"])
+        self._release_pending(req)
+        self._slots[slot] = None  # lint: lock-discipline-ok (abort runs under the caller's pump lock)
+        # the side cache holds the aborted prompt's partial prefix —
+        # rebuild zeroed before the next chunked admission
+        self._row_cache = self._fresh_row_cache()
+        self._cancel(req, reason, label=label)
+        self._note_cost(req)
+
     def _dispatch_decode(self):
         exe = self._exe_step()
         if self._spec is None:
@@ -1144,6 +1565,10 @@ class ServingEngine:
                 self._out_buf, self._tok_buf, self._tok_len,
                 self._proposed, self._accepted)
         self._steps_since_poll += 1
+        if self._chunking is not None:
+            # decode steps interleaved into THIS chunked admission —
+            # the serve.prefill.interleave_ratio numerator
+            self._chunking["decode_steps"] += 1
         if self._window_steps == 0:
             # anchor the latency window at the first dispatch after a
             # poll — idle gaps between traffic bursts must not be
@@ -1192,11 +1617,17 @@ class ServingEngine:
             # the compute bucket), plus page*seconds for its resident
             # KV pages. Charged BEFORE completions below, so a request
             # finishing this window still pays for it.
-            live = sum(r is not None for r in self._slots)
+            # PENDING_PREFILL slots are NOT in the decode window: the
+            # chunk walls charge to prefill_s in _advance_chunked —
+            # charging a share here would double-bill the request
+            live = sum(r is not None
+                       and r.status is not RequestStatus.PENDING_PREFILL
+                       for r in self._slots)
             if live:
                 share = window_dt / live
                 for i, r in enumerate(self._slots):
-                    if r is None:
+                    if r is None or \
+                            r.status is RequestStatus.PENDING_PREFILL:
                         continue
                     r._cost_decode_s += share
                     if self._alloc is not None:
@@ -1206,6 +1637,11 @@ class ServingEngine:
         t_poll_ns = flight_recorder.now_ns()
         for i, req in enumerate(self._slots):
             if req is None:
+                continue
+            if req.status is RequestStatus.PENDING_PREFILL:
+                # mid-chunked-prefill: the lane is parked (its finished
+                # flag reads True) — completion/deadline/trace handling
+                # belongs to _advance_chunked, not the decode poll
                 continue
             if fin[i]:
                 toks = np.asarray(self._out_buf[i])[:int(steps[i])]  # lint: host-sync-ok (one row read per completion)
@@ -1467,6 +1903,11 @@ class ServingEngine:
                 req._finish(RequestStatus.REJECTED, "shutdown")
                 self.stats["rejected"] += 1
                 monitor.record_serve_request("rejected")
+            # a PENDING_PREFILL slot can never decode to terminal —
+            # abort it NOW (pages back to the free list, request
+            # CANCELLED) or the decode drain below would spin on its
+            # occupied slot until the timeout
+            self._abort_chunked("shutdown")
             deadline = time.monotonic() + self.drain_timeout_s
             while any(s is not None for s in self._slots) and \
                     time.monotonic() < deadline:
@@ -1608,6 +2049,17 @@ class ServingEngine:
         else:
             cap_tokens = self.max_batch * self.max_len
             free_tokens = (self.max_batch - busy) * self.max_len
+        # prefill backlog (chunked admission in flight): prompt tokens
+        # not yet written to the KV cache + chunks still to run. The
+        # fleet router folds this into its score so long prompts steer
+        # away from a replica that is mid-prefill — its next chunks
+        # will keep taxing every decode window it serves.
+        pp_tokens = pp_chunks = 0
+        st = self._chunking
+        if st is not None:
+            pp_tokens = max(
+                0, st["plen"] - st["next"] * self.prefill_chunk_tokens)
+            pp_chunks = st["n"] - st["next"]
         return {
             "ready": not reasons,
             **({"reason": ",".join(reasons)} if reasons else {}),
@@ -1618,6 +2070,8 @@ class ServingEngine:
             "kv_cache_dtype": self._kv_dtype_label,
             "capacity_tokens": cap_tokens,
             "free_tokens": free_tokens,
+            "pending_prefill_tokens": pp_tokens,
+            "prefill_chunks_queued": pp_chunks,
             **({"free_pages": self._alloc.free_pages(),
                 "total_pages": self._alloc.n_pages - 1,
                 "page_occupancy": round(
@@ -1677,6 +2131,19 @@ class ServingEngine:
             sds((1,), jnp.int32), key, self._cfg, self.max_len,
             static_argnums=(4, 5),
             name=f"serving.prefill.{self.buckets[-1]}")
+        chunk = None
+        if self._chunk_enabled:
+            # the chunk program's transient rides on top of the SAME
+            # resident engine state as an inline admission — plus it
+            # keeps the side cache resident between chunks (an operand
+            # of the plan, so its bytes are inside chunk.peak_bytes)
+            chunk = plan_memory(
+                self._chunk_fn, state,
+                sds((1, self.prefill_chunk_tokens), jnp.int32),
+                self._row_cache_aval,
+                donate=self._chunk_donate_intent,
+                name=f"serving.prefill_chunk."
+                     f"{self.prefill_chunk_tokens}")
         if decode.arg_bytes is not None:
             weights = decode.arg_bytes[0]
             kv = decode.arg_bytes[2]
@@ -1684,6 +2151,9 @@ class ServingEngine:
             resident = sum(decode.arg_bytes)
             predicted = max(decode.peak_bytes,
                             resident + prefill.peak_bytes - weights)
+            if chunk is not None:
+                predicted = max(
+                    predicted, resident + chunk.peak_bytes - weights)
         else:
             # exotic-pytree fail-safe (audit couldn't line leaves up
             # with positional args): no per-operand breakdown, and the
@@ -1692,13 +2162,19 @@ class ServingEngine:
             weights = kv = lanes = None
             predicted = max(decode.peak_bytes,
                             decode.args_bytes + prefill.peak_bytes)
+            if chunk is not None:
+                predicted = max(predicted,
+                                decode.args_bytes + chunk.peak_bytes)
         self._mem_summary = {
             "weights_bytes": weights, "kv_cache_bytes": kv,
             "lanes_bytes": lanes,
             "decode_peak_bytes": decode.peak_bytes,
             "prefill_peak_bytes": prefill.peak_bytes,
+            **({"chunk_peak_bytes": chunk.peak_bytes}
+               if chunk is not None else {}),
             "predicted_peak_bytes": predicted,
-            "plans": {"decode": decode, "prefill": prefill},
+            "plans": {"decode": decode, "prefill": prefill,
+                      **({"chunk": chunk} if chunk is not None else {})},
         }
         return self._mem_summary
 
@@ -1775,6 +2251,29 @@ class ServingEngine:
             self._free_fn, self._cache, self._finished, scalar,
             donate=self._free_donate_intent, name=f"{base}.free",
             **audit_kw)
+        if self._chunk_enabled:
+            # the chunk-prefill pair (and the paged span install) join
+            # the audited program set: the tier-1 ledger drift gate and
+            # the donation-coverage gate extend to them — the side
+            # cache must round-trip IN PLACE every chunk
+            C = self.prefill_chunk_tokens
+            rc_a = self._row_cache_aval
+            reports[("chunk", C)] = _audit(
+                self._chunk_fn, state, sds((1, C), jnp.int32), rc_a,
+                donate=self._chunk_donate_intent,
+                name=f"{base}.prefill_chunk.{C}", **audit_kw)
+            reports[("chunk_final", C)] = _audit(
+                self._chunk_final_fn, state, sds((1, C), jnp.int32),
+                sds((1,), jnp.int32), key, rc_a, self._cfg,
+                static_argnums=(5,),
+                donate=self._chunk_final_donate_intent,
+                name=f"{base}.prefill_chunk_final.{C}", **audit_kw)
+            if self._alloc is not None:
+                reports[("install_span",)] = _audit(
+                    self._span_fn, self._cache, rc_a,
+                    sds((self.pages_per_row,), jnp.int32), scalar,
+                    donate=self._span_donate_intent,
+                    name=f"{base}.install_span", **audit_kw)
         return reports
 
     def __repr__(self):

@@ -38,6 +38,11 @@ __all__ = ["QueueFull", "Request", "RequestFailed", "RequestParams",
 
 class RequestStatus(str, enum.Enum):
     QUEUED = "queued"
+    #: chunked prefill in flight: the request owns a slot (and its
+    #: committed pages) but its prompt is only partially written — the
+    #: scheduler never decodes a PENDING_PREFILL slot; the final chunk's
+    #: admission flips it to RUNNING
+    PENDING_PREFILL = "pending_prefill"
     RUNNING = "running"
     COMPLETED = "completed"
     CANCELLED = "cancelled"

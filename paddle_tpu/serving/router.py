@@ -367,14 +367,17 @@ class FleetRouter:
     @staticmethod
     def _score(health: dict) -> float:
         """Admission score: ``ready × (1 + free_tokens) ×
-        headroom_fraction / (1 + queue_depth)``. ``free_tokens`` is the
-        engine's dtype-adjusted capacity remainder (an int8 pool at
-        equal HBM scores ~2× the bf16 one — comparable across
-        precisions); the headroom fraction scales by the static HBM
-        plan when a budget gates the replica (predicted headroom /
-        budget, clipped to [0, 1]); the queue-depth divisor spreads
-        ties so a burst doesn't pile onto one replica before its
-        occupancy moves."""
+        headroom_fraction / (1 + queue_depth +
+        prefill_chunks_queued)``. ``free_tokens`` is the engine's
+        dtype-adjusted capacity remainder (an int8 pool at equal HBM
+        scores ~2× the bf16 one — comparable across precisions); the
+        headroom fraction scales by the static HBM plan when a budget
+        gates the replica (predicted headroom / budget, clipped to
+        [0, 1]); the queue+chunk divisor spreads ties so a burst
+        doesn't pile onto one replica before its occupancy moves —
+        pending chunked-prefill work counts like queued requests,
+        since every outstanding chunk steals a scheduler iteration
+        from decode on that replica."""
         if not health.get("ready", False):
             return 0.0
         free_tokens = health.get("free_tokens") or 0
@@ -384,7 +387,8 @@ class FleetRouter:
         if budget and headroom is not None:
             frac = max(0.0, min(1.0, headroom / budget))
         depth = health.get("queue_depth") or 0
-        return (1.0 + free_tokens) * frac / (1.0 + depth)
+        chunks = health.get("prefill_chunks_queued") or 0
+        return (1.0 + free_tokens) * frac / (1.0 + depth + chunks)
 
     def _candidates(self) -> List[_Replica]:
         """Placement order (callers hold the lock): half-open probes
@@ -653,6 +657,8 @@ class FleetRouter:
                         k: h[k] for k in
                         ("ready", "reason", "queue_depth", "free_slots",
                          "free_tokens", "capacity_tokens",
+                         "pending_prefill_tokens",
+                         "prefill_chunks_queued",
                          "predicted_headroom_bytes")
                         if k in h}
                     row["score"] = round(self._score(h), 4)

@@ -468,7 +468,8 @@ class TestEngineFleetWiring:
                 roll = json.loads(_get(base + "/fleet/healthz")[1])
                 return roll["ranks"]["0"]["ready"]
 
-            _wait_until(rank0_ready, 15, "rank 0 ready in /fleet/healthz")
+            _wait_until(rank0_ready, 30,
+                        "rank 0 ready in /fleet/healthz")
             roll = json.loads(_get(base + "/fleet/healthz")[1])
             assert "queue_depth" in roll["ranks"]["0"]
 

@@ -164,6 +164,26 @@ def test_env_knobs(tiny_gpt, store, monkeypatch):
     eng.shutdown()
 
 
+# ----------------------------------------------------- admission scoring
+
+
+def test_score_counts_chunked_prefill_backlog():
+    """ISSUE-20: a replica grinding through a chunked prefill scores
+    below an otherwise-identical idle peer — every outstanding chunk
+    steals a scheduler iteration from decode, so the backlog weighs
+    exactly like queued requests in the divisor."""
+    base = {"ready": True, "free_tokens": 100, "queue_depth": 2}
+    busy = dict(base, prefill_chunks_queued=6)
+    assert FleetRouter._score(busy) < FleetRouter._score(base)
+    assert FleetRouter._score(busy) == \
+        FleetRouter._score(dict(base, queue_depth=8))
+    # absent / zero field (engine without chunking): score unchanged
+    assert FleetRouter._score(dict(base, prefill_chunks_queued=0)) == \
+        FleetRouter._score(base)
+    assert FleetRouter._score(dict(base, ready=False,
+                                   prefill_chunks_queued=6)) == 0.0
+
+
 # ------------------------------------------------- routing over engines
 
 
